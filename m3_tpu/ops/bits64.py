@@ -1,0 +1,167 @@
+"""64-bit integer bit manipulation on (hi, lo) uint32 pairs, in 32-bit lanes.
+
+TPU VPU lanes are 32-bit; XLA emulates 64-bit integers as pairs anyway, and
+staying in explicit u32 pairs keeps the codec kernels (m3_tpu/ops/tsz.py) free
+of the global jax x64 flag and maps 1:1 onto what the hardware executes. All
+functions are elementwise and broadcast/vmap-trivially.
+
+A "pair" is a tuple (hi, lo) of uint32 arrays: value = hi * 2^32 + lo.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+U32 = jnp.uint32
+
+
+def pair(hi, lo):
+    return jnp.asarray(hi, U32), jnp.asarray(lo, U32)
+
+
+def from_u64_np(x):
+    """Host helper: split numpy uint64/int64 array into (hi, lo) u32 arrays."""
+    import numpy as np
+
+    x = np.asarray(x).astype(np.uint64, copy=False) if np.asarray(x).dtype.kind in "iu" else np.asarray(x).view(np.uint64)
+    return (x >> np.uint64(32)).astype(np.uint32), (x & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+
+
+def to_u64_np(hi, lo):
+    """Host helper: combine (hi, lo) u32 numpy arrays into uint64."""
+    import numpy as np
+
+    return (np.asarray(hi, dtype=np.uint64) << np.uint64(32)) | np.asarray(lo, dtype=np.uint64)
+
+
+def xor64(a, b):
+    return a[0] ^ b[0], a[1] ^ b[1]
+
+
+def or64(a, b):
+    return a[0] | b[0], a[1] | b[1]
+
+
+def and64(a, b):
+    return a[0] & b[0], a[1] & b[1]
+
+
+def not64(a):
+    return ~a[0], ~a[1]
+
+
+def eq0(a):
+    return (a[0] | a[1]) == 0
+
+
+def eq64(a, b):
+    return (a[0] == b[0]) & (a[1] == b[1])
+
+
+def add64(a, b):
+    lo = a[1] + b[1]
+    carry = (lo < a[1]).astype(U32)
+    hi = a[0] + b[0] + carry
+    return hi, lo
+
+
+def sub64(a, b):
+    lo = a[1] - b[1]
+    borrow = (a[1] < b[1]).astype(U32)
+    hi = a[0] - b[0] - borrow
+    return hi, lo
+
+
+def neg64(a):
+    return add64(not64(a), (jnp.zeros_like(a[0]), jnp.ones_like(a[1])))
+
+
+def _shl32(x, s):
+    """x << s with s possibly 0..32; s>=32 yields 0 (XLA shift is UB at 32)."""
+    s = jnp.asarray(s, U32)
+    return jnp.where(s >= 32, jnp.zeros_like(x), x << jnp.minimum(s, U32(31)))
+
+
+def _shr32(x, s):
+    s = jnp.asarray(s, U32)
+    return jnp.where(s >= 32, jnp.zeros_like(x), x >> jnp.minimum(s, U32(31)))
+
+
+def shl64(a, s):
+    """Logical left shift by dynamic s in [0, 64]."""
+    hi, lo = a
+    s = jnp.asarray(s, U32)
+    hi_out = _shl32(hi, s) | _shr32(lo, U32(32) - s) | _shl32(lo, s - U32(32))
+    lo_out = _shl32(lo, s)
+    return hi_out, lo_out
+
+
+def shr64(a, s):
+    """Logical right shift by dynamic s in [0, 64]."""
+    hi, lo = a
+    s = jnp.asarray(s, U32)
+    lo_out = _shr32(lo, s) | _shl32(hi, U32(32) - s) | _shr32(hi, s - U32(32))
+    hi_out = _shr32(hi, s)
+    return hi_out, lo_out
+
+
+def sar63(a):
+    """Arithmetic shift right by 63: all-ones if sign bit set, else zero."""
+    sign = (a[0] >> U32(31)).astype(jnp.int32)
+    mask = jnp.where(sign == 1, U32(0xFFFFFFFF), U32(0))
+    return mask, mask
+
+
+def shl1(a):
+    hi, lo = a
+    return (hi << U32(1)) | (lo >> U32(31)), lo << U32(1)
+
+
+def zigzag64(a):
+    """(x << 1) ^ (x >> 63) for two's complement pair."""
+    return xor64(shl1(a), sar63(a))
+
+
+def unzigzag64(z):
+    """(z >> 1) ^ -(z & 1)."""
+    lsb = z[1] & U32(1)
+    mask = jnp.where(lsb == 1, U32(0xFFFFFFFF), U32(0))
+    return xor64(shr64(z, 1), (mask, mask))
+
+
+def clz32(x):
+    return jax.lax.clz(jnp.asarray(x, U32)).astype(jnp.int32)
+
+
+def ctz32(x):
+    x = jnp.asarray(x, U32)
+    isolated = x & (~x + U32(1))
+    return jnp.where(x == 0, jnp.int32(32), 31 - clz32(isolated))
+
+
+def clz64(a):
+    hi, lo = a
+    return jnp.where(hi != 0, clz32(hi), 32 + clz32(lo))
+
+
+def ctz64(a):
+    hi, lo = a
+    return jnp.where(lo != 0, ctz32(lo), 32 + ctz32(hi))
+
+
+def bitlen64(a):
+    return 64 - clz64(a)
+
+
+def i32_to_pair(x):
+    """Sign-extend int32 array to a 64-bit pair."""
+    x = jnp.asarray(x, jnp.int32)
+    lo = x.astype(U32)
+    hi = jnp.where(x < 0, U32(0xFFFFFFFF), U32(0))
+    return hi, lo
+
+
+def pair_to_i32(a):
+    """Truncate pair to int32 (caller guarantees it fits)."""
+    return a[1].astype(jnp.int32)

@@ -1,0 +1,332 @@
+"""Scalar reference implementation of the TTSZ codec (TPU-TSZ).
+
+TTSZ is this framework's time series compression format. It keeps the
+algorithmic structure of the reference's M3TSZ codec —
+delta-of-delta timestamps (reference: src/dbnode/encoding/m3tsz/encoder.go:113,
+timestamp buckets src/dbnode/encoding/m3tsz/scheme.go:41-52) and Gorilla-style
+XOR float encoding (encoder.go:371-391) with the M3 extension of an
+integer-optimized path for decimal-scaled values
+(src/dbnode/encoding/m3tsz/m3tsz.go:51,70-110 convertToIntFloat) — but the bit
+layout is redesigned so a batch of N series encodes/decodes as a single
+vectorized TPU launch (see m3_tpu/ops/tsz.py). It is NOT byte-compatible with
+M3TSZ; it carries the same invariants (exact float64 roundtrip, ~1.45
+bytes/datapoint on production-like workloads).
+
+Wire format (MSB-first bitstream, one stream per series block):
+
+    header:
+        mode  : 1 bit   (0 = float/XOR mode, 1 = int-optimized mode)
+        k     : 3 bits  (decimal exponent 0..6; only meaningful in int mode)
+        t0    : 64 bits (signed block-start-relative-or-absolute ticks)
+        v0    : 64 bits (float mode: raw IEEE-754 bits of value[0];
+                         int mode: two's-complement of m0 = rint(v0 * 10^k))
+    per point i >= 1 (timestamp bits then value bits):
+        timestamp, dod = (t[i]-t[i-1]) - (t[i-1]-t[i-2]), with t[-1]=t[0]:
+            dod == 0                  -> '0'
+            -2^6  <= dod < 2^6        -> '10'   + 7-bit two's complement
+            -2^8  <= dod < 2^8        -> '110'  + 9-bit two's complement
+            -2^11 <= dod < 2^11       -> '1110' + 12-bit two's complement
+            otherwise                 -> '1111' + 32-bit two's complement
+        value, float mode (xor = bits(v[i]) ^ bits(v[i-1])):
+            xor == 0                                    -> '0'
+            lead >= L and trail >= T (window reuse)     -> '10' + (64-L-T) bits
+                                                           of xor >> T
+            else (rewrite window; L,T := lead,trail)    -> '11' + lead(6 bits)
+                                                           + (mlen-1)(6 bits)
+                                                           + mlen bits of
+                                                             xor >> trail
+            where lead = clz64(xor), trail = ctz64(xor),
+            mlen = 64 - lead - trail, window starts invalid (first non-zero
+            xor always rewrites).
+        value, int mode (vdod = (m[i]-m[i-1]) - (m[i-1]-m[i-2]), m[-1]=m[0];
+                         zz = zigzag64(vdod)):
+            zz == 0              -> '0'
+            bitlen(zz) <= 7      -> '10'    + 7 bits
+            bitlen(zz) <= 12     -> '110'   + 12 bits
+            bitlen(zz) <= 20     -> '1110'  + 20 bits
+            bitlen(zz) <= 32     -> '11110' + 32 bits
+            otherwise            -> '11111' + 64 bits
+
+The number of points is carried out-of-band in block metadata (the reference
+instead writes an end-of-stream marker, scheme.go:197-242); batched device
+decode wants explicit lengths.
+
+Int-mode eligibility (mirrors the intent of convertToIntFloat): the smallest
+k in 0..6 such that for every finite v, m = rint(v * 10^k) satisfies
+|m| < 2^53 and float64(m) / 10^k == v exactly. NaN/Inf force float mode.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import numpy as np
+
+U64 = 0xFFFFFFFFFFFFFFFF
+MAX_DECIMAL_EXP = 6  # reference: m3tsz.go:51 maxMult = 10^6
+
+# Timestamp DoD buckets: (prefix_bits, prefix_len, payload_bits).
+# Mirrors the seconds-unit scheme of scheme.go:41-52 {7,9,12}-bit + 32 default.
+TS_BUCKETS = ((0b10, 2, 7), (0b110, 3, 9), (0b1110, 4, 12), (0b1111, 4, 32))
+# Int-mode value DoD buckets (zigzag payload).
+INT_BUCKETS = (
+    (0b10, 2, 7),
+    (0b110, 3, 12),
+    (0b1110, 4, 20),
+    (0b11110, 5, 32),
+    (0b11111, 5, 64),
+)
+
+
+def zigzag64(x: int) -> int:
+    return ((x << 1) ^ (x >> 63)) & U64
+
+
+def unzigzag64(z: int) -> int:
+    x = (z >> 1) ^ (-(z & 1) & U64)
+    return x - (1 << 64) if x >= (1 << 63) else x
+
+
+def clz64(x: int) -> int:
+    return 64 - x.bit_length() if x else 64
+
+
+def ctz64(x: int) -> int:
+    return (x & -x).bit_length() - 1 if x else 64
+
+
+def float_to_bits(v: float) -> int:
+    return int(np.float64(v).view(np.uint64))
+
+
+def bits_to_float(b: int) -> float:
+    return float(np.uint64(b).view(np.float64))
+
+
+def detect_int_mode(values: np.ndarray) -> tuple[bool, int]:
+    """Return (int_mode, k): smallest decimal exponent giving exact roundtrip.
+
+    Reference semantics: convertToIntFloat (m3tsz.go:70-110) tracks a decimal
+    multiplier <= 10^6 per value; we resolve one exponent per block, which is
+    what the batched kernel wants and what real workloads (fixed-precision
+    gauges, integer counters) look like.
+    """
+    v = np.asarray(values, dtype=np.float64)
+    if not np.isfinite(v).all():
+        return False, 0
+    for k in range(MAX_DECIMAL_EXP + 1):
+        scale = np.float64(10.0**k)
+        m = np.rint(v * scale)
+        if np.abs(m).max(initial=0.0) >= 2.0**53:
+            continue
+        if np.array_equal(m / scale, v):
+            return True, k
+    return False, 0
+
+
+class BitWriter:
+    __slots__ = ("_acc", "_nbits")
+
+    def __init__(self) -> None:
+        self._acc = 0
+        self._nbits = 0
+
+    def write(self, value: int, nbits: int) -> None:
+        assert 0 <= nbits <= 64
+        self._acc = (self._acc << nbits) | (value & ((1 << nbits) - 1) if nbits < 64 else value & U64)
+        self._nbits += nbits
+
+    @property
+    def nbits(self) -> int:
+        return self._nbits
+
+    def to_words(self) -> np.ndarray:
+        """Pack MSB-first into big-endian uint32 words, zero-padded."""
+        nwords = (self._nbits + 31) // 32
+        acc = self._acc << (nwords * 32 - self._nbits)
+        words = [(acc >> (32 * (nwords - 1 - i))) & 0xFFFFFFFF for i in range(nwords)]
+        return np.array(words, dtype=np.uint32)
+
+
+class BitReader:
+    __slots__ = ("words", "pos")
+
+    def __init__(self, words: np.ndarray, pos: int = 0) -> None:
+        self.words = np.asarray(words, dtype=np.uint32)
+        self.pos = pos
+
+    def read(self, nbits: int) -> int:
+        out = 0
+        pos, need = self.pos, nbits
+        while need > 0:
+            w, b = pos >> 5, pos & 31
+            take = min(32 - b, need)
+            word = int(self.words[w])
+            chunk = (word >> (32 - b - take)) & ((1 << take) - 1)
+            out = (out << take) | chunk
+            pos += take
+            need -= take
+        self.pos = pos
+        return out
+
+    def read_signed(self, nbits: int) -> int:
+        u = self.read(nbits)
+        return u - (1 << nbits) if u >= (1 << (nbits - 1)) else u
+
+
+@dataclass
+class EncodedBlock:
+    words: np.ndarray  # uint32, MSB-first packed
+    nbits: int
+    npoints: int
+
+
+def _write_ts_dod(w: BitWriter, dod: int) -> None:
+    if not -(1 << 31) <= dod < (1 << 31):
+        raise ValueError(f"timestamp delta-of-delta {dod} exceeds 32-bit signed range")
+    if dod == 0:
+        w.write(0, 1)
+        return
+    for prefix, plen, nbits in TS_BUCKETS[:-1]:
+        if -(1 << (nbits - 1)) <= dod < (1 << (nbits - 1)):
+            w.write(prefix, plen)
+            w.write(dod, nbits)
+            return
+    prefix, plen, nbits = TS_BUCKETS[-1]
+    w.write(prefix, plen)
+    w.write(dod, nbits)
+
+
+def _write_int_vdod(w: BitWriter, zz: int) -> None:
+    if zz == 0:
+        w.write(0, 1)
+        return
+    blen = zz.bit_length()
+    for prefix, plen, nbits in INT_BUCKETS:
+        if blen <= nbits:
+            w.write(prefix, plen)
+            w.write(zz, nbits)
+            return
+    raise AssertionError("unreachable: zigzag fits in 64 bits")
+
+
+def encode(timestamps: np.ndarray, values: np.ndarray) -> EncodedBlock:
+    """Encode one series window. timestamps int64 ticks, values float64."""
+    ts = np.asarray(timestamps, dtype=np.int64)
+    vs = np.asarray(values, dtype=np.float64)
+    n = len(ts)
+    assert n >= 1 and len(vs) == n
+    int_mode, k = detect_int_mode(vs)
+
+    w = BitWriter()
+    w.write(1 if int_mode else 0, 1)
+    w.write(k, 3)
+    w.write(int(ts[0]), 64)
+    if int_mode:
+        m = np.rint(vs * np.float64(10.0**k)).astype(np.int64)
+        w.write(int(m[0]), 64)
+    else:
+        w.write(float_to_bits(vs[0]), 64)
+
+    prev_delta = 0
+    prev_vdelta = 0
+    lead, mlen = -1, -1  # invalid window
+    for i in range(1, n):
+        delta = int(ts[i]) - int(ts[i - 1])
+        _write_ts_dod(w, delta - prev_delta)
+        prev_delta = delta
+
+        if int_mode:
+            vdelta = int(m[i]) - int(m[i - 1])
+            _write_int_vdod(w, zigzag64(vdelta - prev_vdelta))
+            prev_vdelta = vdelta
+        else:
+            xor = float_to_bits(vs[i]) ^ float_to_bits(vs[i - 1])
+            if xor == 0:
+                w.write(0, 1)
+            else:
+                lz, tz = clz64(xor), ctz64(xor)
+                if lead >= 0 and lz >= lead and tz >= (64 - lead - mlen):
+                    w.write(0b10, 2)
+                    w.write(xor >> (64 - lead - mlen), mlen)
+                else:
+                    lead, ml = lz, 64 - lz - tz
+                    mlen = ml
+                    w.write(0b11, 2)
+                    w.write(lead, 6)
+                    w.write(ml - 1, 6)
+                    w.write(xor >> tz, ml)
+    return EncodedBlock(words=w.to_words(), nbits=w.nbits, npoints=n)
+
+
+def decode(block: EncodedBlock) -> tuple[np.ndarray, np.ndarray]:
+    """Decode an EncodedBlock back to (timestamps int64, values float64)."""
+    r = BitReader(block.words)
+    n = block.npoints
+    int_mode = r.read(1)
+    k = r.read(3)
+    t = r.read_signed(64)
+    v0_bits = r.read(64)
+
+    ts = np.empty(n, dtype=np.int64)
+    ts[0] = t
+    if int_mode:
+        ms = np.empty(n, dtype=np.int64)
+        ms[0] = v0_bits - (1 << 64) if v0_bits >= (1 << 63) else v0_bits
+    else:
+        vbits = np.empty(n, dtype=np.uint64)
+        vbits[0] = v0_bits
+
+    prev_delta = 0
+    prev_vdelta = 0
+    lead, mlen = -1, -1
+    for i in range(1, n):
+        # timestamp: '0' | '10'+7 | '110'+9 | '1110'+12 | '1111'+32
+        if r.read(1) == 0:
+            dod = 0
+        elif r.read(1) == 0:
+            dod = r.read_signed(7)
+        elif r.read(1) == 0:
+            dod = r.read_signed(9)
+        elif r.read(1) == 0:
+            dod = r.read_signed(12)
+        else:
+            dod = r.read_signed(32)
+        prev_delta = prev_delta + dod
+        ts[i] = ts[i - 1] + prev_delta
+
+        if int_mode:
+            if r.read(1) == 0:
+                vdod = 0
+            else:
+                if r.read(1) == 0:
+                    vdod = unzigzag64(r.read(7))
+                elif r.read(1) == 0:
+                    vdod = unzigzag64(r.read(12))
+                elif r.read(1) == 0:
+                    vdod = unzigzag64(r.read(20))
+                elif r.read(1) == 0:
+                    vdod = unzigzag64(r.read(32))
+                else:
+                    vdod = unzigzag64(r.read(64))
+            prev_vdelta = prev_vdelta + vdod
+            ms[i] = ms[i - 1] + prev_vdelta
+        else:
+            c = r.read(1)
+            if c == 0:
+                vbits[i] = vbits[i - 1]
+            else:
+                if r.read(1) == 0:  # '10' reuse window
+                    xor = r.read(mlen) << (64 - lead - mlen)
+                else:  # '11' rewrite
+                    lead = r.read(6)
+                    mlen = r.read(6) + 1
+                    xor = r.read(mlen) << (64 - lead - mlen)
+                vbits[i] = vbits[i - 1] ^ np.uint64(xor)
+
+    if int_mode:
+        values = ms.astype(np.float64) / np.float64(10.0**k)
+    else:
+        values = vbits.view(np.float64).copy()
+    return ts, values

@@ -1,0 +1,22 @@
+"""Test harness config: force an 8-device virtual CPU platform.
+
+Multi-chip TPU hardware is not available in CI; all sharding/mesh tests run
+against XLA's host-platform device emulation, which exercises the same
+GSPMD partitioning and collective lowering paths (the driver separately
+dry-runs the multi-chip path via __graft_entry__.dryrun_multichip).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(12345)

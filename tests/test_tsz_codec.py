@@ -1,0 +1,163 @@
+"""TTSZ codec: batched device codec must be bit-exact vs the scalar oracle.
+
+Mirrors the reference's encoding test strategy
+(src/dbnode/encoding/m3tsz/roundtrip_test.go semantics): roundtrip exactness
+across workload shapes, plus cross-checking two independent implementations.
+"""
+
+import numpy as np
+import pytest
+
+from m3_tpu.ops import ref_codec as rc
+from m3_tpu.ops import tsz
+
+
+def make_workload(rng, n, w):
+    """Mixed fleet of series shaped like production metrics (m3nsch datums)."""
+    base = 1_700_000_000
+    ts = base + np.arange(w, dtype=np.int64)[None, :] * 10 + rng.integers(0, 2, (n, w))
+    ts = np.sort(ts, axis=1)
+    kinds = rng.integers(0, 6, n)
+    vals = np.empty((n, w), dtype=np.float64)
+    for i in range(n):
+        k = kinds[i]
+        if k == 0:  # counter
+            vals[i] = np.cumsum(rng.poisson(5.0, w)).astype(np.float64)
+        elif k == 1:  # gauge, 2 decimal places
+            vals[i] = np.round(rng.normal(100, 5, w), 2)
+        elif k == 2:  # constant
+            vals[i] = float(rng.integers(0, 100))
+        elif k == 3:  # raw float noise
+            vals[i] = rng.normal(0, 1, w)
+        elif k == 4:  # percentage, 1 dp
+            vals[i] = np.round(rng.uniform(0, 100, w), 1)
+        else:  # sparse NaN-ish gauge
+            vals[i] = np.where(rng.random(w) < 0.05, np.nan, np.round(rng.normal(10, 1, w), 3))
+    return ts, vals
+
+
+def ref_encode_all(ts, vals, npoints):
+    blocks = [rc.encode(ts[i, : npoints[i]], vals[i, : npoints[i]]) for i in range(len(ts))]
+    return blocks
+
+
+def assert_values_equal(a, b):
+    """Bitwise equality except int-mode may canonicalize -0.0 to 0.0."""
+    ab = np.asarray(a, np.float64).view(np.uint64)
+    bb = np.asarray(b, np.float64).view(np.uint64)
+    eq = ab == bb
+    both_zero = (np.asarray(a) == 0) & (np.asarray(b) == 0)
+    assert (eq | both_zero).all()
+
+
+class TestScalarOracle:
+    def test_roundtrip(self, rng):
+        ts, vals = make_workload(rng, 16, 120)
+        for i in range(len(ts)):
+            blk = rc.encode(ts[i], vals[i])
+            t2, v2 = rc.decode(blk)
+            assert np.array_equal(ts[i], t2)
+            assert_values_equal(vals[i], v2)
+
+    def test_single_point(self):
+        blk = rc.encode(np.array([1234567890]), np.array([3.14159]))
+        t2, v2 = rc.decode(blk)
+        assert t2[0] == 1234567890 and v2[0] == 3.14159
+
+    def test_negative_timestamps_and_values(self, rng):
+        ts = np.array([-1000, -990, -975, -960], dtype=np.int64)
+        vals = np.array([-1.5, -2.5, 3.25, -0.75])
+        blk = rc.encode(ts, vals)
+        t2, v2 = rc.decode(blk)
+        assert np.array_equal(ts, t2)
+        assert np.array_equal(vals, v2)
+
+
+class TestBatchedVsOracle:
+    @pytest.mark.parametrize("w", [2, 17, 120])
+    def test_encode_bit_exact(self, rng, w):
+        n = 24
+        ts, vals = make_workload(rng, n, w)
+        npoints = np.full(n, w, dtype=np.int32)
+        words, nbits = tsz.encode(ts, vals, npoints)
+        words, nbits = np.asarray(words), np.asarray(nbits)
+        for i, blk in enumerate(ref_encode_all(ts, vals, npoints)):
+            assert nbits[i] == blk.nbits, f"series {i}: nbits {nbits[i]} != {blk.nbits}"
+            nw = (blk.nbits + 31) // 32
+            assert np.array_equal(words[i, :nw], blk.words), f"series {i} words differ"
+
+    def test_decode_roundtrip(self, rng):
+        n, w = 24, 90
+        ts, vals = make_workload(rng, n, w)
+        npoints = np.full(n, w, dtype=np.int32)
+        words, _ = tsz.encode(ts, vals, npoints)
+        t2, v2 = tsz.decode(words, npoints, w)
+        assert np.array_equal(ts, t2)
+        assert_values_equal(vals, v2)
+
+    def test_decode_of_oracle_streams(self, rng):
+        """Device decoder consumes streams produced by the scalar encoder."""
+        n, w = 8, 40
+        ts, vals = make_workload(rng, n, w)
+        npoints = np.full(n, w, dtype=np.int32)
+        mw = tsz.max_words_for(w)
+        words = np.zeros((n, mw), dtype=np.uint32)
+        for i, blk in enumerate(ref_encode_all(ts, vals, npoints)):
+            words[i, : len(blk.words)] = blk.words
+        t2, v2 = tsz.decode(words, npoints, w)
+        assert np.array_equal(ts, t2)
+        assert_values_equal(vals, v2)
+
+    def test_ragged_npoints(self, rng):
+        n, w = 12, 60
+        ts, vals = make_workload(rng, n, w)
+        npoints = rng.integers(1, w + 1, n).astype(np.int32)
+        words, nbits = tsz.encode(ts, vals, npoints)
+        words, nbits = np.asarray(words), np.asarray(nbits)
+        for i, blk in enumerate(ref_encode_all(ts, vals, npoints)):
+            assert nbits[i] == blk.nbits
+            nw = (blk.nbits + 31) // 32
+            assert np.array_equal(words[i, :nw], blk.words)
+        t2, v2 = tsz.decode(words, npoints, w)
+        for i in range(n):
+            p = npoints[i]
+            assert np.array_equal(ts[i, :p], t2[i, :p])
+            assert_values_equal(vals[i, :p], v2[i, :p])
+
+    def test_dod_overflow_rejected(self):
+        ts = np.array([[0, 2**31 - 1, 2]], dtype=np.int64)
+        vals = np.ones((1, 3))
+        with pytest.raises(ValueError):
+            tsz.encode(ts, vals)
+        with pytest.raises(ValueError):
+            rc.encode(ts[0], vals[0])
+
+    def test_ragged_padding_ignored_by_guards(self):
+        """Garbage in the padded tail beyond npoints must not trip validation."""
+        ts = np.array([[3_000_000_000, 3_000_000_010, 0, 0]], dtype=np.int64)
+        vals = np.array([[1.0, 2.0, 0.0, 0.0]])
+        words, nbits = tsz.encode(ts, vals, np.array([2], np.int32))
+        t2, v2 = tsz.decode(words, np.array([2], np.int32), 4)
+        assert np.array_equal(ts[0, :2], t2[0, :2])
+        assert np.array_equal(vals[0, :2], v2[0, :2])
+
+    def test_max_words_too_small_rejected(self, rng):
+        ts, vals = make_workload(rng, 2, 40)
+        with pytest.raises(ValueError, match="max_words"):
+            tsz.encode(ts, vals, max_words=4)
+
+    def test_compression_ratio(self, rng):
+        """Production-like mix must stay near the reference's 1.45 B/dp
+        (docs/m3db/architecture/engine.md:9)."""
+        n, w = 64, 360
+        ts = 1_700_000_000 + np.arange(w, dtype=np.int64)[None, :] * 10
+        ts = np.broadcast_to(ts, (n, w)).copy()
+        vals = np.empty((n, w))
+        for i in range(n):
+            if i % 2 == 0:
+                vals[i] = np.cumsum(rng.poisson(5.0, w)).astype(np.float64)
+            else:
+                vals[i] = np.round(rng.normal(100, 5, w), 2)
+        _, nbits = tsz.encode(ts, vals, np.full(n, w, dtype=np.int32))
+        bpd = float(np.asarray(nbits).sum()) / 8.0 / (n * w)
+        assert bpd < 2.0, f"bytes/datapoint {bpd:.3f} too high"
