@@ -1,0 +1,95 @@
+"""Headline benchmark: M3TSZ encode + 1m rollup datapoints/sec on one chip.
+
+Per BASELINE.json's north star, measures the per-shard ingest hot path —
+batched M3TSZ compression (delta-of-delta timestamps + XOR/int-optimized
+values, src/dbnode/encoding/m3tsz/encoder.go:113 semantics) fused with the
+10s->1m Counter/Gauge rollup (src/aggregator/aggregation) — over a
+100k-series shard, as one jitted XLA program per block window.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where
+vs_baseline compares against the recorded CPU baseline in
+bench_baseline.json (same kernels on the host platform — the "CPU M3TSZ
+encode baseline" config; the reference publishes no absolute throughput
+numbers, BASELINE.md). Also embeds bytes/datapoint (reference: 1.45,
+docs/m3db/architecture/engine.md:9) in the "extra" field.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def run(n_series: int, window: int, iters: int):
+    import jax
+
+    from m3_tpu.parallel import ingest
+
+    rng = np.random.default_rng(7)
+    batch = ingest.make_example_batch(n_series, window, rng)
+    max_words = ingest.tsz.max_words_for(window)
+    batch = jax.device_put(batch)
+
+    import functools
+
+    step = jax.jit(
+        functools.partial(ingest.ingest_step, rollup_factor=6, max_words=max_words)
+    )
+    out = step(batch)
+    np.asarray(out[1][:1])  # compile + warm; host fetch forces completion
+    # NB: on remote-tunnel platforms block_until_ready can return before the
+    # device has executed, so completion is forced with a host fetch of a
+    # value produced by the final dispatch (the device queue is in-order).
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = step(batch)
+    np.asarray(out[1][:1])
+    dt = time.perf_counter() - t0
+
+    words, nbits = out[0], out[1]
+    total_points = n_series * window
+    dps = total_points * iters / dt
+    bytes_per_dp = float(np.asarray(nbits, dtype=np.int64).sum()) / 8.0 / total_points
+    return dps, bytes_per_dp
+
+
+def main():
+    n_series = int(os.environ.get("BENCH_SERIES", "100000"))
+    window = int(os.environ.get("BENCH_WINDOW", "120"))
+    iters = int(os.environ.get("BENCH_ITERS", "5"))
+
+    dps, bytes_per_dp = run(n_series, window, iters)
+
+    baseline_dps = None
+    try:
+        with open(os.path.join(os.path.dirname(__file__), "bench_baseline.json")) as f:
+            baseline_dps = json.load(f)["cpu_dps"]
+    except Exception as e:
+        print(f"warning: no usable bench_baseline.json ({e})", file=sys.stderr)
+    vs = dps / baseline_dps if baseline_dps else None
+
+    print(
+        json.dumps(
+            {
+                "metric": "m3tsz_encode_1m_rollup",
+                "value": round(dps, 1),
+                "unit": "datapoints/sec",
+                "vs_baseline": round(vs, 3) if vs is not None else None,
+                "extra": {
+                    "bytes_per_datapoint": round(bytes_per_dp, 3),
+                    "reference_bytes_per_datapoint": 1.45,
+                    "series": n_series,
+                    "window": window,
+                    "cpu_baseline_dps": baseline_dps,
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,10 +8,17 @@ dry-runs the multi-chip path via __graft_entry__.dryrun_multichip).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax
+
+# The env var alone is NOT sufficient here: the axon TPU plugin registers
+# itself regardless of JAX_PLATFORMS, so the config override is load-bearing
+# (verified empirically — with only the env var, jax.devices() is the TPU).
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

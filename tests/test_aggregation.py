@@ -1,0 +1,109 @@
+"""Aggregation kernels vs. a straightforward numpy oracle implementing the
+reference semantics (src/aggregator/aggregation/{counter,gauge,timer}.go)."""
+
+import numpy as np
+import pytest
+
+from m3_tpu.ops import aggregation as agg
+
+
+def np_stats(values, mask):
+    out = {k: [] for k in agg.STAT_KEYS}
+    for row_v, row_m in zip(values.reshape(-1, values.shape[-1]), mask.reshape(-1, values.shape[-1])):
+        v = row_v[row_m]
+        out["sum"].append(v.sum() if v.size else 0.0)
+        out["sumsq"].append((v * v).sum() if v.size else 0.0)
+        out["count"].append(float(v.size))
+        out["min"].append(v.min() if v.size else np.inf)
+        out["max"].append(v.max() if v.size else -np.inf)
+        out["last"].append(v[-1] if v.size else 0.0)
+        out["first"].append(v[0] if v.size else 0.0)
+        out["m2"].append(((v - v.mean()) ** 2).sum() if v.size else 0.0)
+    return {k: np.array(vs).reshape(values.shape[:-1]) for k, vs in out.items()}
+
+
+def test_window_stats_matches_oracle(rng):
+    v = rng.standard_normal((17, 40)).astype(np.float32) * 100
+    mask = rng.random((17, 40)) < 0.8
+    mask[3] = False  # one empty window
+    got = {k: np.asarray(x) for k, x in agg.window_stats(v, mask).items()}
+    want = np_stats(v.astype(np.float64), mask)
+    for k in agg.STAT_KEYS:
+        np.testing.assert_allclose(got[k], want[k], rtol=1e-4, atol=1e-3, err_msg=k)
+
+
+def test_rollup_stats_shapes_and_values(rng):
+    v = rng.standard_normal((5, 60)).astype(np.float32)
+    mask = np.ones((5, 60), bool)
+    r = agg.rollup_stats(v, mask, 6)
+    assert np.asarray(r["sum"]).shape == (5, 10)
+    np.testing.assert_allclose(
+        np.asarray(r["sum"]), v.reshape(5, 10, 6).sum(-1), rtol=1e-5
+    )
+    np.testing.assert_allclose(np.asarray(r["last"]), v.reshape(5, 10, 6)[..., -1], rtol=1e-6)
+
+
+def test_merge_stats_equals_whole_window(rng):
+    v = rng.standard_normal((9, 64)).astype(np.float32)
+    mask = rng.random((9, 64)) < 0.7
+    a = agg.window_stats(v[:, :32], mask[:, :32])
+    b = agg.window_stats(v[:, 32:], mask[:, 32:])
+    m = agg.merge_stats(a, b)
+    whole = agg.window_stats(v, mask)
+    for k in agg.STAT_KEYS:
+        np.testing.assert_allclose(
+            np.asarray(m[k]), np.asarray(whole[k]), rtol=1e-4, atol=1e-3, err_msg=k
+        )
+
+
+def test_stdev_stable_for_offset_values(rng):
+    # mean >> stdev: the raw-moment formula cancels in f32; the centered m2
+    # path must stay accurate.
+    v = (3000.0 + rng.standard_normal((6, 120)) * 2.0).astype(np.float32)
+    mask = np.ones_like(v, bool)
+    s = agg.window_stats(v, mask)
+    np.testing.assert_allclose(
+        np.asarray(agg.stdev(s)), np.std(v.astype(np.float64), axis=1, ddof=1), rtol=1e-3
+    )
+    # And through a merge of two halves.
+    m = agg.merge_stats(
+        agg.window_stats(v[:, :60], mask[:, :60]), agg.window_stats(v[:, 60:], mask[:, 60:])
+    )
+    np.testing.assert_allclose(
+        np.asarray(agg.stdev(m)), np.std(v.astype(np.float64), axis=1, ddof=1), rtol=1e-3
+    )
+
+
+def test_mean_stdev_reference_formula():
+    v = np.array([[1.0, 2.0, 3.0, 4.0]], np.float32)
+    s = agg.window_stats(v, np.ones_like(v, bool))
+    np.testing.assert_allclose(float(agg.mean(s)[0]), 2.5)
+    # common.go:29: sqrt((n*sumSq - sum^2) / (n*(n-1)))
+    np.testing.assert_allclose(float(agg.stdev(s)[0]), np.std(v, ddof=1), rtol=1e-6)
+    empty = agg.window_stats(v, np.zeros_like(v, bool))
+    assert float(agg.mean(empty)[0]) == 0.0
+    assert float(agg.stdev(empty)[0]) == 0.0
+
+
+@pytest.mark.parametrize("q", [0.0, 0.5, 0.95, 0.99, 1.0])
+def test_quantiles_exact_rank(rng, q):
+    v = rng.standard_normal((8, 100)).astype(np.float32)
+    mask = rng.random((8, 100)) < 0.9
+    got = np.asarray(agg.quantiles(v, mask, (q,)))[:, 0]
+    for i in range(8):
+        vals = np.sort(v[i][mask[i]])
+        n = len(vals)
+        rank = max(int(np.ceil(q * n)), 1)
+        np.testing.assert_allclose(got[i], vals[rank - 1], rtol=1e-6)
+
+
+def test_quantiles_empty_window():
+    v = np.zeros((2, 8), np.float32)
+    mask = np.zeros((2, 8), bool)
+    assert np.all(np.asarray(agg.quantiles(v, mask, (0.5,))) == 0.0)
+
+
+def test_rollup_quantiles_shape(rng):
+    v = rng.standard_normal((4, 24)).astype(np.float32)
+    out = agg.rollup_quantiles(v, np.ones_like(v, bool), 6, (0.5, 0.99))
+    assert np.asarray(out).shape == (4, 4, 2)
