@@ -1,0 +1,137 @@
+"""Metrics domain model: policies, filters, rules matching, transformations
+(reference semantics from src/metrics)."""
+
+import numpy as np
+
+from m3_tpu.metrics import id as metric_id
+from m3_tpu.metrics.aggregation import AggID, AggType, default_types_for, parse_types
+from m3_tpu.metrics.filters import Filter, TagsFilter
+from m3_tpu.metrics.metric import MetricType
+from m3_tpu.metrics.pipeline import Op, Pipeline
+from m3_tpu.metrics.policy import StoragePolicy
+from m3_tpu.metrics.rules import (
+    ActiveRuleSet,
+    MappingRuleSnapshot,
+    RollupRuleSnapshot,
+    RollupTarget,
+    Rule,
+)
+from m3_tpu.metrics.transformation import (
+    Datapoint,
+    TransformType,
+    absolute,
+    per_second,
+    per_second_batch,
+)
+from m3_tpu.utils import xtime
+
+
+def test_storage_policy_roundtrip():
+    for s in ("10s:2d", "1m:40d", "1m@1s:40d"):
+        assert str(StoragePolicy.parse(s)) == s
+    p = StoragePolicy.parse("10s:2d")
+    assert p.resolution.window_ns == 10 * xtime.SECOND
+    assert p.retention_ns == 2 * xtime.DAY
+    assert p.resolution.precision == xtime.Unit.SECOND
+
+
+def test_agg_types():
+    assert AggType.P99.quantile() == 0.99
+    assert AggType.MEDIAN.quantile() == 0.5
+    assert AggType.MAX.type_string == "upper"
+    assert AggType.P999.type_string == "p999"
+    assert not AggType.LAST.is_valid_for(MetricType.COUNTER)
+    assert AggType.LAST.is_valid_for(MetricType.GAUGE)
+    assert default_types_for(MetricType.GAUGE) == (AggType.LAST,)
+    types = parse_types("Sum,Max,P99")
+    # The bitmask loses list order (as in the reference's compressed ID).
+    assert set(AggID.decompress(AggID.compress(types))) == set(types)
+
+
+def test_filters_glob():
+    assert Filter("foo*").matches(b"foobar")
+    assert not Filter("foo*").matches(b"barfoo")
+    assert Filter("*.bar").matches(b"x.bar")
+    assert Filter("f?o").matches(b"foo")
+    assert Filter("[a-c]x").matches(b"bx")
+    assert Filter("{ab,cd}e").matches(b"cde")
+    assert not Filter("{ab,cd}e").matches(b"abe,cde")
+    assert Filter("!prod").matches(b"dev")
+    assert not Filter("!prod").matches(b"prod")
+
+
+def test_tags_filter():
+    f = TagsFilter({"__name__": "requests*", "env": "prod", "dc": "!east"})
+    mk = lambda name, **tags: metric_id.encode(
+        name.encode(), {k.encode(): v.encode() for k, v in tags.items()}
+    )
+    assert f.matches(mk("requests.count", env="prod", dc="west"))
+    assert not f.matches(mk("latency", env="prod", dc="west"))
+    assert not f.matches(mk("requests.count", env="dev", dc="west"))
+    assert not f.matches(mk("requests.count", env="prod", dc="east"))
+    # Missing positively-filtered tag fails; missing negated tag passes.
+    assert not f.matches(mk("requests.count", dc="west"))
+    assert f.matches(mk("requests.count", env="prod"))
+
+
+def _mid(name, **tags):
+    return metric_id.encode(name.encode(), {k.encode(): v.encode() for k, v in tags.items()})
+
+
+def test_mapping_rule_matching_with_cutovers():
+    p1 = (StoragePolicy.parse("10s:2d"),)
+    p2 = (StoragePolicy.parse("1m:40d"),)
+    rule = Rule([
+        MappingRuleSnapshot("r1", 100, TagsFilter({"env": "prod"}), storage_policies=p1),
+        MappingRuleSnapshot("r1", 200, TagsFilter({"env": "prod"}), storage_policies=p2),
+    ])
+    rs = ActiveRuleSet(1, [rule], [])
+    mid = _mid("m", env="prod")
+
+    res = rs.forward_match(mid, 150, 180)
+    assert len(res.for_existing_id) == 1
+    assert res.for_existing_id[0].metadata.pipelines[0].storage_policies == p1
+    assert res.expire_at_nanos == 200
+
+    # Range crossing the cutover: two stages.
+    res = rs.forward_match(mid, 150, 250)
+    assert len(res.for_existing_id) == 2
+    assert res.for_existing_id[1].cutover_nanos == 200
+    assert res.for_existing_id[1].metadata.pipelines[0].storage_policies == p2
+
+    # Non-matching id gets default staged metadata.
+    res = rs.forward_match(_mid("m", env="dev"), 150, 180)
+    assert res.for_existing_id[0].metadata.pipelines == ()
+
+
+def test_rollup_rule_generates_new_id():
+    sp = (StoragePolicy.parse("1m:40d"),)
+    target = RollupTarget(
+        Pipeline((Op.roll(b"requests.by_dc", [b"dc"]),)), sp
+    )
+    rule = Rule([RollupRuleSnapshot("roll", 0, TagsFilter({"__name__": "requests*"}), (target,))])
+    rs = ActiveRuleSet(1, [], [rule])
+    res = rs.forward_match(_mid("requests.count", dc="west", host="h1"), 10, 20)
+    assert len(res.for_new_rollup_ids) == 1
+    rid = res.for_new_rollup_ids[0].id
+    name, tags = metric_id.decode(rid)
+    assert name == b"requests.by_dc"
+    assert tags[b"dc"] == b"west"
+    assert b"host" not in tags
+    assert metric_id.is_rollup_id(rid)
+    pm = res.for_new_rollup_ids[0].metadatas[0].metadata.pipelines[0]
+    assert pm.storage_policies == sp
+    assert pm.pipeline.is_empty()
+
+
+def test_transformations():
+    assert absolute(Datapoint(5, -3.0)).value == 3.0
+    r = per_second(Datapoint(0, 10.0), Datapoint(2_000_000_000, 30.0))
+    assert r.value == 10.0
+    assert np.isnan(per_second(Datapoint(5, 10.0), Datapoint(5, 30.0)).value)
+    assert np.isnan(per_second(Datapoint(0, 30.0), Datapoint(5, 10.0)).value)
+
+    t = np.array([0, 1, 2, 3], np.int64) * 1_000_000_000
+    v = np.array([0.0, 10.0, 5.0, 6.0], np.float32)
+    out = np.asarray(per_second_batch(t, v))
+    assert np.isnan(out[0]) and out[1] == 10.0 and np.isnan(out[2]) and out[3] == 1.0
