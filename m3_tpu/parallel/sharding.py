@@ -1,0 +1,35 @@
+"""Shard sets: murmur3 virtual-shard hashing (reference:
+src/dbnode/sharding/shardset.go — murmur3.Sum32(id) % numShards over 4096
+default virtual shards, docs/m3db/architecture/sharding.md)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..utils.hashing import hash_batch, murmur3_32
+
+DEFAULT_NUM_SHARDS = 4096
+
+
+class ShardSet:
+    """The set of virtual shards this node (or a topology) hashes over."""
+
+    def __init__(self, num_shards: int = DEFAULT_NUM_SHARDS,
+                 owned: Optional[Sequence[int]] = None):
+        self.num_shards = num_shards
+        self.owned = sorted(owned) if owned is not None else list(range(num_shards))
+
+    def lookup(self, series_id: bytes) -> int:
+        """shardset.go:76 Lookup."""
+        return murmur3_32(series_id) % self.num_shards
+
+    def lookup_batch(self, ids: Sequence[bytes]) -> np.ndarray:
+        return (hash_batch(ids) % np.uint32(self.num_shards)).astype(np.int32)
+
+    def all_shard_ids(self) -> List[int]:
+        return list(self.owned)
+
+    def owns(self, shard_id: int) -> bool:
+        return shard_id in set(self.owned)

@@ -1,0 +1,122 @@
+"""Immutable sealed blocks + block LRU (reference: src/dbnode/storage/block:
+DatabaseBlock holding one compressed segment per series per block window, and
+wired_list.go's global LRU of blocks paged in from disk).
+
+A sealed block here is batch-first: ONE object holds the compressed streams
+of every series in a (shard, block-start) — words [S, MW] u32 — because
+that is the unit the device encodes/decodes in a single launch, and the unit
+filesets persist. Per-series access slices a row."""
+
+from __future__ import annotations
+
+import dataclasses
+import zlib
+from collections import OrderedDict
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..ops import tsz
+from ..utils import xtime
+
+
+def choose_time_unit(ts: np.ndarray) -> xtime.Unit:
+    """Coarsest unit that represents every timestamp losslessly (the codec
+    works in scaled integer ticks; the reference keys its DoD bucket scheme
+    by time unit, m3tsz/scheme.go:41-52)."""
+    for u in (xtime.Unit.MINUTE, xtime.Unit.SECOND, xtime.Unit.MILLISECOND,
+              xtime.Unit.MICROSECOND):
+        if (ts % u.nanos == 0).all():
+            return u
+    return xtime.Unit.NANOSECOND
+
+
+@dataclasses.dataclass
+class SealedBlock:
+    """Compressed block for all series written in one (shard, block_start)."""
+
+    block_start: int
+    window: int                    # static decode window (max points/series)
+    series_indices: np.ndarray     # int32 [S] registry indices, sorted
+    words: np.ndarray              # uint32 [S, MW] packed streams
+    nbits: np.ndarray              # int32 [S]
+    npoints: np.ndarray            # int32 [S]
+    time_unit: xtime.Unit = xtime.Unit.NANOSECOND  # tick scale of the streams
+    checksum: int = 0
+
+    def __post_init__(self):
+        if self.checksum == 0:
+            self.checksum = zlib.adler32(np.ascontiguousarray(self.words).tobytes())
+
+    @property
+    def num_series(self) -> int:
+        return len(self.series_indices)
+
+    def row_of(self, series_idx: int) -> Optional[int]:
+        i = int(np.searchsorted(self.series_indices, series_idx))
+        if i < len(self.series_indices) and self.series_indices[i] == series_idx:
+            return i
+        return None
+
+    def read(self, series_idx: int) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        """Decode one series' datapoints (device launch batched to 1 row)."""
+        row = self.row_of(series_idx)
+        if row is None:
+            return None
+        ts, vals = tsz.decode(self.words[row : row + 1], self.npoints[row : row + 1], window=self.window)
+        n = int(self.npoints[row])
+        return ts[0, :n] * self.time_unit.nanos, vals[0, :n]
+
+    def read_all(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Decode every series in one batched launch: (ts [S, W], vals, npoints)."""
+        ts, vals = tsz.decode(self.words, self.npoints, window=self.window)
+        return ts * self.time_unit.nanos, vals, self.npoints
+
+    def nbytes(self) -> int:
+        return int(self.words.nbytes)
+
+
+def encode_block(block_start: int, series_indices, tdense, vdense, npoints,
+                 max_words: Optional[int] = None) -> SealedBlock:
+    """Batch-encode dense tiles (from ShardBuffer.drain) into a SealedBlock."""
+    window = tdense.shape[1]
+    unit = choose_time_unit(tdense)
+    words, nbits = tsz.encode(tdense // unit.nanos, vdense, npoints, max_words=max_words)
+    return SealedBlock(
+        block_start=block_start,
+        window=window,
+        series_indices=np.asarray(series_indices, np.int32),
+        words=np.asarray(words),
+        nbits=np.asarray(nbits),
+        npoints=np.asarray(npoints, np.int32),
+        time_unit=unit,
+    )
+
+
+class WiredList:
+    """Capacity-bounded LRU over blocks paged in from disk
+    (block/wired_list.go:77): evicts least-recently-read whole blocks."""
+
+    def __init__(self, max_bytes: int = 1 << 30):
+        self.max_bytes = max_bytes
+        self._items: "OrderedDict[Tuple, SealedBlock]" = OrderedDict()
+        self._bytes = 0
+
+    def get(self, key) -> Optional[SealedBlock]:
+        blk = self._items.get(key)
+        if blk is not None:
+            self._items.move_to_end(key)
+        return blk
+
+    def put(self, key, blk: SealedBlock):
+        if key in self._items:
+            self._items.move_to_end(key)
+            return
+        self._items[key] = blk
+        self._bytes += blk.nbytes()
+        while self._bytes > self.max_bytes and len(self._items) > 1:
+            _, old = self._items.popitem(last=False)
+            self._bytes -= old.nbytes()
+
+    def __len__(self):
+        return len(self._items)

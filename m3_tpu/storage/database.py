@@ -1,0 +1,119 @@
+"""Top-level database (reference: src/dbnode/storage/database.go `db` +
+mediator.go background lifecycle).
+
+Owns namespaces, routes writes by shard hash, appends to the commit log,
+and drives the tick -> seal -> flush -> cleanup lifecycle. Background
+behavior is explicit (`tick()`, `flush()`) so tests and services control
+timing; services wrap it in a mediator thread."""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .namespace import Namespace, NamespaceOptions
+
+
+class Database:
+    def __init__(self, shard_set, commitlog=None, clock: Callable[[], int] = None):
+        """shard_set: m3_tpu.sharding.ShardSet; commitlog: persist.CommitLog."""
+        self.shard_set = shard_set
+        self.commitlog = commitlog
+        self.clock = clock or (lambda: time.time_ns())
+        self.namespaces: Dict[bytes, Namespace] = {}
+        self._bootstrapped = False
+
+    # ------------------------------------------------------------- namespaces
+
+    def create_namespace(self, name: bytes, opts: NamespaceOptions = NamespaceOptions(),
+                         index=None) -> Namespace:
+        if name in self.namespaces:
+            raise ValueError(f"namespace {name!r} already exists")
+        ns = Namespace(name, opts, self.shard_set.all_shard_ids(), index=index)
+        self.namespaces[name] = ns
+        return ns
+
+    def namespace(self, name: bytes) -> Namespace:
+        ns = self.namespaces.get(name)
+        if ns is None:
+            raise KeyError(f"no such namespace {name!r}")
+        return ns
+
+    # ------------------------------------------------------------------ write
+
+    def write(self, namespace: bytes, series_id: bytes, t_ns: int, value: float,
+              tags: Optional[dict] = None):
+        """database.go:536 Write + :561 commit log append."""
+        ns = self.namespace(namespace)
+        shard_id = self.shard_set.lookup(series_id)
+        now = self.clock()
+        ns.write(shard_id, series_id, t_ns, value, now, tags)
+        if self.commitlog is not None and ns.opts.writes_to_commitlog:
+            self.commitlog.write(namespace, series_id, t_ns, value)
+
+    def write_batch(self, namespace: bytes, ids: Sequence[bytes], ts, vals,
+                    tags: Optional[Sequence[Optional[dict]]] = None):
+        """database.go:624 WriteBatch: single shard-route + columnar append."""
+        ns = self.namespace(namespace)
+        ts = np.asarray(ts, np.int64)
+        vals = np.asarray(vals, np.float64)
+        now = self.clock()
+        shard_ids = self.shard_set.lookup_batch(ids)
+        for sid in np.unique(shard_ids):
+            m = shard_ids == sid
+            sel = np.flatnonzero(m)
+            ns.shard_for(int(sid)).write_batch(
+                [ids[i] for i in sel], ts[m], vals[m], now,
+                tags=[tags[i] for i in sel] if tags else None,
+            )
+        if self.commitlog is not None and ns.opts.writes_to_commitlog:
+            self.commitlog.write_batch(namespace, ids, ts, vals)
+
+    # ------------------------------------------------------------------- read
+
+    def read(self, namespace: bytes, series_id: bytes, start_ns: int, end_ns: int):
+        """database.go:739 ReadEncoded equivalent, returning decoded points."""
+        ns = self.namespace(namespace)
+        return ns.read(self.shard_set.lookup(series_id), series_id, start_ns, end_ns)
+
+    def query_ids(self, namespace: bytes, query, start_ns: int = 0, end_ns: int = 2**63 - 1):
+        """database.go:724 QueryIDs -> reverse index query."""
+        ns = self.namespace(namespace)
+        if ns.index is None:
+            raise RuntimeError(f"namespace {namespace!r} has no index")
+        return ns.index.query(query, start_ns, end_ns)
+
+    # -------------------------------------------------------------- lifecycle
+
+    def tick(self, now_ns: Optional[int] = None) -> dict:
+        now = now_ns if now_ns is not None else self.clock()
+        totals = {"sealed": 0, "expired": 0}
+        for ns in self.namespaces.values():
+            r = ns.tick(now)
+            for k in totals:
+                totals[k] += r[k]
+        return totals
+
+    def flush(self, persist_manager, now_ns: Optional[int] = None) -> int:
+        """Flush all sealed-but-unflushed blocks through a persist manager
+        (storage/flush.go); returns number of filesets written."""
+        now = now_ns if now_ns is not None else self.clock()
+        flushed = 0
+        for ns in self.namespaces.values():
+            for shard in ns.shards.values():
+                for bs in shard.flushable(now):
+                    persist_manager.write_block(ns.name, shard.shard_id, shard.blocks[bs], shard.registry)
+                    shard.mark_flushed(bs)
+                    flushed += 1
+        if self.commitlog is not None and flushed:
+            self.commitlog.rotate()
+        return flushed
+
+    def mark_bootstrapped(self):
+        self._bootstrapped = True
+
+    @property
+    def bootstrapped(self) -> bool:
+        return self._bootstrapped

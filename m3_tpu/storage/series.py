@@ -1,0 +1,58 @@
+"""Series registry: id <-> dense index mapping per shard.
+
+The reference's dbShard keeps a concurrent map id -> *dbSeries with each
+series owning encoders and cached blocks (storage/shard.go, generated
+shard_map_gen.go). In the columnar design, per-series state collapses to a
+dense int32 index used across buffer columns and block rows; the registry
+is the only id-keyed structure on the hot path."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class SeriesRegistry:
+    def __init__(self):
+        self._index: Dict[bytes, int] = {}
+        self._ids: List[bytes] = []
+        self._tags: List[Optional[dict]] = []
+
+    def __len__(self) -> int:
+        return len(self._ids)
+
+    def get(self, series_id: bytes) -> Optional[int]:
+        return self._index.get(series_id)
+
+    def id_of(self, idx: int) -> bytes:
+        return self._ids[idx]
+
+    def tags_of(self, idx: int) -> Optional[dict]:
+        return self._tags[idx]
+
+    def get_or_create(self, series_id: bytes, tags: Optional[dict] = None) -> Tuple[int, bool]:
+        idx = self._index.get(series_id)
+        if idx is not None:
+            if tags is not None and self._tags[idx] is None:
+                self._tags[idx] = tags
+            return idx, False
+        idx = len(self._ids)
+        self._index[series_id] = idx
+        self._ids.append(series_id)
+        self._tags.append(tags)
+        return idx, True
+
+    def get_or_create_batch(self, ids: Sequence[bytes]) -> Tuple[np.ndarray, List[int]]:
+        """Bulk resolve; returns (indices [N], list of newly created idxs)."""
+        out = np.empty(len(ids), np.int32)
+        created: List[int] = []
+        for i, sid in enumerate(ids):
+            idx, is_new = self.get_or_create(sid)
+            out[i] = idx
+            if is_new:
+                created.append(idx)
+        return out, created
+
+    def all_ids(self) -> List[bytes]:
+        return list(self._ids)

@@ -1,0 +1,173 @@
+"""Database shard (reference: src/dbnode/storage/shard.go dbShard).
+
+Owns one virtual shard's series registry, mutable columnar buffer, sealed
+blocks, and lifecycle (tick-driven sealing, retention expiry, flush state).
+The reference's write path (shard.go:769 writeAndIndex) resolves a series
+entry, appends to its encoder, and enqueues async index inserts; here
+writes append to shard columns and new series surface index insert batches
+for the namespace's reverse index."""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils import xtime
+from .block import SealedBlock, encode_block
+from .buffer import ShardBuffer
+from .series import SeriesRegistry
+
+
+class ShardState(enum.Enum):
+    """cluster/shard shard states."""
+
+    INITIALIZING = "initializing"
+    AVAILABLE = "available"
+    LEAVING = "leaving"
+
+
+class FlushState(enum.Enum):
+    """Per-(shard, block) durability state (storage/shard.go flushState)."""
+
+    NOT_STARTED = "not_started"
+    IN_PROGRESS = "in_progress"
+    SUCCESS = "success"
+    FAILED = "failed"
+
+
+@dataclasses.dataclass
+class ShardOptions:
+    block_size_ns: int = 2 * xtime.HOUR
+    retention_ns: int = 2 * xtime.DAY
+    buffer_past_ns: int = 10 * xtime.MINUTE
+    buffer_future_ns: int = 2 * xtime.MINUTE
+
+
+class Shard:
+    def __init__(self, shard_id: int, opts: ShardOptions,
+                 on_new_series: Optional[Callable] = None,
+                 state: ShardState = ShardState.AVAILABLE):
+        self.shard_id = shard_id
+        self.opts = opts
+        self.state = state
+        self.registry = SeriesRegistry()
+        self.buffer = ShardBuffer(opts.block_size_ns, opts.buffer_past_ns, opts.buffer_future_ns)
+        self.blocks: Dict[int, SealedBlock] = {}
+        self.flush_states: Dict[int, FlushState] = {}
+        # Callback (series_id, tags, series_idx) when a series is first seen
+        # — the namespace wires this to reverse-index insertion
+        # (shard.go:769 writeAndIndex's index hook).
+        self.on_new_series = on_new_series
+
+    # ------------------------------------------------------------------ write
+
+    def write(self, series_id: bytes, t_ns: int, value: float, now_ns: int,
+              tags: Optional[dict] = None) -> bool:
+        if not self.buffer.accepts(now_ns, t_ns):
+            raise ValueError(
+                f"datapoint at {t_ns} outside acceptance window at {now_ns} "
+                f"(past {self.opts.buffer_past_ns}, future {self.opts.buffer_future_ns})"
+            )
+        idx, is_new = self.registry.get_or_create(series_id, tags)
+        if is_new and self.on_new_series is not None:
+            self.on_new_series(series_id, tags, idx)
+        self.buffer.write(idx, t_ns, value)
+        return is_new
+
+    def write_batch(self, ids: Sequence[bytes], ts: np.ndarray, vals: np.ndarray,
+                    now_ns: int, tags: Optional[Sequence[Optional[dict]]] = None):
+        ts = np.asarray(ts, np.int64)
+        vals = np.asarray(vals, np.float64)
+        ok = (ts >= now_ns - self.opts.buffer_past_ns) & (ts <= now_ns + self.opts.buffer_future_ns)
+        if not ok.all():
+            bad = int((~ok).sum())
+            raise ValueError(f"{bad} datapoints outside acceptance window")
+        sidx = np.empty(len(ids), np.int32)
+        for i, sid in enumerate(ids):
+            idx, is_new = self.registry.get_or_create(sid, tags[i] if tags else None)
+            sidx[i] = idx
+            if is_new and self.on_new_series is not None:
+                self.on_new_series(sid, tags[i] if tags else None, idx)
+        self.buffer.write_batch(sidx, ts, vals)
+
+    # ------------------------------------------------------------------- tick
+
+    def tick(self, now_ns: int) -> dict:
+        """Seal no-longer-writable buckets into device-encoded blocks and
+        expire blocks past retention (shard.go:573 tick + cleanup)."""
+        sealed, expired = 0, 0
+        for bs in self.buffer.sealable(now_ns):
+            dense = self.buffer.drain(bs)
+            if dense is not None:
+                series, tdense, vdense, npoints = dense
+                self.blocks[bs] = encode_block(bs, series, tdense, vdense, npoints)
+                self.flush_states.setdefault(bs, FlushState.NOT_STARTED)
+                sealed += 1
+        cutoff = now_ns - self.opts.retention_ns
+        for bs in [b for b in self.blocks if b + self.opts.block_size_ns <= cutoff]:
+            del self.blocks[bs]
+            self.flush_states.pop(bs, None)
+            expired += 1
+        return {"sealed": sealed, "expired": expired}
+
+    # ------------------------------------------------------------------- read
+
+    def read(self, series_id: bytes, start_ns: int, end_ns: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Merged datapoints from sealed blocks + mutable buffer in [start, end)."""
+        idx = self.registry.get(series_id)
+        if idx is None:
+            return np.zeros(0, np.int64), np.zeros(0, np.float64)
+        parts_t: List[np.ndarray] = []
+        parts_v: List[np.ndarray] = []
+        for bs in sorted(self.blocks):
+            if bs + self.opts.block_size_ns <= start_ns or bs >= end_ns:
+                continue
+            got = self.blocks[bs].read(idx)
+            if got is not None:
+                t, v = got
+                keep = (t >= start_ns) & (t < end_ns)
+                parts_t.append(t[keep])
+                parts_v.append(v[keep])
+        bt, bv = self.buffer.read(idx, start_ns, end_ns)
+        if len(bt):
+            parts_t.append(bt)
+            parts_v.append(bv)
+        if not parts_t:
+            return np.zeros(0, np.int64), np.zeros(0, np.float64)
+        t = np.concatenate(parts_t)
+        v = np.concatenate(parts_v)
+        order = np.argsort(t, kind="stable")
+        return t[order], v[order]
+
+    # ------------------------------------------------------- flush/bootstrap
+
+    def flushable(self, now_ns: int) -> List[int]:
+        """Sealed blocks not yet durably flushed."""
+        return sorted(
+            bs for bs, st in self.flush_states.items()
+            if st in (FlushState.NOT_STARTED, FlushState.FAILED) and bs in self.blocks
+        )
+
+    def mark_flushed(self, block_start: int, ok: bool = True):
+        self.flush_states[block_start] = FlushState.SUCCESS if ok else FlushState.FAILED
+
+    def load_block(self, blk: SealedBlock, remap: Optional[np.ndarray] = None):
+        """Install a bootstrapped/streamed block (bootstrap result merge).
+
+        `remap` translates the block's series indices into this registry's
+        (peer blocks arrive with the remote's indices)."""
+        if remap is not None:
+            blk = dataclasses.replace(blk, series_indices=remap.astype(np.int32))
+            order = np.argsort(blk.series_indices)
+            blk.series_indices = blk.series_indices[order]
+            blk.words = blk.words[order]
+            blk.nbits = blk.nbits[order]
+            blk.npoints = blk.npoints[order]
+        self.blocks[blk.block_start] = blk
+        self.flush_states.setdefault(blk.block_start, FlushState.SUCCESS)
+
+    def num_series(self) -> int:
+        return len(self.registry)
