@@ -1,0 +1,171 @@
+"""Commit log WAL (reference: src/dbnode/persist/fs/commitlog).
+
+Same invariants as the reference's chunked WAL (commit_log.go:69,205;
+writer.go; chunk_reader.go):
+  - entries buffer in memory and flush as length-prefixed chunks with an
+    adler32 per chunk; a torn final chunk is detected and dropped on replay
+  - per-file series dictionary: a series' {namespace, id} metadata is
+    written once per file, entries reference it by index
+    (docs/m3db/architecture/commitlogs.md:21-33)
+  - strategies: WRITE_WAIT flushes synchronously on every write;
+    WRITE_BEHIND flushes on the flush interval / explicit flush
+    (commit_log.go:241-242)
+  - rotation starts a new numbered file; one commit log serves ALL
+    namespaces (commitlogs.md:5)
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+import struct
+import time
+import zlib
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+_CHUNK_HEADER = struct.Struct("<II")      # payload_len, adler32
+_META_ENTRY = struct.Struct("<BHH")       # tag=0, ns_len, id_len
+_DATA_ENTRY = struct.Struct("<BIqd")      # tag=1, series_ref, time_ns, value
+
+
+class Strategy(enum.Enum):
+    WRITE_WAIT = "write_wait"
+    WRITE_BEHIND = "write_behind"
+
+
+class CommitLog:
+    def __init__(self, directory: str, strategy: Strategy = Strategy.WRITE_BEHIND,
+                 flush_interval_ns: int = 1_000_000_000,
+                 clock: Optional[Callable[[], int]] = None):
+        self.directory = directory
+        self.strategy = strategy
+        self.flush_interval_ns = flush_interval_ns
+        self.clock = clock or time.time_ns
+        os.makedirs(directory, exist_ok=True)
+        existing = [int(f.split("-")[1].split(".")[0]) for f in os.listdir(directory)
+                    if f.startswith("commitlog-")]
+        self._file_num = max(existing, default=-1) + 1
+        self._f = None
+        self._buf = bytearray()
+        self._series_refs: Dict[Tuple[bytes, bytes], int] = {}
+        self._last_flush = self.clock()
+        self._open_new_file()
+
+    # ----------------------------------------------------------------- files
+
+    def _path(self, num: int) -> str:
+        return os.path.join(self.directory, f"commitlog-{num:08d}.bin")
+
+    def _open_new_file(self):
+        if self._f is not None:
+            self.flush()
+            self._f.close()
+        self._f = open(self._path(self._file_num), "ab")
+        self._series_refs.clear()
+
+    def rotate(self) -> int:
+        """Start a new commit log file (rotation on flush/time window)."""
+        old = self._file_num
+        self._file_num += 1
+        self._open_new_file()
+        return old
+
+    def active_file(self) -> str:
+        return self._path(self._file_num)
+
+    def files(self) -> List[str]:
+        return sorted(
+            os.path.join(self.directory, f) for f in os.listdir(self.directory)
+            if f.startswith("commitlog-")
+        )
+
+    def remove_files_before(self, file_num: int):
+        """Cleanup after flush durability (storage/cleanup.go)."""
+        for f in self.files():
+            num = int(os.path.basename(f).split("-")[1].split(".")[0])
+            if num < file_num:
+                os.remove(f)
+
+    # ---------------------------------------------------------------- writes
+
+    def _ref(self, namespace: bytes, series_id: bytes) -> int:
+        key = (namespace, series_id)
+        ref = self._series_refs.get(key)
+        if ref is None:
+            ref = len(self._series_refs)
+            self._series_refs[key] = ref
+            self._buf += _META_ENTRY.pack(0, len(namespace), len(series_id))
+            self._buf += namespace
+            self._buf += series_id
+        return ref
+
+    def write(self, namespace: bytes, series_id: bytes, t_ns: int, value: float):
+        ref = self._ref(namespace, series_id)
+        self._buf += _DATA_ENTRY.pack(1, ref, t_ns, value)
+        self._maybe_flush()
+
+    def write_batch(self, namespace: bytes, ids, ts, vals):
+        for sid, t, v in zip(ids, ts, vals):
+            ref = self._ref(namespace, sid)
+            self._buf += _DATA_ENTRY.pack(1, ref, int(t), float(v))
+        self._maybe_flush()
+
+    def _maybe_flush(self):
+        if self.strategy == Strategy.WRITE_WAIT:
+            self.flush()
+        elif self.clock() - self._last_flush >= self.flush_interval_ns:
+            self.flush()
+
+    def flush(self):
+        """Write buffered entries as one checksummed chunk (writer.go)."""
+        if not self._buf:
+            return
+        payload = bytes(self._buf)
+        self._buf.clear()
+        self._f.write(_CHUNK_HEADER.pack(len(payload), zlib.adler32(payload)))
+        self._f.write(payload)
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._last_flush = self.clock()
+
+    def close(self):
+        if self._f is not None:
+            self.flush()
+            self._f.close()
+            self._f = None
+
+
+def replay(directory: str) -> Iterator[Tuple[bytes, bytes, int, float]]:
+    """Iterate all (namespace, series_id, time_ns, value) entries across
+    commit log files in order, dropping any torn tail chunk
+    (commitlog/reader.go + iterator.go)."""
+    if not os.path.isdir(directory):
+        return
+    files = sorted(f for f in os.listdir(directory) if f.startswith("commitlog-"))
+    for fname in files:
+        series: List[Tuple[bytes, bytes]] = []
+        with open(os.path.join(directory, fname), "rb") as f:
+            data = f.read()
+        pos = 0
+        while pos + _CHUNK_HEADER.size <= len(data):
+            plen, checksum = _CHUNK_HEADER.unpack_from(data, pos)
+            body = data[pos + _CHUNK_HEADER.size : pos + _CHUNK_HEADER.size + plen]
+            if len(body) < plen or zlib.adler32(body) != checksum:
+                break  # torn/corrupt tail chunk: stop replaying this file
+            pos += _CHUNK_HEADER.size + plen
+            epos = 0
+            while epos < len(body):
+                tag = body[epos]
+                if tag == 0:
+                    _, ns_len, id_len = _META_ENTRY.unpack_from(body, epos)
+                    epos += _META_ENTRY.size
+                    ns = body[epos : epos + ns_len]
+                    epos += ns_len
+                    sid = body[epos : epos + id_len]
+                    epos += id_len
+                    series.append((ns, sid))
+                else:
+                    _, ref, t_ns, value = _DATA_ENTRY.unpack_from(body, epos)
+                    epos += _DATA_ENTRY.size
+                    ns, sid = series[ref]
+                    yield ns, sid, t_ns, value

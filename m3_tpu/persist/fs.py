@@ -1,0 +1,297 @@
+"""Fileset persistence (reference: src/dbnode/persist/fs).
+
+One fileset per (namespace, shard, block start), same seven-file invariant
+structure as the reference's writer (persist/fs/write.go:53-78):
+
+  info.json        fileset metadata (block start, window, time unit, counts)
+  data.bin         packed u32 codewords, row-major [S, MW] (mmap-read)
+  index.bin        per-series entries sorted by id: {id, row, nbits,
+                   npoints, data checksum} (write.go:283-290 equivalent)
+  summaries.bin    every Nth index entry for coarse seek (summaries file)
+  bloom.bin        bloom filter over ids (bloom_filter.go)
+  digest.json      adler32 of every file above (dbnode/digest)
+  checkpoint.json  digest-of-digests, written LAST — a fileset without a
+                   valid checkpoint is incomplete and ignored (write.go:44)
+
+Readers mmap data.bin (np.memmap; x/mmap analog); the Seeker answers
+point-id lookups via bloom -> summaries -> index binary search -> row slice
+(seek.go:159,332 flow). Volumes: snapshots write the same structure under a
+`snapshot-<version>` suffix with snapshot metadata (snapshot_metadata_write.go)."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import struct
+import zlib
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ..storage.block import SealedBlock
+from ..utils import xtime
+from ..utils.bloom import BloomFilter
+
+INFO_FILE = "info.json"
+DATA_FILE = "data.bin"
+INDEX_FILE = "index.bin"
+SUMMARIES_FILE = "summaries.bin"
+BLOOM_FILE = "bloom.bin"
+DIGEST_FILE = "digest.json"
+CHECKPOINT_FILE = "checkpoint.json"
+SUMMARY_EVERY = 32
+
+_IDX_HEADER = struct.Struct("<IIiiI")  # id_len, row, nbits, npoints, checksum
+
+
+def fileset_dir(root: str, namespace: bytes, shard: int, block_start: int,
+                snapshot_version: Optional[int] = None) -> str:
+    kind = f"snapshot-{snapshot_version}" if snapshot_version is not None else "fileset"
+    return os.path.join(root, namespace.decode(), f"shard-{shard:05d}", f"{kind}-{block_start}")
+
+
+def _adler(path: str) -> int:
+    a = 1
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                return a
+            a = zlib.adler32(chunk, a)
+
+
+class FilesetWriter:
+    """persist/fs/write.go DataFileSetWriter equivalent."""
+
+    def __init__(self, root: str):
+        self.root = root
+
+    def write(self, namespace: bytes, shard: int, blk: SealedBlock, registry,
+              snapshot_version: Optional[int] = None) -> str:
+        d = fileset_dir(self.root, namespace, shard, blk.block_start, snapshot_version)
+        tmp = d + ".tmp"
+        os.makedirs(tmp, exist_ok=True)
+
+        words = np.ascontiguousarray(blk.words, np.uint32)
+        with open(os.path.join(tmp, DATA_FILE), "wb") as f:
+            f.write(words.tobytes())
+
+        # Index entries sorted by series id (the write path buffers and sorts,
+        # write.go WriteAll) with per-row data checksums.
+        ids = [registry.id_of(int(si)) for si in blk.series_indices]
+        order = sorted(range(len(ids)), key=lambda i: ids[i])
+        bloom = BloomFilter.for_capacity(len(ids))
+        bloom.add_batch([ids[i] for i in order])
+        index_offsets: List[Tuple[bytes, int]] = []
+        with open(os.path.join(tmp, INDEX_FILE), "wb") as f:
+            for i in order:
+                row_bytes = words[i].tobytes()
+                entry = _IDX_HEADER.pack(
+                    len(ids[i]), i, int(blk.nbits[i]), int(blk.npoints[i]),
+                    zlib.adler32(row_bytes),
+                )
+                index_offsets.append((ids[i], f.tell()))
+                f.write(entry)
+                f.write(ids[i])
+        with open(os.path.join(tmp, SUMMARIES_FILE), "wb") as f:
+            for sid, off in index_offsets[::SUMMARY_EVERY]:
+                f.write(struct.pack("<IQ", len(sid), off))
+                f.write(sid)
+        with open(os.path.join(tmp, BLOOM_FILE), "wb") as f:
+            f.write(bloom.tobytes())
+
+        info = {
+            "block_start": blk.block_start,
+            "window": blk.window,
+            "time_unit": int(blk.time_unit),
+            "num_series": len(ids),
+            "max_words": int(words.shape[1]),
+            "block_checksum": blk.checksum,
+            "bloom_m": bloom.m,
+            "bloom_k": bloom.k,
+            "snapshot_version": snapshot_version,
+            "volume_type": "snapshot" if snapshot_version is not None else "flush",
+        }
+        with open(os.path.join(tmp, INFO_FILE), "w") as f:
+            json.dump(info, f)
+
+        digests = {
+            name: _adler(os.path.join(tmp, name))
+            for name in (INFO_FILE, DATA_FILE, INDEX_FILE, SUMMARIES_FILE, BLOOM_FILE)
+        }
+        with open(os.path.join(tmp, DIGEST_FILE), "w") as f:
+            json.dump(digests, f)
+        # Checkpoint LAST: its presence + matching digest-of-digests marks the
+        # fileset durable (write.go checkpoint semantics).
+        with open(os.path.join(tmp, CHECKPOINT_FILE), "w") as f:
+            json.dump({"digest": _adler(os.path.join(tmp, DIGEST_FILE))}, f)
+
+        if os.path.exists(d):
+            import shutil
+
+            shutil.rmtree(d)
+        os.replace(tmp, d)
+        return d
+
+
+def fileset_complete(d: str) -> bool:
+    """Checkpoint present and digest chain intact (read.go validation)."""
+    cp = os.path.join(d, CHECKPOINT_FILE)
+    dg = os.path.join(d, DIGEST_FILE)
+    if not (os.path.exists(cp) and os.path.exists(dg)):
+        return False
+    try:
+        with open(cp) as f:
+            want = json.load(f)["digest"]
+        return _adler(dg) == want
+    except (ValueError, KeyError, OSError):
+        return False
+
+
+@dataclasses.dataclass
+class IndexEntry:
+    id: bytes
+    row: int
+    nbits: int
+    npoints: int
+    checksum: int
+
+
+class FilesetReader:
+    """persist/fs/read.go DataFileSetReader: full-fileset scans (bootstrap)."""
+
+    def __init__(self, path: str, verify: bool = True):
+        if not fileset_complete(path):
+            raise FileNotFoundError(f"incomplete or missing fileset at {path}")
+        self.path = path
+        with open(os.path.join(path, INFO_FILE)) as f:
+            self.info = json.load(f)
+        if verify:
+            with open(os.path.join(path, DIGEST_FILE)) as f:
+                digests = json.load(f)
+            for name, want in digests.items():
+                if _adler(os.path.join(path, name)) != want:
+                    raise IOError(f"digest mismatch for {name} in {path}")
+        self._words = np.memmap(
+            os.path.join(path, DATA_FILE), dtype=np.uint32, mode="r",
+            shape=(self.info["num_series"], self.info["max_words"]),
+        )
+        self.entries = list(self._read_index())
+
+    def _read_index(self) -> Iterator[IndexEntry]:
+        with open(os.path.join(self.path, INDEX_FILE), "rb") as f:
+            data = f.read()
+        pos = 0
+        while pos < len(data):
+            id_len, row, nbits, npoints, checksum = _IDX_HEADER.unpack_from(data, pos)
+            pos += _IDX_HEADER.size
+            sid = data[pos : pos + id_len]
+            pos += id_len
+            yield IndexEntry(sid, row, nbits, npoints, checksum)
+
+    def to_block(self) -> Tuple[SealedBlock, List[bytes]]:
+        """Load the whole fileset back as a SealedBlock + ids by row order.
+
+        series_indices are row numbers; callers remap into their registry
+        (Shard.load_block)."""
+        info = self.info
+        rows = sorted(self.entries, key=lambda e: e.row)
+        nbits = np.array([e.nbits for e in rows], np.int32)
+        npoints = np.array([e.npoints for e in rows], np.int32)
+        blk = SealedBlock(
+            block_start=info["block_start"],
+            window=info["window"],
+            series_indices=np.arange(len(rows), dtype=np.int32),
+            words=np.asarray(self._words),
+            nbits=nbits,
+            npoints=npoints,
+            time_unit=xtime.Unit(info["time_unit"]),
+            checksum=info["block_checksum"],
+        )
+        return blk, [e.id for e in rows]
+
+
+class Seeker:
+    """persist/fs/seek.go: point-id lookup without loading the fileset.
+
+    bloom (negative fast path) -> in-memory sorted index (summaries would
+    page the index; ours is small enough to hold) -> mmap row slice."""
+
+    def __init__(self, path: str):
+        if not fileset_complete(path):
+            raise FileNotFoundError(f"incomplete or missing fileset at {path}")
+        self.path = path
+        with open(os.path.join(path, INFO_FILE)) as f:
+            self.info = json.load(f)
+        with open(os.path.join(path, BLOOM_FILE), "rb") as f:
+            self.bloom = BloomFilter.frombytes(f.read(), self.info["bloom_m"], self.info["bloom_k"])
+        reader = FilesetReader(path, verify=False)
+        self._entries = sorted(reader.entries, key=lambda e: e.id)
+        self._ids = [e.id for e in self._entries]
+        self._words = reader._words
+
+    def seek(self, series_id: bytes) -> Optional[Tuple[np.ndarray, int, int]]:
+        """-> (packed words row, nbits, npoints) or None (seek.go:332 SeekByID)."""
+        if series_id not in self.bloom:
+            return None
+        import bisect
+
+        i = bisect.bisect_left(self._ids, series_id)
+        if i >= len(self._ids) or self._ids[i] != series_id:
+            return None
+        e = self._entries[i]
+        row = np.asarray(self._words[e.row])
+        if zlib.adler32(row.tobytes()) != e.checksum:
+            raise IOError(f"checksum mismatch for {series_id!r} in {self.path}")
+        return row, e.nbits, e.npoints
+
+
+class PersistManager:
+    """persist_manager.go: the flush-side entry point the database calls."""
+
+    def __init__(self, root: str):
+        self.root = root
+        self.writer = FilesetWriter(root)
+
+    def write_block(self, namespace: bytes, shard: int, blk: SealedBlock, registry) -> str:
+        return self.writer.write(namespace, shard, blk, registry)
+
+    def write_snapshot(self, namespace: bytes, shard: int, blk: SealedBlock, registry,
+                       version: int) -> str:
+        return self.writer.write(namespace, shard, blk, registry, snapshot_version=version)
+
+    def list_filesets(self, namespace: bytes, shard: int) -> List[Tuple[int, str]]:
+        """Complete flush filesets for a shard: [(block_start, path)]."""
+        d = os.path.join(self.root, namespace.decode(), f"shard-{shard:05d}")
+        out = []
+        if not os.path.isdir(d):
+            return out
+        for name in os.listdir(d):
+            if name.startswith("fileset-"):
+                path = os.path.join(d, name)
+                if fileset_complete(path):
+                    out.append((int(name.split("-")[-1]), path))
+        return sorted(out)
+
+    def list_snapshots(self, namespace: bytes, shard: int) -> List[Tuple[int, int, str]]:
+        """[(block_start, version, path)] for complete snapshots."""
+        d = os.path.join(self.root, namespace.decode(), f"shard-{shard:05d}")
+        out = []
+        if not os.path.isdir(d):
+            return out
+        for name in os.listdir(d):
+            if name.startswith("snapshot-"):
+                path = os.path.join(d, name)
+                if fileset_complete(path):
+                    _, version, block_start = name.split("-")
+                    out.append((int(block_start), int(version), path))
+        return sorted(out)
+
+    def shards_with_data(self, namespace: bytes) -> List[int]:
+        d = os.path.join(self.root, namespace.decode())
+        if not os.path.isdir(d):
+            return []
+        return sorted(
+            int(name.split("-")[1]) for name in os.listdir(d) if name.startswith("shard-")
+        )
