@@ -1,0 +1,130 @@
+"""Per-namespace time-partitioned reverse index (reference:
+src/dbnode/storage/index nsIndex: per-blockstart index blocks, mutable
+segments sealed and compacted into immutable segments, queried via m3ninx
+searchers).
+
+Writes land in the active block's mutable segment (async-batched in the
+reference via index_insert_queue; synchronous here — the storage write path
+already batches). Tick seals past blocks (mutable -> immutable compaction)
+and expires blocks beyond retention."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from ..utils import xtime
+from .query import Query
+from .segment import Document, ImmutableSegment, MutableSegment, execute
+
+
+class IndexBlock:
+    """index/block.go: one index block's segments."""
+
+    def __init__(self, block_start: int):
+        self.block_start = block_start
+        self.mutable = MutableSegment()
+        self.immutable: List[ImmutableSegment] = []
+        self.sealed = False
+
+    def segments(self):
+        segs = list(self.immutable)
+        if len(self.mutable):
+            segs.append(self.mutable)
+        return segs
+
+    def seal(self):
+        """Mutable -> immutable compaction; merge accumulated immutables
+        (index/compaction/compactor.go plan: fewest, largest segments)."""
+        if len(self.mutable):
+            self.immutable.append(ImmutableSegment.from_mutable(self.mutable))
+            self.mutable = MutableSegment()
+        if len(self.immutable) > 1:
+            self.immutable = [ImmutableSegment.merge(self.immutable)]
+        self.sealed = True
+
+    def query(self, q: Query) -> Set[bytes]:
+        out: Set[bytes] = set()
+        for seg in self.segments():
+            for pos in execute(seg, q):
+                out.add(seg.doc(int(pos)).id)
+        return out
+
+
+def tags_to_doc(series_id: bytes, tags: dict) -> Document:
+    """index/convert: series id + tags -> indexed document."""
+    fields = tuple(sorted((k, v) for k, v in tags.items()))
+    return Document(series_id, fields)
+
+
+class NamespaceIndex:
+    def __init__(self, block_size_ns: int = 4 * xtime.HOUR,
+                 clock=None):
+        self.block_size_ns = block_size_ns
+        self.clock = clock
+        self.blocks: Dict[int, IndexBlock] = {}
+        self._known: Set[bytes] = set()
+
+    def _block_for(self, t_ns: int) -> IndexBlock:
+        bs = xtime.truncate(t_ns, self.block_size_ns)
+        blk = self.blocks.get(bs)
+        if blk is None:
+            blk = self.blocks[bs] = IndexBlock(bs)
+        return blk
+
+    def insert(self, series_id: bytes, tags: dict, t_ns: Optional[int] = None):
+        """nsIndex.WriteBatch analog (per new series)."""
+        if series_id in self._known:
+            return
+        self._known.add(series_id)
+        if t_ns is None:
+            t_ns = self.clock() if self.clock else 0
+        self._block_for(t_ns).mutable.insert(tags_to_doc(series_id, tags))
+
+    def insert_batch(self, items: List[Tuple[bytes, dict]], t_ns: int):
+        blk = self._block_for(t_ns)
+        for sid, tags in items:
+            if sid not in self._known:
+                self._known.add(sid)
+                blk.mutable.insert(tags_to_doc(sid, tags))
+
+    def query(self, q: Query, start_ns: int = 0, end_ns: int = 2**63 - 1) -> List[bytes]:
+        """nsIndex.Query: union across blocks overlapping [start, end)."""
+        out: Set[bytes] = set()
+        for bs, blk in self.blocks.items():
+            if bs + self.block_size_ns <= start_ns or bs >= end_ns:
+                continue
+            out |= blk.query(q)
+        return sorted(out)
+
+    def aggregate_terms(self, field: bytes, start_ns: int = 0, end_ns: int = 2**63 - 1) -> List[bytes]:
+        """Distinct values for a tag (complete-tags / tag-values API)."""
+        vals: Set[bytes] = set()
+        for bs, blk in self.blocks.items():
+            if bs + self.block_size_ns <= start_ns or bs >= end_ns:
+                continue
+            for seg in blk.segments():
+                vals.update(seg.terms(field))
+        return sorted(vals)
+
+    def fields(self, start_ns: int = 0, end_ns: int = 2**63 - 1) -> List[bytes]:
+        names: Set[bytes] = set()
+        for bs, blk in self.blocks.items():
+            if bs + self.block_size_ns <= start_ns or bs >= end_ns:
+                continue
+            for seg in blk.segments():
+                names.update(seg.fields())
+        return sorted(names)
+
+    def tick(self, now_ns: int, retention_ns: int):
+        """Seal past blocks; expire blocks beyond retention."""
+        for bs, blk in list(self.blocks.items()):
+            if not blk.sealed and bs + self.block_size_ns <= now_ns:
+                blk.seal()
+            if bs + self.block_size_ns <= now_ns - retention_ns:
+                for seg in self.blocks[bs].segments():
+                    for i in range(len(seg)):
+                        self._known.discard(seg.doc(i).id)
+                del self.blocks[bs]

@@ -1,0 +1,80 @@
+"""Index query DSL (reference: src/m3ninx/idx/query.go — term / regexp /
+conjunction / disjunction / negation builders compiled into searchers)."""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Tuple
+
+
+class Query:
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class AllQuery(Query):
+    """Matches every document (m3ninx all searcher)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class TermQuery(Query):
+    field: bytes
+    value: bytes
+
+
+@dataclasses.dataclass(frozen=True)
+class RegexpQuery(Query):
+    field: bytes
+    pattern: bytes
+
+    def compiled(self):
+        return re.compile(self.pattern)
+
+
+@dataclasses.dataclass(frozen=True)
+class ConjunctionQuery(Query):
+    queries: Tuple[Query, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class DisjunctionQuery(Query):
+    queries: Tuple[Query, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class NegationQuery(Query):
+    query: Query
+
+
+def new_term(field: bytes, value: bytes) -> TermQuery:
+    return TermQuery(field, value)
+
+
+def new_regexp(field: bytes, pattern: bytes) -> RegexpQuery:
+    re.compile(pattern)  # validate eagerly like idx.NewRegexpQuery
+    return RegexpQuery(field, pattern)
+
+
+def new_conjunction(*queries: Query) -> Query:
+    flat = []
+    for q in queries:
+        if isinstance(q, ConjunctionQuery):
+            flat.extend(q.queries)
+        else:
+            flat.append(q)
+    return flat[0] if len(flat) == 1 else ConjunctionQuery(tuple(flat))
+
+
+def new_disjunction(*queries: Query) -> Query:
+    flat = []
+    for q in queries:
+        if isinstance(q, DisjunctionQuery):
+            flat.extend(q.queries)
+        else:
+            flat.append(q)
+    return flat[0] if len(flat) == 1 else DisjunctionQuery(tuple(flat))
+
+
+def new_negation(q: Query) -> NegationQuery:
+    return NegationQuery(q)

@@ -1,0 +1,211 @@
+"""Index segments (reference: src/m3ninx/index/segment).
+
+MutableSegment mirrors segment/mem (hash-map terms dict -> postings); the
+ImmutableSegment is the TPU-idiomatic stand-in for the FST segment
+(segment/fst/segment.go): per-field SORTED term arrays searched by binary
+search, postings as sorted int32 numpy arrays. Set algebra over postings
+(union/intersect/difference) is vectorized numpy — the batch-friendly
+equivalent of roaring-bitmap ops (postings/roaring) — and term-range scans
+for regexps run the compiled automaton over the sorted term list the way
+fst/regexp walks the automaton over the FST."""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .query import (
+    AllQuery,
+    ConjunctionQuery,
+    DisjunctionQuery,
+    NegationQuery,
+    Query,
+    RegexpQuery,
+    TermQuery,
+)
+
+EMPTY = np.zeros(0, np.int32)
+
+
+@dataclasses.dataclass(frozen=True)
+class Document:
+    """m3ninx/doc Document: opaque id + (name, value) fields."""
+
+    id: bytes
+    fields: Tuple[Tuple[bytes, bytes], ...]
+
+
+class MutableSegment:
+    """segment/mem: concurrent terms dict of field -> value -> postings."""
+
+    def __init__(self):
+        self._docs: List[Document] = []
+        self._ids: Dict[bytes, int] = {}
+        self._terms: Dict[bytes, Dict[bytes, List[int]]] = {}
+
+    def __len__(self) -> int:
+        return len(self._docs)
+
+    def insert(self, doc: Document) -> int:
+        existing = self._ids.get(doc.id)
+        if existing is not None:
+            return existing
+        pos = len(self._docs)
+        self._docs.append(doc)
+        self._ids[doc.id] = pos
+        for name, value in doc.fields:
+            self._terms.setdefault(name, {}).setdefault(value, []).append(pos)
+        return pos
+
+    def insert_batch(self, docs: Iterable[Document]) -> List[int]:
+        return [self.insert(d) for d in docs]
+
+    def doc(self, pos: int) -> Document:
+        return self._docs[pos]
+
+    def all_postings(self) -> np.ndarray:
+        return np.arange(len(self._docs), dtype=np.int32)
+
+    def term_postings(self, field: bytes, value: bytes) -> np.ndarray:
+        vals = self._terms.get(field)
+        if not vals or value not in vals:
+            return EMPTY
+        return np.asarray(vals[value], np.int32)
+
+    def regexp_postings(self, field: bytes, pattern) -> np.ndarray:
+        vals = self._terms.get(field)
+        if not vals:
+            return EMPTY
+        out = [np.asarray(p, np.int32) for v, p in vals.items() if pattern.fullmatch(v)]
+        if not out:
+            return EMPTY
+        return np.unique(np.concatenate(out))
+
+    def fields(self) -> List[bytes]:
+        return sorted(self._terms)
+
+    def terms(self, field: bytes) -> List[bytes]:
+        return sorted(self._terms.get(field, ()))
+
+
+class ImmutableSegment:
+    """FST-segment equivalent: sorted terms + concatenated postings arrays."""
+
+    def __init__(self, docs: Sequence[Document],
+                 fields: Dict[bytes, Tuple[List[bytes], List[np.ndarray]]]):
+        self._docs = list(docs)
+        # field -> (sorted terms list, postings offsets, concatenated postings)
+        self._fields: Dict[bytes, Tuple[List[bytes], np.ndarray, np.ndarray]] = {}
+        for name, (terms, plists) in fields.items():
+            lens = np.fromiter((len(p) for p in plists), np.int64, len(plists))
+            offs = np.concatenate([[0], np.cumsum(lens)])
+            cat = np.concatenate(plists) if plists else EMPTY
+            self._fields[name] = (terms, offs, cat.astype(np.int32))
+
+    def __len__(self) -> int:
+        return len(self._docs)
+
+    @staticmethod
+    def from_mutable(seg: MutableSegment) -> "ImmutableSegment":
+        """Builder path: batch docs -> sorted fields/terms (segment/builder)."""
+        fields = {}
+        for name in seg.fields():
+            terms = seg.terms(name)
+            plists = [np.unique(seg.term_postings(name, t)) for t in terms]
+            fields[name] = (terms, plists)
+        return ImmutableSegment(seg._docs, fields)
+
+    @staticmethod
+    def merge(segments: Sequence["ImmutableSegment"]) -> "ImmutableSegment":
+        """Compaction: merge sorted runs (index/compaction/compactor.go).
+
+        Doc ids are offset per input segment; duplicate document IDs across
+        segments are kept (the namespace dedups at write time)."""
+        docs: List[Document] = []
+        offsets = []
+        for s in segments:
+            offsets.append(len(docs))
+            docs.extend(s._docs)
+        fields: Dict[bytes, Dict[bytes, List[np.ndarray]]] = {}
+        for s, off in zip(segments, offsets):
+            for name, (terms, offs, cat) in s._fields.items():
+                tmap = fields.setdefault(name, {})
+                for i, t in enumerate(terms):
+                    tmap.setdefault(t, []).append(cat[offs[i] : offs[i + 1]] + off)
+        out = {}
+        for name, tmap in fields.items():
+            terms = sorted(tmap)
+            plists = [np.unique(np.concatenate(tmap[t])) for t in terms]
+            out[name] = (terms, plists)
+        return ImmutableSegment(docs, out)
+
+    def doc(self, pos: int) -> Document:
+        return self._docs[pos]
+
+    def all_postings(self) -> np.ndarray:
+        return np.arange(len(self._docs), dtype=np.int32)
+
+    def term_postings(self, field: bytes, value: bytes) -> np.ndarray:
+        entry = self._fields.get(field)
+        if entry is None:
+            return EMPTY
+        terms, offs, cat = entry
+        import bisect
+
+        i = bisect.bisect_left(terms, value)
+        if i >= len(terms) or terms[i] != value:
+            return EMPTY
+        return cat[offs[i] : offs[i + 1]]
+
+    def regexp_postings(self, field: bytes, pattern) -> np.ndarray:
+        entry = self._fields.get(field)
+        if entry is None:
+            return EMPTY
+        terms, offs, cat = entry
+        parts = [cat[offs[i] : offs[i + 1]] for i, t in enumerate(terms) if pattern.fullmatch(t)]
+        if not parts:
+            return EMPTY
+        return np.unique(np.concatenate(parts))
+
+    def fields(self) -> List[bytes]:
+        return sorted(self._fields)
+
+    def terms(self, field: bytes) -> List[bytes]:
+        entry = self._fields.get(field)
+        return list(entry[0]) if entry else []
+
+
+def execute(seg, query: Query) -> np.ndarray:
+    """Boolean searcher over one segment (m3ninx/search/executor)."""
+    if isinstance(query, AllQuery):
+        return seg.all_postings()
+    if isinstance(query, TermQuery):
+        return seg.term_postings(query.field, query.value)
+    if isinstance(query, RegexpQuery):
+        return seg.regexp_postings(query.field, query.compiled())
+    if isinstance(query, ConjunctionQuery):
+        neg = [q for q in query.queries if isinstance(q, NegationQuery)]
+        pos = [q for q in query.queries if not isinstance(q, NegationQuery)]
+        if not pos:
+            acc = seg.all_postings()
+        else:
+            acc = execute(seg, pos[0])
+            for q in pos[1:]:
+                if not len(acc):
+                    return EMPTY
+                acc = np.intersect1d(acc, execute(seg, q), assume_unique=False)
+        for q in neg:
+            acc = np.setdiff1d(acc, execute(seg, q.query), assume_unique=False)
+        return acc.astype(np.int32)
+    if isinstance(query, DisjunctionQuery):
+        parts = [execute(seg, q) for q in query.queries]
+        parts = [p for p in parts if len(p)]
+        if not parts:
+            return EMPTY
+        return np.unique(np.concatenate(parts)).astype(np.int32)
+    if isinstance(query, NegationQuery):
+        return np.setdiff1d(seg.all_postings(), execute(seg, query.query)).astype(np.int32)
+    raise TypeError(f"unknown query type {type(query)}")

@@ -1,0 +1,107 @@
+"""Inverted index: segments, boolean search, namespace index lifecycle
+(reference semantics from src/m3ninx and src/dbnode/storage/index)."""
+
+import numpy as np
+
+from m3_tpu.index import query as idx
+from m3_tpu.index.namespace_index import NamespaceIndex, tags_to_doc
+from m3_tpu.index.segment import (
+    Document,
+    ImmutableSegment,
+    MutableSegment,
+    execute,
+)
+from m3_tpu.storage.database import Database
+from m3_tpu.storage.namespace import NamespaceOptions
+from m3_tpu.parallel.sharding import ShardSet
+from m3_tpu.utils import xtime
+
+T0 = 1_600_000_000 * xtime.SECOND
+
+
+def build_segment(immutable: bool):
+    seg = MutableSegment()
+    seg.insert(Document(b"cpu;host=a", ((b"host", b"a"), (b"role", b"db"))))
+    seg.insert(Document(b"cpu;host=b", ((b"host", b"b"), (b"role", b"db"))))
+    seg.insert(Document(b"mem;host=a", ((b"host", b"a"), (b"role", b"web"))))
+    return ImmutableSegment.from_mutable(seg) if immutable else seg
+
+
+def _ids(seg, q):
+    return sorted(seg.doc(int(p)).id for p in execute(seg, q))
+
+
+def test_term_and_boolean_queries():
+    for immutable in (False, True):
+        seg = build_segment(immutable)
+        assert _ids(seg, idx.new_term(b"host", b"a")) == [b"cpu;host=a", b"mem;host=a"]
+        assert _ids(seg, idx.new_conjunction(
+            idx.new_term(b"host", b"a"), idx.new_term(b"role", b"db"))) == [b"cpu;host=a"]
+        assert _ids(seg, idx.new_disjunction(
+            idx.new_term(b"role", b"web"), idx.new_term(b"host", b"b"))) == [
+            b"cpu;host=b", b"mem;host=a"]
+        assert _ids(seg, idx.new_conjunction(
+            idx.new_term(b"role", b"db"), idx.new_negation(idx.new_term(b"host", b"a")))) == [
+            b"cpu;host=b"]
+        assert _ids(seg, idx.AllQuery()) == [b"cpu;host=a", b"cpu;host=b", b"mem;host=a"]
+        assert _ids(seg, idx.new_term(b"host", b"zzz")) == []
+
+
+def test_regexp_query():
+    for immutable in (False, True):
+        seg = build_segment(immutable)
+        assert _ids(seg, idx.new_regexp(b"role", b"d.*")) == [b"cpu;host=a", b"cpu;host=b"]
+        assert _ids(seg, idx.new_regexp(b"host", b"[ab]")) == [
+            b"cpu;host=a", b"cpu;host=b", b"mem;host=a"]
+
+
+def test_segment_merge_compaction():
+    s1 = MutableSegment()
+    s1.insert(Document(b"a", ((b"t", b"1"),)))
+    s2 = MutableSegment()
+    s2.insert(Document(b"b", ((b"t", b"1"),)))
+    s2.insert(Document(b"c", ((b"t", b"2"),)))
+    merged = ImmutableSegment.merge(
+        [ImmutableSegment.from_mutable(s1), ImmutableSegment.from_mutable(s2)]
+    )
+    assert len(merged) == 3
+    assert sorted(merged.doc(int(p)).id for p in execute(merged, idx.new_term(b"t", b"1"))) == [b"a", b"b"]
+    assert merged.terms(b"t") == [b"1", b"2"]
+
+
+def test_namespace_index_lifecycle():
+    nsi = NamespaceIndex(block_size_ns=4 * xtime.HOUR)
+    nsi.insert(b"cpu;host=a", {b"host": b"a"}, T0)
+    nsi.insert(b"cpu;host=b", {b"host": b"b"}, T0)
+    nsi.insert(b"cpu;host=a", {b"host": b"a"}, T0)  # dedup
+    assert nsi.query(idx.new_term(b"host", b"a")) == [b"cpu;host=a"]
+    assert nsi.aggregate_terms(b"host") == [b"a", b"b"]
+    assert nsi.fields() == [b"host"]
+
+    # Seal on tick; queries still work against the immutable segment.
+    nsi.tick(T0 + 5 * xtime.HOUR, retention_ns=2 * xtime.DAY)
+    blk = next(iter(nsi.blocks.values()))
+    assert blk.sealed and len(blk.immutable) == 1 and len(blk.mutable) == 0
+    assert nsi.query(idx.new_term(b"host", b"b")) == [b"cpu;host=b"]
+
+    # Expiry past retention frees the id for reinsertion.
+    nsi.tick(T0 + 3 * xtime.DAY, retention_ns=2 * xtime.DAY)
+    assert nsi.query(idx.AllQuery()) == []
+    nsi.insert(b"cpu;host=a", {b"host": b"a"}, T0 + 3 * xtime.DAY)
+    assert nsi.query(idx.new_term(b"host", b"a")) == [b"cpu;host=a"]
+
+
+def test_database_query_ids_via_index():
+    now = {"t": T0}
+    db = Database(ShardSet(8), clock=lambda: now["t"])
+    nsi = NamespaceIndex(clock=lambda: now["t"])
+    db.create_namespace(b"default", NamespaceOptions(), index=nsi)
+    db.write(b"default", b"reqs;dc=east;host=h1", T0, 1.0,
+             tags={b"dc": b"east", b"host": b"h1"})
+    db.write(b"default", b"reqs;dc=west;host=h2", T0, 2.0,
+             tags={b"dc": b"west", b"host": b"h2"})
+    got = db.query_ids(b"default", idx.new_term(b"dc", b"east"))
+    assert got == [b"reqs;dc=east;host=h1"]
+    # Read the matched series back.
+    t, v = db.read(b"default", got[0], T0 - 1, T0 + 1)
+    np.testing.assert_allclose(v, [1.0])
