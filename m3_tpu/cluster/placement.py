@@ -1,0 +1,273 @@
+"""Placement: instance <-> shard assignment (reference:
+src/cluster/placement — sharded algorithm placement/algo/sharded.go,
+shard states cluster/shard with Initializing/Available/Leaving and
+cutover/cutoff times, storage in KV as versioned snapshots).
+
+The balanced sharded algorithm assigns every virtual shard to
+replica-factor distinct instances, balancing counts; add/remove/replace
+move the minimum number of shards, marking moves Initializing on the
+receiver and Leaving on the donor so data can migrate before cutover."""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import heapq
+import json
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from . import kv as kvmod
+
+
+class ShardState(enum.Enum):
+    INITIALIZING = "initializing"
+    AVAILABLE = "available"
+    LEAVING = "leaving"
+
+
+@dataclasses.dataclass
+class ShardAssignment:
+    shard: int
+    state: ShardState = ShardState.INITIALIZING
+    source_id: Optional[str] = None  # donor instance for Initializing shards
+
+
+@dataclasses.dataclass
+class Instance:
+    id: str
+    endpoint: str
+    isolation_group: str = ""
+    weight: int = 1
+    zone: str = ""
+    shards: Dict[int, ShardAssignment] = dataclasses.field(default_factory=dict)
+
+    def shard_ids(self, states=(ShardState.INITIALIZING, ShardState.AVAILABLE)) -> List[int]:
+        return sorted(s.shard for s in self.shards.values() if s.state in states)
+
+
+@dataclasses.dataclass
+class Placement:
+    instances: Dict[str, Instance]
+    num_shards: int
+    replica_factor: int
+    version: int = 0
+
+    def replicas_for(self, shard: int,
+                     states=(ShardState.INITIALIZING, ShardState.AVAILABLE)) -> List[Instance]:
+        return [
+            inst for inst in self.instances.values()
+            if shard in inst.shards and inst.shards[shard].state in states
+        ]
+
+    def validate(self):
+        for s in range(self.num_shards):
+            owners = self.replicas_for(s)
+            if len(owners) != self.replica_factor:
+                raise ValueError(
+                    f"shard {s} has {len(owners)} replicas, want {self.replica_factor}"
+                )
+
+    def to_json(self) -> dict:
+        return {
+            "num_shards": self.num_shards,
+            "replica_factor": self.replica_factor,
+            "instances": {
+                iid: {
+                    "endpoint": inst.endpoint,
+                    "isolation_group": inst.isolation_group,
+                    "weight": inst.weight,
+                    "zone": inst.zone,
+                    "shards": [
+                        {"shard": a.shard, "state": a.state.value, "source_id": a.source_id}
+                        for a in inst.shards.values()
+                    ],
+                }
+                for iid, inst in self.instances.items()
+            },
+        }
+
+    @staticmethod
+    def from_json(obj: dict, version: int = 0) -> "Placement":
+        instances = {}
+        for iid, d in obj["instances"].items():
+            inst = Instance(iid, d["endpoint"], d.get("isolation_group", ""),
+                            d.get("weight", 1), d.get("zone", ""))
+            for a in d["shards"]:
+                inst.shards[a["shard"]] = ShardAssignment(
+                    a["shard"], ShardState(a["state"]), a.get("source_id")
+                )
+            instances[iid] = inst
+        return Placement(instances, obj["num_shards"], obj["replica_factor"], version)
+
+
+def _rebalance_targets(counts: Dict[str, int], num_shards: int, rf: int) -> Dict[str, int]:
+    total = num_shards * rf
+    n = len(counts)
+    base, extra = divmod(total, n)
+    targets = {}
+    for i, iid in enumerate(sorted(counts)):
+        targets[iid] = base + (1 if i < extra else 0)
+    return targets
+
+
+def initial_placement(instances: Sequence[Instance], num_shards: int,
+                      replica_factor: int) -> Placement:
+    """algo/sharded.go InitialPlacement: round-robin replicas across
+    instances, never two replicas of one shard on one instance."""
+    if len(instances) < replica_factor:
+        raise ValueError("fewer instances than replica factor")
+    insts = {i.id: dataclasses.replace(i, shards={}) for i in instances}
+    heap = [(0, iid) for iid in sorted(insts)]
+    heapq.heapify(heap)
+    for shard in range(num_shards):
+        picked = []
+        skipped = []
+        while len(picked) < replica_factor:
+            cnt, iid = heapq.heappop(heap)
+            picked.append((cnt, iid))
+        for cnt, iid in picked:
+            insts[iid].shards[shard] = ShardAssignment(shard, ShardState.AVAILABLE)
+            heapq.heappush(heap, (cnt + 1, iid))
+    p = Placement(insts, num_shards, replica_factor)
+    p.validate()
+    return p
+
+
+def add_instance(p: Placement, new: Instance) -> Placement:
+    """algo/sharded.go AddInstance: pull shards from the most loaded
+    instances onto the new one as Initializing with source donors."""
+    insts = {iid: dataclasses.replace(i, shards=dict(i.shards)) for iid, i in p.instances.items()}
+    newinst = dataclasses.replace(new, shards={})
+    insts[new.id] = newinst
+    counts = {iid: len(i.shards) for iid, i in insts.items()}
+    targets = _rebalance_targets(counts, p.num_shards, p.replica_factor)
+    want = targets[new.id]
+    donors = sorted((iid for iid in insts if iid != new.id),
+                    key=lambda i: -counts[i])
+    for donor_id in donors:
+        if len(newinst.shards) >= want:
+            break
+        donor = insts[donor_id]
+        surplus = counts[donor_id] - targets[donor_id]
+        movable = [s for s in donor.shards.values()
+                   if s.state == ShardState.AVAILABLE and s.shard not in newinst.shards]
+        for a in movable[: max(surplus, 0)]:
+            if len(newinst.shards) >= want:
+                break
+            donor.shards[a.shard] = ShardAssignment(a.shard, ShardState.LEAVING)
+            newinst.shards[a.shard] = ShardAssignment(a.shard, ShardState.INITIALIZING, donor_id)
+            counts[donor_id] -= 1
+    return Placement(insts, p.num_shards, p.replica_factor, p.version)
+
+
+def remove_instance(p: Placement, instance_id: str) -> Placement:
+    """algo/sharded.go RemoveInstance: redistribute its shards to the
+    least-loaded instances that don't already own them."""
+    if instance_id not in p.instances:
+        raise KeyError(instance_id)
+    insts = {iid: dataclasses.replace(i, shards=dict(i.shards))
+             for iid, i in p.instances.items() if iid != instance_id}
+    leaving = p.instances[instance_id]
+    heap = [(len(i.shards), iid) for iid, i in insts.items()]
+    heapq.heapify(heap)
+    for a in leaving.shards.values():
+        if a.state == ShardState.LEAVING:
+            continue
+        placed = False
+        buffer = []
+        while heap and not placed:
+            cnt, iid = heapq.heappop(heap)
+            if a.shard not in insts[iid].shards:
+                insts[iid].shards[a.shard] = ShardAssignment(
+                    a.shard, ShardState.INITIALIZING, instance_id
+                )
+                heapq.heappush(heap, (cnt + 1, iid))
+                placed = True
+            else:
+                buffer.append((cnt, iid))
+        for item in buffer:
+            heapq.heappush(heap, item)
+        if not placed:
+            raise ValueError(f"cannot place shard {a.shard}: all instances own it")
+    return Placement(insts, p.num_shards, p.replica_factor, p.version)
+
+
+def replace_instance(p: Placement, leaving_id: str, new: Instance) -> Placement:
+    """algo/sharded.go ReplaceInstance: the new instance inherits the
+    leaving instance's shards 1:1 (Initializing <- source)."""
+    if leaving_id not in p.instances:
+        raise KeyError(leaving_id)
+    insts = {iid: dataclasses.replace(i, shards=dict(i.shards)) for iid, i in p.instances.items()}
+    old = insts.pop(leaving_id)
+    newinst = dataclasses.replace(new, shards={})
+    for a in old.shards.values():
+        newinst.shards[a.shard] = ShardAssignment(a.shard, ShardState.INITIALIZING, leaving_id)
+    insts[new.id] = newinst
+    return Placement(insts, p.num_shards, p.replica_factor, p.version)
+
+
+def mark_shard_available(p: Placement, instance_id: str, shard: int) -> Placement:
+    """placement.Service MarkShardAvailable: Initializing -> Available on the
+    receiver, dropping the donor's Leaving assignment."""
+    insts = {iid: dataclasses.replace(i, shards=dict(i.shards)) for iid, i in p.instances.items()}
+    inst = insts[instance_id]
+    a = inst.shards.get(shard)
+    if a is None or a.state != ShardState.INITIALIZING:
+        raise ValueError(f"shard {shard} not initializing on {instance_id}")
+    if a.source_id and a.source_id in insts:
+        donor = insts[a.source_id]
+        da = donor.shards.get(shard)
+        if da is not None and da.state == ShardState.LEAVING:
+            del donor.shards[shard]
+    inst.shards[shard] = ShardAssignment(shard, ShardState.AVAILABLE)
+    return Placement(insts, p.num_shards, p.replica_factor, p.version)
+
+
+class PlacementService:
+    """KV-backed placement storage + operations (placement.Service)."""
+
+    def __init__(self, store, key: str = "_placement"):
+        self.store = store
+        self.key = key
+
+    def get(self) -> Optional[Placement]:
+        obj, version = kvmod.get_json(self.store, self.key)
+        if obj is None:
+            return None
+        return Placement.from_json(obj, version)
+
+    def _put(self, p: Placement, expect_version: int) -> Placement:
+        data = json.dumps(p.to_json()).encode()
+        new_version = self.store.check_and_set(self.key, expect_version, data)
+        p.version = new_version
+        return p
+
+    def init(self, instances: Sequence[Instance], num_shards: int, replica_factor: int) -> Placement:
+        return self._put(initial_placement(instances, num_shards, replica_factor), 0)
+
+    def add_instance(self, new: Instance) -> Placement:
+        cur = self.get()
+        return self._put(add_instance(cur, new), cur.version)
+
+    def remove_instance(self, instance_id: str) -> Placement:
+        cur = self.get()
+        return self._put(remove_instance(cur, instance_id), cur.version)
+
+    def replace_instance(self, leaving_id: str, new: Instance) -> Placement:
+        cur = self.get()
+        return self._put(replace_instance(cur, leaving_id, new), cur.version)
+
+    def mark_shard_available(self, instance_id: str, shard: int) -> Placement:
+        cur = self.get()
+        return self._put(mark_shard_available(cur, instance_id, shard), cur.version)
+
+    def mark_instance_available(self, instance_id: str) -> Placement:
+        cur = self.get()
+        p = cur
+        for a in list(cur.instances[instance_id].shards.values()):
+            if a.state == ShardState.INITIALIZING:
+                p = mark_shard_available(p, instance_id, a.shard)
+        return self._put(p, cur.version)
+
+    def watch(self):
+        return self.store.watch(self.key)

@@ -1,0 +1,171 @@
+"""Cluster plane: KV store, placement algorithms, services/election,
+topology (reference semantics from src/cluster and src/dbnode/topology)."""
+
+import pytest
+
+from m3_tpu.cluster import kv as kvmod
+from m3_tpu.cluster.kv import FileStore, MemStore
+from m3_tpu.cluster.placement import (
+    Instance,
+    PlacementService,
+    ShardState,
+    initial_placement,
+)
+from m3_tpu.cluster.services import (
+    CampaignState,
+    HeartbeatService,
+    LeaderService,
+    ServiceInstance,
+    Services,
+)
+from m3_tpu.cluster.topology import (
+    ConsistencyLevel,
+    DynamicTopology,
+    TopologyMap,
+    required_acks,
+)
+
+
+def test_kv_versions_and_cas():
+    s = MemStore()
+    assert s.get("k") is None
+    assert s.set("k", b"v1") == 1
+    assert s.set("k", b"v2") == 2
+    assert s.get("k").data == b"v2"
+    with pytest.raises(ValueError):
+        s.check_and_set("k", 1, b"v3")
+    assert s.check_and_set("k", 2, b"v3") == 3
+    with pytest.raises(KeyError):
+        s.set_if_not_exists("k", b"x")
+
+
+def test_kv_watch_and_callbacks():
+    s = MemStore()
+    w = s.watch("key")
+    assert not w.wait(0.01)
+    s.set("key", b"a")
+    assert w.wait(0.5)
+    seen = []
+    s.on_change("key", lambda k, v: seen.append(v.data))
+    assert seen == [b"a"]  # immediate delivery of current value
+    s.set("key", b"b")
+    assert seen == [b"a", b"b"]
+
+
+def test_file_store_reload(tmp_path):
+    path = str(tmp_path / "kv.json")
+    s1 = FileStore(path)
+    s1.set("a", b"hello")
+    s2 = FileStore(path)
+    assert s2.get("a").data == b"hello"
+
+
+def insts(n):
+    return [Instance(f"i{k}", f"host{k}:9000") for k in range(n)]
+
+
+def test_initial_placement_balanced():
+    p = initial_placement(insts(4), num_shards=64, replica_factor=3)
+    p.validate()
+    counts = [len(i.shards) for i in p.instances.values()]
+    assert max(counts) - min(counts) <= 1
+    assert sum(counts) == 64 * 3
+    # No instance owns the same shard twice (structural) and replicas differ.
+    for s in range(64):
+        owners = {i.id for i in p.replicas_for(s)}
+        assert len(owners) == 3
+
+
+def test_placement_add_remove_replace():
+    store = MemStore()
+    svc = PlacementService(store)
+    svc.init(insts(3), num_shards=30, replica_factor=3)
+
+    p = svc.add_instance(Instance("i3", "host3:9000"))
+    new = p.instances["i3"]
+    assert all(a.state == ShardState.INITIALIZING and a.source_id for a in new.shards.values())
+    # Receivers + leavers keep every shard at >= RF owners during the move.
+    for s in range(30):
+        assert len(p.replicas_for(s, states=tuple(ShardState))) >= 3
+
+    p = svc.mark_instance_available("i3")
+    assert all(a.state == ShardState.AVAILABLE for a in p.instances["i3"].shards.values())
+    p.validate()
+
+    before = set(svc.get().instances["i0"].shards)
+    p = svc.replace_instance("i0", Instance("i9", "host9:9000"))
+    assert "i0" not in p.instances
+    # Replacement inherits the leaving instance's shards 1:1.
+    assert set(p.instances["i9"].shards) == before
+    assert all(a.source_id == "i0" for a in p.instances["i9"].shards.values())
+    p = svc.mark_instance_available("i9")
+    p.validate()
+
+    p = svc.remove_instance("i9")
+    assert "i9" not in p.instances
+    p = svc.mark_instance_available("i1")
+    p = svc.mark_instance_available("i2")
+    p = svc.mark_instance_available("i3")
+    p.validate()
+
+
+def test_services_and_heartbeat():
+    now = {"t": 0}
+    store = MemStore()
+    hb = HeartbeatService(store, ttl_ns=100, clock=lambda: now["t"])
+    svcs = Services(store, hb)
+    svcs.advertise("m3dbnode", ServiceInstance("a", "h1:9000"))
+    svcs.advertise("m3dbnode", ServiceInstance("b", "h2:9000"))
+    assert [i.instance_id for i in svcs.instances("m3dbnode")] == ["a", "b"]
+    assert hb.alive_instances("m3dbnode") == ["a", "b"]
+    now["t"] = 150
+    hb.beat("m3dbnode", "b")
+    assert hb.alive_instances("m3dbnode") == ["b"]
+    svcs.unadvertise("m3dbnode", "a")
+    assert [i.instance_id for i in svcs.instances("m3dbnode")] == ["b"]
+
+
+def test_leader_election_failover():
+    now = {"t": 0}
+    store = MemStore()
+    e1 = LeaderService(store, "agg", "node1", lease_ttl_ns=100, clock=lambda: now["t"])
+    e2 = LeaderService(store, "agg", "node2", lease_ttl_ns=100, clock=lambda: now["t"])
+    assert e1.campaign() == CampaignState.LEADER
+    assert e2.campaign() == CampaignState.FOLLOWER
+    assert e2.leader() == "node1"
+    # Leader renews within TTL.
+    now["t"] = 50
+    assert e1.renew()
+    now["t"] = 120
+    assert e2.leader() == "node1"
+    # Lease expires without renewal -> follower takes over.
+    now["t"] = 200
+    assert e2.campaign() == CampaignState.LEADER
+    assert e1.campaign() == CampaignState.FOLLOWER
+    # Resign releases immediately.
+    e2.resign()
+    assert e1.campaign() == CampaignState.LEADER
+
+
+def test_topology_map_and_consistency():
+    p = initial_placement(insts(3), num_shards=16, replica_factor=3)
+    tm = TopologyMap(p)
+    for s in range(16):
+        assert len(tm.route_shard(s)) == 3
+    assert tm.majority_replicas() == 2
+    assert required_acks(ConsistencyLevel.ONE, 3) == 1
+    assert required_acks(ConsistencyLevel.MAJORITY, 3) == 2
+    assert required_acks(ConsistencyLevel.ALL, 3) == 3
+
+
+def test_dynamic_topology_reacts_to_placement_change():
+    store = MemStore()
+    svc = PlacementService(store)
+    svc.init(insts(3), num_shards=8, replica_factor=2)
+    topo = DynamicTopology(svc)
+    seen = []
+    topo.subscribe(lambda m: seen.append(len(m.hosts)))
+    assert seen == [3]
+    svc.add_instance(Instance("i3", "host3:9000"))
+    assert seen[-1] == 4
+    assert "i3" in topo.get().hosts
