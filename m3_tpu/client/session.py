@@ -1,0 +1,536 @@
+"""Replicating smart client (reference: src/dbnode/client/session.go).
+
+Session parity: topology-watching (session.go:536-543), per-host queues
+with op batching (host_queue.go), connection pools
+(connection_pool.go), write fanout to all shard replicas with quorum
+wait (session.go:867 Write -> :903 writeAttempt, majority :609),
+FetchTagged with consistency accumulation
+(fetch_tagged_results_accumulator.go), and the AdminSession peer
+metadata/block streaming used by bootstrap & repair
+(FetchBootstrapBlocksFromPeers; docs/m3db/architecture/peer_streaming.md)."""
+
+from __future__ import annotations
+
+import dataclasses
+import socket
+import threading
+import time
+from collections import Counter
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait as futures_wait
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..cluster.topology import (
+    ConsistencyLevel,
+    ReadConsistencyLevel,
+    required_acks,
+    required_reads,
+)
+from ..parallel.sharding import ShardSet
+from ..rpc import wire
+from .decode import ConflictStrategy, merge_replica_points, series_points
+
+
+class ConsistencyError(Exception):
+    """Not enough replica acks/responses to satisfy the consistency level."""
+
+
+class ConnectionError_(ConnectionError):
+    pass
+
+
+# ------------------------------------------------------------------ transport
+
+
+class Connection:
+    """One framed TCP connection (connection_pool.go conn)."""
+
+    def __init__(self, endpoint: str, timeout: float = 10.0):
+        host, port = endpoint.rsplit(":", 1)
+        self.sock = socket.create_connection((host, int(port)), timeout=timeout)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._msg_id = 0
+
+    def call(self, method: str, args: dict):
+        self._msg_id += 1
+        wire.write_frame(self.sock, {"m": method, "id": self._msg_id, "a": args})
+        resp = wire.read_frame(self.sock)
+        if not resp.get("ok"):
+            raise RemoteError(resp.get("err", "unknown remote error"))
+        return resp["r"]
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class RemoteError(Exception):
+    """Server-side failure relayed to the caller (not a transport error)."""
+
+
+class HostClient:
+    """Connection pool for one host (client/connection_pool.go)."""
+
+    def __init__(self, endpoint: str, pool_size: int = 4, timeout: float = 10.0):
+        self.endpoint = endpoint
+        self.timeout = timeout
+        self._free: List[Connection] = []
+        self._lock = threading.Lock()
+        self._sema = threading.Semaphore(pool_size)
+
+    def call(self, method: str, **args):
+        with self._sema:
+            with self._lock:
+                conn = self._free.pop() if self._free else None
+            if conn is None:
+                conn = Connection(self.endpoint, self.timeout)
+            try:
+                result = conn.call(method, args)
+            except RemoteError:
+                with self._lock:
+                    self._free.append(conn)
+                raise
+            except Exception:
+                conn.close()
+                raise
+            with self._lock:
+                self._free.append(conn)
+            return result
+
+    def health(self) -> bool:
+        try:
+            return bool(self.call("health")["ok"])
+        except Exception:  # noqa: BLE001
+            return False
+
+    def close(self):
+        with self._lock:
+            for c in self._free:
+                c.close()
+            self._free.clear()
+
+
+# ------------------------------------------------------------------- batching
+
+
+class _Completion:
+    """Quorum wait for one logical write (session writeState)."""
+
+    __slots__ = ("required", "total", "acks", "errors", "errs", "_cond")
+
+    def __init__(self, required: int, total: int):
+        self.required = required
+        self.total = total
+        self.acks = 0
+        self.errors = 0
+        self.errs: List[str] = []
+        self._cond = threading.Condition()
+
+    def ack(self):
+        with self._cond:
+            self.acks += 1
+            self._cond.notify_all()
+
+    def error(self, err: str):
+        with self._cond:
+            self.errors += 1
+            self.errs.append(err)
+            self._cond.notify_all()
+
+    def wait(self, timeout: float):
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while True:
+                if self.acks >= self.required:
+                    return
+                if self.acks + self.errors >= self.total:
+                    raise ConsistencyError(
+                        f"{self.acks}/{self.total} acks, need {self.required}: {self.errs}"
+                    )
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise ConsistencyError(
+                        f"timeout: {self.acks}/{self.total} acks, need {self.required}"
+                    )
+                self._cond.wait(remaining)
+
+
+@dataclasses.dataclass
+class _WriteOp:
+    ns: bytes
+    id: bytes
+    t_ns: int
+    value: float
+    tags: Optional[dict]
+    completion: _Completion
+
+
+class HostQueue:
+    """Per-host op queue: batches writes into write_batch RPCs
+    (client/host_queue.go). Drains whatever is queued on each wake, so
+    batching emerges under load without adding idle latency."""
+
+    def __init__(self, client: HostClient, max_batch: int = 256):
+        self.client = client
+        self.max_batch = max_batch
+        self._ops: List[_WriteOp] = []
+        self._cond = threading.Condition()
+        self._closed = False
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def enqueue(self, op: _WriteOp):
+        with self._cond:
+            if self._closed:
+                raise ConnectionError_("host queue closed")
+            self._ops.append(op)
+            self._cond.notify()
+
+    def _run(self):
+        while True:
+            with self._cond:
+                while not self._ops and not self._closed:
+                    self._cond.wait()
+                if self._closed and not self._ops:
+                    return
+                batch, self._ops = self._ops[: self.max_batch], self._ops[self.max_batch :]
+            self._flush(batch)
+
+    def _flush(self, batch: List[_WriteOp]):
+        by_ns: Dict[bytes, List[_WriteOp]] = {}
+        for op in batch:
+            by_ns.setdefault(op.ns, []).append(op)
+        for ns, ops in by_ns.items():
+            try:
+                self.client.call(
+                    "write_batch",
+                    ns=ns,
+                    ids=[o.id for o in ops],
+                    ts=np.array([o.t_ns for o in ops], np.int64),
+                    vals=np.array([o.value for o in ops], np.float64),
+                    tags=[o.tags for o in ops],
+                )
+            except Exception as e:  # noqa: BLE001 — propagate via completion
+                for o in ops:
+                    o.completion.error(f"{self.client.endpoint}: {e}")
+            else:
+                for o in ops:
+                    o.completion.ack()
+
+    def close(self):
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._thread.join(timeout=5)
+
+
+# -------------------------------------------------------------------- session
+
+
+@dataclasses.dataclass
+class SessionOptions:
+    write_consistency: ConsistencyLevel = ConsistencyLevel.MAJORITY
+    read_consistency: ReadConsistencyLevel = ReadConsistencyLevel.UNSTRICT_MAJORITY
+    conflict_strategy: ConflictStrategy = ConflictStrategy.LAST_PUSHED
+    timeout_s: float = 30.0
+    pool_size: int = 4
+    max_batch: int = 256
+
+
+class Session:
+    """client.Session: Write/WriteTagged/Fetch/FetchTagged over a topology."""
+
+    def __init__(self, topology, opts: SessionOptions = SessionOptions()):
+        self.topology = topology
+        self.opts = opts
+        self._clients: Dict[str, HostClient] = {}
+        self._queues: Dict[str, HostQueue] = {}
+        self._lock = threading.RLock()  # _queue -> _client nest on this lock
+        self._pool = ThreadPoolExecutor(max_workers=16)
+        self._shard_set: Optional[ShardSet] = None
+        if hasattr(topology, "subscribe"):
+            topology.subscribe(lambda _m: None)  # keep map fresh
+
+    # ---------------------------------------------------------------- routing
+
+    def _map(self):
+        m = self.topology.get()
+        if m is None:
+            raise ConnectionError_("no topology available")
+        return m
+
+    def _shards(self) -> ShardSet:
+        m = self._map()
+        if self._shard_set is None or self._shard_set.num_shards != m.num_shards:
+            self._shard_set = ShardSet(m.num_shards)
+        return self._shard_set
+
+    def _client(self, host) -> HostClient:
+        with self._lock:
+            c = self._clients.get(host.id)
+            if c is None or c.endpoint != host.endpoint:
+                if c is not None:
+                    c.close()  # endpoint moved: release the old socket pool
+                c = HostClient(host.endpoint, self.opts.pool_size, self.opts.timeout_s)
+                self._clients[host.id] = c
+            return c
+
+    def _queue(self, host) -> HostQueue:
+        with self._lock:
+            q = self._queues.get(host.id)
+            if q is None or q.client.endpoint != host.endpoint:
+                if q is not None:
+                    q.close()
+                    q.client.close()
+                q = HostQueue(self._client(host), self.opts.max_batch)
+                self._queues[host.id] = q
+            return q
+
+    # ----------------------------------------------------------------- writes
+
+    def write(self, ns: bytes, id: bytes, t_ns: int, value: float,
+              tags: Optional[dict] = None):
+        """session.go:867 Write: fan out to all shard replicas, wait quorum."""
+        m = self._map()
+        shard = self._shards().lookup(id)
+        hosts = m.route_shard(shard)
+        if not hosts:
+            raise ConsistencyError(f"no hosts own shard {shard}")
+        required = required_acks(self.opts.write_consistency, m.replica_factor)
+        completion = _Completion(required=min(required, len(hosts)), total=len(hosts))
+        op = _WriteOp(ns, id, t_ns, value, tags, completion)
+        for h in hosts:
+            self._queue(h).enqueue(op)
+        completion.wait(self.opts.timeout_s)
+
+    def write_tagged(self, ns: bytes, id: bytes, tags: dict, t_ns: int, value: float):
+        self.write(ns, id, t_ns, value, tags)
+
+    def write_batch(self, ns: bytes, ids: Sequence[bytes], ts, vals,
+                    tags: Optional[Sequence[Optional[dict]]] = None):
+        """Batched write: one quorum completion per datapoint, ops fanned
+        through the same host queues (host queues re-batch per host)."""
+        ts = np.asarray(ts, np.int64)
+        vals = np.asarray(vals, np.float64)
+        m = self._map()
+        required = required_acks(self.opts.write_consistency, m.replica_factor)
+        completions = []
+        ss = self._shards()
+        for i, sid in enumerate(ids):
+            hosts = m.route_shard(ss.lookup(sid))
+            if not hosts:
+                raise ConsistencyError(f"no hosts own shard for {sid!r}")
+            c = _Completion(required=min(required, len(hosts)), total=len(hosts))
+            completions.append(c)
+            op = _WriteOp(ns, sid, int(ts[i]), float(vals[i]),
+                          tags[i] if tags else None, c)
+            for h in hosts:
+                self._queue(h).enqueue(op)
+        for c in completions:
+            c.wait(self.opts.timeout_s)
+
+    # ------------------------------------------------------------------ reads
+
+    def fetch(self, ns: bytes, id: bytes, start_ns: int, end_ns: int
+              ) -> Tuple[np.ndarray, np.ndarray]:
+        """Fetch decoded + replica-merged datapoints for one series."""
+        m = self._map()
+        hosts = m.route_shard(self._shards().lookup(id))
+        required = min(required_reads(self.opts.read_consistency, m.replica_factor),
+                       len(hosts)) or 1
+        results, errs = [], []
+        pending = {self._pool.submit(self._client(h).call, "fetch", ns=ns, id=id,
+                                     start_ns=start_ns, end_ns=end_ns) for h in hosts}
+        deadline = time.monotonic() + self.opts.timeout_s
+        # Return as soon as the read consistency level is satisfied — a dead
+        # replica must not stall a quorum-satisfiable read.
+        while pending and len(results) < required:
+            done, pending = futures_wait(
+                pending, timeout=max(0.0, deadline - time.monotonic()),
+                return_when=FIRST_COMPLETED)
+            if not done:
+                break
+            for fut in done:
+                try:
+                    results.append(fut.result())
+                except Exception as e:  # noqa: BLE001
+                    errs.append(str(e))
+        if len(results) < required:
+            raise ConsistencyError(f"{len(results)}/{len(hosts)} reads, need {required}: {errs}")
+        return merge_replica_points([r["t"] for r in results], [r["v"] for r in results],
+                                    self.opts.conflict_strategy)
+
+    def fetch_tagged(self, ns: bytes, query, start_ns: int, end_ns: int,
+                     limit: int = 0) -> Dict[bytes, dict]:
+        """session.go:1091 FetchTagged: fan out, accumulate per-shard
+        consistency, decode + merge replicas. Returns id -> {tags, t, v}."""
+        m = self._map()
+        q = wire.query_to_wire(query)
+        hosts = list(m.hosts.values())
+        required = required_reads(self.opts.read_consistency, m.replica_factor)
+
+        def coverage_met(ok_ids):
+            # Per-shard accumulation (fetch_tagged_results_accumulator.go):
+            # every owned shard needs >= required responders among its owners.
+            for shard in range(m.num_shards):
+                owners = m.route_shard(shard)
+                if not owners:
+                    continue
+                got = sum(1 for h in owners if h.id in ok_ids)
+                if got < min(required, len(owners)):
+                    return False
+            return True
+
+        results, errs = [], []
+        ok_ids = set()
+        pending = {self._pool.submit(self._client(h).call, "fetch_tagged", ns=ns,
+                                     query=q, start_ns=start_ns, end_ns=end_ns,
+                                     limit=limit): h for h in hosts}
+        deadline = time.monotonic() + self.opts.timeout_s
+        while pending and not coverage_met(ok_ids):
+            done, _ = futures_wait(
+                set(pending), timeout=max(0.0, deadline - time.monotonic()),
+                return_when=FIRST_COMPLETED)
+            if not done:
+                break
+            for fut in done:
+                h = pending.pop(fut)
+                try:
+                    results.append(fut.result())
+                    ok_ids.add(h.id)
+                except Exception as e:  # noqa: BLE001
+                    errs.append(f"{h.id}: {e}")
+        if not coverage_met(ok_ids):
+            raise ConsistencyError(
+                f"insufficient replica coverage ({len(ok_ids)} responders, "
+                f"need {required} per shard): {errs}")
+        merged: Dict[bytes, dict] = {}
+        for r in results:
+            for entry in r["series"]:
+                sid = entry["id"]
+                t, v = series_points(entry, self.opts.conflict_strategy)
+                cur = merged.get(sid)
+                if cur is None:
+                    merged[sid] = {"tags": entry["tags"], "t": t, "v": v}
+                else:
+                    if not cur["tags"] and entry["tags"]:
+                        cur["tags"] = entry["tags"]
+                    cur["t"], cur["v"] = merge_replica_points(
+                        [cur["t"], t], [cur["v"], v], self.opts.conflict_strategy
+                    )
+        return merged
+
+    def query_ids(self, ns: bytes, query, start_ns: int, end_ns: int) -> Dict[bytes, dict]:
+        """ids + tags only (thrift Query / FetchTagged fetchData=false)."""
+        m = self._map()
+        out: Dict[bytes, dict] = {}
+        for h in m.hosts.values():
+            try:
+                r = self._client(h).call("query", ns=ns, query=wire.query_to_wire(query),
+                                         start_ns=start_ns, end_ns=end_ns)
+            except Exception:  # noqa: BLE001
+                continue
+            for s in r["series"]:
+                out.setdefault(s["id"], {"tags": s["tags"]})
+        return out
+
+    # ------------------------------------------------------------------ admin
+
+    def fetch_blocks_metadata_from_peers(self, ns: bytes, shard: int, start_ns: int,
+                                         end_ns: int, exclude_host: Optional[str] = None):
+        """AdminSession peer metadata streaming: paged metadata from every
+        replica of a shard -> {host_id: {series_id: {tags, blocks}}}."""
+        m = self._map()
+        out: Dict[str, Dict[bytes, dict]] = {}
+        for h in m.route_shard(shard):
+            if h.id == exclude_host:
+                continue
+            series: Dict[bytes, dict] = {}
+            token = 0
+            while token is not None:
+                try:
+                    r = self._client(h).call(
+                        "fetch_blocks_metadata", ns=ns, shard=shard,
+                        start_ns=start_ns, end_ns=end_ns, page_token=token)
+                except Exception:  # noqa: BLE001 — peer down: skip
+                    series = None
+                    break
+                for s in r["series"]:
+                    series[s["id"]] = {"tags": s["tags"], "blocks": s["blocks"]}
+                token = r["next_page_token"]
+            if series is not None:
+                out[h.id] = series
+        return out
+
+    def fetch_bootstrap_blocks_from_peers(self, ns: bytes, shard: int, start_ns: int,
+                                          end_ns: int, exclude_host: Optional[str] = None
+                                          ) -> Dict[bytes, dict]:
+        """Peer bootstrap streaming (session FetchBootstrapBlocksFromPeers):
+        diff peer metadata, pick the best peer per block by checksum
+        agreement (majority checksum first, else any), stream the blocks.
+
+        Returns {series_id: {"tags": .., "blocks": [wire block dicts]}}."""
+        meta = self.fetch_blocks_metadata_from_peers(ns, shard, start_ns, end_ns,
+                                                     exclude_host)
+        # (series, block_start) -> {checksum -> [host_ids]}
+        wanted: Dict[bytes, dict] = {}
+        plan: Dict[str, Dict[bytes, List[int]]] = {}
+        for sid in {s for hs in meta.values() for s in hs}:
+            per_block: Dict[int, Counter] = {}
+            tags = {}
+            for host_id, hseries in meta.items():
+                e = hseries.get(sid)
+                if e is None:
+                    continue
+                tags = tags or e["tags"]
+                for b in e["blocks"]:
+                    per_block.setdefault(b["bs"], Counter())[(b["checksum"], host_id)] = 1
+            wanted[sid] = {"tags": tags, "blocks": []}
+            for bs, ck in per_block.items():
+                by_sum = Counter()
+                hosts_by_sum: Dict[int, List[str]] = {}
+                for (checksum, host_id), _n in ck.items():
+                    by_sum[checksum] += 1
+                    hosts_by_sum.setdefault(checksum, []).append(host_id)
+                best_sum, _cnt = by_sum.most_common(1)[0]
+                host_id = hosts_by_sum[best_sum][0]
+                plan.setdefault(host_id, {}).setdefault(sid, []).append(bs)
+        m = self._map()
+        hosts = {h.id: h for h in m.hosts.values()}
+        for host_id, reqs in plan.items():
+            r = self._client(hosts[host_id]).call(
+                "fetch_blocks", ns=ns, shard=shard,
+                requests=[{"id": sid, "block_starts": bss} for sid, bss in reqs.items()])
+            for s in r["series"]:
+                wanted[s["id"]]["blocks"].extend(s["blocks"])
+        return {sid: e for sid, e in wanted.items() if e["blocks"]}
+
+    def fetch_blocks_from_host(self, host_id: str, ns: bytes, shard: int,
+                               requests: List[dict]) -> dict:
+        """Raw encoded blocks from one specific replica (repair path)."""
+        m = self._map()
+        host = m.hosts.get(host_id)
+        if host is None:
+            raise ConnectionError_(f"unknown host {host_id}")
+        return self._client(host).call("fetch_blocks", ns=ns, shard=shard,
+                                       requests=requests)
+
+    def truncate(self, ns: bytes) -> int:
+        m = self._map()
+        total = 0
+        for h in m.hosts.values():
+            total += self._client(h).call("truncate", ns=ns)
+        return total
+
+    def close(self):
+        with self._lock:
+            for q in self._queues.values():
+                q.close()
+            for c in self._clients.values():
+                c.close()
+            self._queues.clear()
+            self._clients.clear()
+        self._pool.shutdown(wait=False)

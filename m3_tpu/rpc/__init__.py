@@ -1,0 +1,26 @@
+"""RPC transport layer (reference: src/dbnode/network/server/tchannelthrift).
+
+Length-prefixed binary frames over TCP; node service method parity with
+the thrift `Node` service IDL (src/dbnode/generated/thrift/rpc.thrift)."""
+
+from .node_server import NodeServer, NodeService, RPCError
+from .wire import (
+    decode,
+    encode,
+    query_from_wire,
+    query_to_wire,
+    read_frame,
+    write_frame,
+)
+
+__all__ = [
+    "NodeServer",
+    "NodeService",
+    "RPCError",
+    "decode",
+    "encode",
+    "query_from_wire",
+    "query_to_wire",
+    "read_frame",
+    "write_frame",
+]

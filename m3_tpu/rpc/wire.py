@@ -1,0 +1,224 @@
+"""Binary wire format + framing for the node RPC data plane.
+
+The reference speaks TChannel+Thrift with a forked pooled-binary decoder
+(src/dbnode/network/server/tchannelthrift, glide.yaml:40-44 fork note).
+The TPU build keeps the same shape — a compact self-describing binary
+codec over length-prefixed TCP frames — but the bulk payloads are numpy
+arrays (packed u32 TSZ codewords, i64 timestamp / f64 value columns)
+serialized as raw buffers so a fetch response can be fed straight into
+the batched device decode kernel without per-element marshalling.
+
+Frame: <u32 length><body>, body = encode(value). Values: None, bool,
+int (i64), float (f64), bytes, str, list, dict, ndarray.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+from typing import Any
+
+import numpy as np
+
+_NIL = 0
+_FALSE = 1
+_TRUE = 2
+_I64 = 3
+_F64 = 4
+_BYTES = 5
+_STR = 6
+_LIST = 7
+_DICT = 8
+_NDARRAY = 9
+
+_U8 = struct.Struct("<B")
+_U32 = struct.Struct("<I")
+_I64S = struct.Struct("<q")
+_F64S = struct.Struct("<d")
+
+MAX_FRAME = 1 << 31  # 2 GiB hard cap against corrupt length prefixes
+
+
+def _enc(out: bytearray, v: Any) -> None:
+    if v is None:
+        out += b"\x00"
+    elif v is True:
+        out += b"\x02"
+    elif v is False:
+        out += b"\x01"
+    elif isinstance(v, (int, np.integer)):
+        out += _U8.pack(_I64)
+        out += _I64S.pack(int(v))
+    elif isinstance(v, (float, np.floating)):
+        out += _U8.pack(_F64)
+        out += _F64S.pack(float(v))
+    elif isinstance(v, (bytes, bytearray, memoryview)):
+        out += _U8.pack(_BYTES)
+        out += _U32.pack(len(v))
+        out += v
+    elif isinstance(v, str):
+        b = v.encode()
+        out += _U8.pack(_STR)
+        out += _U32.pack(len(b))
+        out += b
+    elif isinstance(v, np.ndarray):
+        a = np.ascontiguousarray(v)
+        dt = a.dtype.str.encode()
+        out += _U8.pack(_NDARRAY)
+        out += _U8.pack(len(dt))
+        out += dt
+        out += _U8.pack(a.ndim)
+        for s in a.shape:
+            out += _I64S.pack(s)
+        buf = a.tobytes()
+        out += _U32.pack(len(buf))
+        out += buf
+    elif isinstance(v, (list, tuple)):
+        out += _U8.pack(_LIST)
+        out += _U32.pack(len(v))
+        for item in v:
+            _enc(out, item)
+    elif isinstance(v, dict):
+        out += _U8.pack(_DICT)
+        out += _U32.pack(len(v))
+        for k, item in v.items():
+            _enc(out, k)
+            _enc(out, item)
+    else:
+        raise TypeError(f"wire: cannot encode {type(v)!r}")
+
+
+def encode(v: Any) -> bytes:
+    out = bytearray()
+    _enc(out, v)
+    return bytes(out)
+
+
+def _dec(buf: memoryview, pos: int):
+    tag = buf[pos]
+    pos += 1
+    if tag == _NIL:
+        return None, pos
+    if tag == _FALSE:
+        return False, pos
+    if tag == _TRUE:
+        return True, pos
+    if tag == _I64:
+        return _I64S.unpack_from(buf, pos)[0], pos + 8
+    if tag == _F64:
+        return _F64S.unpack_from(buf, pos)[0], pos + 8
+    if tag == _BYTES:
+        n = _U32.unpack_from(buf, pos)[0]
+        pos += 4
+        return bytes(buf[pos : pos + n]), pos + n
+    if tag == _STR:
+        n = _U32.unpack_from(buf, pos)[0]
+        pos += 4
+        return bytes(buf[pos : pos + n]).decode(), pos + n
+    if tag == _NDARRAY:
+        dtn = buf[pos]
+        pos += 1
+        dt = np.dtype(bytes(buf[pos : pos + dtn]).decode())
+        pos += dtn
+        ndim = buf[pos]
+        pos += 1
+        shape = []
+        for _ in range(ndim):
+            shape.append(_I64S.unpack_from(buf, pos)[0])
+            pos += 8
+        n = _U32.unpack_from(buf, pos)[0]
+        pos += 4
+        a = np.frombuffer(buf[pos : pos + n], dtype=dt).reshape(shape).copy()
+        return a, pos + n
+    if tag == _LIST:
+        n = _U32.unpack_from(buf, pos)[0]
+        pos += 4
+        out = []
+        for _ in range(n):
+            item, pos = _dec(buf, pos)
+            out.append(item)
+        return out, pos
+    if tag == _DICT:
+        n = _U32.unpack_from(buf, pos)[0]
+        pos += 4
+        d = {}
+        for _ in range(n):
+            k, pos = _dec(buf, pos)
+            v, pos = _dec(buf, pos)
+            d[k] = v
+        return d, pos
+    raise ValueError(f"wire: bad tag {tag}")
+
+
+def decode(buf: bytes) -> Any:
+    v, pos = _dec(memoryview(buf), 0)
+    if pos != len(buf):
+        raise ValueError(f"wire: trailing bytes ({len(buf) - pos})")
+    return v
+
+
+# ------------------------------------------------------------------- framing
+
+
+def write_frame(sock: socket.socket, value: Any) -> None:
+    body = encode(value)
+    sock.sendall(_U32.pack(len(body)) + body)
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    parts = []
+    while n:
+        chunk = sock.recv(min(n, 1 << 20))
+        if not chunk:
+            raise ConnectionError("wire: peer closed")
+        parts.append(chunk)
+        n -= len(chunk)
+    return b"".join(parts)
+
+
+def read_frame(sock: socket.socket) -> Any:
+    (n,) = _U32.unpack(_read_exact(sock, 4))
+    if n > MAX_FRAME:
+        raise ValueError(f"wire: frame too large ({n})")
+    return decode(_read_exact(sock, n))
+
+
+# -------------------------------------------------- index query serialization
+
+
+def query_to_wire(q) -> dict:
+    """index.Query <-> plain dict (thrift rpc.thrift Query equivalent)."""
+    from ..index import query as iq
+
+    if isinstance(q, iq.AllQuery):
+        return {"t": "all"}
+    if isinstance(q, iq.TermQuery):
+        return {"t": "term", "f": q.field, "v": q.value}
+    if isinstance(q, iq.RegexpQuery):
+        return {"t": "regexp", "f": q.field, "v": q.pattern}
+    if isinstance(q, iq.ConjunctionQuery):
+        return {"t": "conj", "qs": [query_to_wire(s) for s in q.queries]}
+    if isinstance(q, iq.DisjunctionQuery):
+        return {"t": "disj", "qs": [query_to_wire(s) for s in q.queries]}
+    if isinstance(q, iq.NegationQuery):
+        return {"t": "neg", "q": query_to_wire(q.query)}
+    raise TypeError(f"unknown query {type(q)!r}")
+
+
+def query_from_wire(d: dict):
+    from ..index import query as iq
+
+    t = d["t"]
+    if t == "all":
+        return iq.AllQuery()
+    if t == "term":
+        return iq.TermQuery(d["f"], d["v"])
+    if t == "regexp":
+        return iq.RegexpQuery(d["f"], d["v"])
+    if t == "conj":
+        return iq.ConjunctionQuery(tuple(query_from_wire(s) for s in d["qs"]))
+    if t == "disj":
+        return iq.DisjunctionQuery(tuple(query_from_wire(s) for s in d["qs"]))
+    if t == "neg":
+        return iq.NegationQuery(query_from_wire(d["q"]))
+    raise ValueError(f"unknown query type {t!r}")

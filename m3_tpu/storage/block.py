@@ -52,6 +52,11 @@ class SealedBlock:
     def num_series(self) -> int:
         return len(self.series_indices)
 
+    def row_checksum(self, row: int) -> int:
+        """adler32 of one series' packed stream (the unit of repair/peer
+        metadata comparison, persist/fs write.go per-entry checksum)."""
+        return zlib.adler32(np.ascontiguousarray(self.words[row]).tobytes())
+
     def row_of(self, series_idx: int) -> Optional[int]:
         i = int(np.searchsorted(self.series_indices, series_idx))
         if i < len(self.series_indices) and self.series_indices[i] == series_idx:
@@ -76,12 +81,38 @@ class SealedBlock:
         return int(self.words.nbytes)
 
 
+def _next_pow2(n: int, floor: int = 8) -> int:
+    n = max(n, floor)
+    return 1 << (n - 1).bit_length()
+
+
 def encode_block(block_start: int, series_indices, tdense, vdense, npoints,
                  max_words: Optional[int] = None) -> SealedBlock:
-    """Batch-encode dense tiles (from ShardBuffer.drain) into a SealedBlock."""
-    window = tdense.shape[1]
+    """Batch-encode dense tiles (from ShardBuffer.drain) into a SealedBlock.
+
+    Tiles are padded to power-of-two (series, window) geometry so XLA
+    re-uses one compiled kernel across shards/blocks instead of compiling
+    per exact shape (shape bucketing; padding columns replicate the last
+    point, padding rows are npoints=1 dummies sliced away afterwards)."""
+    s, w = tdense.shape
+    wp = _next_pow2(w)
+    sp = _next_pow2(s, floor=1)
+    if wp != w:
+        padc_t = np.repeat(tdense[:, -1:], wp - w, axis=1)
+        padc_v = np.repeat(vdense[:, -1:], wp - w, axis=1)
+        tdense = np.concatenate([tdense, padc_t], axis=1)
+        vdense = np.concatenate([vdense, padc_v], axis=1)
+    npoints = np.asarray(npoints, np.int32)
+    if sp != s:
+        tdense = np.concatenate([tdense, np.repeat(tdense[:1], sp - s, axis=0)])
+        vdense = np.concatenate([vdense, np.repeat(vdense[:1], sp - s, axis=0)])
+        npoints = np.concatenate([npoints, np.ones(sp - s, np.int32)])
+    window = wp
     unit = choose_time_unit(tdense)
     words, nbits = tsz.encode(tdense // unit.nanos, vdense, npoints, max_words=max_words)
+    words = np.asarray(words)[:s]
+    nbits = np.asarray(nbits)[:s]
+    npoints = npoints[:s]
     return SealedBlock(
         block_start=block_start,
         window=window,

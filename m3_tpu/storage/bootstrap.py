@@ -1,0 +1,323 @@
+"""Bootstrap chain (reference: src/dbnode/storage/bootstrap).
+
+Chain-of-responsibility bootstrappers, each claiming shard-time-ranges
+and passing the unfulfilled remainder to the next (process.go:150; chain
+order filesystem -> commitlog -> peers -> uninitialized_topology per
+src/dbnode/config/m3dbnode-local-etcd.yml:72-76, built in
+cmd/services/m3dbnode/config/bootstrap.go:115-160).
+
+- filesystem: load complete flushed filesets (bootstrapper/fs/source.go)
+- commitlog: most-recent snapshots + WAL replay (bootstrapper/commitlog)
+- peers: AdminSession block streaming from replicas, best peer per block
+  by checksum agreement (peer_streaming.md)
+- uninitialized_topology: succeeds only for brand-new topologies"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..persist import commitlog as cl
+from ..persist.fs import FilesetReader, PersistManager
+from ..utils import xtime
+from .block import SealedBlock
+from .timerange import ShardTimeRanges, intersect, overlaps
+
+
+@dataclasses.dataclass
+class BootstrapContext:
+    persist: Optional[PersistManager] = None
+    commitlog_dir: Optional[str] = None
+    session: Optional[object] = None       # client.Session (admin surface)
+    host_id: Optional[str] = None
+    placement: Optional[object] = None     # cluster.placement.Placement
+    shard_lookup: Optional[object] = None  # Callable[[bytes], int] (shard set)
+
+
+@dataclasses.dataclass
+class BootstrapResult:
+    """Per-namespace outcome: what each bootstrapper claimed and what was
+    left unfulfilled (bootstrap/result pkg)."""
+
+    requested: ShardTimeRanges
+    claimed: Dict[str, ShardTimeRanges] = dataclasses.field(default_factory=dict)
+    unfulfilled: Optional[ShardTimeRanges] = None
+
+
+class Bootstrapper:
+    name = "base"
+
+    def bootstrap(self, ns, shard_ranges: ShardTimeRanges,
+                  ctx: BootstrapContext) -> ShardTimeRanges:
+        """Load what it can into `ns`, return the claimed (fulfilled) ranges."""
+        raise NotImplementedError
+
+
+class FilesystemBootstrapper(Bootstrapper):
+    """bootstrapper/fs: read complete filesets whose block intersects the
+    requested ranges, install as sealed blocks."""
+
+    name = "filesystem"
+
+    def bootstrap(self, ns, shard_ranges, ctx):
+        claimed = ShardTimeRanges()
+        if ctx.persist is None:
+            return claimed
+        bsz = ns.opts.block_size_ns
+        for shard_id in shard_ranges.shards():
+            shard = ns.shards.get(shard_id)
+            if shard is None:
+                continue
+            for bs, path in ctx.persist.list_filesets(ns.name, shard_id):
+                if not overlaps(shard_ranges.ranges(shard_id), bs, bs + bsz):
+                    continue
+                try:
+                    blk, ids = FilesetReader(path).to_block()
+                except (IOError, FileNotFoundError):
+                    continue
+                remap = np.array(
+                    [shard.registry.get_or_create(sid)[0] for sid in ids], np.int32
+                )
+                shard.load_block(blk, remap)
+                claimed.add(shard_id, bs, bs + bsz)
+        return claimed
+
+
+class CommitlogBootstrapper(Bootstrapper):
+    """bootstrapper/commitlog: load the newest snapshot per block, then
+    replay WAL entries on top; claims ALL requested ranges (the commit log
+    cannot prove absence of data, matching the reference's source which
+    marks everything fulfilled)."""
+
+    name = "commitlog"
+
+    def bootstrap(self, ns, shard_ranges, ctx):
+        claimed = ShardTimeRanges()
+        if ctx.persist is None and ctx.commitlog_dir is None:
+            # No durability sources configured: claim nothing so the chain
+            # falls through to peers/uninitialized.
+            return claimed
+        bsz = ns.opts.block_size_ns
+        # Snapshots first (newest version per block start).
+        if ctx.persist is not None:
+            for shard_id in shard_ranges.shards():
+                shard = ns.shards.get(shard_id)
+                if shard is None:
+                    continue
+                newest: Dict[int, Tuple[int, str]] = {}
+                for bs, version, path in ctx.persist.list_snapshots(ns.name, shard_id):
+                    if not overlaps(shard_ranges.ranges(shard_id), bs, bs + bsz):
+                        continue
+                    if bs not in newest or version > newest[bs][0]:
+                        newest[bs] = (version, path)
+                for bs, (_v, path) in newest.items():
+                    try:
+                        blk, ids = FilesetReader(path).to_block()
+                    except (IOError, FileNotFoundError):
+                        continue
+                    ts, vals, npoints = blk.read_all()
+                    for row, sid in enumerate(ids):
+                        idx, _ = shard.registry.get_or_create(sid)
+                        n = int(npoints[row])
+                        shard.buffer.write_batch(
+                            np.full(n, idx, np.int32),
+                            np.asarray(ts[row, :n], np.int64),
+                            np.asarray(vals[row, :n], np.float64),
+                        )
+        # WAL replay on top (iterator.go replay).
+        if ctx.commitlog_dir is not None:
+            batch: Dict[int, List[Tuple[bytes, int, float]]] = {}
+            lookup = ctx.shard_lookup
+            if lookup is None:
+                # Fallback only valid when this node owns the FULL contiguous
+                # shard space (single-node): murmur3 % N matches the cluster
+                # routing. Otherwise skip replay rather than misroute.
+                if ns.shards and len(ns.shards) == max(ns.shards) + 1:
+                    n = len(ns.shards)
+                    lookup = lambda sid: _murmur_shard(sid, n)  # noqa: E731
+                else:
+                    lookup = None
+            for entry_ns, sid, t_ns, value in cl.replay(ctx.commitlog_dir) if lookup else ():
+                if entry_ns != ns.name:
+                    continue
+                shard_id = lookup(sid)
+                if shard_id not in shard_ranges.m:
+                    continue
+                if not overlaps(shard_ranges.ranges(shard_id), t_ns, t_ns + 1):
+                    continue
+                batch.setdefault(shard_id, []).append((sid, t_ns, value))
+            for shard_id, entries in batch.items():
+                shard = ns.shards.get(shard_id)
+                if shard is None:
+                    continue
+                sidx = np.empty(len(entries), np.int32)
+                for i, (sid, _t, _v) in enumerate(entries):
+                    sidx[i], _ = shard.registry.get_or_create(sid)
+                shard.buffer.write_batch(
+                    sidx,
+                    np.array([t for _s, t, _v in entries], np.int64),
+                    np.array([v for _s, _t, v in entries], np.float64),
+                )
+        for shard_id in shard_ranges.shards():
+            for s, e in shard_ranges.ranges(shard_id):
+                claimed.add(shard_id, s, e)
+        return claimed
+
+
+def _murmur_shard(sid: bytes, num_shards: int) -> int:
+    from ..utils.hashing import murmur3_32
+
+    return murmur3_32(sid) % num_shards
+
+
+class PeersBootstrapper(Bootstrapper):
+    """bootstrapper/peers: stream replica blocks via the admin session
+    (FetchBootstrapBlocksFromPeers), choosing the best peer per block by
+    checksum agreement."""
+
+    name = "peers"
+
+    def bootstrap(self, ns, shard_ranges, ctx):
+        claimed = ShardTimeRanges()
+        if ctx.session is None:
+            return claimed
+        for shard_id in shard_ranges.shards():
+            shard = ns.shards.get(shard_id)
+            if shard is None:
+                continue
+            ranges = shard_ranges.ranges(shard_id)
+            start = min(s for s, _e in ranges)
+            end = max(e for _s, e in ranges)
+            try:
+                series = ctx.session.fetch_bootstrap_blocks_from_peers(
+                    ns.name, shard_id, start, end, exclude_host=ctx.host_id)
+            except Exception:  # noqa: BLE001 — peers unavailable: claim nothing
+                continue
+            per_block: Dict[int, List[Tuple[int, dict]]] = {}
+            for sid, entry in series.items():
+                idx, _ = shard.registry.get_or_create(sid, entry.get("tags") or None)
+                for b in entry["blocks"]:
+                    per_block.setdefault(b["bs"], []).append((idx, b))
+            for bs, rows in per_block.items():
+                units = {int(b["time_unit"]) for _i, b in rows}
+                if len(units) == 1:
+                    window = max(int(b["window"]) for _i, b in rows)
+                    mw = max(np.asarray(b["words"]).shape[-1] for _i, b in rows)
+                    words = np.zeros((len(rows), mw), np.uint32)
+                    nbits = np.zeros(len(rows), np.int32)
+                    npoints = np.zeros(len(rows), np.int32)
+                    remap = np.zeros(len(rows), np.int32)
+                    for i, (idx, b) in enumerate(rows):
+                        w = np.asarray(b["words"])
+                        words[i, : w.shape[-1]] = w
+                        nbits[i] = b["nbits"]
+                        npoints[i] = b["npoints"]
+                        remap[i] = idx
+                    blk = SealedBlock(
+                        block_start=bs, window=window,
+                        series_indices=np.arange(len(rows), dtype=np.int32),
+                        words=words, nbits=nbits, npoints=npoints,
+                        time_unit=xtime.Unit(units.pop()),
+                    )
+                    shard.load_block(blk, remap)
+                else:
+                    # Replicas sealed this block with different tick scales
+                    # (choose_time_unit diverged): decode each row at its own
+                    # unit and re-encode the tile uniformly.
+                    from ..client.decode import decode_segment_groups
+                    from .buffer import to_dense
+                    from .block import encode_block
+
+                    decoded = decode_segment_groups([b for _i, b in rows])
+                    sidx = np.concatenate([
+                        np.full(len(t), idx, np.int32)
+                        for (idx, _b), (t, _v) in zip(rows, decoded)])
+                    ts = np.concatenate([t for t, _v in decoded])
+                    vs = np.concatenate([v for _t, v in decoded])
+                    order = np.lexsort((ts, sidx))
+                    series, td, vd, counts = to_dense(sidx[order], ts[order], vs[order])
+                    shard.blocks[bs] = encode_block(bs, series, td, vd, counts)
+                    from .shard import FlushState
+
+                    shard.flush_states.setdefault(bs, FlushState.SUCCESS)
+            for s, e in ranges:
+                claimed.add(shard_id, s, e)
+        return claimed
+
+
+class UninitializedTopologyBootstrapper(Bootstrapper):
+    """bootstrapper/uninitialized: succeeds only when every replica of the
+    shard is still INITIALIZING — i.e. a brand-new topology where no peer
+    could possibly have data."""
+
+    name = "uninitialized_topology"
+
+    def bootstrap(self, ns, shard_ranges, ctx):
+        from ..cluster.placement import ShardState
+
+        claimed = ShardTimeRanges()
+        if ctx.placement is None:
+            # No cluster: single-node fresh start claims everything.
+            for shard_id in shard_ranges.shards():
+                for s, e in shard_ranges.ranges(shard_id):
+                    claimed.add(shard_id, s, e)
+            return claimed
+        for shard_id in shard_ranges.shards():
+            replicas = ctx.placement.replicas_for(
+                shard_id, states=(ShardState.INITIALIZING, ShardState.AVAILABLE))
+            all_new = all(
+                inst.shards[shard_id].state == ShardState.INITIALIZING
+                for inst in replicas
+            ) if replicas else True
+            if all_new:
+                for s, e in shard_ranges.ranges(shard_id):
+                    claimed.add(shard_id, s, e)
+        return claimed
+
+
+DEFAULT_CHAIN = ("filesystem", "commitlog", "peers", "uninitialized_topology")
+
+_REGISTRY = {
+    "filesystem": FilesystemBootstrapper,
+    "commitlog": CommitlogBootstrapper,
+    "peers": PeersBootstrapper,
+    "uninitialized_topology": UninitializedTopologyBootstrapper,
+}
+
+
+class BootstrapProcess:
+    """process.go:150 run: compute target ranges from retention, run the
+    chain per namespace, mark the db bootstrapped."""
+
+    def __init__(self, chain=DEFAULT_CHAIN, ctx: BootstrapContext = None):
+        self.bootstrappers = [_REGISTRY[name]() for name in chain]
+        self.ctx = ctx or BootstrapContext()
+
+    def target_ranges(self, ns, now_ns: int,
+                      shard_ids: Optional[List[int]] = None) -> ShardTimeRanges:
+        bsz = ns.opts.block_size_ns
+        start = xtime.truncate(now_ns - ns.opts.retention_ns, bsz)
+        end = xtime.truncate(now_ns, bsz) + bsz
+        shards = shard_ids if shard_ids is not None else sorted(ns.shards)
+        return ShardTimeRanges.uniform(shards, start, end)
+
+    def run(self, db, now_ns: Optional[int] = None,
+            shard_ids: Optional[List[int]] = None) -> Dict[bytes, BootstrapResult]:
+        now = now_ns if now_ns is not None else db.clock()
+        results: Dict[bytes, BootstrapResult] = {}
+        for name, ns in db.namespaces.items():
+            requested = self.target_ranges(ns, now, shard_ids)
+            remaining = requested.copy()
+            result = BootstrapResult(requested=requested)
+            for b in self.bootstrappers:
+                if remaining.is_empty():
+                    break
+                claimed = b.bootstrap(ns, remaining, self.ctx)
+                result.claimed[b.name] = claimed
+                remaining = remaining.subtract(claimed)
+            result.unfulfilled = remaining
+            results[name] = result
+        db.mark_bootstrapped()
+        return results

@@ -1,0 +1,119 @@
+"""Mediator: the background lifecycle driver (reference:
+src/dbnode/storage/mediator.go:112 Open -> :157 ongoingTick; tick.go,
+flush.go, fs.go:115 flush/snapshot run, cleanup.go).
+
+`run_once` is the deterministic unit tests call; `start` wraps it in a
+ticker thread the service binary owns. Order per tick matches the
+reference: tick (seal/expire) -> flush sealed blocks -> snapshot warm
+buffers -> cleanup (expired filesets, old snapshots, rotated commitlog
+files)."""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import shutil
+import threading
+from typing import Dict, Optional
+
+from ..persist.fs import PersistManager
+from ..storage.block import encode_block
+from ..utils import xtime
+
+
+@dataclasses.dataclass
+class MediatorOptions:
+    tick_interval_ns: int = 10 * xtime.SECOND
+    snapshot_enabled: bool = True
+
+
+class Mediator:
+    def __init__(self, db, persist: Optional[PersistManager] = None,
+                 opts: MediatorOptions = MediatorOptions()):
+        self.db = db
+        self.persist = persist
+        self.opts = opts
+        self._snapshot_version = 0
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.last_stats: Dict[str, int] = {}
+
+    # ------------------------------------------------------------------ steps
+
+    def run_once(self, now_ns: Optional[int] = None) -> Dict[str, int]:
+        now = now_ns if now_ns is not None else self.db.clock()
+        stats = dict(self.db.tick(now))
+        if self.persist is not None:
+            stats["flushed"] = self.db.flush(self.persist, now)
+            if self.opts.snapshot_enabled:
+                stats["snapshotted"] = self.snapshot(now)
+            stats["cleaned"] = self.cleanup(now)
+        self.last_stats = stats
+        return stats
+
+    def snapshot(self, now_ns: int) -> int:
+        """Persist warm (still-mutable) buckets as snapshot filesets
+        (storage/flush.go snapshot state; persist/fs snapshot volumes)."""
+        self._snapshot_version += 1
+        version = self._snapshot_version
+        count = 0
+        for ns in self.db.namespaces.values():
+            if not ns.opts.snapshot_enabled:
+                continue
+            for shard in ns.shards.values():
+                for bs in sorted(shard.buffer.buckets):
+                    dense = shard.buffer.snapshot(bs)
+                    if dense is None:
+                        continue
+                    series, tdense, vdense, npoints = dense
+                    blk = encode_block(bs, series, tdense, vdense, npoints)
+                    self.persist.write_snapshot(ns.name, shard.shard_id, blk,
+                                                shard.registry, version)
+                    count += 1
+        return count
+
+    def cleanup(self, now_ns: int) -> int:
+        """cleanup.go: remove filesets past retention, superseded snapshots,
+        and snapshots for blocks already flushed."""
+        removed = 0
+        for ns in self.db.namespaces.values():
+            cutoff = now_ns - ns.opts.retention_ns
+            for shard_id in ns.shards:
+                for bs, path in self.persist.list_filesets(ns.name, shard_id):
+                    if bs + ns.opts.block_size_ns <= cutoff:
+                        shutil.rmtree(path, ignore_errors=True)
+                        removed += 1
+                snaps = self.persist.list_snapshots(ns.name, shard_id)
+                newest: Dict[int, int] = {}
+                for bs, version, _p in snaps:
+                    newest[bs] = max(newest.get(bs, -1), version)
+                flushed = {bs for bs, _p in self.persist.list_filesets(ns.name, shard_id)}
+                for bs, version, path in snaps:
+                    stale = (version < newest[bs] or bs in flushed
+                             or bs + ns.opts.block_size_ns <= cutoff)
+                    if stale:
+                        shutil.rmtree(path, ignore_errors=True)
+                        removed += 1
+        return removed
+
+    # ------------------------------------------------------------- background
+
+    def start(self, interval_s: Optional[float] = None):
+        iv = interval_s if interval_s is not None else self.opts.tick_interval_ns / 1e9
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(iv):
+                try:
+                    self.run_once()
+                except Exception:  # noqa: BLE001 — background loop survives
+                    pass
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

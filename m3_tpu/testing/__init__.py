@@ -1,0 +1,6 @@
+"""In-process multi-node test infrastructure (reference:
+src/dbnode/integration/setup.go newTestSetup + fake cluster services)."""
+
+from .cluster import ClusterHarness, ClusterNode
+
+__all__ = ["ClusterHarness", "ClusterNode"]

@@ -1,0 +1,131 @@
+"""In-process multi-node cluster harness.
+
+The reference spins up multiple real dbnodes in one process with fake
+in-memory cluster services replacing etcd
+(src/dbnode/integration/setup.go:118, integration/fake/cluster_services.go:115)
+and controllable clocks (setup.go:348). Same here: N real NodeServers on
+localhost ports, one shared MemStore standing in for etcd, a
+placement-derived DynamicTopology, and a shared settable clock."""
+
+from __future__ import annotations
+
+import tempfile
+from typing import Dict, List, Optional
+
+from ..cluster.kv import MemStore
+from ..cluster.placement import Instance, PlacementService
+from ..cluster.topology import DynamicTopology
+from ..index.namespace_index import NamespaceIndex
+from ..parallel.sharding import ShardSet
+from ..persist.commitlog import CommitLog
+from ..persist.fs import PersistManager
+from ..rpc import NodeServer, NodeService
+from ..storage.database import Database
+from ..storage.namespace import NamespaceOptions
+
+
+class SettableClock:
+    """Controllable time source (integration setup's clock override)."""
+
+    def __init__(self, now_ns: int):
+        self.now_ns = now_ns
+
+    def __call__(self) -> int:
+        return self.now_ns
+
+    def advance(self, delta_ns: int):
+        self.now_ns += delta_ns
+
+
+class ClusterNode:
+    def __init__(self, host_id: str, db: Database, server: NodeServer,
+                 persist: PersistManager, data_dir: str):
+        self.host_id = host_id
+        self.db = db
+        self.server = server
+        self.persist = persist
+        self.data_dir = data_dir
+
+    @property
+    def endpoint(self) -> str:
+        return self.server.endpoint
+
+    def stop(self):
+        self.server.close()
+
+
+class ClusterHarness:
+    """N-node localhost cluster over a shared in-memory KV."""
+
+    def __init__(self, n_nodes: int = 3, replica_factor: int = 3,
+                 num_shards: int = 64,
+                 ns_opts: Optional[NamespaceOptions] = None,
+                 namespaces: List[bytes] = (b"default",),
+                 start_ns: int = 1_600_000_000_000_000_000,
+                 data_root: Optional[str] = None,
+                 with_commitlog: bool = False):
+        self.kv = MemStore()
+        self.clock = SettableClock(start_ns)
+        self.num_shards = num_shards
+        self.ns_opts = ns_opts or NamespaceOptions()
+        self.namespaces = list(namespaces)
+        self.nodes: Dict[str, ClusterNode] = {}
+        self.data_root = data_root or tempfile.mkdtemp(prefix="m3tpu-cluster-")
+        self.with_commitlog = with_commitlog
+
+        # Start servers first so endpoints exist for the placement.
+        self._pending: List[ClusterNode] = []
+        for i in range(n_nodes):
+            self._pending.append(self._make_node(f"node{i}"))
+        self.placement_svc = PlacementService(self.kv)
+        self.placement_svc.init(
+            [Instance(id=n.host_id, endpoint=n.endpoint) for n in self._pending],
+            num_shards=num_shards, replica_factor=min(replica_factor, n_nodes),
+        )
+        for n in self._pending:
+            self.placement_svc.mark_instance_available(n.host_id)
+            n.db.mark_bootstrapped()
+            self.nodes[n.host_id] = n
+        self.topology = DynamicTopology(self.placement_svc)
+
+    def _make_node(self, host_id: str) -> ClusterNode:
+        import os
+
+        data_dir = os.path.join(self.data_root, host_id)
+        os.makedirs(data_dir, exist_ok=True)
+        commitlog = None
+        if self.with_commitlog:
+            commitlog = CommitLog(os.path.join(data_dir, "commitlog"))
+        db = Database(ShardSet(self.num_shards), commitlog=commitlog, clock=self.clock)
+        for ns in self.namespaces:
+            index = NamespaceIndex(self.ns_opts.index_block_size_ns, clock=self.clock) \
+                if self.ns_opts.index_enabled else None
+            db.create_namespace(ns, self.ns_opts, index=index)
+        server = NodeServer(NodeService(db)).start()
+        return ClusterNode(host_id, db, server, PersistManager(os.path.join(data_dir, "data")),
+                           data_dir)
+
+    # ----------------------------------------------------------------- admin
+
+    def add_node(self, host_id: Optional[str] = None) -> ClusterNode:
+        host_id = host_id or f"node{len(self.nodes)}"
+        node = self._make_node(host_id)
+        self.placement_svc.add_instance(Instance(id=host_id, endpoint=node.endpoint))
+        self.nodes[host_id] = node
+        return node
+
+    def stop_node(self, host_id: str):
+        self.nodes[host_id].stop()
+
+    def remove_node(self, host_id: str):
+        self.stop_node(host_id)
+        del self.nodes[host_id]
+        self.placement_svc.remove_instance(host_id)
+
+    def tick_all(self):
+        for n in self.nodes.values():
+            n.db.tick()
+
+    def close(self):
+        for n in self.nodes.values():
+            n.server.close()

@@ -1,0 +1,153 @@
+"""Bootstrap chain + repair tests (reference test model:
+src/dbnode/integration peers_bootstrap_*.go, fs_bootstrap tests,
+storage/repair tests)."""
+
+import numpy as np
+import pytest
+
+from m3_tpu.client import Session, SessionOptions
+from m3_tpu.parallel.sharding import ShardSet
+from m3_tpu.persist.commitlog import CommitLog
+from m3_tpu.persist.fs import PersistManager
+from m3_tpu.storage.bootstrap import (
+    BootstrapContext,
+    BootstrapProcess,
+)
+from m3_tpu.storage.database import Database
+from m3_tpu.storage.namespace import NamespaceOptions
+from m3_tpu.storage.repair import ShardRepairer
+from m3_tpu.storage.timerange import ShardTimeRanges, intersect, normalize, subtract
+from m3_tpu.testing import ClusterHarness
+from m3_tpu.utils import xtime
+
+NS = b"default"
+T0 = 1_600_000_000_000_000_000
+
+
+def test_timerange_algebra():
+    assert normalize([(5, 10), (0, 6)]) == [(0, 10)]
+    assert normalize([(0, 5), (5, 10)]) == [(0, 10)]
+    assert subtract([(0, 10)], [(3, 5)]) == [(0, 3), (5, 10)]
+    assert subtract([(0, 10)], [(0, 10)]) == []
+    assert subtract([(0, 4), (6, 10)], [(2, 8)]) == [(0, 2), (8, 10)]
+    assert intersect([(0, 10)], [(5, 15)]) == [(5, 10)]
+    str_ = ShardTimeRanges.uniform([1, 2], 0, 100)
+    rem = str_.subtract(ShardTimeRanges({1: [(0, 100)], 2: [(0, 40)]}))
+    assert rem.m == {2: [(40, 100)]}
+    assert not rem.is_empty() and rem.total_ns() == 60
+
+
+def _mk_db(tmp, with_cl=False, num_shards=8):
+    cl = CommitLog(str(tmp / "commitlog")) if with_cl else None
+    db = Database(ShardSet(num_shards), commitlog=cl, clock=lambda: _mk_db.now)
+    db.create_namespace(NS, NamespaceOptions(index_enabled=False))
+    return db
+
+
+_mk_db.now = T0
+
+
+def test_fs_then_commitlog_chain(tmp_path):
+    _mk_db.now = T0
+    db = _mk_db(tmp_path, with_cl=True)
+    pm = PersistManager(str(tmp_path / "data"))
+    # Old block (will be sealed + flushed) ...
+    old_ts = [T0 - i * xtime.SECOND for i in range(1, 11)]
+    db.write_batch(NS, [b"series.flushed"] * 10, old_ts, np.arange(10.0))
+    # ... advance past block end so it seals, write fresh points (commitlog only)
+    _mk_db.now = T0 + 2 * xtime.HOUR + 11 * xtime.MINUTE
+    db.tick()
+    assert db.flush(pm) >= 1
+    fresh_ts = [_mk_db.now - i * xtime.SECOND for i in range(1, 6)]
+    db.write_batch(NS, [b"series.walonly"] * 5, fresh_ts, np.arange(5.0) + 100)
+    db.commitlog.flush()
+
+    # A fresh db bootstraps: fs claims the flushed block, commitlog the rest.
+    db2 = _mk_db(tmp_path / "node2")
+    proc = BootstrapProcess(
+        chain=("filesystem", "commitlog", "uninitialized_topology"),
+        ctx=BootstrapContext(
+            persist=pm, commitlog_dir=str(tmp_path / "commitlog"),
+            shard_lookup=db2.shard_set.lookup),
+    )
+    results = proc.run(db2, now_ns=_mk_db.now)
+    res = results[NS]
+    assert res.unfulfilled.is_empty()
+    assert not res.claimed["filesystem"].is_empty()
+    assert db2.bootstrapped
+
+    t, v = db2.read(NS, b"series.flushed", T0 - xtime.HOUR, T0 + xtime.HOUR)
+    np.testing.assert_array_equal(v, np.arange(9.0, -1.0, -1))
+    t, v = db2.read(NS, b"series.walonly", _mk_db.now - xtime.HOUR, _mk_db.now + 1)
+    np.testing.assert_array_equal(v, np.array([104.0, 103, 102, 101, 100]))
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    h = ClusterHarness(n_nodes=3, replica_factor=3, num_shards=8,
+                       ns_opts=NamespaceOptions())
+    yield h
+    h.close()
+
+
+def _seed_and_seal(cluster, session, ids, base_val=0.0):
+    now = cluster.clock.now_ns
+    ts = [now - i * xtime.SECOND for i in range(12)]
+    for j, sid in enumerate(ids):
+        session.write_batch(NS, [sid] * 12, ts,
+                            np.arange(12.0) + base_val + 10 * j,
+                            [{b"role": b"seed"}] * 12)
+    cluster.clock.advance(2 * xtime.HOUR + 11 * xtime.MINUTE)
+    cluster.tick_all()
+    return ts
+
+
+def test_peers_bootstrap(cluster):
+    session = Session(cluster.topology, SessionOptions(timeout_s=10))
+    ids = [b"peer.a", b"peer.b", b"peer.c"]
+    _seed_and_seal(cluster, session, ids)
+
+    # Replacement node: empty db, same shard space, bootstraps from peers.
+    newdb = Database(ShardSet(cluster.num_shards), clock=cluster.clock)
+    newdb.create_namespace(NS, NamespaceOptions(index_enabled=False))
+    proc = BootstrapProcess(
+        chain=("peers", "uninitialized_topology"),
+        ctx=BootstrapContext(session=session,
+                             placement=cluster.placement_svc.get()),
+    )
+    res = proc.run(newdb)[NS]
+    assert res.unfulfilled.is_empty()
+    for j, sid in enumerate(ids):
+        t, v = newdb.read(NS, sid, 0, cluster.clock.now_ns)
+        assert len(t) == 12
+        np.testing.assert_array_equal(np.sort(v), np.arange(12.0) + 10 * j)
+    session.close()
+
+
+def test_repair_detects_and_heals_divergence(cluster):
+    session = Session(cluster.topology, SessionOptions(timeout_s=10))
+    ids = [b"repair.x", b"repair.y"]
+    _seed_and_seal(cluster, session, ids, base_val=500.0)
+
+    # Damage node0: drop one sealed block containing repair.x.
+    node0 = cluster.nodes["node0"]
+    shard_id = node0.db.shard_set.lookup(b"repair.x")
+    shard = node0.db.namespace(NS).shards[shard_id]
+    victim_bs = None
+    idx = shard.registry.get(b"repair.x")
+    for bs, blk in list(shard.blocks.items()):
+        if blk.row_of(idx) is not None:
+            victim_bs = bs
+            del shard.blocks[bs]
+            break
+    assert victim_bs is not None
+
+    rep = ShardRepairer(session, host_id="node0")
+    stats = rep.repair_shard(node0.db.namespace(NS), shard_id,
+                             0, cluster.clock.now_ns)
+    assert stats.rows_missing_locally >= 1
+    assert stats.blocks_rebuilt >= 1
+    assert victim_bs in shard.blocks
+    t, v = shard.read(b"repair.x", 0, cluster.clock.now_ns)
+    assert len(t) >= 12
+    session.close()
