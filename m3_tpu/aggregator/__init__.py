@@ -1,0 +1,34 @@
+"""Streaming windowed aggregation tier (reference: src/aggregator).
+
+Host side keeps the reference's control shape — shard-aware routing, per-ID
+entries, leader/follower flush with KV-persisted flush times — while all
+window reduction work is batched onto the device: every flush pads the
+closed windows of a whole resolution into one tile and reduces it in a
+single jitted call (list.py batched_reduce over m3_tpu.ops.aggregation
+kernels)."""
+
+from .aggregator import Aggregator, AggregatorShard, ForwardedWriter
+from .client import AggregatorClient
+from .election import ElectionManager, ElectionState
+from .elem import Elem, ElemKey
+from .entry import Entry, MetricMap, RateLimiter
+from .flush import FlushManager, FlushTimesManager
+from .handler import (
+    AggregatedMetric,
+    BlackholeHandler,
+    BroadcastHandler,
+    CallbackHandler,
+    CaptureHandler,
+    Handler,
+    LoggingHandler,
+)
+from .list import MetricList, MetricLists, batched_reduce
+
+__all__ = [
+    "AggregatedMetric", "Aggregator", "AggregatorClient", "AggregatorShard",
+    "BlackholeHandler", "BroadcastHandler", "CallbackHandler", "CaptureHandler",
+    "Elem", "ElemKey", "ElectionManager", "ElectionState", "Entry",
+    "FlushManager", "FlushTimesManager", "ForwardedWriter", "Handler",
+    "LoggingHandler", "MetricList", "MetricLists", "MetricMap", "RateLimiter",
+    "batched_reduce",
+]

@@ -1,0 +1,183 @@
+"""The aggregator: shard-aware ingestion with placement-watched ownership
+(reference: src/aggregator/aggregator/aggregator.go:88 — AddUntimed :167,
+AddTimed :189, AddForwarded :208, shardFor :268, placement watch :307;
+shard.go aggregatorShard).
+
+Each instance owns the shards the placement assigns it; metric IDs hash to
+shards with murmur3 % num_shards (aggregator/sharding/hash.go:89). Each
+shard owns its own metric map + lists so flushes and ticks parallelize per
+shard; a forwarded-writer loops multi-stage pipeline outputs back into the
+aggregation ring (forwarded_writer.go)."""
+
+from __future__ import annotations
+
+import time as _time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..metrics.metadata import ForwardMetadata, StagedMetadata
+from ..metrics.metric import MetricType, MetricUnion
+from ..metrics.policy import StoragePolicy
+from ..utils.hashing import murmur3_32
+from .election import ElectionManager
+from .entry import MetricMap
+from .flush import FlushManager, FlushTimesManager
+from .handler import Handler
+from .list import MetricLists
+
+
+class AggregatorShard:
+    """One shard's aggregation state (aggregator/shard.go): a metric map over
+    its own lists, with cutover/cutoff write gating for placement changes."""
+
+    def __init__(self, shard_id: int, clock: Callable[[], int],
+                 rate_limit_per_second: int = 0,
+                 default_policies: Sequence[StoragePolicy] = ()):
+        self.shard_id = shard_id
+        self.lists = MetricLists()
+        self.map = MetricMap(self.lists, clock, rate_limit_per_second,
+                             default_policies)
+        # Writes accepted only within [cutover, cutoff) — shards being handed
+        # off stop accepting before they're removed (shard.go SetWriteableRange).
+        self.cutover_nanos = 0
+        self.cutoff_nanos = 2**63 - 1
+        self._clock = clock
+
+    def is_writeable(self) -> bool:
+        now = self._clock()
+        return self.cutover_nanos <= now < self.cutoff_nanos
+
+
+class ForwardedWriter:
+    """Routes rollup-pipeline outputs to the next aggregation stage
+    (forwarded_writer.go). In-process it feeds straight back into an
+    Aggregator (the reference sends over the network to the instance owning
+    the rollup ID's shard — the routing hash is identical)."""
+
+    def __init__(self, target: "Aggregator"):
+        self._target = target
+
+    def __call__(self, new_id: bytes, t_nanos: int, value: float,
+                 meta: ForwardMetadata, source_id: bytes):
+        self._target.add_forwarded(MetricType.GAUGE, new_id, t_nanos, value, meta)
+
+
+class Aggregator:
+    def __init__(self, num_shards: int = 64,
+                 clock: Optional[Callable[[], int]] = None,
+                 flush_handler: Optional[Handler] = None,
+                 election: Optional[ElectionManager] = None,
+                 flush_times: Optional[FlushTimesManager] = None,
+                 rate_limit_per_second: int = 0,
+                 default_policies: Sequence[StoragePolicy] = (),
+                 buffer_past_ns: int = 0):
+        self.num_shards = num_shards
+        self._clock = clock or (lambda: _time.time_ns())
+        self._rate_limit = rate_limit_per_second
+        self._default_policies = tuple(default_policies)
+        self._shards: Dict[int, AggregatorShard] = {}
+        self._owned = set(range(num_shards))
+        self._flush_handler = flush_handler
+        self._forward = ForwardedWriter(self)
+        self._flush_mgrs: Dict[int, FlushManager] = {}
+        self._election = election
+        self._flush_times = flush_times
+        self._buffer_past_ns = buffer_past_ns
+        self.writes_for_unowned_shard = 0
+
+    # -- placement ---------------------------------------------------------
+
+    def assign_shards(self, shard_ids: Sequence[int]):
+        """React to a placement change (aggregator.go:307 updateShardsWithLock):
+        new shards open, removed shards get a cutoff and stop accepting."""
+        new = set(shard_ids)
+        now = self._clock()
+        for sid in new - self._owned:
+            if sid in self._shards:
+                self._shards[sid].cutoff_nanos = 2**63 - 1
+        for sid in self._owned - new:
+            if sid in self._shards:
+                self._shards[sid].cutoff_nanos = now
+        self._owned = new
+
+    def owned_shards(self) -> List[int]:
+        return sorted(self._owned)
+
+    def shard_for(self, metric_id: bytes) -> int:
+        """aggregator/sharding/hash.go:89 — murmur3 % num_shards."""
+        return murmur3_32(metric_id) % self.num_shards
+
+    def _shard(self, metric_id: bytes) -> Optional[AggregatorShard]:
+        sid = self.shard_for(metric_id)
+        if sid not in self._owned:
+            self.writes_for_unowned_shard += 1
+            return None
+        shard = self._shards.get(sid)
+        if shard is None:
+            shard = self._shards[sid] = AggregatorShard(
+                sid, self._clock, self._rate_limit, self._default_policies)
+        return shard if shard.is_writeable() else None
+
+    # -- ingest ------------------------------------------------------------
+
+    def add_untimed(self, mu: MetricUnion,
+                    metadatas: Sequence[StagedMetadata] = ()) -> bool:
+        shard = self._shard(mu.id)
+        return shard is not None and shard.map.add_untimed(mu, metadatas)
+
+    def add_timed(self, metric_type: MetricType, metric_id: bytes,
+                  t_nanos: int, value: float, policy: StoragePolicy,
+                  aggregation_id: int = 0) -> bool:
+        shard = self._shard(metric_id)
+        return shard is not None and shard.map.add_timed(
+            metric_type, metric_id, t_nanos, value, policy, aggregation_id)
+
+    def add_forwarded(self, metric_type: MetricType, metric_id: bytes,
+                      t_nanos: int, value: float, meta: ForwardMetadata) -> bool:
+        shard = self._shard(metric_id)
+        return shard is not None and shard.map.add_forwarded(
+            metric_type, metric_id, t_nanos, value, meta)
+
+    # -- flush/tick --------------------------------------------------------
+
+    def _flush_mgr(self, shard: AggregatorShard) -> FlushManager:
+        mgr = self._flush_mgrs.get(shard.shard_id)
+        if mgr is None:
+            if self._election is None or self._flush_times is None:
+                raise RuntimeError("aggregator not configured for managed flush")
+            mgr = self._flush_mgrs[shard.shard_id] = FlushManager(
+                shard.lists, self._election, self._flush_times,
+                self._flush_handler, self._forward,
+                buffer_past_ns=self._buffer_past_ns, shard_id=shard.shard_id)
+        return mgr
+
+    def flush(self, now_nanos: Optional[int] = None) -> int:
+        """One flush pass over all owned shards, batched into a single device
+        reduction (list.reduce_and_emit). With an election manager the
+        leader/follower protocol gates emission; without one, flush directly
+        (the embedded coordinator downsampler runs leaderless,
+        downsample/leader_local.go)."""
+        from .flush import plan_jobs
+        from .list import reduce_and_emit
+
+        now = self._clock() if now_nanos is None else now_nanos
+        jobs, commits = [], []
+        for sid in sorted(self._shards):
+            shard = self._shards[sid]
+            if self._election is not None:
+                shard_jobs, commit = self._flush_mgr(shard).plan(now)
+                jobs.extend(shard_jobs)
+                commits.append(commit)
+            else:
+                jobs.extend(plan_jobs(shard.lists, now, self._buffer_past_ns,
+                                      self._flush_handler, self._forward))
+        total = reduce_and_emit(jobs)
+        for commit in commits:
+            commit()
+        return total
+
+    def tick(self) -> int:
+        """Expire idle entries across shards (aggregator.go tickInternal)."""
+        return sum(s.map.tick() for s in self._shards.values())
+
+    def num_entries(self) -> int:
+        return sum(len(s.map) for s in self._shards.values())

@@ -1,0 +1,215 @@
+"""Aggregation elements: per-(id, storage policy, agg types, pipeline) windowed
+state (reference: src/aggregator/aggregator/generic_elem.go:116 and the genny
+instantiations counter_elem_gen.go / gauge_elem_gen.go / timer_elem_gen.go).
+
+TPU-first redesign: the reference's elem holds one locked aggregation struct
+per time bucket and folds values in scalar-at-a-time (generic_elem.go:199
+AddUnion -> lockedAgg.Add). Here an elem only *stages* raw values columnar
+per bucket (cheap numpy appends on the ingest path); all reduction work is
+deferred to consume time, where the owning metric list pads every closed
+bucket of every elem into one (buckets x values) tile and reduces them in a
+single jitted device call (see list.py). That turns the per-datapoint hot
+loop into an MXU/VPU-friendly batch reduce and keeps the ingest path free of
+device transfers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..metrics import aggregation as magg
+from ..metrics.metadata import ForwardMetadata
+from ..metrics.metric import MetricType, MetricUnion
+from ..metrics.pipeline import OpType, Pipeline
+from ..metrics.policy import StoragePolicy
+from ..metrics.transformation import TransformType, apply as apply_transform, Datapoint
+
+
+@dataclasses.dataclass(frozen=True)
+class ElemKey:
+    """Identity of one aggregation element (aggregator/elem_base.go elemBase:
+    id x storage policy x aggregation types x remaining pipeline)."""
+
+    metric_id: bytes
+    storage_policy: StoragePolicy
+    aggregation_id: int = 0
+    pipeline: Pipeline = Pipeline()
+    num_forwarded_times: int = 0
+
+
+class _Bucket:
+    """Staged raw values for one aligned window (generic_elem.go timedAggregation,
+    minus the eager reduction)."""
+
+    __slots__ = ("chunks", "n")
+
+    def __init__(self):
+        self.chunks: List[np.ndarray] = []
+        self.n = 0
+
+    def add(self, values: np.ndarray):
+        self.chunks.append(values)
+        self.n += values.size
+
+    def concat(self) -> np.ndarray:
+        if not self.chunks:
+            return np.empty(0, dtype=np.float64)
+        if len(self.chunks) == 1:
+            return self.chunks[0]
+        return np.concatenate(self.chunks)
+
+
+class Elem:
+    """One metric's windowed aggregation state for one storage policy.
+
+    add_union/add_value stage values into the bucket for the aligned window;
+    closed_buckets hands (window_start, values) pairs to the list's batched
+    consumer and drops them (generic_elem.go:264 Consume).
+    """
+
+    def __init__(self, key: ElemKey, metric_type: MetricType,
+                 agg_types: Optional[Sequence[magg.AggType]] = None):
+        self.key = key
+        self.metric_type = metric_type
+        if agg_types is None:
+            if key.aggregation_id == magg.AggID.DEFAULT:
+                agg_types = magg.default_types_for(metric_type)
+            else:
+                agg_types = magg.AggID.decompress(key.aggregation_id)
+        self.agg_types: Tuple[magg.AggType, ...] = tuple(agg_types)
+        self.resolution_ns = key.storage_policy.resolution.window_ns
+        self._buckets: Dict[int, _Bucket] = {}
+        # Per-pipeline-transform previous datapoint, for binary transforms
+        # (PerSecond needs the prior window's value: generic_elem.go:300
+        # processValueWithAggregationLock keeps lastConsumedValues).
+        self._prev: Dict[int, Datapoint] = {}
+        self.tombstoned = False
+
+    # -- ingest path -------------------------------------------------------
+
+    def _bucket_for(self, t_nanos: int) -> _Bucket:
+        start = t_nanos - t_nanos % self.resolution_ns
+        b = self._buckets.get(start)
+        if b is None:
+            b = self._buckets[start] = _Bucket()
+        return b
+
+    def add_union(self, t_nanos: int, mu: MetricUnion):
+        if mu.type == MetricType.COUNTER:
+            self._bucket_for(t_nanos).add(np.asarray([mu.counter_val], dtype=np.float64))
+        elif mu.type == MetricType.GAUGE:
+            self._bucket_for(t_nanos).add(np.asarray([mu.gauge_val], dtype=np.float64))
+        elif mu.type == MetricType.TIMER:
+            self._bucket_for(t_nanos).add(np.asarray(mu.batch_timer_val, dtype=np.float64))
+        else:
+            raise ValueError(f"invalid metric type {mu.type}")
+
+    def add_value(self, t_nanos: int, value: float):
+        self._bucket_for(t_nanos).add(np.asarray([value], dtype=np.float64))
+
+    def add_values(self, t_nanos: int, values: np.ndarray):
+        self._bucket_for(t_nanos).add(np.asarray(values, dtype=np.float64))
+
+    # -- consume path ------------------------------------------------------
+
+    def closed_buckets(self, target_nanos: int) -> List[Tuple[int, np.ndarray]]:
+        """Pop buckets whose window has fully closed before target_nanos."""
+        out = []
+        for start in sorted(self._buckets):
+            if start + self.resolution_ns <= target_nanos:
+                out.append((start, self._buckets.pop(start).concat()))
+        return out
+
+    def is_empty(self) -> bool:
+        return not self._buckets
+
+    # -- post-reduction emission ------------------------------------------
+
+    def quantiles_needed(self) -> Tuple[float, ...]:
+        return tuple(sorted({q for t in self.agg_types if (q := t.quantile()) is not None}))
+
+    def emit(self, window_start: int, stats_row: Dict[str, float],
+             quantile_row: Dict[float, float],
+             flush_fn: Callable, forward_fn: Optional[Callable] = None):
+        """Turn one reduced window into flushed datapoints.
+
+        flush_fn(metric_id, time_nanos, value, storage_policy) per agg type;
+        an elem with remaining pipeline ops instead applies transforms and
+        forwards through forward_fn (aggregator/forwarded_writer.go).
+        Timestamp is the window end, matching the reference's convention
+        (generic_elem.go:283 timestamp = timeNanos + resolution).
+        """
+        end_nanos = window_start + self.resolution_ns
+        for at in self.agg_types:
+            q = at.quantile()
+            value = quantile_row[q] if q is not None else _stat_value(at, stats_row)
+            if self.key.pipeline.is_empty():
+                flush_fn(self._output_id(at), end_nanos, value, self.key.storage_policy)
+            else:
+                self._process_pipeline(at, end_nanos, value, flush_fn, forward_fn)
+
+    def _process_pipeline(self, at, t_nanos: int, value: float,
+                          flush_fn, forward_fn):
+        ops = self.key.pipeline.ops
+        dp = Datapoint(t_nanos, value)
+        for i, op in enumerate(ops):
+            if op.type == OpType.TRANSFORMATION:
+                tt: TransformType = op.transformation
+                prev = self._prev.get(int(at))
+                if tt.is_binary() and prev is None:
+                    self._prev[int(at)] = dp
+                    return
+                out = apply_transform(tt, prev, dp)
+                self._prev[int(at)] = dp
+                dp = out
+            elif op.type == OpType.ROLLUP:
+                if forward_fn is None:
+                    return
+                rop = op.rollup
+                meta = ForwardMetadata(
+                    aggregation_id=rop.aggregation_id,
+                    storage_policy=self.key.storage_policy,
+                    pipeline=self.key.pipeline.sub(i + 1),
+                    source_id=self.key.metric_id,
+                    num_forwarded_times=self.key.num_forwarded_times + 1,
+                )
+                forward_fn(rop.new_name, dp.time_nanos, dp.value, meta,
+                           self.key.metric_id)
+                return
+            else:
+                raise ValueError(f"unsupported pipeline op {op.type} in elem")
+        flush_fn(self._output_id(at), dp.time_nanos, dp.value, self.key.storage_policy)
+
+    def _output_id(self, at: magg.AggType) -> bytes:
+        """Aggregated output ID: base id + '.' + type suffix, suppressed when
+        the type is the metric type's single default (types_options.go
+        default type strings; counters default to bare 'id' for Sum,
+        gauges for Last)."""
+        defaults = magg.default_types_for(self.metric_type)
+        if len(defaults) == 1 and self.agg_types == tuple(defaults):
+            return self.key.metric_id
+        return self.key.metric_id + b"." + at.type_string.encode()
+
+
+def _stat_value(at: magg.AggType, stats: Dict[str, float]) -> float:
+    if at == magg.AggType.SUM:
+        return stats["sum"]
+    if at == magg.AggType.SUMSQ:
+        return stats["sumsq"]
+    if at == magg.AggType.COUNT:
+        return stats["count"]
+    if at == magg.AggType.MIN:
+        return stats["min"] if stats["count"] > 0 else 0.0
+    if at == magg.AggType.MAX:
+        return stats["max"] if stats["count"] > 0 else 0.0
+    if at == magg.AggType.LAST:
+        return stats["last"]
+    if at == magg.AggType.MEAN:
+        return stats["sum"] / stats["count"] if stats["count"] > 0 else 0.0
+    if at == magg.AggType.STDEV:
+        n = stats["count"]
+        return float(np.sqrt(stats["m2"] / (n - 1))) if n > 1 else 0.0
+    raise ValueError(f"no stat mapping for {at}")

@@ -1,0 +1,84 @@
+"""Flush output handlers (reference: src/aggregator/aggregator/handler/ —
+blackhole, logging, broadcast, protobuf->m3msg producer handler.go:38).
+
+A handler receives fully-aggregated datapoints (id, timestamp, value,
+storage policy). The production path publishes them onto the m3msg-style
+sharded pub/sub (m3_tpu.msg) for the coordinator's ingester to consume;
+tests use the capture/blackhole handlers."""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, List, NamedTuple, Sequence
+
+from ..metrics.policy import StoragePolicy
+
+
+class AggregatedMetric(NamedTuple):
+    id: bytes
+    time_nanos: int
+    value: float
+    storage_policy: StoragePolicy
+
+
+class Handler:
+    def handle(self, metric: AggregatedMetric):  # pragma: no cover - iface
+        raise NotImplementedError
+
+    # Adapter so handlers can be passed directly as MetricList flush_fn.
+    def __call__(self, metric_id: bytes, time_nanos: int, value: float,
+                 storage_policy: StoragePolicy):
+        self.handle(AggregatedMetric(metric_id, time_nanos, value, storage_policy))
+
+
+class BlackholeHandler(Handler):
+    """Drops everything (handler/blackhole.go)."""
+
+    def handle(self, metric: AggregatedMetric):
+        pass
+
+
+class CaptureHandler(Handler):
+    """Accumulates flushed metrics in memory — the test sink."""
+
+    def __init__(self):
+        self.metrics: List[AggregatedMetric] = []
+
+    def handle(self, metric: AggregatedMetric):
+        self.metrics.append(metric)
+
+    def by_id(self, metric_id: bytes) -> List[AggregatedMetric]:
+        return [m for m in self.metrics if m.id == metric_id]
+
+
+class LoggingHandler(Handler):
+    """handler/logging.go"""
+
+    def __init__(self, logger=None):
+        self._log = logger or logging.getLogger("m3_tpu.aggregator.flush")
+
+    def handle(self, metric: AggregatedMetric):
+        self._log.info("flush %s@%d=%g (%s)", metric.id, metric.time_nanos,
+                       metric.value, metric.storage_policy)
+
+
+class BroadcastHandler(Handler):
+    """Fan out to several handlers (handler/broadcast.go)."""
+
+    def __init__(self, handlers: Sequence[Handler]):
+        self._handlers = list(handlers)
+
+    def handle(self, metric: AggregatedMetric):
+        for h in self._handlers:
+            h.handle(metric)
+
+
+class CallbackHandler(Handler):
+    """Bridges to an arbitrary callable (used by the coordinator downsampler's
+    flush handler, src/cmd/services/m3coordinator/downsample/flush_handler.go)."""
+
+    def __init__(self, fn: Callable[[AggregatedMetric], None]):
+        self._fn = fn
+
+    def handle(self, metric: AggregatedMetric):
+        self._fn(metric)

@@ -1,0 +1,186 @@
+"""Metric lists: per-resolution collections of elems with batched device
+consumption (reference: src/aggregator/aggregator/list.go:296 Flush).
+
+The reference walks a linked list of elems and calls Consume on each, which
+re-reduces one locked struct per bucket. Here Flush gathers every closed
+bucket across all elems of the resolution, pads them into one
+(buckets x max_values) float64 tile, and reduces the whole tile in a single
+jitted call (window moments + exact sort quantiles from m3_tpu.ops.aggregation)
+— one device launch per flush per resolution, vmapped across metrics, instead
+of a Python loop of scalar folds.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .elem import Elem, ElemKey
+
+_LANE = 128  # pad the value axis to lane multiples to limit recompiles
+
+
+@functools.lru_cache(maxsize=64)
+def _quantile_rank_fn(width: int, qs: Tuple[float, ...]):
+    """Jitted batched rank selector: [B, width] f32 values + [B] counts ->
+    [B, len(qs)] i32 indices of each quantile element within its row.
+
+    The sort runs on device in f32 (what the VPU executes natively); only
+    *indices* come back, and the host gathers the exact float64 values by
+    index — so quantile outputs keep full f64 precision without the global
+    x64 flag (ordering ties at f32 granularity pick either of two values
+    that agree to 2^-24, far inside the reference CM sketch's eps-rank
+    tolerance, quantile/cm/stream.go).
+    """
+
+    def fn(values, counts):
+        mask = jnp.arange(width)[None, :] < counts[:, None]
+        filled = jnp.where(mask, values, jnp.inf)
+        order = jnp.argsort(filled, axis=-1).astype(jnp.int32)
+        outs = []
+        for q in qs:
+            # Target rank ceil(q*n), q=0 -> rank 1 (cm/stream.go:160).
+            rank = jnp.ceil(q * counts).astype(jnp.int32)
+            idx = jnp.clip(jnp.maximum(rank, 1) - 1, 0, width - 1)
+            outs.append(jnp.take_along_axis(order, idx[:, None], axis=-1)[:, 0])
+        return jnp.stack(outs, axis=-1)
+
+    return jax.jit(fn)
+
+
+def batched_reduce(buckets: List[np.ndarray], qs: Tuple[float, ...]):
+    """Reduce a ragged list of value arrays: mergeable moments + quantiles.
+
+    Moments (sum/sumsq/count/min/max/first/last/m2) are one vectorized host
+    pass over the concatenated values (np.reduceat — exact f64, matching the
+    reference's float64 accumulators); the heavy O(W log W) work, batched
+    quantile ordering, runs on device. Returns (stats_rows, quantile_rows):
+    per-bucket dicts of python floats.
+    """
+    if not buckets:
+        return [], []
+    counts = np.array([b.size for b in buckets], dtype=np.int64)
+    nonempty = counts > 0
+    safe = [b if b.size else np.zeros(1) for b in buckets]
+    sizes = np.maximum(counts, 1)
+    starts = np.zeros(len(safe), dtype=np.int64)
+    starts[1:] = np.cumsum(sizes)[:-1]
+    cat = np.concatenate(safe)
+    sums = np.where(nonempty, np.add.reduceat(cat, starts), 0.0)
+    sumsq = np.where(nonempty, np.add.reduceat(cat * cat, starts), 0.0)
+    mins = np.where(nonempty, np.minimum.reduceat(cat, starts), np.inf)
+    maxs = np.where(nonempty, np.maximum.reduceat(cat, starts), -np.inf)
+    first = np.where(nonempty, cat[starts], 0.0)
+    last = np.where(nonempty, cat[starts + sizes - 1], 0.0)
+    mu = np.where(nonempty, sums / sizes, 0.0)
+    dev = cat - np.repeat(mu, sizes)
+    m2 = np.where(nonempty, np.add.reduceat(dev * dev, starts), 0.0)
+    stats_rows = [
+        {
+            "sum": float(sums[i]), "sumsq": float(sumsq[i]),
+            "count": float(counts[i]), "min": float(mins[i]),
+            "max": float(maxs[i]), "first": float(first[i]),
+            "last": float(last[i]), "m2": float(m2[i]),
+        }
+        for i in range(len(buckets))
+    ]
+    if not qs:
+        return stats_rows, [{} for _ in buckets]
+    max_n = max(1, int(counts.max()))
+    width = ((max_n + _LANE - 1) // _LANE) * _LANE
+    tile = np.zeros((len(buckets), width), dtype=np.float32)
+    for i, b in enumerate(buckets):
+        tile[i, : b.size] = b
+    idx = np.asarray(
+        _quantile_rank_fn(width, qs)(tile, counts.astype(np.int32))
+    )
+    quantile_rows = [
+        {
+            q: float(buckets[i][min(idx[i, j], counts[i] - 1)]) if counts[i] else 0.0
+            for j, q in enumerate(qs)
+        }
+        for i in range(len(buckets))
+    ]
+    return stats_rows, quantile_rows
+
+
+def reduce_and_emit(jobs) -> int:
+    """Reduce a batch of (elem, window_start, values, flush_fn, forward_fn)
+    jobs — possibly gathered across many lists and shards — in one device
+    call, then emit each window through its own sink."""
+    if not jobs:
+        return 0
+    qset = set()
+    for elem, _, _, _, _ in jobs:
+        qset.update(elem.quantiles_needed())
+    qs = tuple(sorted(qset))
+    stats_rows, quantile_rows = batched_reduce([j[2] for j in jobs], qs)
+    for (elem, start, _, flush_fn, forward_fn), srow, qrow in zip(
+            jobs, stats_rows, quantile_rows):
+        elem.emit(start, srow, qrow, flush_fn, forward_fn)
+    return len(jobs)
+
+
+class MetricList:
+    """All elems sharing one resolution (list.go metricList); flushes are
+    aligned to resolution boundaries by the flush manager."""
+
+    def __init__(self, resolution_ns: int):
+        self.resolution_ns = resolution_ns
+        self._elems: Dict[ElemKey, Elem] = {}
+
+    def get_or_create(self, key: ElemKey, factory: Callable[[], Elem]) -> Elem:
+        e = self._elems.get(key)
+        if e is None:
+            e = self._elems[key] = factory()
+        return e
+
+    def __len__(self):
+        return len(self._elems)
+
+    def elems(self) -> List[Elem]:
+        return list(self._elems.values())
+
+    def collect(self, target_nanos: int) -> List[Tuple[Elem, int, np.ndarray]]:
+        """Pop every window closed before target_nanos as (elem, start, values)
+        jobs, and GC drained tombstoned elems (list.go removes closed elems)."""
+        jobs = []
+        for elem in self._elems.values():
+            for start, vals in elem.closed_buckets(target_nanos):
+                jobs.append((elem, start, vals))
+        self._elems = {
+            k: e for k, e in self._elems.items()
+            if not (e.tombstoned and e.is_empty())
+        }
+        return jobs
+
+    def flush(self, target_nanos: int, flush_fn: Callable,
+              forward_fn: Optional[Callable] = None) -> int:
+        """Consume every window closed before target_nanos across all elems in
+        one batched device reduction. Returns number of windows consumed."""
+        jobs = self.collect(target_nanos)
+        reduce_and_emit([(e, s, v, flush_fn, forward_fn) for e, s, v in jobs])
+        return len(jobs)
+
+
+class MetricLists:
+    """Resolution -> MetricList registry (list.go metricLists)."""
+
+    def __init__(self):
+        self._lists: Dict[int, MetricList] = {}
+
+    def for_resolution(self, resolution_ns: int) -> MetricList:
+        lst = self._lists.get(resolution_ns)
+        if lst is None:
+            lst = self._lists[resolution_ns] = MetricList(resolution_ns)
+        return lst
+
+    def resolutions(self) -> List[int]:
+        return sorted(self._lists)
+
+    def lists(self) -> List[MetricList]:
+        return [self._lists[r] for r in sorted(self._lists)]
