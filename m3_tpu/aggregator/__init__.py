@@ -21,6 +21,8 @@ from .handler import (
     CaptureHandler,
     Handler,
     LoggingHandler,
+    ProducerHandler,
+    decode_aggregated,
 )
 from .list import MetricList, MetricLists, batched_reduce
 
@@ -29,6 +31,6 @@ __all__ = [
     "BlackholeHandler", "BroadcastHandler", "CallbackHandler", "CaptureHandler",
     "Elem", "ElemKey", "ElectionManager", "ElectionState", "Entry",
     "FlushManager", "FlushTimesManager", "ForwardedWriter", "Handler",
-    "LoggingHandler", "MetricList", "MetricLists", "MetricMap", "RateLimiter",
+    "LoggingHandler", "ProducerHandler", "decode_aggregated", "MetricList", "MetricLists", "MetricMap", "RateLimiter",
     "batched_reduce",
 ]

@@ -82,3 +82,38 @@ class CallbackHandler(Handler):
 
     def handle(self, metric: AggregatedMetric):
         self._fn(metric)
+
+
+class ProducerHandler(Handler):
+    """Publishes flushed metrics onto an m3msg producer (handler/protobuf.go:38
+    NewProtobufHandler), sharded by metric id the same way the data plane
+    shards series. The coordinator's m3msg ingester decodes and writes to
+    storage (src/cmd/services/m3coordinator/ingest/m3msg)."""
+
+    def __init__(self, producer, num_shards: int):
+        from ..rpc import wire
+        from ..utils.hashing import murmur3_32
+
+        self._producer = producer
+        self._num_shards = num_shards
+        self._encode = wire.encode
+        self._hash = murmur3_32
+
+    def handle(self, metric: AggregatedMetric):
+        payload = self._encode({
+            "id": metric.id,
+            "t": metric.time_nanos,
+            "v": metric.value,
+            "sp": str(metric.storage_policy),
+        })
+        self._producer.publish(self._hash(metric.id) % self._num_shards, payload)
+
+
+def decode_aggregated(payload: bytes) -> AggregatedMetric:
+    """Inverse of ProducerHandler's encoding, for the coordinator ingester."""
+    from ..metrics.policy import StoragePolicy
+    from ..rpc import wire
+
+    obj = wire.decode(payload)
+    return AggregatedMetric(
+        obj["id"], obj["t"], obj["v"], StoragePolicy.parse(obj["sp"]))

@@ -1,0 +1,6 @@
+"""Collector: rule-matched metric forwarding agent (reference: src/collector
+— alpha per collector/README.md, reporter + aggregator client)."""
+
+from .reporter import Reporter
+
+__all__ = ["Reporter"]

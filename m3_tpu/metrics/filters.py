@@ -101,5 +101,12 @@ class TagsFilter:
     def __repr__(self):
         return f"TagsFilter({self.patterns!r})"
 
+    def to_json(self) -> Dict[str, str]:
+        return dict(self.patterns)
+
+    @staticmethod
+    def from_json(obj: Mapping[str, str]) -> "TagsFilter":
+        return TagsFilter(obj)
+
 
 MATCH_ALL = TagsFilter({})

@@ -1,0 +1,175 @@
+"""Rule matcher: KV-watched rule sets compiled per namespace with a result
+cache (reference: src/metrics/matcher/{match.go,ruleset.go,namespaces.go,
+cache/cache.go}).
+
+The collector/coordinator matches every incoming metric ID against the
+namespace's active rule set; match results carry an expiry (the next rule
+cutover) so the cache invalidates itself exactly when rules change."""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Callable, Dict, Optional
+
+from ..cluster import kv as cluster_kv
+from .filters import TagsFilter
+from .pipeline import Op, Pipeline
+from .policy import StoragePolicy
+from .rules import (
+    MappingRuleSnapshot,
+    MatchResult,
+    RollupRuleSnapshot,
+    RollupTarget,
+    Rule,
+    RuleSet,
+)
+
+
+def ruleset_to_json(rs: RuleSet) -> dict:
+    """Serialize a rule set for KV storage (the reference stores protobuf
+    rule sets under one key per namespace, matcher/ruleset.go kv watch)."""
+
+    def snap(s):
+        if isinstance(s, MappingRuleSnapshot):
+            return {
+                "kind": "mapping", "name": s.name, "cutover": s.cutover_nanos,
+                "filter": s.filter.to_json(),
+                "agg_id": s.aggregation_id,
+                "policies": [str(p) for p in s.storage_policies],
+                "drop": s.drop_policy, "tomb": s.tombstoned,
+            }
+        return {
+            "kind": "rollup", "name": s.name, "cutover": s.cutover_nanos,
+            "filter": s.filter.to_json(), "tomb": s.tombstoned,
+            "targets": [
+                {
+                    "new_name": t.pipeline.ops[0].rollup.new_name.decode()
+                    if t.pipeline.ops and t.pipeline.ops[0].rollup else "",
+                    "tags": [
+                        tg.decode()
+                        for tg in (t.pipeline.ops[0].rollup.tags
+                                   if t.pipeline.ops and t.pipeline.ops[0].rollup else ())
+                    ],
+                    "agg_id": (t.pipeline.ops[0].rollup.aggregation_id
+                               if t.pipeline.ops and t.pipeline.ops[0].rollup else 0),
+                    "policies": [str(p) for p in t.storage_policies],
+                }
+                for t in s.targets
+            ],
+        }
+
+    return {
+        "namespace": rs.namespace.decode(),
+        "version": rs.version,
+        "tombstoned": rs.tombstoned,
+        "mapping": [[snap(s) for s in r.snapshots] for r in rs.mapping_rules],
+        "rollup": [[snap(s) for s in r.snapshots] for r in rs.rollup_rules],
+    }
+
+
+def ruleset_from_json(obj: dict) -> RuleSet:
+    def unsnap(d):
+        filt = TagsFilter.from_json(d["filter"])
+        if d["kind"] == "mapping":
+            return MappingRuleSnapshot(
+                d["name"], d["cutover"], filt, d["agg_id"],
+                tuple(StoragePolicy.parse(p) for p in d["policies"]),
+                d["drop"], d["tomb"],
+            )
+        return RollupRuleSnapshot(
+            d["name"], d["cutover"], filt,
+            tuple(
+                RollupTarget(
+                    Pipeline((Op.roll(t["new_name"].encode(),
+                                      tuple(tg.encode() for tg in t["tags"]),
+                                      t["agg_id"]),)),
+                    tuple(StoragePolicy.parse(p) for p in t["policies"]),
+                )
+                for t in d["targets"]
+            ),
+            d["tomb"],
+        )
+
+    return RuleSet(
+        obj["namespace"].encode(), obj["version"],
+        [Rule([unsnap(s) for s in snaps]) for snaps in obj["mapping"]],
+        [Rule([unsnap(s) for s in snaps]) for snaps in obj["rollup"]],
+        obj["tombstoned"],
+    )
+
+
+class RuleSetStore:
+    """Publish/read rule sets in KV, one key per namespace
+    (matcher/namespaces.go namespaces key + per-ns ruleset keys)."""
+
+    def __init__(self, store: cluster_kv.MemStore, prefix: str = "_rules"):
+        self._store = store
+        self._prefix = prefix
+
+    def _key(self, namespace: bytes) -> str:
+        return f"{self._prefix}/{namespace.decode()}"
+
+    def publish(self, rs: RuleSet) -> int:
+        return self._store.set(
+            self._key(rs.namespace), json.dumps(ruleset_to_json(rs)).encode())
+
+    def get(self, namespace: bytes) -> Optional[RuleSet]:
+        val = self._store.get(self._key(namespace))
+        if val is None:
+            return None
+        return ruleset_from_json(json.loads(val.data.decode()))
+
+    def on_change(self, namespace: bytes, fn: Callable[[RuleSet], None]):
+        self._store.on_change(
+            self._key(namespace),
+            lambda _k, v: fn(ruleset_from_json(json.loads(v.data.decode()))))
+
+
+class Matcher:
+    """Per-namespace matcher with KV watch + expiring result cache
+    (matcher/match.go, cache/cache.go)."""
+
+    def __init__(self, store: RuleSetStore, namespace: bytes,
+                 clock: Optional[Callable[[], int]] = None,
+                 cache_capacity: int = 65536):
+        import time as _time
+
+        self._store = store
+        self._namespace = namespace
+        self._clock = clock or _time.time_ns
+        self._lock = threading.Lock()
+        self._cache: Dict[bytes, MatchResult] = {}
+        self._capacity = cache_capacity
+        rs = store.get(namespace)
+        self._active = rs.active_set() if rs is not None else None
+        store.on_change(namespace, self._on_ruleset_change)
+        self.hits = 0
+        self.misses = 0
+
+    def _on_ruleset_change(self, rs: RuleSet):
+        with self._lock:
+            self._active = rs.active_set()
+            self._cache.clear()  # new version invalidates everything
+
+    def match(self, metric_id: bytes,
+              from_nanos: Optional[int] = None,
+              to_nanos: Optional[int] = None) -> Optional[MatchResult]:
+        now = self._clock()
+        from_nanos = now if from_nanos is None else from_nanos
+        to_nanos = now + 1 if to_nanos is None else to_nanos
+        with self._lock:
+            active = self._active
+            cached = self._cache.get(metric_id)
+            if cached is not None and not cached.has_expired(now):
+                self.hits += 1
+                return cached
+        if active is None:
+            return None
+        self.misses += 1
+        result = active.forward_match(metric_id, from_nanos, to_nanos)
+        with self._lock:
+            if len(self._cache) >= self._capacity:
+                self._cache.clear()  # simple full-flush eviction
+            self._cache[metric_id] = result
+        return result

@@ -1,0 +1,68 @@
+"""Consumer: TCP listener for framed messages with explicit acks (reference:
+src/msg/consumer/{consumer,handlers}.go — proto-framed Message/Ack exchange,
+the handler acks after processing so redelivery stops).
+
+Wire messages ride the shared framed codec (m3_tpu.rpc.wire):
+  {"t": "msg", "shard": i64, "id": i64, "sent_at": i64, "value": bytes}
+  {"t": "ack", "ids": [i64, ...]}   (consumer -> producer, batched)
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import threading
+from typing import Callable, List, Optional
+
+from ..rpc import wire
+
+
+class Consumer:
+    """Listens for producer connections; calls handler(shard, value) for each
+    message and acks it (consumer/handlers.go messageHandler)."""
+
+    def __init__(self, handler: Callable[[int, bytes], None],
+                 host: str = "127.0.0.1", port: int = 0,
+                 ack_batch: int = 1):
+        self._handler = handler
+        self._ack_batch = ack_batch
+        outer = self
+
+        class _Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                sock = self.request
+                pending_acks: List[int] = []
+                try:
+                    while True:
+                        frame = wire.read_frame(sock)
+                        if frame is None or frame.get("t") != "msg":
+                            continue
+                        outer._handler(frame["shard"], frame["value"])
+                        pending_acks.append(frame["id"])
+                        if len(pending_acks) >= outer._ack_batch:
+                            wire.write_frame(sock, {"t": "ack", "ids": pending_acks})
+                            pending_acks = []
+                except (ConnectionError, OSError):
+                    pass
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = _Server((host, port), _Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def endpoint(self) -> str:
+        h, p = self._server.server_address
+        return f"{h}:{p}"
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def close(self):
+        self._server.shutdown()
+        self._server.server_close()

@@ -1,0 +1,264 @@
+"""Producer: ref-counted buffered publish with at-least-once delivery
+(reference: src/msg/producer/{producer,buffer}.go and producer/writer/ —
+message_writer.go retry-until-ack, consumer_service_writer.go per-service
+fan-out, shard_writer.go shard->instance routing).
+
+A published message is ref-counted across the topic's consumer services;
+each service's message writer keeps it queued until that service acks it,
+retrying over the connection with backoff. The buffer enforces a max-bytes
+cap by dropping the oldest unacked messages (buffer.go dropOldest), which
+bounds memory during consumer outages at the cost of redelivery loss —
+the same tradeoff the reference makes.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..cluster.placement import Placement, ShardState
+from ..rpc import wire
+from .topic import ConsumptionType, Topic
+
+
+class _Message:
+    __slots__ = ("id", "shard", "value", "refs", "size", "sent_at")
+
+    def __init__(self, mid: int, shard: int, value: bytes, refs: int):
+        self.id = mid
+        self.shard = shard
+        self.value = value
+        self.refs = refs
+        self.size = len(value)
+        self.sent_at = 0
+
+
+class MessageWriter:
+    """Per-connection write loop with ack tracking (writer/message_writer.go):
+    messages stay queued until acked; a retry pass rewrites everything unacked
+    older than the retry delay."""
+
+    def __init__(self, connect: Callable[[], "wire.socket.socket"],
+                 retry_delay_s: float = 0.2):
+        self._connect = connect
+        self._retry_delay_s = retry_delay_s
+        self._lock = threading.Lock()
+        self._queue: Dict[int, _Message] = {}
+        self._sock = None
+        self._reader: Optional[threading.Thread] = None
+        self._closed = False
+        self._on_ack: Optional[Callable[[_Message], None]] = None
+        self.acked = 0
+        self.retried = 0
+
+    def write(self, msg: _Message):
+        with self._lock:
+            self._queue[msg.id] = msg
+        self._send(msg)
+
+    def _ensure_conn(self) -> bool:
+        if self._sock is not None:
+            return True
+        try:
+            self._sock = self._connect()
+        except OSError:
+            self._sock = None
+            return False
+        self._reader = threading.Thread(target=self._read_acks, daemon=True)
+        self._reader.start()
+        return True
+
+    def _send(self, msg: _Message) -> bool:
+        if not self._ensure_conn():
+            return False
+        try:
+            wire.write_frame(self._sock, {
+                "t": "msg", "shard": msg.shard, "id": msg.id,
+                "sent_at": time.monotonic_ns(), "value": msg.value,
+            })
+            msg.sent_at = time.monotonic_ns()
+            return True
+        except OSError:
+            self._drop_conn()
+            return False
+
+    def _drop_conn(self):
+        sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _read_acks(self):
+        sock = self._sock
+        try:
+            while not self._closed and sock is self._sock:
+                frame = wire.read_frame(sock)
+                if frame.get("t") != "ack":
+                    continue
+                with self._lock:
+                    msgs = [self._queue.pop(i) for i in frame["ids"] if i in self._queue]
+                for m in msgs:
+                    self.acked += 1
+                    if self._on_ack is not None:
+                        self._on_ack(m)
+        except (OSError, ConnectionError, Exception):
+            pass
+
+    def retry_unacked(self):
+        """One retry pass (message_writer.go scanMessageQueue)."""
+        cutoff = time.monotonic_ns() - int(self._retry_delay_s * 1e9)
+        with self._lock:
+            stale = [m for m in self._queue.values() if m.sent_at <= cutoff]
+        for m in stale:
+            self.retried += 1
+            if not self._send(m):
+                break
+
+    def unacked(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def unacked_messages(self) -> List[_Message]:
+        with self._lock:
+            return list(self._queue.values())
+
+    def forget(self, mid: int) -> Optional[_Message]:
+        with self._lock:
+            return self._queue.pop(mid, None)
+
+    def close(self):
+        self._closed = True
+        self._drop_conn()
+
+
+class ConsumerServiceWriter:
+    """Routes each shard to the consumer-service instance owning it per the
+    service's placement (writer/consumer_service_writer.go), one MessageWriter
+    per instance endpoint."""
+
+    def __init__(self, service_id: str,
+                 placement_getter: Callable[[], Optional[Placement]],
+                 connect: Callable[[str], "wire.socket.socket"],
+                 retry_delay_s: float = 0.2):
+        self.service_id = service_id
+        self._placement = placement_getter
+        self._connect = connect
+        self._retry_delay_s = retry_delay_s
+        self._writers: Dict[str, MessageWriter] = {}
+        self._on_ack: Optional[Callable[[_Message], None]] = None
+
+    def _writer_for(self, endpoint: str) -> MessageWriter:
+        w = self._writers.get(endpoint)
+        if w is None:
+            w = MessageWriter(lambda: self._connect(endpoint), self._retry_delay_s)
+            w._on_ack = self._on_ack
+            self._writers[endpoint] = w
+        return w
+
+    def write(self, msg: _Message) -> bool:
+        p = self._placement()
+        if p is None:
+            return False
+        shard = msg.shard % p.num_shards
+        sent = False
+        for inst in p.replicas_for(shard, states=(ShardState.INITIALIZING,
+                                                  ShardState.AVAILABLE)):
+            self._writer_for(inst.endpoint).write(msg)
+            sent = True
+            break  # shared consumption: one instance per shard
+        return sent
+
+    def retry_unacked(self):
+        for w in self._writers.values():
+            w.retry_unacked()
+
+    def unacked(self) -> int:
+        return sum(w.unacked() for w in self._writers.values())
+
+    def close(self):
+        for w in self._writers.values():
+            w.close()
+
+
+class Producer:
+    """Topic-level publish API (producer/producer.go): ref-counts each message
+    across consumer services, enforces the buffer cap with drop-oldest."""
+
+    def __init__(self, topic: Topic,
+                 service_placements: Dict[str, Callable[[], Optional[Placement]]],
+                 connect: Callable[[str], "wire.socket.socket"] = None,
+                 max_buffer_bytes: int = 64 * 1024 * 1024,
+                 retry_delay_s: float = 0.2):
+        self.topic = topic
+        self._next_id = 0
+        self._max_buffer_bytes = max_buffer_bytes
+        self._buffered_bytes = 0
+        self._lock = threading.Lock()
+        self._order: List[_Message] = []  # oldest first, for drop-oldest
+        connect = connect or _default_connect
+        self._service_writers = [
+            ConsumerServiceWriter(cs.service_id, service_placements[cs.service_id],
+                                  connect, retry_delay_s)
+            for cs in topic.consumer_services
+        ]
+        for w in self._service_writers:
+            w._on_ack = self._message_acked
+        self.dropped_oldest = 0
+
+    def publish(self, shard: int, value: bytes) -> int:
+        """Publish one message to every consumer service; returns message id."""
+        with self._lock:
+            mid = self._next_id
+            self._next_id += 1
+            msg = _Message(mid, shard, value, refs=len(self._service_writers))
+            self._order.append(msg)
+            self._buffered_bytes += msg.size
+        self._enforce_buffer()
+        for w in self._service_writers:
+            w.write(msg)
+        return mid
+
+    def _message_acked(self, msg: _Message):
+        with self._lock:
+            msg.refs -= 1
+            if msg.refs <= 0 and msg in self._order:
+                self._order.remove(msg)
+                self._buffered_bytes -= msg.size
+
+    def _enforce_buffer(self):
+        """Drop oldest until under the cap (producer/buffer.go dropOldest)."""
+        with self._lock:
+            while self._buffered_bytes > self._max_buffer_bytes and self._order:
+                victim = self._order.pop(0)
+                self._buffered_bytes -= victim.size
+                self.dropped_oldest += 1
+                for w in self._service_writers:
+                    for mw in w._writers.values():
+                        mw.forget(victim.id)
+
+    def retry_unacked(self):
+        for w in self._service_writers:
+            w.retry_unacked()
+
+    def unacked(self) -> int:
+        return sum(w.unacked() for w in self._service_writers)
+
+    def buffered_bytes(self) -> int:
+        with self._lock:
+            return self._buffered_bytes
+
+    def close(self):
+        for w in self._service_writers:
+            w.close()
+
+
+def _default_connect(endpoint: str):
+    import socket as _socket
+
+    host, _, port = endpoint.rpartition(":")
+    s = _socket.create_connection((host, int(port)), timeout=5.0)
+    s.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+    return s

@@ -1,0 +1,259 @@
+"""m3msg pub/sub + matcher + collector tests (reference behaviors:
+at-least-once delivery with acks, drop-oldest buffering, KV-watched rule
+matching with cache invalidation, end-to-end collector->aggregator flow)."""
+
+import threading
+import time
+
+import pytest
+
+from m3_tpu.aggregator import Aggregator, AggregatorClient, CaptureHandler
+from m3_tpu.cluster import kv as cluster_kv
+from m3_tpu.cluster.placement import Instance, initial_placement
+from m3_tpu.collector import Reporter
+from m3_tpu.metrics import aggregation as magg
+from m3_tpu.metrics import id as metric_id
+from m3_tpu.metrics.filters import TagsFilter
+from m3_tpu.metrics.matcher import Matcher, RuleSetStore
+from m3_tpu.metrics.pipeline import Op, Pipeline
+from m3_tpu.metrics.policy import StoragePolicy
+from m3_tpu.metrics.rules import (
+    MappingRuleSnapshot,
+    RollupRuleSnapshot,
+    RollupTarget,
+    Rule,
+    RuleSet,
+)
+from m3_tpu.msg import Consumer, ConsumerService, Producer, Topic, TopicService
+from m3_tpu.testing.cluster import SettableClock
+
+S = 1_000_000_000
+TEN_S = StoragePolicy.of("10s", "2d")
+ONE_M = StoragePolicy.of("1m", "40d")
+
+
+def _await(cond, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def one_instance_placement(endpoint, num_shards=4):
+    return initial_placement(
+        [Instance(id="c0", endpoint=endpoint)], num_shards=num_shards,
+        replica_factor=1)
+
+
+class TestTopicService:
+    def test_crud_and_watch(self):
+        store = cluster_kv.MemStore()
+        svc = TopicService(store)
+        t = Topic("aggregated_metrics", 4).add_consumer(
+            ConsumerService("coordinator"))
+        svc.upsert(t)
+        got = svc.get("aggregated_metrics")
+        assert got.num_shards == 4
+        assert got.consumer_services[0].service_id == "coordinator"
+        seen = []
+        svc.on_change("aggregated_metrics", lambda topic: seen.append(topic))
+        svc.upsert(got.remove_consumer("coordinator"))
+        assert seen and not seen[-1].consumer_services
+
+
+class TestProducerConsumer:
+    def test_publish_consume_ack(self):
+        received = []
+        consumer = Consumer(lambda shard, value: received.append((shard, value))).start()
+        try:
+            topic = Topic("t", 4, (ConsumerService("svc"),))
+            p = one_instance_placement(consumer.endpoint)
+            prod = Producer(topic, {"svc": lambda: p})
+            for i in range(20):
+                prod.publish(i % 4, b"payload-%d" % i)
+            assert _await(lambda: len(received) == 20)
+            assert _await(lambda: prod.unacked() == 0)
+            # Ref-counted buffer drains once every consumer service acked.
+            assert _await(lambda: prod.buffered_bytes() == 0)
+            assert {v for _, v in received} == {b"payload-%d" % i for i in range(20)}
+            prod.close()
+        finally:
+            consumer.close()
+
+    def test_redelivery_after_consumer_restart(self):
+        """Messages published while the consumer is down are redelivered by
+        the retry pass once it returns (at-least-once, message_writer.go)."""
+        received = []
+        consumer = Consumer(lambda s, v: received.append(v)).start()
+        endpoint = consumer.endpoint
+        host, _, port = endpoint.rpartition(":")
+        topic = Topic("t", 1, (ConsumerService("svc"),))
+        p = one_instance_placement(endpoint, num_shards=1)
+        prod = Producer(topic, {"svc": lambda: p}, retry_delay_s=0.05)
+        try:
+            prod.publish(0, b"before")
+            assert _await(lambda: received == [b"before"])
+            consumer.close()
+            time.sleep(0.05)
+            prod.publish(0, b"during")  # connection is dead -> send fails
+            # Restart a consumer on the SAME port.
+            consumer = Consumer(lambda s, v: received.append(v),
+                                port=int(port)).start()
+            for _ in range(100):
+                prod.retry_unacked()
+                if b"during" in received:
+                    break
+                time.sleep(0.05)
+            assert b"during" in received
+            assert _await(lambda: prod.unacked() == 0)
+        finally:
+            prod.close()
+            consumer.close()
+
+    def test_drop_oldest_bounds_buffer(self):
+        # No consumer reachable: everything stays buffered; cap forces drops.
+        topic = Topic("t", 1, (ConsumerService("svc"),))
+        dead = one_instance_placement("127.0.0.1:1", num_shards=1)
+        prod = Producer(topic, {"svc": lambda: dead}, max_buffer_bytes=1000)
+        for i in range(50):
+            prod.publish(0, b"x" * 100)
+        assert prod.buffered_bytes() <= 1000
+        assert prod.dropped_oldest >= 40
+        prod.close()
+
+
+class TestMatcher:
+    def _publish_rules(self, store, policies=(TEN_S,), version=1):
+        rs = RuleSet(
+            b"default", version,
+            mapping_rules=[Rule([MappingRuleSnapshot(
+                "api-metrics", 0, TagsFilter({"service": "api"}),
+                0, tuple(policies))])],
+            rollup_rules=[Rule([RollupRuleSnapshot(
+                "per-region", 0, TagsFilter({"service": "api"}),
+                (RollupTarget(
+                    Pipeline((Op.roll(b"api_by_region", (b"region",),
+                                      magg.AggID.compress([magg.AggType.SUM])),)),
+                    (ONE_M,)),))])],
+        )
+        RuleSetStore(store).publish(rs)
+        return rs
+
+    def test_match_and_cache(self):
+        store = cluster_kv.MemStore()
+        clock = SettableClock(100 * S)
+        self._publish_rules(store)
+        m = Matcher(RuleSetStore(store), b"default", clock=clock)
+        mid = metric_id.encode(b"requests", {b"service": b"api", b"region": b"us"})
+        r1 = m.match(mid)
+        assert r1 is not None
+        policies = r1.for_existing_id[0].metadata.pipelines[0].storage_policies
+        assert policies == (TEN_S,)
+        assert len(r1.for_new_rollup_ids) == 1
+        rid = r1.for_new_rollup_ids[0].id
+        assert b"api_by_region" in rid and b"region" in rid
+        m.match(mid)
+        assert m.hits == 1 and m.misses == 1
+
+    def test_rules_update_invalidates_cache(self):
+        store = cluster_kv.MemStore()
+        clock = SettableClock(100 * S)
+        self._publish_rules(store)
+        rstore = RuleSetStore(store)
+        m = Matcher(rstore, b"default", clock=clock)
+        mid = metric_id.encode(b"requests", {b"service": b"api"})
+        r1 = m.match(mid)
+        self._publish_rules(store, policies=(TEN_S, ONE_M), version=2)
+        r2 = m.match(mid)
+        assert r2.for_existing_id[0].metadata.pipelines[0].storage_policies == (
+            TEN_S, ONE_M)
+
+    def test_no_match_gives_empty_metadata(self):
+        store = cluster_kv.MemStore()
+        clock = SettableClock(100 * S)
+        self._publish_rules(store)
+        m = Matcher(RuleSetStore(store), b"default", clock=clock)
+        mid = metric_id.encode(b"other", {b"service": b"web"})
+        r = m.match(mid)
+        assert r.for_existing_id[0].metadata.pipelines == ()
+
+
+class TestProducerHandler:
+    def test_flush_rides_m3msg_to_consumer(self):
+        """aggregator flush -> ProducerHandler -> m3msg TCP -> consumer
+        decode (the §3.4 handler.Handle -> m3msg -> coordinator hop)."""
+        from m3_tpu.aggregator import ProducerHandler, decode_aggregated
+        from m3_tpu.metrics.metadata import Metadata, PipelineMetadata, StagedMetadata
+        from m3_tpu.metrics.metric import MetricUnion
+
+        received = []
+        consumer = Consumer(
+            lambda shard, value: received.append(decode_aggregated(value))).start()
+        try:
+            topic = Topic("aggregated_metrics", 4, (ConsumerService("coord"),))
+            p = one_instance_placement(consumer.endpoint)
+            prod = Producer(topic, {"coord": lambda: p})
+            clock = SettableClock(100 * S)
+            agg = Aggregator(num_shards=8, clock=clock,
+                             flush_handler=ProducerHandler(prod, 4))
+            md = (StagedMetadata(0, False, Metadata((PipelineMetadata(0, (TEN_S,)),))),)
+            agg.add_untimed(MetricUnion.counter(b"total_requests", 41), md)
+            agg.add_untimed(MetricUnion.counter(b"total_requests", 1), md)
+            clock.advance(10 * S)
+            agg.flush()
+            assert _await(lambda: len(received) == 1)
+            m = received[0]
+            assert m.id == b"total_requests"
+            assert m.value == 42.0
+            assert m.time_nanos == 110 * S
+            assert m.storage_policy == TEN_S
+            prod.close()
+        finally:
+            consumer.close()
+
+
+class TestCollectorEndToEnd:
+    def test_report_through_aggregator(self):
+        """collector Reporter -> matcher -> aggregator client -> aggregator
+        -> flush handler, including the rollup ID emitted by the rollup rule
+        (the §3.4 ingest->flush pipeline, minus the network)."""
+        store = cluster_kv.MemStore()
+        clock = SettableClock(600 * S)
+        rs = RuleSet(
+            b"default", 1,
+            mapping_rules=[Rule([MappingRuleSnapshot(
+                "all", 0, TagsFilter({"service": "api"}), 0, (TEN_S,))])],
+            rollup_rules=[Rule([RollupRuleSnapshot(
+                "sum-by-region", 0, TagsFilter({"service": "api"}),
+                (RollupTarget(
+                    Pipeline((Op.roll(b"api_region_total", (b"region",),
+                                      magg.AggID.compress([magg.AggType.SUM])),)),
+                    (TEN_S,)),))])],
+        )
+        rstore = RuleSetStore(store)
+        rstore.publish(rs)
+        matcher = Matcher(rstore, b"default", clock=clock)
+
+        cap = CaptureHandler()
+        agg = Aggregator(num_shards=16, clock=clock, flush_handler=cap)
+        p = initial_placement([Instance(id="agg0", endpoint="l:0")],
+                              num_shards=16, replica_factor=1)
+        client = AggregatorClient(16, lambda: p, {"agg0": agg.add_untimed})
+        rep = Reporter(matcher, client)
+
+        for host, v in [(b"a", 5), (b"b", 7)]:
+            mid = metric_id.encode(
+                b"requests", {b"service": b"api", b"region": b"us", b"host": host})
+            assert rep.report_counter(mid, v)
+        clock.advance(10 * S)
+        agg.flush()
+        assert rep.reported == 2
+        # Each original ID emitted its own sum...
+        originals = [m for m in cap.metrics if b"host=" in m.id]
+        assert sorted(m.value for m in originals) == [5.0, 7.0]
+        # ...and both contributed to one rolled-up series keyed by region.
+        rollups = [m for m in cap.metrics if m.id.startswith(b"api_region_total")]
+        assert len(rollups) == 1
+        assert rollups[0].value == 12.0
