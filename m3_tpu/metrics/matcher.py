@@ -26,6 +26,41 @@ from .rules import (
 )
 
 
+def pipeline_to_json(p: Pipeline) -> list:
+    """Generic op-list serialization: aggregation, transformation, and
+    rollup ops all round-trip (pipeline/type.go Pipeline proto shape)."""
+    out = []
+    for op in p.ops:
+        if op.rollup is not None:
+            out.append({"t": "rollup", "new_name": op.rollup.new_name.decode(),
+                        "tags": [t.decode() for t in op.rollup.tags],
+                        "agg_id": op.rollup.aggregation_id})
+        elif op.transformation is not None:
+            out.append({"t": "transform", "op": int(op.transformation)})
+        elif op.aggregation is not None:
+            out.append({"t": "agg", "op": int(op.aggregation)})
+        else:
+            raise ValueError(f"unserializable pipeline op {op}")
+    return out
+
+
+def pipeline_from_json(ops: list) -> Pipeline:
+    from .aggregation import AggType
+    from .transformation import TransformType
+
+    built = []
+    for d in ops:
+        if d["t"] == "rollup":
+            built.append(Op.roll(d["new_name"].encode(),
+                                 tuple(t.encode() for t in d["tags"]),
+                                 d["agg_id"]))
+        elif d["t"] == "transform":
+            built.append(Op.transform(TransformType(d["op"])))
+        else:
+            built.append(Op.aggregate(AggType(d["op"])))
+    return Pipeline(tuple(built))
+
+
 def ruleset_to_json(rs: RuleSet) -> dict:
     """Serialize a rule set for KV storage (the reference stores protobuf
     rule sets under one key per namespace, matcher/ruleset.go kv watch)."""
@@ -44,15 +79,7 @@ def ruleset_to_json(rs: RuleSet) -> dict:
             "filter": s.filter.to_json(), "tomb": s.tombstoned,
             "targets": [
                 {
-                    "new_name": t.pipeline.ops[0].rollup.new_name.decode()
-                    if t.pipeline.ops and t.pipeline.ops[0].rollup else "",
-                    "tags": [
-                        tg.decode()
-                        for tg in (t.pipeline.ops[0].rollup.tags
-                                   if t.pipeline.ops and t.pipeline.ops[0].rollup else ())
-                    ],
-                    "agg_id": (t.pipeline.ops[0].rollup.aggregation_id
-                               if t.pipeline.ops and t.pipeline.ops[0].rollup else 0),
+                    "pipeline": pipeline_to_json(t.pipeline),
                     "policies": [str(p) for p in t.storage_policies],
                 }
                 for t in s.targets
@@ -81,9 +108,7 @@ def ruleset_from_json(obj: dict) -> RuleSet:
             d["name"], d["cutover"], filt,
             tuple(
                 RollupTarget(
-                    Pipeline((Op.roll(t["new_name"].encode(),
-                                      tuple(tg.encode() for tg in t["tags"]),
-                                      t["agg_id"]),)),
+                    pipeline_from_json(t["pipeline"]),
                     tuple(StoragePolicy.parse(p) for p in t["policies"]),
                 )
                 for t in d["targets"]
@@ -141,6 +166,7 @@ class Matcher:
         self._lock = threading.Lock()
         self._cache: Dict[bytes, MatchResult] = {}
         self._capacity = cache_capacity
+        self._generation = 0
         rs = store.get(namespace)
         self._active = rs.active_set() if rs is not None else None
         store.on_change(namespace, self._on_ruleset_change)
@@ -151,6 +177,7 @@ class Matcher:
         with self._lock:
             self._active = rs.active_set()
             self._cache.clear()  # new version invalidates everything
+            self._generation += 1
 
     def match(self, metric_id: bytes,
               from_nanos: Optional[int] = None,
@@ -160,6 +187,7 @@ class Matcher:
         to_nanos = now + 1 if to_nanos is None else to_nanos
         with self._lock:
             active = self._active
+            generation = self._generation
             cached = self._cache.get(metric_id)
             if cached is not None and not cached.has_expired(now):
                 self.hits += 1
@@ -169,7 +197,11 @@ class Matcher:
         self.misses += 1
         result = active.forward_match(metric_id, from_nanos, to_nanos)
         with self._lock:
-            if len(self._cache) >= self._capacity:
-                self._cache.clear()  # simple full-flush eviction
-            self._cache[metric_id] = result
+            # Only cache if no rule-set swap raced this computation — a
+            # stale insert after the invalidating clear would otherwise be
+            # served until its (possibly infinite) expiry.
+            if self._generation == generation:
+                if len(self._cache) >= self._capacity:
+                    self._cache.clear()  # simple full-flush eviction
+                self._cache[metric_id] = result
         return result

@@ -30,18 +30,33 @@ class Consumer:
 
         class _Handler(socketserver.BaseRequestHandler):
             def handle(self):
+                import select
+
                 sock = self.request
                 pending_acks: List[int] = []
+
+                def flush():
+                    nonlocal pending_acks
+                    if pending_acks:
+                        wire.write_frame(sock, {"t": "ack", "ids": pending_acks})
+                        pending_acks = []
+
                 try:
                     while True:
+                        # Idle wait WITHOUT consuming bytes (framing-safe):
+                        # a lull flushes partial ack batches so < ack_batch
+                        # outstanding messages never sit unacked forever.
+                        ready, _, _ = select.select([sock], [], [], 0.05)
+                        if not ready:
+                            flush()
+                            continue
                         frame = wire.read_frame(sock)
                         if frame is None or frame.get("t") != "msg":
                             continue
                         outer._handler(frame["shard"], frame["value"])
                         pending_acks.append(frame["id"])
                         if len(pending_acks) >= outer._ack_batch:
-                            wire.write_frame(sock, {"t": "ack", "ids": pending_acks})
-                            pending_acks = []
+                            flush()
                 except (ConnectionError, OSError):
                     pass
 

@@ -149,6 +149,12 @@ class ConsumerServiceWriter:
         self._retry_delay_s = retry_delay_s
         self._writers: Dict[str, MessageWriter] = {}
         self._on_ack: Optional[Callable[[_Message], None]] = None
+        # Messages with no routable instance yet (placement missing or shard
+        # unowned): re-routed on every retry pass so at-least-once holds
+        # across placement gaps (consumer_service_writer.go re-resolves the
+        # placement on update).
+        self._unrouted: Dict[int, _Message] = {}
+        self._lock = threading.Lock()
 
     def _writer_for(self, endpoint: str) -> MessageWriter:
         w = self._writers.get(endpoint)
@@ -159,24 +165,43 @@ class ConsumerServiceWriter:
         return w
 
     def write(self, msg: _Message) -> bool:
+        if self._route(msg):
+            return True
+        with self._lock:
+            self._unrouted[msg.id] = msg
+        return False
+
+    def _route(self, msg: _Message) -> bool:
         p = self._placement()
         if p is None:
             return False
         shard = msg.shard % p.num_shards
-        sent = False
         for inst in p.replicas_for(shard, states=(ShardState.INITIALIZING,
                                                   ShardState.AVAILABLE)):
             self._writer_for(inst.endpoint).write(msg)
-            sent = True
-            break  # shared consumption: one instance per shard
-        return sent
+            return True  # shared consumption: one instance per shard
+        return False
 
     def retry_unacked(self):
+        with self._lock:
+            pending = list(self._unrouted.values())
+        for msg in pending:
+            if self._route(msg):
+                with self._lock:
+                    self._unrouted.pop(msg.id, None)
         for w in self._writers.values():
             w.retry_unacked()
 
     def unacked(self) -> int:
-        return sum(w.unacked() for w in self._writers.values())
+        with self._lock:
+            unrouted = len(self._unrouted)
+        return unrouted + sum(w.unacked() for w in self._writers.values())
+
+    def forget(self, mid: int):
+        with self._lock:
+            self._unrouted.pop(mid, None)
+        for w in self._writers.values():
+            w.forget(mid)
 
     def close(self):
         for w in self._writers.values():
@@ -197,7 +222,9 @@ class Producer:
         self._max_buffer_bytes = max_buffer_bytes
         self._buffered_bytes = 0
         self._lock = threading.Lock()
-        self._order: List[_Message] = []  # oldest first, for drop-oldest
+        # id -> message, insertion-ordered (dicts preserve order) so
+        # drop-oldest pops the front and acks remove in O(1).
+        self._order: Dict[int, _Message] = {}
         connect = connect or _default_connect
         self._service_writers = [
             ConsumerServiceWriter(cs.service_id, service_placements[cs.service_id],
@@ -214,7 +241,7 @@ class Producer:
             mid = self._next_id
             self._next_id += 1
             msg = _Message(mid, shard, value, refs=len(self._service_writers))
-            self._order.append(msg)
+            self._order[mid] = msg
             self._buffered_bytes += msg.size
         self._enforce_buffer()
         for w in self._service_writers:
@@ -224,20 +251,22 @@ class Producer:
     def _message_acked(self, msg: _Message):
         with self._lock:
             msg.refs -= 1
-            if msg.refs <= 0 and msg in self._order:
-                self._order.remove(msg)
+            if msg.refs <= 0 and self._order.pop(msg.id, None) is not None:
                 self._buffered_bytes -= msg.size
 
     def _enforce_buffer(self):
         """Drop oldest until under the cap (producer/buffer.go dropOldest)."""
+        victims = []
         with self._lock:
             while self._buffered_bytes > self._max_buffer_bytes and self._order:
-                victim = self._order.pop(0)
+                mid, victim = next(iter(self._order.items()))
+                del self._order[mid]
                 self._buffered_bytes -= victim.size
                 self.dropped_oldest += 1
-                for w in self._service_writers:
-                    for mw in w._writers.values():
-                        mw.forget(victim.id)
+                victims.append(mid)
+        for mid in victims:
+            for w in self._service_writers:
+                w.forget(mid)
 
     def retry_unacked(self):
         for w in self._service_writers:

@@ -1,0 +1,155 @@
+"""Cross-series (instant-vector) aggregation kernels (reference:
+src/query/functions/aggregation/{function,take,count_values}.go — sum/min/
+max/avg/count/stddev/stdvar/quantile and topk/bottomk grouped by labels).
+
+Grouping structure (which output row each series feeds) is label algebra and
+stays on the host; the arithmetic over the [n_series, n_steps] matrix runs
+as one batched segment reduction on device. NaN cells are excluded the way
+the reference skips missing points."""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.lru_cache(maxsize=128)
+def _segment_fn(n_groups: int, kind: str):
+    def fn(values, group_ids):
+        mask = jnp.isfinite(values)
+        z = jnp.where(mask, values, 0.0)
+        cnt = jax.ops.segment_sum(mask.astype(jnp.float32), group_ids,
+                                  num_segments=n_groups)
+        if kind == "count":
+            out = cnt
+        elif kind == "sum":
+            out = jax.ops.segment_sum(z, group_ids, num_segments=n_groups)
+        elif kind == "avg":
+            s = jax.ops.segment_sum(z, group_ids, num_segments=n_groups)
+            out = s / jnp.maximum(cnt, 1)
+        elif kind == "min":
+            out = jax.ops.segment_min(
+                jnp.where(mask, values, jnp.inf), group_ids,
+                num_segments=n_groups)
+        elif kind == "max":
+            out = jax.ops.segment_max(
+                jnp.where(mask, values, -jnp.inf), group_ids,
+                num_segments=n_groups)
+        elif kind in ("stddev", "stdvar"):
+            s = jax.ops.segment_sum(z, group_ids, num_segments=n_groups)
+            mu = s / jnp.maximum(cnt, 1)
+            dev = jnp.where(mask, values - mu[group_ids], 0.0)
+            m2 = jax.ops.segment_sum(dev * dev, group_ids,
+                                     num_segments=n_groups)
+            var = m2 / jnp.maximum(cnt, 1)  # population (promql stddev)
+            out = jnp.sqrt(var) if kind == "stddev" else var
+        else:
+            raise ValueError(kind)
+        return jnp.where(cnt > 0, out, jnp.nan)
+
+    return jax.jit(fn, static_argnames=())
+
+
+def grouped_reduce(values: np.ndarray, group_ids: np.ndarray, n_groups: int,
+                   kind: str) -> np.ndarray:
+    """[S, T] + group id per series -> [G, T]."""
+    if values.size == 0:
+        return np.full((n_groups, values.shape[1]), np.nan)
+    out = _segment_fn(n_groups, kind)(
+        values.astype(np.float32), group_ids.astype(np.int32))
+    return np.asarray(out, dtype=np.float64)
+
+
+def grouped_reduce_f64(values: np.ndarray, group_ids: np.ndarray,
+                       n_groups: int, kind: str) -> np.ndarray:
+    """Exact-f64 host fallback used when magnitudes demand it (counter sums):
+    same semantics as grouped_reduce via np.add.at on the f64 matrix."""
+    S, T = values.shape
+    mask = np.isfinite(values)
+    z = np.where(mask, values, 0.0)
+    cnt = np.zeros((n_groups, T))
+    np.add.at(cnt, group_ids, mask.astype(np.float64))
+    if kind == "count":
+        out = cnt
+    elif kind in ("sum", "avg", "stddev", "stdvar"):
+        s = np.zeros((n_groups, T))
+        np.add.at(s, group_ids, z)
+        if kind == "sum":
+            out = s
+        else:
+            mu = s / np.maximum(cnt, 1)
+            if kind == "avg":
+                out = mu
+            else:
+                dev = np.where(mask, values - mu[group_ids], 0.0)
+                m2 = np.zeros((n_groups, T))
+                np.add.at(m2, group_ids, dev * dev)
+                var = m2 / np.maximum(cnt, 1)
+                out = np.sqrt(var) if kind == "stddev" else var
+    elif kind == "min":
+        out = np.full((n_groups, T), np.inf)
+        np.minimum.at(out, group_ids, np.where(mask, values, np.inf))
+    elif kind == "max":
+        out = np.full((n_groups, T), -np.inf)
+        np.maximum.at(out, group_ids, np.where(mask, values, -np.inf))
+    else:
+        raise ValueError(kind)
+    return np.where(cnt > 0, out, np.nan)
+
+
+def grouped_quantile(values: np.ndarray, group_ids: np.ndarray,
+                     n_groups: int, q: float) -> np.ndarray:
+    """promql quantile(): linear-interpolated quantile across the series of
+    each group, per step (host — group sizes are ragged and small)."""
+    S, T = values.shape
+    out = np.full((n_groups, T), np.nan)
+    for g in range(n_groups):
+        rows = values[group_ids == g]
+        if rows.size == 0:
+            continue
+        with np.errstate(invalid="ignore"):
+            out[g] = np.nanquantile(rows, q, axis=0)
+    return out
+
+
+def topk_mask(values: np.ndarray, group_ids: np.ndarray, n_groups: int,
+              k: int, largest: bool) -> np.ndarray:
+    """Per-step membership mask for topk/bottomk (aggregation/take.go):
+    True where the series is among its group's k best at that step."""
+    S, T = values.shape
+    keep = np.zeros((S, T), dtype=bool)
+    for g in range(n_groups):
+        sel = np.flatnonzero(group_ids == g)
+        if sel.size == 0:
+            continue
+        rows = values[sel]  # [Sg, T]
+        filled = np.where(np.isfinite(rows), rows,
+                          -np.inf if largest else np.inf)
+        order = np.argsort(-filled if largest else filled, axis=0, kind="stable")
+        ranks = np.empty_like(order)
+        np.put_along_axis(ranks, order, np.arange(sel.size)[:, None], axis=0)
+        keep[sel] = (ranks < k) & np.isfinite(rows)
+    return keep
+
+
+def count_values(values: np.ndarray, group_ids: np.ndarray,
+                 n_groups: int) -> dict:
+    """promql count_values(): per (group, step, value) counts; returns
+    {(g, value): [T] counts} (aggregation/count_values.go)."""
+    out = {}
+    S, T = values.shape
+    for g in range(n_groups):
+        rows = values[group_ids == g]
+        for t in range(T):
+            col = rows[:, t]
+            col = col[np.isfinite(col)]
+            for v in np.unique(col):
+                key = (g, float(v))
+                if key not in out:
+                    out[key] = np.zeros(T)
+                out[key][t] = (col == v).sum()
+    return out

@@ -1,0 +1,426 @@
+"""Batched temporal (sliding-window) kernels for the query engine
+(reference: src/query/functions/temporal/{base,rate,aggregation,
+holt_winters,linear_regression}.go — the north-star query hot loop).
+
+The reference slides a per-series iterator over consolidated block steps.
+Here the whole (series x output-step x window) volume is gathered as one
+tile and every window reduces in a single jitted call on device.
+
+Precision strategy (TPU has no native f64): values are centered on a
+per-series f64 baseline on the host (first finite sample of the extended
+grid), and the device computes on f32 *residuals*. Every rate/delta-style
+result is a difference, hence shift-invariant and exact in residual space;
+absolute-valued outputs (sum/avg/min/max/last/..._over_time) are corrected
+back on the host in f64 (sum += count*baseline, ...). Quantiles return
+window *indices* from the device and the host gathers exact f64 values —
+the same split the aggregator flush uses (m3_tpu/aggregator/list.py).
+
+Window convention: prom range selector (t-R, t] at step s with data grid at
+the same step: W = R/s cells, window w covers offsets (w+1-W)*s relative to
+the output time; column j of the extended grid is time
+start - (W-1)*s + j*s, so output step t reads columns [t, t+W).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_F32 = jnp.float32
+
+
+def extend_window_cells(range_ns: int, step_ns: int) -> int:
+    """Number of grid cells per window: ceil-less R/s (prom half-open
+    (t-R, t] with samples gridded at s)."""
+    if range_ns % step_ns:
+        raise ValueError(
+            f"range {range_ns} not a multiple of step {step_ns}; "
+            "the storage adapter grids at a divisor of the query step")
+    return max(1, range_ns // step_ns)
+
+
+def center(values: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Split [S, T] f64 grid into (residual f32, baseline f64 [S])."""
+    finite = np.isfinite(values)
+    first_idx = np.argmax(finite, axis=1)
+    has = finite.any(axis=1)
+    baseline = np.where(
+        has, values[np.arange(values.shape[0]), first_idx], 0.0)
+    resid = (values - baseline[:, None]).astype(np.float32)
+    return resid, baseline
+
+
+def _window_volume(resid, W: int):
+    T_out = resid.shape[1] - W + 1
+    idx = jnp.arange(T_out)[:, None] + jnp.arange(W)[None, :]
+    return resid[:, idx]  # [S, T_out, W]
+
+
+def _first_last(mask):
+    """First/last valid window indices + validity counts."""
+    W = mask.shape[-1]
+    cnt = mask.sum(axis=-1)
+    first_i = jnp.where(mask, jnp.arange(W), W).min(axis=-1)
+    last_i = jnp.where(mask, jnp.arange(W), -1).max(axis=-1)
+    return first_i, last_i, cnt
+
+
+def _take_w(vol, idx):
+    return jnp.take_along_axis(
+        vol, jnp.clip(idx, 0, vol.shape[-1] - 1)[..., None], axis=-1)[..., 0]
+
+
+@functools.lru_cache(maxsize=256)
+def _window_sum_fn(W: int):
+    """Device pass: per-window validity structure + masked sum of the
+    adjusted-diff grid. The O(S*T*W) work lives here; extrapolation finishes
+    on the host in f64, O(S*T) elementwise."""
+
+    def fn(adj, finite):
+        mvol = _window_volume(finite, W)
+        first_i, last_i, cnt = _first_last(mvol)
+        avol = _window_volume(adj, W)
+        # Only cells strictly after the window's first valid sample
+        # contribute — their previous-valid reference is inside the window.
+        valid_pair = mvol & (jnp.arange(W) > first_i[..., None])
+        adj_sum = jnp.where(valid_pair, avol, 0.0).sum(-1)
+        return {"first_i": first_i, "last_i": last_i, "cnt": cnt,
+                "adj_sum": adj_sum}
+
+    return jax.jit(fn)
+
+
+def _host_diff_grid(grid: np.ndarray, is_counter: bool):
+    """f64 host pass: per-cell adjusted diff vs the previous valid sample.
+    adj[i] = v[i] - prev_valid (or v[i] itself at a counter reset, promql's
+    reset correction). Small by construction — consecutive counter deltas
+    and post-reset restart values — so the f32 device windowed sums hold
+    full precision even for 1e9-magnitude counters."""
+    finite = np.isfinite(grid)
+    S, T = grid.shape
+    idx = np.where(finite, np.arange(T)[None, :], -1)
+    run = np.maximum.accumulate(idx, axis=1)
+    prev_run = np.concatenate([np.full((S, 1), -1, run.dtype), run[:, :-1]], axis=1)
+    rows = np.arange(S)[:, None]
+    prev_val = np.where(prev_run >= 0, grid[rows, np.clip(prev_run, 0, T - 1)], np.nan)
+    d = grid - prev_val
+    if is_counter:
+        adj = np.where(d < 0, grid, d)
+    else:
+        adj = d
+    adj = np.where(finite & (prev_run >= 0), adj, 0.0)
+    return adj.astype(np.float32), finite
+
+
+def _extrapolated(grid: np.ndarray, W: int, step_ns: int, range_ns: int,
+                  is_counter: bool, is_rate: bool) -> np.ndarray:
+    """promql extrapolatedRate finishing pass (f64, host) over the device
+    window components."""
+    adj, finite = _host_diff_grid(grid, is_counter)
+    c = {k: np.asarray(v)
+         for k, v in _window_sum_fn(W)(adj, finite).items()}
+    step_s = step_ns / 1e9
+    cnt = c["cnt"].astype(np.float64)
+    first_i = c["first_i"].astype(np.float64)
+    last_i = c["last_i"].astype(np.float64)
+    ok = c["cnt"] >= 2
+    increase = c["adj_sum"].astype(np.float64)
+    dur_start = (first_i + 1) * step_s
+    dur_end = (W - 1 - last_i) * step_s
+    sampled = (last_i - first_i) * step_s
+    with np.errstate(divide="ignore", invalid="ignore"):
+        avg_dur = sampled / np.maximum(cnt - 1, 1)
+        threshold = avg_dur * 1.1
+        if is_counter:
+            # Absolute first value gathered from the f64 grid by index.
+            S, T_out = c["first_i"].shape
+            rows = np.arange(S)[:, None]
+            cols = np.arange(T_out)[None, :] + np.clip(c["first_i"], 0, W - 1)
+            abs_first = grid[rows, np.clip(cols, 0, grid.shape[1] - 1)]
+            dur_zero = np.where(
+                (increase > 0) & (abs_first >= 0),
+                sampled * (abs_first / np.where(increase > 0, increase, 1.0)),
+                np.inf)
+            dur_start = np.minimum(dur_start, dur_zero)
+        extrap = (
+            sampled
+            + np.where(dur_start < threshold, dur_start, avg_dur / 2)
+            + np.where(dur_end < threshold, dur_end, avg_dur / 2)
+        )
+        out = increase * (extrap / np.where(sampled > 0, sampled, 1.0))
+        if is_rate:
+            out = out / (range_ns / 1e9)
+    return np.where(ok & (sampled > 0), out, np.nan)
+
+
+def _ffill(vol, mask):
+    """Forward-fill invalid cells with the last valid value (0 before the
+    first valid cell) via a running max over valid indices."""
+    W = vol.shape[-1]
+    idx = jnp.where(mask, jnp.arange(W), -1)
+    run = jax.lax.associative_scan(jnp.maximum, idx, axis=-1)
+    return jnp.where(run >= 0, _gather_last(vol, run), 0.0)
+
+
+def _gather_last(vol, run):
+    return jnp.take_along_axis(vol, jnp.clip(run, 0, vol.shape[-1] - 1), axis=-1)
+
+
+def rate(grid: np.ndarray, W: int, step_ns: int, range_ns: int) -> np.ndarray:
+    return _extrapolated(grid, W, step_ns, range_ns, True, True)
+
+
+def increase(grid: np.ndarray, W: int, step_ns: int, range_ns: int) -> np.ndarray:
+    return _extrapolated(grid, W, step_ns, range_ns, True, False)
+
+
+def delta(grid: np.ndarray, W: int, step_ns: int, range_ns: int) -> np.ndarray:
+    return _extrapolated(grid, W, step_ns, range_ns, False, False)
+
+
+@functools.lru_cache(maxsize=256)
+def _last_two_idx_fn(W: int):
+    """irate/idelta index pass: last two valid window indices."""
+
+    def fn(finite):
+        mvol = _window_volume(finite, W)
+        Wr = jnp.arange(W)
+        last_i = jnp.where(mvol, Wr, -1).max(axis=-1)
+        prev_mask = mvol & (Wr < last_i[..., None])
+        prev_i = jnp.where(prev_mask, Wr, -1).max(axis=-1)
+        return last_i, prev_i
+
+    return jax.jit(fn)
+
+
+def _instant(grid: np.ndarray, W: int, step_ns: int, is_rate: bool) -> np.ndarray:
+    """temporal/rate.go irateFn / promql instantValue: last two valid
+    samples; a counter reset (v_last < v_prev) rates from zero. Values are
+    gathered from the f64 grid by device-computed indices."""
+    finite = np.isfinite(grid)
+    last_i, prev_i = (np.asarray(a) for a in _last_two_idx_fn(W)(finite))
+    ok = prev_i >= 0
+    S, T_out = last_i.shape
+    rows = np.arange(S)[:, None]
+    t_base = np.arange(T_out)[None, :]
+    v_last = grid[rows, t_base + np.clip(last_i, 0, W - 1)]
+    v_prev = grid[rows, t_base + np.clip(prev_i, 0, W - 1)]
+    dt = (last_i - prev_i) * (step_ns / 1e9)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        if is_rate:
+            dv = np.where(v_last < v_prev, v_last, v_last - v_prev)
+            out = dv / np.where(ok, dt, 1.0)
+        else:
+            out = v_last - v_prev
+    return np.where(ok, out, np.nan)
+
+
+def irate(grid: np.ndarray, W: int, step_ns: int) -> np.ndarray:
+    return _instant(grid, W, step_ns, True)
+
+
+def idelta(grid: np.ndarray, W: int, step_ns: int) -> np.ndarray:
+    return _instant(grid, W, step_ns, False)
+
+
+@functools.lru_cache(maxsize=256)
+def _over_time_fn(W: int):
+    """Masked window moments for *_over_time (temporal/aggregation.go)."""
+
+    def fn(resid):
+        vol = _window_volume(resid, W)
+        mask = jnp.isfinite(vol)
+        z = jnp.where(mask, vol, 0.0)
+        cnt = mask.sum(axis=-1).astype(_F32)
+        s = z.sum(axis=-1)
+        mu = s / jnp.maximum(cnt, 1)
+        dev = jnp.where(mask, vol - mu[..., None], 0.0)
+        m2 = (dev * dev).sum(axis=-1)
+        mn = jnp.where(mask, vol, jnp.inf).min(axis=-1)
+        mx = jnp.where(mask, vol, -jnp.inf).max(axis=-1)
+        first_i, last_i, _ = _first_last(mask)
+        return {
+            "count": cnt, "sum": s, "min": mn, "max": mx, "m2": m2,
+            "last": _take_w(vol, last_i), "first": _take_w(vol, first_i),
+        }
+
+    return jax.jit(fn)
+
+
+def over_time(grid: np.ndarray, W: int, kind: str) -> np.ndarray:
+    """sum|avg|min|max|count|last|stddev|stdvar|present_over_time.
+
+    Host corrects absolute-valued outputs back into f64 value space."""
+    resid, base = center(grid)
+    stats = {k: np.asarray(v) for k, v in _over_time_fn(W)(resid).items()}
+    cnt = stats["count"]
+    ok = cnt > 0
+    b = base[:, None]
+    if kind == "count":
+        return np.where(ok, cnt, np.nan)
+    if kind == "present":
+        return np.where(ok, 1.0, np.nan)
+    if kind == "sum":
+        return np.where(ok, stats["sum"] + cnt * b, np.nan)
+    if kind == "avg":
+        return np.where(ok, stats["sum"] / np.maximum(cnt, 1) + b, np.nan)
+    if kind == "min":
+        return np.where(ok, stats["min"] + b, np.nan)
+    if kind == "max":
+        return np.where(ok, stats["max"] + b, np.nan)
+    if kind == "last":
+        return np.where(ok, stats["last"] + b, np.nan)
+    if kind == "stdvar":  # population variance (promql stdvar_over_time)
+        return np.where(ok, stats["m2"] / np.maximum(cnt, 1), np.nan)
+    if kind == "stddev":
+        return np.where(ok, np.sqrt(stats["m2"] / np.maximum(cnt, 1)), np.nan)
+    raise ValueError(f"unknown over_time kind {kind!r}")
+
+
+@functools.lru_cache(maxsize=256)
+def _quantile_idx_fn(W: int):
+    """Window-quantile index selection; host gathers exact f64 values."""
+
+    def fn(resid, q):
+        vol = _window_volume(resid, W)
+        mask = jnp.isfinite(vol)
+        cnt = mask.sum(axis=-1)
+        order = jnp.argsort(jnp.where(mask, vol, jnp.inf), axis=-1)
+        # promql quantile_over_time: linear interpolation rank q*(n-1).
+        pos = q * (cnt - 1).astype(_F32)
+        lo = jnp.clip(jnp.floor(pos).astype(jnp.int32), 0, W - 1)
+        hi = jnp.clip(lo + 1, 0, W - 1)
+        frac = pos - lo.astype(_F32)
+        lo_idx = _take_w(order, lo)
+        hi_idx = jnp.where(hi < cnt, _take_w(order, hi), _take_w(order, lo))
+        return lo_idx, hi_idx, frac, cnt
+
+    return jax.jit(fn)
+
+
+def quantile_over_time(grid: np.ndarray, W: int, q: float) -> np.ndarray:
+    resid, _ = center(grid)
+    lo_idx, hi_idx, frac, cnt = _quantile_idx_fn(W)(
+        resid, np.float32(q))
+    lo_idx, hi_idx = np.asarray(lo_idx), np.asarray(hi_idx)
+    frac, cnt = np.asarray(frac), np.asarray(cnt)
+    S, T_out = lo_idx.shape
+    t_base = np.arange(T_out)[None, :]
+    rows = np.arange(S)[:, None]
+    v_lo = grid[rows, t_base + lo_idx]
+    v_hi = grid[rows, t_base + hi_idx]
+    out = v_lo + (v_hi - v_lo) * frac
+    return np.where(cnt > 0, out, np.nan)
+
+
+@functools.lru_cache(maxsize=256)
+def _changes_resets_fn(W: int, count_resets: bool):
+    def fn(resid):
+        vol = _window_volume(resid, W)
+        mask = jnp.isfinite(vol)
+        filled = _ffill(vol, mask)
+        prev = jnp.concatenate([filled[..., :1], filled[..., :-1]], axis=-1)
+        first_i, _, cnt = _first_last(mask)
+        after_first = jnp.arange(W) > first_i[..., None]
+        valid_pair = mask & after_first
+        d = vol - prev
+        if count_resets:
+            hits = valid_pair & (d < 0)
+        else:
+            hits = valid_pair & (d != 0)
+        return jnp.where(cnt > 0, hits.sum(axis=-1).astype(_F32), jnp.nan)
+
+    return jax.jit(fn)
+
+
+def changes(grid: np.ndarray, W: int) -> np.ndarray:
+    resid, _ = center(grid)
+    return np.asarray(_changes_resets_fn(W, False)(resid))
+
+
+def resets(grid: np.ndarray, W: int) -> np.ndarray:
+    resid, _ = center(grid)
+    return np.asarray(_changes_resets_fn(W, True)(resid))
+
+
+@functools.lru_cache(maxsize=256)
+def _regression_fn(W: int, step_s: float, predict_offset_s: float,
+                   is_deriv: bool):
+    """Least-squares over valid (t, v) window points; t relative to the
+    window's first valid sample for stability (promql linearRegression;
+    temporal/linear_regression.go)."""
+
+    def fn(resid):
+        vol = _window_volume(resid, W)
+        mask = jnp.isfinite(vol)
+        first_i, last_i, cnt = _first_last(mask)
+        ok = cnt >= 2
+        t = (jnp.arange(W)[None, None, :] - first_i[..., None]).astype(_F32) * step_s
+        tm = jnp.where(mask, t, 0.0)
+        v = jnp.where(mask, vol, 0.0)
+        n = cnt.astype(_F32)
+        st = tm.sum(-1)
+        sv = v.sum(-1)
+        stt = (tm * tm).sum(-1)
+        stv = (tm * v).sum(-1)
+        denom = n * stt - st * st
+        slope = jnp.where(denom != 0, (n * stv - st * sv) / denom, jnp.nan)
+        if is_deriv:
+            return jnp.where(ok, slope, jnp.nan)
+        intercept = (sv - slope * st) / n
+        # Evaluate at output time + offset: output time is the last window
+        # cell, i.e. t = (W-1-first_i)*step relative to the reference point.
+        t_eval = (W - 1 - first_i).astype(_F32) * step_s + predict_offset_s
+        return jnp.where(ok, intercept + slope * t_eval, jnp.nan)
+
+    return jax.jit(fn)
+
+
+def deriv(grid: np.ndarray, W: int, step_ns: int) -> np.ndarray:
+    resid, _ = center(grid)
+    return np.asarray(_regression_fn(W, step_ns / 1e9, 0.0, True)(resid))
+
+
+def predict_linear(grid: np.ndarray, W: int, step_ns: int,
+                   offset_s: float) -> np.ndarray:
+    resid, base = center(grid)
+    out = np.asarray(_regression_fn(W, step_ns / 1e9, float(offset_s), False)(resid))
+    return out + base[:, None]
+
+
+@functools.lru_cache(maxsize=256)
+def _holt_winters_fn(W: int, sf: float, tf: float):
+    """Double exponential smoothing (temporal/holt_winters.go; promql
+    holt_winters): scan over the window, skipping invalid cells."""
+
+    def one_window(win, mask):
+        def step(carry, xm):
+            x, m = xm
+            s_prev, b_prev, n = carry
+            # promql holtWinters: s0 = v0, b0 = v1 - v0 (applied when the
+            # second valid sample arrives), then standard double smoothing.
+            b_eff = jnp.where(n == 1, x - s_prev, b_prev)
+            s1 = jnp.where(n == 0, x, sf * x + (1 - sf) * (s_prev + b_eff))
+            b1 = jnp.where(n == 0, 0.0, tf * (s1 - s_prev) + (1 - tf) * b_eff)
+            new = (jnp.where(m, s1, s_prev), jnp.where(m, b1, b_prev),
+                   n + m.astype(jnp.int32))
+            return new, 0.0
+
+        (s, b, n), _ = jax.lax.scan(step, (0.0, 0.0, 0), (win, mask))
+        return jnp.where(n >= 2, s, jnp.nan)
+
+    def fn(resid):
+        vol = _window_volume(resid, W)
+        mask = jnp.isfinite(vol)
+        return jax.vmap(jax.vmap(one_window))(vol, mask)
+
+    return jax.jit(fn)
+
+
+def holt_winters(grid: np.ndarray, W: int, sf: float, tf: float) -> np.ndarray:
+    resid, base = center(grid)
+    return np.asarray(_holt_winters_fn(W, float(sf), float(tf))(resid)) + base[:, None]

@@ -1,0 +1,104 @@
+"""Block model: the unit of query dataflow (reference: src/query/block/
+{types,column,series}.go — a Block is a (series x time-step) matrix viewable
+by column or by series).
+
+TPU-first redesign: the reference streams per-step column iterators between
+transform goroutines; here a Block literally IS the dense [n_series, n_steps]
+float32 matrix (NaN = no sample), so every transform is one batched device
+op over the whole block instead of a per-step iterator hop. Series metadata
+(tags) stays host-side alongside the matrix."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .model import Tags
+
+NAN = np.nan
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockMeta:
+    """Time bounds of a block (block/types.go Metadata/Bounds): steps at
+    start_ns, start_ns+step_ns, ..., count steps."""
+
+    start_ns: int
+    step_ns: int
+    steps: int
+
+    def step_time(self, i: int) -> int:
+        return self.start_ns + i * self.step_ns
+
+    def times(self) -> np.ndarray:
+        return self.start_ns + self.step_ns * np.arange(self.steps, dtype=np.int64)
+
+    @property
+    def end_ns(self) -> int:
+        """Exclusive end."""
+        return self.start_ns + self.step_ns * self.steps
+
+
+@dataclasses.dataclass
+class Block:
+    meta: BlockMeta
+    series_tags: List[Tags]
+    values: np.ndarray  # [n_series, steps] float, NaN = missing
+
+    def __post_init__(self):
+        assert self.values.ndim == 2
+        assert self.values.shape == (len(self.series_tags), self.meta.steps), (
+            self.values.shape, len(self.series_tags), self.meta.steps)
+
+    @property
+    def n_series(self) -> int:
+        return len(self.series_tags)
+
+    def with_values(self, values: np.ndarray, tags: Optional[List[Tags]] = None,
+                    meta: Optional[BlockMeta] = None) -> "Block":
+        return Block(meta or self.meta, tags if tags is not None else self.series_tags,
+                     np.asarray(values))
+
+    @staticmethod
+    def empty(meta: BlockMeta) -> "Block":
+        return Block(meta, [], np.zeros((0, meta.steps)))
+
+
+def consolidate(timestamps: np.ndarray, values: np.ndarray, meta: BlockMeta,
+                lookback_ns: int) -> np.ndarray:
+    """Consolidate one series' raw datapoints onto the block's step grid:
+    value at step time t = the latest sample in (t - lookback, t]
+    (reference: src/query/ts/values.go consolidation + the Prometheus
+    lookback-delta instant-vector rule its engine follows). Vectorized via
+    searchsorted; returns [steps] with NaN where no sample qualifies."""
+    out = np.full(meta.steps, NAN)
+    if timestamps.size == 0:
+        return out
+    order = np.argsort(timestamps, kind="stable")
+    ts = timestamps[order]
+    vs = values[order]
+    step_times = meta.times()
+    idx = np.searchsorted(ts, step_times, side="right") - 1
+    ok = idx >= 0
+    safe = np.clip(idx, 0, ts.size - 1)
+    age_ok = (step_times - ts[safe]) < lookback_ns
+    take = ok & age_ok
+    out[take] = vs[safe[take]]
+    return out
+
+
+def block_from_series(series: Dict[bytes, dict], meta: BlockMeta,
+                      lookback_ns: int) -> Block:
+    """Assemble a Block from a client fetch_tagged result
+    ({id: {tags, t, v}}), consolidating every series onto the step grid."""
+    tags_list: List[Tags] = []
+    rows = np.full((len(series), meta.steps), NAN)
+    for i, (sid, entry) in enumerate(sorted(series.items())):
+        tags_list.append(Tags.of(dict(entry["tags"])))
+        rows[i] = consolidate(
+            np.asarray(entry["t"], dtype=np.int64),
+            np.asarray(entry["v"], dtype=np.float64),
+            meta, lookback_ns)
+    return Block(meta, tags_list, rows)

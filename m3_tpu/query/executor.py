@@ -1,0 +1,633 @@
+"""Query executor: evaluates a PromQL AST over blocks (reference:
+src/query/executor/{engine,state}.go + functions/* — the push-based
+per-step iterator DAG is re-expressed as whole-block batched ops; every
+transform consumes and produces a dense [series x steps] Block, with the
+sliding-window/temporal math in m3_tpu.ops.temporal and cross-series
+aggregation in m3_tpu.ops.series_agg running as jitted device kernels).
+
+Matrix selectors grid at gcd(step, range) so sub-step samples inside a
+window survive consolidation (the reference's block consolidation has the
+same step-alignment semantics, src/query/ts/values.go)."""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from ..ops import series_agg, temporal
+from . import promql
+from .block import Block, BlockMeta, consolidate
+from .model import Matcher, MatchType, METRIC_NAME, Tags
+from .promql import (
+    Aggregation,
+    BinaryOp,
+    Call,
+    Node,
+    NumberLiteral,
+    StringLiteral,
+    Unary,
+    VectorSelector,
+)
+
+DEFAULT_LOOKBACK_NS = 5 * 60 * 1_000_000_000
+
+Scalar = np.ndarray  # [steps] float
+Value = Union[Block, np.ndarray, float]
+
+
+class QueryError(ValueError):
+    pass
+
+
+@dataclasses.dataclass
+class QueryParams:
+    start_ns: int
+    end_ns: int      # inclusive of the last step <= end
+    step_ns: int
+
+    @property
+    def steps(self) -> int:
+        return (self.end_ns - self.start_ns) // self.step_ns + 1
+
+    def meta(self) -> BlockMeta:
+        return BlockMeta(self.start_ns, self.step_ns, self.steps)
+
+
+class Engine:
+    """executor/engine.go: compile -> plan -> execute. Storage is anything
+    with fetch_raw(matchers, start_ns, end_ns) -> {id: {tags, t, v}}."""
+
+    def __init__(self, storage, lookback_ns: int = DEFAULT_LOOKBACK_NS):
+        self.storage = storage
+        self.lookback_ns = lookback_ns
+
+    def execute_range(self, query: str, start_ns: int, end_ns: int,
+                      step_ns: int) -> Block:
+        ast = promql.parse(query)
+        params = QueryParams(start_ns, end_ns, step_ns)
+        val = self._eval(ast, params)
+        return _to_block(val, params)
+
+    def execute_instant(self, query: str, t_ns: int) -> Block:
+        return self.execute_range(query, t_ns, t_ns, 1_000_000_000)
+
+    # -- evaluation --------------------------------------------------------
+
+    def _eval(self, node: Node, params: QueryParams) -> Value:
+        if isinstance(node, NumberLiteral):
+            return float(node.value)
+        if isinstance(node, StringLiteral):
+            return node.value
+        if isinstance(node, VectorSelector):
+            if node.range_ns:
+                raise QueryError("matrix selector used outside a function")
+            return self._eval_instant_selector(node, params)
+        if isinstance(node, Unary):
+            val = self._eval(node.expr, params)
+            return _map_values(val, lambda v: -v)
+        if isinstance(node, Call):
+            return self._eval_call(node, params)
+        if isinstance(node, Aggregation):
+            return self._eval_aggregation(node, params)
+        if isinstance(node, BinaryOp):
+            return self._eval_binary(node, params)
+        raise QueryError(f"unsupported node {type(node).__name__}")
+
+    # -- selectors ---------------------------------------------------------
+
+    def _fetch(self, sel: VectorSelector, start_ns: int, end_ns: int):
+        return self.storage.fetch_raw(
+            promql.selector_matchers(sel), start_ns, end_ns)
+
+    def _eval_instant_selector(self, sel: VectorSelector,
+                               params: QueryParams) -> Block:
+        off = sel.offset_ns
+        meta = params.meta()
+        series = self._fetch(sel, params.start_ns - self.lookback_ns - off,
+                             params.end_ns - off + 1)
+        tags_list, rows = [], []
+        shifted = BlockMeta(meta.start_ns - off, meta.step_ns, meta.steps)
+        for sid, entry in sorted(series.items()):
+            tags_list.append(Tags.of(dict(entry["tags"])))
+            rows.append(consolidate(
+                np.asarray(entry["t"], np.int64), np.asarray(entry["v"]),
+                shifted, self.lookback_ns))
+        values = np.stack(rows) if rows else np.zeros((0, meta.steps))
+        return Block(meta, tags_list, values)
+
+    def _eval_range_selector(self, sel: VectorSelector, params: QueryParams
+                             ) -> Tuple[Block, int, int]:
+        """Fetch + grid a matrix selector: returns (extended block at the
+        window grid, W cells per window, stride to subsample back to the
+        query step)."""
+        off = sel.offset_ns
+        wgrid = math.gcd(params.step_ns, sel.range_ns)
+        W = sel.range_ns // wgrid
+        stride = params.step_ns // wgrid
+        meta = params.meta()
+        # Extended grid: (W-1) cells of history before the first output step.
+        ext_start = meta.start_ns - (W - 1) * wgrid - off
+        ext_steps = (W - 1) + (meta.steps - 1) * stride + 1
+        ext_meta = BlockMeta(ext_start, wgrid, ext_steps)
+        series = self._fetch(sel, ext_start - wgrid, meta.end_ns - off + 1)
+        tags_list, rows = [], []
+        for sid, entry in sorted(series.items()):
+            tags_list.append(Tags.of(dict(entry["tags"])))
+            # Range selectors see raw samples (no lookback): a cell holds
+            # the latest sample within its grid cell only.
+            rows.append(consolidate(
+                np.asarray(entry["t"], np.int64), np.asarray(entry["v"]),
+                ext_meta, wgrid))
+        values = np.stack(rows) if rows else np.zeros((0, ext_steps))
+        return Block(ext_meta, tags_list, values), W, stride
+
+    # -- functions ---------------------------------------------------------
+
+    _RANGE_FUNCS = {
+        "rate", "increase", "delta", "irate", "idelta", "deriv",
+        "predict_linear", "holt_winters", "changes", "resets",
+        "sum_over_time", "avg_over_time", "min_over_time", "max_over_time",
+        "count_over_time", "last_over_time", "stddev_over_time",
+        "stdvar_over_time", "present_over_time", "quantile_over_time",
+    }
+
+    def _eval_call(self, node: Call, params: QueryParams) -> Value:
+        if node.func in self._RANGE_FUNCS:
+            return self._eval_range_func(node, params)
+        return self._eval_instant_func(node, params)
+
+    def _eval_range_func(self, node: Call, params: QueryParams) -> Block:
+        sel_args = [a for a in node.args if isinstance(a, VectorSelector)]
+        if not sel_args or not sel_args[-1].range_ns:
+            raise QueryError(f"{node.func} expects a range vector")
+        sel = sel_args[-1]
+        ext, W, stride = self._eval_range_selector(sel, params)
+        grid = ext.values
+        step_ns = ext.meta.step_ns
+        f = node.func
+        if f == "rate":
+            out = temporal.rate(grid, W, step_ns, sel.range_ns)
+        elif f == "increase":
+            out = temporal.increase(grid, W, step_ns, sel.range_ns)
+        elif f == "delta":
+            out = temporal.delta(grid, W, step_ns, sel.range_ns)
+        elif f == "irate":
+            out = temporal.irate(grid, W, step_ns)
+        elif f == "idelta":
+            out = temporal.idelta(grid, W, step_ns)
+        elif f == "deriv":
+            out = temporal.deriv(grid, W, step_ns)
+        elif f == "predict_linear":
+            out = temporal.predict_linear(
+                grid, W, step_ns, _const_param(node.args[1]))
+        elif f == "holt_winters":
+            out = temporal.holt_winters(
+                grid, W, _const_param(node.args[1]), _const_param(node.args[2]))
+        elif f == "changes":
+            out = temporal.changes(grid, W)
+        elif f == "resets":
+            out = temporal.resets(grid, W)
+        elif f == "quantile_over_time":
+            out = temporal.quantile_over_time(grid, W, _const_param(node.args[0]))
+        else:
+            kind = f[: -len("_over_time")]
+            out = temporal.over_time(grid, W, kind)
+        out = out[:, ::stride]
+        drop_name = f not in ("last_over_time",)
+        tags = [_strip_name(t) if drop_name else t for t in ext.series_tags]
+        return Block(params.meta(), tags, out)
+
+    def _eval_instant_func(self, node: Call, params: QueryParams) -> Value:
+        f = node.func
+        if f == "time":
+            return params.meta().times() / 1e9
+        if f == "scalar":
+            block = self._eval(node.args[0], params)
+            if not isinstance(block, Block):
+                raise QueryError("scalar() expects a vector")
+            if block.n_series == 1:
+                return block.values[0].astype(np.float64)
+            return np.full(params.steps, np.nan)
+        if f == "vector":
+            val = self._eval(node.args[0], params)
+            arr = _broadcast_scalar(val, params)
+            return Block(params.meta(), [Tags.of({})], arr[None, :])
+        if f == "absent":
+            block = self._eval(node.args[0], params)
+            present = np.isfinite(block.values).any(axis=0) if block.n_series else (
+                np.zeros(params.steps, dtype=bool))
+            vals = np.where(present, np.nan, 1.0)[None, :]
+            tags = _absent_tags(node.args[0])
+            return Block(params.meta(), [tags], vals)
+        if f in ("label_replace", "label_join"):
+            return self._eval_label_func(node, params)
+        if f == "histogram_quantile":
+            q = _const_param(node.args[0])
+            block = self._eval(node.args[1], params)
+            return _histogram_quantile(q, block)
+        if f in ("sort", "sort_desc"):
+            block = self._eval(node.args[0], params)
+            key = np.where(np.isfinite(block.values), block.values, -np.inf).mean(axis=1)
+            order = np.argsort(-key if f == "sort_desc" else key, kind="stable")
+            return Block(block.meta, [block.series_tags[i] for i in order],
+                         block.values[order])
+        if f == "timestamp":
+            block = self._eval(node.args[0], params)
+            times = block.meta.times() / 1e9
+            vals = np.where(np.isfinite(block.values), times[None, :], np.nan)
+            return block.with_values(vals, [_strip_name(t) for t in block.series_tags])
+        fn = _MATH_FUNCS.get(f)
+        if fn is None:
+            raise QueryError(f"unknown function {f}")
+        args = [self._eval(a, params) for a in node.args]
+        if not args:
+            raise QueryError(f"{f} expects arguments")
+        head = args[0]
+        extra = [(_broadcast_scalar(a, params) if not isinstance(a, Block) else a)
+                 for a in args[1:]]
+        if isinstance(head, Block):
+            vals = fn(head.values, *[e if isinstance(e, np.ndarray) else e
+                                     for e in extra])
+            return head.with_values(vals, [_strip_name(t) for t in head.series_tags])
+        return fn(_broadcast_scalar(head, params), *extra)
+
+    def _eval_label_func(self, node: Call, params: QueryParams) -> Block:
+        import re as _re
+
+        block = self._eval(node.args[0], params)
+        if node.func == "label_replace":
+            dst, repl, src, regex = (_string_param(a) for a in node.args[1:5])
+            pattern = _re.compile(regex)
+            tags = []
+            for t in block.series_tags:
+                val = (t.get(src.encode()) or b"").decode()
+                m = pattern.fullmatch(val)
+                if m:
+                    new = m.expand(_go_template_to_py(repl))
+                    t = t.with_tag(dst.encode(), new.encode())
+                tags.append(t)
+            return block.with_values(block.values, tags)
+        # label_join(v, dst, sep, src...)
+        dst = _string_param(node.args[1]).encode()
+        sep = _string_param(node.args[2]).encode()
+        srcs = [_string_param(a).encode() for a in node.args[3:]]
+        tags = [
+            t.with_tag(dst, sep.join(t.get(s) or b"" for s in srcs))
+            for t in block.series_tags
+        ]
+        return block.with_values(block.values, tags)
+
+    # -- aggregation -------------------------------------------------------
+
+    def _eval_aggregation(self, node: Aggregation, params: QueryParams) -> Block:
+        block = self._eval(node.expr, params)
+        if not isinstance(block, Block):
+            raise QueryError(f"{node.op} expects an instant vector")
+        group_ids, group_tags = _group_series(
+            block.series_tags, node.grouping, node.without)
+        G = len(group_tags)
+        vals = block.values
+        op = node.op
+        if op in ("sum", "avg", "min", "max", "count", "stddev", "stdvar"):
+            # f64 host reduce keeps counter-sum exactness; the jitted f32
+            # segment kernel (series_agg.grouped_reduce) is the fast path
+            # for large fan-in where 24-bit mantissas suffice.
+            out = (series_agg.grouped_reduce_f64(vals, group_ids, G, op)
+                   if vals.shape[0] < 4096 else
+                   series_agg.grouped_reduce(vals, group_ids, G, op))
+            return Block(block.meta, group_tags, out)
+        if op == "quantile":
+            q = _const_param(node.param)
+            out = series_agg.grouped_quantile(vals, group_ids, G, q)
+            return Block(block.meta, group_tags, out)
+        if op in ("topk", "bottomk"):
+            k = int(_const_param(node.param))
+            keep = series_agg.topk_mask(vals, group_ids, G, k, op == "topk")
+            out = np.where(keep, vals, np.nan)
+            rows = ~np.all(np.isnan(out), axis=1)
+            return Block(block.meta,
+                         [t for t, r in zip(block.series_tags, rows) if r],
+                         out[rows])
+        if op == "count_values":
+            label = _string_param(node.param).encode()
+            counts = series_agg.count_values(vals, group_ids, G)
+            tags, rows = [], []
+            for (g, v), cnt in sorted(counts.items()):
+                tags.append(group_tags[g].with_tag(label, _format_value(v)))
+                rows.append(np.where(cnt > 0, cnt, np.nan))
+            values = np.stack(rows) if rows else np.zeros((0, block.meta.steps))
+            return Block(block.meta, tags, values)
+        raise QueryError(f"unsupported aggregation {op}")
+
+    # -- binary ops --------------------------------------------------------
+
+    def _eval_binary(self, node: BinaryOp, params: QueryParams) -> Value:
+        lhs = self._eval(node.lhs, params)
+        rhs = self._eval(node.rhs, params)
+        if node.op in promql.SET_OPS:
+            return _set_op(node.op, lhs, rhs, node.matching)
+        l_vec, r_vec = isinstance(lhs, Block), isinstance(rhs, Block)
+        fn = _BIN_FUNCS[node.op]
+        comparison = node.op in promql.COMPARISON_OPS
+        if not l_vec and not r_vec:
+            lv = _broadcast_scalar(lhs, params)
+            rv = _broadcast_scalar(rhs, params)
+            out = fn(lv, rv)
+            if comparison and not node.bool_mode:
+                # scalar comparisons without bool filter to the lhs value
+                return np.where(out > 0, lv, np.nan)
+            return out.astype(np.float64)
+        if l_vec and r_vec:
+            return _vector_vector(node, lhs, rhs, fn, comparison)
+        # vector <op> scalar (either side)
+        block = lhs if l_vec else rhs
+        scalar = _broadcast_scalar(rhs if l_vec else lhs, params)
+        a = block.values if l_vec else scalar[None, :]
+        b = scalar[None, :] if l_vec else block.values
+        with np.errstate(divide="ignore", invalid="ignore"):
+            out = fn(a, b)
+        if comparison:
+            if node.bool_mode:
+                vals = np.where(np.isfinite(block.values), out.astype(np.float64), np.nan)
+                return block.with_values(vals, [_strip_name(t) for t in block.series_tags])
+            return block.with_values(np.where(out > 0, block.values, np.nan))
+        return block.with_values(out, [_strip_name(t) for t in block.series_tags])
+
+
+# ---------------------------------------------------------------- helpers
+
+_MATH_FUNCS: Dict[str, Callable] = {
+    "abs": np.abs, "ceil": np.ceil, "floor": np.floor, "exp": np.exp,
+    "sqrt": lambda v: _guard(np.sqrt, v), "ln": lambda v: _guard(np.log, v),
+    "log2": lambda v: _guard(np.log2, v), "log10": lambda v: _guard(np.log10, v),
+    "sgn": np.sign,
+    "round": lambda v, to=None: (np.round(v) if to is None
+                                 else np.round(v / to) * to),
+    "clamp": lambda v, lo, hi: np.clip(v, lo, hi),
+    "clamp_min": lambda v, lo: np.maximum(v, lo),
+    "clamp_max": lambda v, hi: np.minimum(v, hi),
+}
+
+_BIN_FUNCS: Dict[str, Callable] = {
+    "+": np.add, "-": np.subtract, "*": np.multiply,
+    # fmod = Go math.Mod truncated-toward-zero semantics (promql '%'),
+    # unlike np.mod's floored modulo.
+    "/": np.divide, "%": np.fmod, "^": np.power,
+    "==": lambda a, b: (a == b).astype(np.float64),
+    "!=": lambda a, b: (a != b).astype(np.float64),
+    "<": lambda a, b: (a < b).astype(np.float64),
+    ">": lambda a, b: (a > b).astype(np.float64),
+    "<=": lambda a, b: (a <= b).astype(np.float64),
+    ">=": lambda a, b: (a >= b).astype(np.float64),
+}
+
+
+def _guard(fn, v):
+    with np.errstate(invalid="ignore", divide="ignore"):
+        return fn(v)
+
+
+def _map_values(val: Value, fn) -> Value:
+    if isinstance(val, Block):
+        return val.with_values(fn(val.values))
+    if isinstance(val, np.ndarray):
+        return fn(val)
+    return fn(val)
+
+
+def _broadcast_scalar(val: Value, params: QueryParams) -> np.ndarray:
+    if isinstance(val, Block):
+        raise QueryError("expected scalar, got vector")
+    if isinstance(val, np.ndarray):
+        return val
+    return np.full(params.steps, float(val))
+
+
+def _to_block(val: Value, params: QueryParams) -> Block:
+    if isinstance(val, Block):
+        return val
+    arr = _broadcast_scalar(val, params)
+    return Block(params.meta(), [Tags.of({})], arr[None, :])
+
+
+def _strip_name(t: Tags) -> Tags:
+    return t.without([METRIC_NAME])
+
+
+def _group_series(tags: List[Tags], grouping: Tuple[bytes, ...],
+                  without: bool) -> Tuple[np.ndarray, List[Tags]]:
+    """Group rows by kept labels (functions/aggregation/function.go
+    collectSeries): by(...) keeps listed labels; without(...) drops them
+    (and the metric name); no modifier = one global group."""
+    ids = np.zeros(len(tags), dtype=np.int64)
+    group_tags: List[Tags] = []
+    seen: Dict[bytes, int] = {}
+    for i, t in enumerate(tags):
+        if without:
+            gt = t.without(list(grouping) + [METRIC_NAME])
+        elif grouping:
+            gt = t.keep(grouping)
+        else:
+            gt = Tags.of({})
+        key = gt.id()
+        g = seen.get(key)
+        if g is None:
+            g = seen[key] = len(group_tags)
+            group_tags.append(gt)
+        ids[i] = g
+    return ids, group_tags
+
+
+def _match_key(t: Tags, matching) -> bytes:
+    if matching is not None and matching.on:
+        return t.keep(matching.labels).id()
+    drop = list(matching.labels) if matching is not None else []
+    return t.without(drop + [METRIC_NAME]).id()
+
+
+def _vector_vector(node: BinaryOp, lhs: Block, rhs: Block, fn,
+                   comparison: bool) -> Block:
+    matching = node.matching
+    many_side_left = matching.group_left if matching else False
+    many_side_right = matching.group_right if matching else False
+    one_to_one = not (many_side_left or many_side_right)
+    # Map the "one" side by matching key.
+    if many_side_right:
+        many, one, swap = rhs, lhs, True
+    else:
+        many, one, swap = lhs, rhs, False
+    one_map: Dict[bytes, int] = {}
+    for j, t in enumerate(one.series_tags):
+        key = _match_key(t, matching)
+        if key in one_map:
+            raise QueryError(
+                "many-to-many vector matching: duplicate series on the "
+                f"'one' side for key {key!r}")
+        one_map[key] = j
+    tags_out: List[Tags] = []
+    rows: List[np.ndarray] = []
+    seen_result: Dict[bytes, int] = {}
+    for i, t in enumerate(many.series_tags):
+        j = one_map.get(_match_key(t, matching))
+        if j is None:
+            continue
+        a = many.values[i]
+        b = one.values[j]
+        with np.errstate(divide="ignore", invalid="ignore"):
+            out = fn(b, a) if swap else fn(a, b)
+        both = np.isfinite(many.values[i]) & np.isfinite(one.values[j])
+        result_tags = _result_tags(t, one.series_tags[j], matching, comparison,
+                                   node.bool_mode)
+        if comparison and not node.bool_mode:
+            out = np.where(out > 0, a, np.nan)
+        else:
+            out = np.where(both, out, np.nan)
+        key = result_tags.id()
+        if one_to_one and key in seen_result:
+            raise QueryError("multiple matches for the same result labels")
+        seen_result[key] = i
+        tags_out.append(result_tags)
+        rows.append(out)
+    values = np.stack(rows) if rows else np.zeros((0, lhs.meta.steps))
+    return Block(lhs.meta, tags_out, values)
+
+
+def _result_tags(many_tags: Tags, one_tags: Tags, matching, comparison: bool,
+                 bool_mode: bool) -> Tags:
+    if comparison and not bool_mode:
+        return many_tags
+    t = many_tags.without([METRIC_NAME])
+    if matching is not None and matching.include:
+        for lbl in matching.include:
+            v = one_tags.get(lbl)
+            if v is not None:
+                t = t.with_tag(lbl, v)
+            else:
+                t = t.without([lbl])
+    return t
+
+
+def _set_op(op: str, lhs: Value, rhs: Value, matching) -> Block:
+    if not isinstance(lhs, Block) or not isinstance(rhs, Block):
+        raise QueryError(f"{op} requires vector operands")
+    rhs_keys = {_match_key(t, matching): j for j, t in enumerate(rhs.series_tags)}
+    tags_out, rows = [], []
+    if op in ("and", "unless"):
+        for i, t in enumerate(lhs.series_tags):
+            j = rhs_keys.get(_match_key(t, matching))
+            if op == "and":
+                if j is None:
+                    continue
+                keep = np.isfinite(rhs.values[j])
+            else:
+                keep = (np.zeros(lhs.meta.steps, bool) if j is None else
+                        np.isfinite(rhs.values[j]))
+                keep = ~keep if j is not None else np.ones(lhs.meta.steps, bool)
+            vals = np.where(keep, lhs.values[i], np.nan)
+            if np.isfinite(vals).any() or op == "and":
+                tags_out.append(t)
+                rows.append(vals)
+    else:  # or
+        lhs_keys = set()
+        for i, t in enumerate(lhs.series_tags):
+            lhs_keys.add(_match_key(t, matching))
+            tags_out.append(t)
+            rows.append(lhs.values[i])
+        for j, t in enumerate(rhs.series_tags):
+            if _match_key(t, matching) not in lhs_keys:
+                tags_out.append(t)
+                rows.append(rhs.values[j])
+    values = np.stack(rows) if rows else np.zeros((0, lhs.meta.steps))
+    return Block(lhs.meta, tags_out, values)
+
+
+def _histogram_quantile(q: float, block: Block) -> Block:
+    """promql histogram_quantile over classic le-bucket series
+    (functions/linear/histogram_quantile.go)."""
+    groups: Dict[bytes, List[Tuple[float, int]]] = {}
+    group_tags: Dict[bytes, Tags] = {}
+    for i, t in enumerate(block.series_tags):
+        le = t.get(b"le")
+        if le is None:
+            continue
+        gt = t.without([b"le", METRIC_NAME])
+        key = gt.id()
+        group_tags[key] = gt
+        groups.setdefault(key, []).append((float(le), i))
+    tags_out, rows = [], []
+    for key, buckets in sorted(groups.items()):
+        buckets.sort()
+        ubs = np.array([b[0] for b in buckets])
+        idxs = [b[1] for b in buckets]
+        counts = block.values[idxs]  # cumulative counts [B, T]
+        total = counts[-1]
+        out = np.full(block.meta.steps, np.nan)
+        with np.errstate(invalid="ignore", divide="ignore"):
+            rank = q * total
+            # First bucket whose cumulative count >= rank.
+            ge = counts >= rank[None, :]
+            first = np.argmax(ge, axis=0)
+            any_ge = ge.any(axis=0)
+            b_idx = np.clip(first, 0, len(buckets) - 1)
+            ub = ubs[b_idx]
+            lb = np.where(b_idx > 0, ubs[np.maximum(b_idx - 1, 0)], 0.0)
+            cnt_ub = counts[b_idx, np.arange(counts.shape[1])]
+            cnt_lb = np.where(b_idx > 0,
+                              counts[np.maximum(b_idx - 1, 0),
+                                     np.arange(counts.shape[1])], 0.0)
+            frac = np.where(cnt_ub > cnt_lb, (rank - cnt_lb) / (cnt_ub - cnt_lb), 0)
+            interp = lb + (ub - lb) * frac
+            # +Inf bucket selected -> return the lower bound (prom behavior).
+            interp = np.where(np.isinf(ub), lb, interp)
+            out = np.where((total > 0) & any_ge, interp, np.nan)
+        tags_out.append(group_tags[key])
+        rows.append(out)
+    values = np.stack(rows) if rows else np.zeros((0, block.meta.steps))
+    return Block(block.meta, tags_out, values)
+
+
+def _const_param(node: Optional[Node]) -> float:
+    if isinstance(node, NumberLiteral):
+        return float(node.value)
+    if isinstance(node, Unary) and isinstance(node.expr, NumberLiteral):
+        return -node.expr.value
+    raise QueryError("expected a constant parameter")
+
+
+def _string_param(node: Node) -> str:
+    if isinstance(node, StringLiteral):
+        return node.value
+    raise QueryError("expected a string parameter")
+
+
+def _absent_tags(node: Node) -> Tags:
+    if isinstance(node, VectorSelector):
+        d = {}
+        if node.name:
+            d[METRIC_NAME] = node.name
+        for m in node.matchers:
+            if m.type == MatchType.EQUAL:
+                d[m.name] = m.value
+        d.pop(METRIC_NAME, None)
+        return Tags.of(d)
+    return Tags.of({})
+
+
+def _format_value(v: float) -> bytes:
+    if v == int(v):
+        return str(int(v)).encode()
+    return repr(v).encode()
+
+
+def _go_template_to_py(repl: str) -> str:
+    """Convert prom's $1/${name} capture refs to python re.expand refs."""
+    return re_sub_dollar(repl)
+
+
+def re_sub_dollar(repl: str) -> str:
+    import re as _re
+
+    return _re.sub(r"\$(\d+|\{\w+\})", lambda m: "\\" + m.group(1).strip("{}"), repl)

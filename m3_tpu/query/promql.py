@@ -1,0 +1,373 @@
+"""PromQL parser: lexer + Pratt parser producing the query AST (reference:
+src/query/parser/promql/parse.go wraps the vendored prometheus promql
+parser; this build implements the grammar natively — selectors with label
+matchers and range/offset, function calls, aggregations with by/without,
+binary operators with precedence, bool modifier and vector matching).
+
+Covers the PromQL surface of the 2018-era engine the reference embeds:
+no subqueries or @-modifiers (which postdate it)."""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import List, Optional, Sequence, Tuple
+
+from .model import Matcher, MatchType, METRIC_NAME
+
+# ---------------------------------------------------------------- tokens
+
+_TOKEN_RE = re.compile(r"""
+    (?P<WS>\s+)
+  | (?P<DURATION>[0-9]+(?:\.[0-9]+)?(?:ms|[smhdwy])(?:[0-9]+(?:\.[0-9]+)?(?:ms|[smhdwy]))*)
+  | (?P<NUMBER>(?:0x[0-9a-fA-F]+)|(?:[0-9]*\.[0-9]+(?:[eE][+-]?[0-9]+)?)|(?:[0-9]+(?:[eE][+-]?[0-9]+)?)|[iI][nN][fF]|[nN][aA][nN])
+  | (?P<IDENT>[a-zA-Z_:][a-zA-Z0-9_:.]*)
+  | (?P<STRING>"(?:\\.|[^"\\])*"|'(?:\\.|[^'\\])*')
+  | (?P<OP>=~|!~|==|!=|<=|>=|<|>|\+|-|\*|/|%|\^|=)
+  | (?P<LPAREN>\()|(?P<RPAREN>\))
+  | (?P<LBRACE>\{)|(?P<RBRACE>\})
+  | (?P<LBRACKET>\[)|(?P<RBRACKET>\])
+  | (?P<COMMA>,)
+""", re.VERBOSE)
+
+_UNITS_NS = {"ms": 10**6, "s": 10**9, "m": 60 * 10**9, "h": 3600 * 10**9,
+             "d": 86400 * 10**9, "w": 7 * 86400 * 10**9, "y": 365 * 86400 * 10**9}
+_DUR_PART = re.compile(r"([0-9]+(?:\.[0-9]+)?)(ms|[smhdwy])")
+
+
+def parse_duration_ns(s: str) -> int:
+    total = 0
+    for num, unit in _DUR_PART.findall(s):
+        total += int(float(num) * _UNITS_NS[unit])
+    return total
+
+
+@dataclasses.dataclass
+class Token:
+    kind: str
+    text: str
+    pos: int
+
+
+def lex(s: str) -> List[Token]:
+    out, i = [], 0
+    while i < len(s):
+        m = _TOKEN_RE.match(s, i)
+        if not m:
+            raise ParseError(f"unexpected character {s[i]!r} at {i}")
+        kind = m.lastgroup
+        if kind != "WS":
+            out.append(Token(kind, m.group(), i))
+        i = m.end()
+    out.append(Token("EOF", "", len(s)))
+    return out
+
+
+class ParseError(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------- AST
+
+@dataclasses.dataclass(frozen=True)
+class Node:
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class NumberLiteral(Node):
+    value: float
+
+
+@dataclasses.dataclass(frozen=True)
+class StringLiteral(Node):
+    value: str
+
+
+@dataclasses.dataclass(frozen=True)
+class VectorSelector(Node):
+    name: bytes
+    matchers: Tuple[Matcher, ...] = ()
+    range_ns: int = 0          # 0 = instant vector; >0 = matrix selector
+    offset_ns: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class Call(Node):
+    func: str
+    args: Tuple[Node, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class Aggregation(Node):
+    op: str
+    expr: Node
+    param: Optional[Node] = None
+    grouping: Tuple[bytes, ...] = ()
+    without: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class VectorMatching(Node):
+    on: bool = False                     # on(...) vs ignoring(...)
+    labels: Tuple[bytes, ...] = ()
+    group_left: bool = False
+    group_right: bool = False
+    include: Tuple[bytes, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class BinaryOp(Node):
+    op: str
+    lhs: Node
+    rhs: Node
+    bool_mode: bool = False
+    matching: Optional[VectorMatching] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Unary(Node):
+    op: str
+    expr: Node
+
+
+AGG_OPS = {"sum", "min", "max", "avg", "count", "stddev", "stdvar",
+           "topk", "bottomk", "quantile", "count_values"}
+_PARAM_AGGS = {"topk", "bottomk", "quantile", "count_values"}
+
+# precedence (prom): or < and/unless < comparisons < +- < */% < ^
+_PRECEDENCE = {
+    "or": 1,
+    "and": 2, "unless": 2,
+    "==": 3, "!=": 3, "<=": 3, "<": 3, ">=": 3, ">": 3,
+    "+": 4, "-": 4,
+    "*": 5, "/": 5, "%": 5,
+    "^": 6,
+}
+_RIGHT_ASSOC = {"^"}
+SET_OPS = {"and", "or", "unless"}
+COMPARISON_OPS = {"==", "!=", "<=", "<", ">=", ">"}
+
+
+class Parser:
+    def __init__(self, s: str):
+        self.toks = lex(s)
+        self.i = 0
+
+    # -- token helpers ----------------------------------------------------
+
+    def peek(self) -> Token:
+        return self.toks[self.i]
+
+    def next(self) -> Token:
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def expect(self, kind: str, text: Optional[str] = None) -> Token:
+        t = self.next()
+        if t.kind != kind or (text is not None and t.text != text):
+            raise ParseError(f"expected {text or kind}, got {t.text!r} at {t.pos}")
+        return t
+
+    def accept(self, kind: str, text: Optional[str] = None) -> Optional[Token]:
+        t = self.peek()
+        if t.kind == kind and (text is None or t.text == text):
+            return self.next()
+        return None
+
+    # -- grammar ----------------------------------------------------------
+
+    def parse(self) -> Node:
+        node = self.parse_expr(0)
+        if self.peek().kind != "EOF":
+            t = self.peek()
+            raise ParseError(f"unexpected {t.text!r} at {t.pos}")
+        return node
+
+    def parse_expr(self, min_prec: int) -> Node:
+        lhs = self.parse_unary()
+        while True:
+            t = self.peek()
+            op = t.text if t.kind in ("OP", "IDENT") else None
+            if op not in _PRECEDENCE or _PRECEDENCE[op] < min_prec:
+                return lhs
+            self.next()
+            bool_mode = bool(self.accept("IDENT", "bool"))
+            matching = self._parse_matching()
+            next_min = _PRECEDENCE[op] + (0 if op in _RIGHT_ASSOC else 1)
+            rhs = self.parse_expr(next_min)
+            lhs = BinaryOp(op, lhs, rhs, bool_mode, matching)
+
+    def _parse_matching(self) -> Optional[VectorMatching]:
+        t = self.peek()
+        if t.kind != "IDENT" or t.text not in ("on", "ignoring"):
+            return None
+        on = self.next().text == "on"
+        labels = self._parse_label_list()
+        group_left = group_right = False
+        include: Tuple[bytes, ...] = ()
+        t = self.peek()
+        if t.kind == "IDENT" and t.text in ("group_left", "group_right"):
+            side = self.next().text
+            group_left = side == "group_left"
+            group_right = side == "group_right"
+            if self.peek().kind == "LPAREN":
+                include = self._parse_label_list()
+        return VectorMatching(on, labels, group_left, group_right, include)
+
+    def _parse_label_list(self) -> Tuple[bytes, ...]:
+        self.expect("LPAREN")
+        labels = []
+        while not self.accept("RPAREN"):
+            labels.append(self.expect("IDENT").text.encode())
+            if self.peek().kind == "COMMA":
+                self.next()
+        return tuple(labels)
+
+    def parse_unary(self) -> Node:
+        t = self.peek()
+        if t.kind == "OP" and t.text in ("+", "-"):
+            self.next()
+            # Unary operators bind between '^' and '*' (Go/prom spec):
+            # -2^2 == -(2^2), -2*3 == (-2)*3.
+            expr = self.parse_expr(_PRECEDENCE["^"])
+            return expr if t.text == "+" else Unary("-", expr)
+        return self.parse_postfix(self.parse_atom())
+
+    def parse_postfix(self, node: Node) -> Node:
+        # range selector [5m] and offset modifier
+        if self.accept("LBRACKET"):
+            dur = self.expect("DURATION").text
+            self.expect("RBRACKET")
+            if not isinstance(node, VectorSelector):
+                raise ParseError("range selector on non-selector expression")
+            node = dataclasses.replace(node, range_ns=parse_duration_ns(dur))
+        if self.accept("IDENT", "offset"):
+            dur = self.expect("DURATION").text
+            if not isinstance(node, VectorSelector):
+                raise ParseError("offset on non-selector expression")
+            node = dataclasses.replace(node, offset_ns=parse_duration_ns(dur))
+        return node
+
+    def parse_atom(self) -> Node:
+        t = self.peek()
+        if t.kind == "NUMBER":
+            self.next()
+            return NumberLiteral(_parse_number(t.text))
+        if t.kind == "STRING":
+            self.next()
+            return StringLiteral(_unquote(t.text))
+        if t.kind == "LPAREN":
+            self.next()
+            node = self.parse_expr(0)
+            self.expect("RPAREN")
+            return node
+        if t.kind == "LBRACE":
+            return VectorSelector(b"", self._parse_matchers())
+        if t.kind == "IDENT":
+            if t.text in AGG_OPS:
+                return self._parse_aggregation()
+            return self._parse_ident()
+        raise ParseError(f"unexpected {t.text!r} at {t.pos}")
+
+    def _parse_ident(self) -> Node:
+        name = self.next().text
+        if self.peek().kind == "LPAREN" and name not in ("on", "ignoring"):
+            self.next()
+            args: List[Node] = []
+            while not self.accept("RPAREN"):
+                args.append(self.parse_expr(0))
+                if self.peek().kind == "COMMA":
+                    self.next()
+            return Call(name, tuple(args))
+        matchers: Tuple[Matcher, ...] = ()
+        if self.peek().kind == "LBRACE":
+            matchers = self._parse_matchers()
+        return VectorSelector(name.encode(), matchers)
+
+    def _parse_aggregation(self) -> Node:
+        op = self.next().text
+        grouping: Tuple[bytes, ...] = ()
+        without = False
+        # modifier may precede or follow the parenthesized body
+        t = self.peek()
+        if t.kind == "IDENT" and t.text in ("by", "without"):
+            without = self.next().text == "without"
+            grouping = self._parse_label_list()
+        self.expect("LPAREN")
+        first = self.parse_expr(0)
+        param = None
+        if self.accept("COMMA"):
+            param, first = first, self.parse_expr(0)
+        self.expect("RPAREN")
+        t = self.peek()
+        if t.kind == "IDENT" and t.text in ("by", "without"):
+            without = self.next().text == "without"
+            grouping = self._parse_label_list()
+        if op in _PARAM_AGGS and param is None:
+            raise ParseError(f"{op} requires a parameter")
+        return Aggregation(op, first, param, grouping, without)
+
+    def _parse_matchers(self) -> Tuple[Matcher, ...]:
+        self.expect("LBRACE")
+        out: List[Matcher] = []
+        while not self.accept("RBRACE"):
+            name = self.expect("IDENT").text
+            opt = self.expect("OP")
+            mt = {"=": MatchType.EQUAL, "!=": MatchType.NOT_EQUAL,
+                  "=~": MatchType.REGEXP, "!~": MatchType.NOT_REGEXP}.get(opt.text)
+            if mt is None:
+                raise ParseError(f"bad matcher operator {opt.text!r} at {opt.pos}")
+            value = _unquote(self.expect("STRING").text)
+            out.append(Matcher(mt, name.encode(), value.encode()))
+            if self.peek().kind == "COMMA":
+                self.next()
+        return tuple(out)
+
+
+def _parse_number(s: str) -> float:
+    low = s.lower()
+    if low == "inf":
+        return float("inf")
+    if low == "nan":
+        return float("nan")
+    if low.startswith("0x"):
+        return float(int(s, 16))
+    return float(s)
+
+
+_ESCAPES = {"n": "\n", "t": "\t", "r": "\r", "\\": "\\", '"': '"',
+            "'": "'", "a": "\a", "b": "\b", "f": "\f", "v": "\v", "/": "/"}
+_ESCAPE_RE = re.compile(
+    r"\\(x[0-9a-fA-F]{2}|u[0-9a-fA-F]{4}|U[0-9a-fA-F]{8}|[0-7]{1,3}|.)")
+
+
+def _unquote(s: str) -> str:
+    """Resolve escape sequences without the unicode_escape latin-1 round
+    trip (which mojibakes non-ASCII literals)."""
+
+    def sub(m: "re.Match") -> str:
+        e = m.group(1)
+        if e[0] in "xuU":
+            return chr(int(e[1:], 16))
+        if e[0] in "01234567":
+            return chr(int(e, 8))
+        if e in _ESCAPES:
+            return _ESCAPES[e]
+        raise ParseError(f"unknown escape \\{e}")
+
+    return _ESCAPE_RE.sub(sub, s[1:-1])
+
+
+def parse(s: str) -> Node:
+    """Parse a PromQL expression string into an AST."""
+    return Parser(s).parse()
+
+
+def selector_matchers(sel: VectorSelector) -> Tuple[Matcher, ...]:
+    """Full matcher set including the metric name."""
+    out = list(sel.matchers)
+    if sel.name:
+        out.insert(0, Matcher(MatchType.EQUAL, METRIC_NAME, sel.name))
+    return tuple(out)

@@ -1,0 +1,96 @@
+"""Query storage interface + implementations (reference:
+src/query/storage/types.go Storage, storage/m3/storage.go the dbnode
+adapter, storage/fanout/storage.go the multi-store fanout).
+
+fetch_raw(matchers, start_ns, end_ns) -> {series_id: {tags, t, v}} raw
+datapoints; the executor grids them per query. Tag index queries compile
+from label matchers via model.matchers_to_index_query."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .model import Matcher, matchers_to_index_query
+
+
+class LocalStorage:
+    """Direct adapter over an in-process storage.Database (the coordinator
+    embedded in a dbnode, storage/m3/storage.go Fetch -> ReadEncoded)."""
+
+    def __init__(self, db, namespace: bytes):
+        self._db = db
+        self._namespace = namespace
+
+    def fetch_raw(self, matchers: Sequence[Matcher], start_ns: int,
+                  end_ns: int) -> Dict[bytes, dict]:
+        q = matchers_to_index_query(matchers)
+        ids = self._db.query_ids(self._namespace, q, start_ns, end_ns)
+        out: Dict[bytes, dict] = {}
+        ns = self._db.namespace(self._namespace)
+        for sid in ids:
+            shard_id = self._db.shard_set.lookup(sid)
+            shard = ns.shards.get(shard_id)
+            if shard is None:
+                continue
+            t, v = shard.read(sid, start_ns, end_ns)
+            idx = shard.registry.get(sid)
+            tags = shard.registry.tags_of(idx) if idx is not None else {}
+            out[sid] = {"tags": tags or {}, "t": t, "v": v}
+        return out
+
+    def write(self, series_id: bytes, tags: Dict[bytes, bytes], t_ns: int,
+              value: float):
+        self._db.write(self._namespace, series_id, t_ns, value, tags=tags)
+
+
+class SessionStorage:
+    """Adapter over the replicating client session (storage/m3/storage.go
+    Fetch -> session.FetchTagged, the coordinator's production path)."""
+
+    def __init__(self, session, namespace: bytes):
+        self._session = session
+        self._namespace = namespace
+
+    def fetch_raw(self, matchers: Sequence[Matcher], start_ns: int,
+                  end_ns: int) -> Dict[bytes, dict]:
+        q = matchers_to_index_query(matchers)
+        return self._session.fetch_tagged(self._namespace, q, start_ns, end_ns)
+
+    def write(self, series_id: bytes, tags: Dict[bytes, bytes], t_ns: int,
+              value: float):
+        self._session.write_tagged(self._namespace, series_id, tags, t_ns, value)
+
+
+class FanoutStorage:
+    """Fan out fetches across stores and merge by series id
+    (storage/fanout/storage.go; replica-level merge already happened in the
+    client, so cross-store merge is simple union preferring more points)."""
+
+    def __init__(self, stores: Sequence):
+        self._stores = list(stores)
+
+    def fetch_raw(self, matchers: Sequence[Matcher], start_ns: int,
+                  end_ns: int) -> Dict[bytes, dict]:
+        merged: Dict[bytes, dict] = {}
+        for store in self._stores:
+            for sid, entry in store.fetch_raw(matchers, start_ns, end_ns).items():
+                cur = merged.get(sid)
+                if cur is None:
+                    merged[sid] = dict(entry)
+                else:
+                    t = np.concatenate([np.asarray(cur["t"]), np.asarray(entry["t"])])
+                    v = np.concatenate([np.asarray(cur["v"]), np.asarray(entry["v"])])
+                    order = np.argsort(t, kind="stable")
+                    t, v = t[order], v[order]
+                    keep = np.ones(t.size, dtype=bool)
+                    keep[1:] = t[1:] != t[:-1]
+                    cur["t"], cur["v"] = t[keep], v[keep]
+                    if not cur["tags"] and entry["tags"]:
+                        cur["tags"] = entry["tags"]
+        return merged
+
+    def write(self, series_id: bytes, tags, t_ns: int, value: float):
+        for store in self._stores:
+            store.write(series_id, tags, t_ns, value)

@@ -112,6 +112,49 @@ class TestProducerConsumer:
             prod.close()
             consumer.close()
 
+    def test_unrouted_messages_recover_when_placement_appears(self):
+        """Regression: publishes during a placement gap must deliver once a
+        placement exists (at-least-once across placement updates)."""
+        received = []
+        consumer = Consumer(lambda s, v: received.append(v)).start()
+        placement = {"p": None}  # no placement yet
+        topic = Topic("t", 1, (ConsumerService("svc"),))
+        prod = Producer(topic, {"svc": lambda: placement["p"]},
+                        retry_delay_s=0.05)
+        try:
+            prod.publish(0, b"early")
+            assert prod.unacked() == 1
+            time.sleep(0.1)
+            assert received == []
+            placement["p"] = one_instance_placement(consumer.endpoint, 1)
+            for _ in range(100):
+                prod.retry_unacked()
+                if received:
+                    break
+                time.sleep(0.02)
+            assert received == [b"early"]
+            assert _await(lambda: prod.unacked() == 0)
+        finally:
+            prod.close()
+            consumer.close()
+
+    def test_partial_ack_batch_flushes_on_idle(self):
+        """Regression: ack_batch larger than in-flight count must still ack
+        via the idle flush."""
+        received = []
+        consumer = Consumer(lambda s, v: received.append(v), ack_batch=10).start()
+        topic = Topic("t", 1, (ConsumerService("svc"),))
+        p = one_instance_placement(consumer.endpoint, 1)
+        prod = Producer(topic, {"svc": lambda: p})
+        try:
+            for i in range(3):
+                prod.publish(0, b"m%d" % i)
+            assert _await(lambda: len(received) == 3)
+            assert _await(lambda: prod.unacked() == 0, timeout=3.0)
+        finally:
+            prod.close()
+            consumer.close()
+
     def test_drop_oldest_bounds_buffer(self):
         # No consumer reachable: everything stays buffered; cap forces drops.
         topic = Topic("t", 1, (ConsumerService("svc"),))
@@ -169,6 +212,26 @@ class TestMatcher:
         r2 = m.match(mid)
         assert r2.for_existing_id[0].metadata.pipelines[0].storage_policies == (
             TEN_S, ONE_M)
+
+    def test_multi_op_pipeline_roundtrips_through_kv(self):
+        """Regression: rollup targets with transform+rollup pipelines must
+        survive KV serialization intact."""
+        from m3_tpu.metrics.matcher import ruleset_from_json, ruleset_to_json
+        from m3_tpu.metrics.transformation import TransformType
+
+        pipe = Pipeline((
+            Op.transform(TransformType.PERSECOND),
+            Op.roll(b"rolled", (b"region",), magg.AggID.compress([magg.AggType.SUM])),
+        ))
+        rs = RuleSet(
+            b"ns", 3,
+            rollup_rules=[Rule([RollupRuleSnapshot(
+                "r", 0, TagsFilter({"a": "b"}),
+                (RollupTarget(pipe, (TEN_S,)),))])])
+        back = ruleset_from_json(ruleset_to_json(rs))
+        target = back.rollup_rules[0].snapshots[0].targets[0]
+        assert target.pipeline == pipe
+        assert target.storage_policies == (TEN_S,)
 
     def test_no_match_gives_empty_metadata(self):
         store = cluster_kv.MemStore()

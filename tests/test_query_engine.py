@@ -1,0 +1,332 @@
+"""Query engine tests: PromQL parsing, executor semantics (selectors,
+temporal functions, aggregation, binary ops, histogram_quantile) against
+in-memory storage (reference behaviors from src/query/functions and the
+promql engine the reference embeds)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from m3_tpu.query import Engine, METRIC_NAME, Tags, parse
+from m3_tpu.query import promql
+from m3_tpu.query.executor import QueryError
+from m3_tpu.query.model import MatchType
+
+S = 1_000_000_000
+MIN = 60 * S
+STEP = 30 * S
+
+
+class MemStorage:
+    """Minimal fetch_raw storage: list of (tags-dict, times, values)."""
+
+    def __init__(self):
+        self.series = []
+
+    def add(self, tags, t, v):
+        self.series.append((
+            {k.encode() if isinstance(k, str) else k:
+             x.encode() if isinstance(x, str) else x for k, x in tags.items()},
+            np.asarray(t, np.int64), np.asarray(v, np.float64)))
+        return self
+
+    def fetch_raw(self, matchers, start_ns, end_ns):
+        out = {}
+        for i, (tags, t, v) in enumerate(self.series):
+            if all(m.matches(tags.get(m.name, b"")) for m in matchers):
+                keep = (t >= start_ns) & (t < end_ns)
+                sid = b",".join(k + b"=" + val for k, val in sorted(tags.items()))
+                out[sid] = {"tags": tags, "t": t[keep], "v": v[keep]}
+        return out
+
+
+@pytest.fixture
+def storage():
+    st = MemStorage()
+    t = np.arange(0, 40) * 15 * S  # 15s resolution, 10 minutes
+    st.add({"__name__": "http_requests_total", "job": "api", "instance": "a"},
+           t, np.arange(40) * 10.0)  # steady 10/15s counter
+    st.add({"__name__": "http_requests_total", "job": "api", "instance": "b"},
+           t, np.arange(40) * 5.0)
+    st.add({"__name__": "http_requests_total", "job": "db", "instance": "c"},
+           t, np.arange(40) * 2.0)
+    st.add({"__name__": "memory_bytes", "job": "api", "instance": "a"},
+           t, np.full(40, 100.0))
+    st.add({"__name__": "memory_bytes", "job": "api", "instance": "b"},
+           t, np.full(40, 300.0))
+    return st
+
+
+@pytest.fixture
+def engine(storage):
+    return Engine(storage)
+
+
+def run(engine, q, start=5 * MIN, end=9 * MIN, step=STEP):
+    return engine.execute_range(q, start, end, step)
+
+
+class TestParser:
+    def test_selector_with_matchers_range_offset(self):
+        ast = parse('http_requests_total{job="api",instance=~"a|b"}[5m] offset 1m')
+        assert ast.name == b"http_requests_total"
+        assert ast.range_ns == 5 * MIN
+        assert ast.offset_ns == MIN
+        assert ast.matchers[0].name == b"job"
+        assert ast.matchers[1].type == MatchType.REGEXP
+
+    def test_precedence(self):
+        ast = parse("a + b * c")
+        assert ast.op == "+"
+        assert ast.rhs.op == "*"
+        ast = parse("a * b + c")
+        assert ast.op == "+"
+        assert ast.lhs.op == "*"
+        ast = parse("2 ^ 3 ^ 2")  # right-assoc
+        assert ast.rhs.op == "^"
+
+    def test_aggregation_modifiers_both_positions(self):
+        a1 = parse("sum by (job) (x)")
+        a2 = parse("sum(x) by (job)")
+        assert a1.grouping == a2.grouping == (b"job",)
+        a3 = parse("topk(3, x)")
+        assert a3.op == "topk" and isinstance(a3.param, promql.NumberLiteral)
+
+    def test_bool_and_matching(self):
+        ast = parse("a > bool b")
+        assert ast.bool_mode
+        ast = parse("a / on(job) group_left(env) b")
+        assert ast.matching.on and ast.matching.labels == (b"job",)
+        assert ast.matching.group_left
+        assert ast.matching.include == (b"env",)
+
+    def test_unary_minus_precedence(self):
+        # Unary '-' binds between '^' and '*' (Go/prom spec).
+        eng = Engine(MemStorage())
+        out = run(eng, "-2^2")
+        np.testing.assert_allclose(out.values[0], -4.0)
+        out = run(eng, "-2*3")
+        np.testing.assert_allclose(out.values[0], -6.0)
+
+    def test_modulo_truncated(self):
+        eng = Engine(MemStorage())
+        out = run(eng, "-5 % 3")
+        np.testing.assert_allclose(out.values[0], -2.0)  # Go math.Mod
+
+    def test_string_escapes_preserve_utf8(self):
+        ast = parse('{env="café", path="a\\nb"}')
+        assert ast.matchers[0].value == "café".encode()
+        assert ast.matchers[1].value == b"a\nb"
+
+    def test_durations(self):
+        assert promql.parse_duration_ns("1h30m") == 90 * 60 * S
+        assert promql.parse_duration_ns("500ms") == 500_000_000
+
+    def test_parse_errors(self):
+        for bad in ["sum(", "a{job=}", "rate(x[5m)", "topk(x)"]:
+            with pytest.raises(ValueError):
+                parse(bad)
+
+
+class TestSelectors:
+    def test_instant_vector_lookback(self, engine):
+        blk = run(engine, "memory_bytes")
+        assert blk.n_series == 2
+        assert np.all(blk.values[0] == 100.0) or np.all(blk.values[1] == 100.0)
+
+    def test_matcher_filtering(self, engine):
+        blk = run(engine, 'http_requests_total{job="api"}')
+        assert blk.n_series == 2
+        blk = run(engine, 'http_requests_total{job!="api"}')
+        assert blk.n_series == 1
+
+    def test_offset(self, engine):
+        blk = run(engine, "http_requests_total offset 1m")
+        base = run(engine, "http_requests_total")
+        # offset shifts values back: at time t we see t-1m's value
+        assert blk.values[0][4] == base.values[0][2]  # 2 steps of 30s = 1m
+
+
+class TestTemporalFunctions:
+    def test_rate_steady_counter(self, engine):
+        blk = run(engine, "rate(http_requests_total[2m])")
+        # instance a increments 10 per 15s -> 2/3 per second
+        rates = {t.as_dict()[b"instance"]: v for t, v in
+                 zip(blk.series_tags, blk.values)}
+        np.testing.assert_allclose(rates[b"a"], 10 / 15, rtol=1e-9)
+        np.testing.assert_allclose(rates[b"b"], 5 / 15, rtol=1e-9)
+        # rate drops the metric name
+        assert all(t.get(METRIC_NAME) is None for t in blk.series_tags)
+
+    def test_increase(self, engine):
+        blk = run(engine, "increase(http_requests_total[2m])")
+        rates = {t.as_dict()[b"instance"]: v for t, v in
+                 zip(blk.series_tags, blk.values)}
+        np.testing.assert_allclose(rates[b"a"], 10 / 15 * 120, rtol=1e-9)
+
+    def test_avg_over_time_gauge(self, engine):
+        blk = run(engine, "avg_over_time(memory_bytes[2m])")
+        vals = {t.as_dict()[b"instance"]: v for t, v in
+                zip(blk.series_tags, blk.values)}
+        np.testing.assert_allclose(vals[b"a"], 100.0)
+        np.testing.assert_allclose(vals[b"b"], 300.0)
+
+
+class TestAggregation:
+    def test_sum_by(self, engine):
+        blk = run(engine, "sum by (job) (rate(http_requests_total[2m]))")
+        assert blk.n_series == 2
+        vals = {t.as_dict()[b"job"]: v for t, v in zip(blk.series_tags, blk.values)}
+        np.testing.assert_allclose(vals[b"api"], 15 / 15, rtol=1e-9)
+        np.testing.assert_allclose(vals[b"db"], 2 / 15, rtol=1e-9)
+
+    def test_sum_without(self, engine):
+        blk = run(engine, "sum without (instance) (memory_bytes)")
+        assert blk.n_series == 1
+        np.testing.assert_allclose(blk.values[0], 400.0)
+        assert blk.series_tags[0].as_dict() == {b"job": b"api"}
+
+    def test_global_aggregations(self, engine):
+        for q, exp in [("sum(memory_bytes)", 400.0), ("min(memory_bytes)", 100.0),
+                       ("max(memory_bytes)", 300.0), ("avg(memory_bytes)", 200.0),
+                       ("count(memory_bytes)", 2.0)]:
+            blk = run(engine, q)
+            assert blk.n_series == 1, q
+            np.testing.assert_allclose(blk.values[0], exp, err_msg=q)
+
+    def test_stddev(self, engine):
+        blk = run(engine, "stddev(memory_bytes)")
+        np.testing.assert_allclose(blk.values[0], 100.0)  # population stddev
+
+    def test_quantile(self, engine):
+        blk = run(engine, "quantile(0.5, memory_bytes)")
+        np.testing.assert_allclose(blk.values[0], 200.0)
+
+    def test_topk(self, engine):
+        blk = run(engine, "topk(1, memory_bytes)")
+        assert blk.n_series == 1
+        assert blk.series_tags[0].as_dict()[b"instance"] == b"b"
+
+    def test_count_values(self, engine):
+        blk = run(engine, 'count_values("val", memory_bytes)')
+        got = {t.as_dict()[b"val"]: v[0] for t, v in
+               zip(blk.series_tags, blk.values)}
+        assert got == {b"100": 1.0, b"300": 1.0}
+
+
+class TestBinaryOps:
+    def test_vector_scalar(self, engine):
+        blk = run(engine, "memory_bytes / 100")
+        assert sorted(v[0] for v in blk.values) == [1.0, 3.0]
+
+    def test_vector_vector_one_to_one(self, engine):
+        blk = run(engine, 'memory_bytes / on(instance) '
+                          'http_requests_total{job="api"}')
+        assert blk.n_series == 2
+
+    def test_comparison_filters(self, engine):
+        blk = run(engine, "memory_bytes > 200")
+        finite = [np.isfinite(v).all() for v in blk.values]
+        # only instance b (300) survives; filter keeps original values
+        surviving = [v for v, f in zip(blk.values, finite) if f]
+        assert len(surviving) == 1
+        np.testing.assert_allclose(surviving[0], 300.0)
+
+    def test_comparison_bool(self, engine):
+        blk = run(engine, "memory_bytes > bool 200")
+        got = sorted(v[0] for v in blk.values)
+        assert got == [0.0, 1.0]
+
+    def test_scalar_arithmetic(self, engine):
+        out = run(engine, "2 + 3 * 4")
+        np.testing.assert_allclose(out.values[0], 14.0)
+
+    def test_set_ops(self, engine):
+        blk = run(engine, 'memory_bytes and http_requests_total{instance="a"}')
+        assert blk.n_series == 1
+        blk = run(engine, 'memory_bytes unless http_requests_total{instance="a"}')
+        assert [t.as_dict()[b"instance"] for t in blk.series_tags] == [b"b"]
+
+    def test_many_to_many_rejected(self, engine):
+        with pytest.raises(QueryError):
+            run(engine, "memory_bytes / on(job) http_requests_total")
+
+
+class TestFunctions:
+    def test_math(self, engine):
+        blk = run(engine, "sqrt(memory_bytes)")
+        assert sorted(v[0] for v in blk.values) == [10.0, pytest.approx(math.sqrt(300))]
+
+    def test_clamp(self, engine):
+        blk = run(engine, "clamp(memory_bytes, 150, 250)")
+        assert sorted(v[0] for v in blk.values) == [150.0, 250.0]
+
+    def test_absent(self, engine):
+        blk = run(engine, 'absent(nonexistent_metric{foo="bar"})')
+        assert blk.n_series == 1
+        np.testing.assert_allclose(blk.values[0], 1.0)
+        blk = run(engine, "absent(memory_bytes)")
+        assert np.all(np.isnan(blk.values[0]))
+
+    def test_scalar_vector_roundtrip(self, engine):
+        blk = run(engine, "vector(42)")
+        np.testing.assert_allclose(blk.values[0], 42.0)
+        blk = run(engine, "scalar(vector(7)) + 1")
+        np.testing.assert_allclose(blk.values[0], 8.0)
+
+    def test_label_replace(self, engine):
+        blk = run(engine, 'label_replace(memory_bytes, "env", "prod-$1", '
+                          '"instance", "(.*)")')
+        envs = sorted(t.as_dict()[b"env"] for t in blk.series_tags)
+        assert envs == [b"prod-a", b"prod-b"]
+
+    def test_time(self, engine):
+        blk = run(engine, "time()")
+        np.testing.assert_allclose(blk.values[0][0], 5 * 60.0)
+
+
+class TestAgainstRealStorage:
+    def test_promql_over_database(self):
+        """End-to-end: tagged writes into the real storage engine, PromQL
+        range query through LocalStorage (the §3.3 read path minus RPC)."""
+        from m3_tpu.index.namespace_index import NamespaceIndex
+        from m3_tpu.parallel.sharding import ShardSet
+        from m3_tpu.query import LocalStorage
+        from m3_tpu.storage.database import Database
+        from m3_tpu.storage.namespace import NamespaceOptions
+
+        T0 = 1_600_000_000 * S
+        now = {"t": T0}
+        db = Database(ShardSet(8), clock=lambda: now["t"])
+        db.create_namespace(b"metrics", NamespaceOptions(index_enabled=True),
+                            index=NamespaceIndex(clock=lambda: now["t"]))
+        for i in range(40):
+            now["t"] = T0 + i * 15 * S  # stay inside the acceptance window
+            for inst, slope in [(b"a", 10.0), (b"b", 5.0)]:
+                tags = {b"__name__": b"requests_total", b"instance": inst}
+                sid = b"requests_total|instance=" + inst
+                db.write(b"metrics", sid, T0 + i * 15 * S, slope * i, tags=tags)
+        eng = Engine(LocalStorage(db, b"metrics"))
+        blk = eng.execute_range("sum(rate(requests_total[2m]))",
+                                T0 + 5 * MIN, T0 + 9 * MIN, STEP)
+        assert blk.n_series == 1
+        np.testing.assert_allclose(blk.values[0], 15 / 15, rtol=1e-9)
+
+
+class TestHistogramQuantile:
+    def test_le_buckets(self):
+        st = MemStorage()
+        t = np.arange(0, 40) * 15 * S
+        # Cumulative bucket counts: 60% <= 0.1, 90% <= 0.5, 100% <= +Inf
+        for le, frac in [("0.1", 0.6), ("0.5", 0.9), ("+Inf", 1.0)]:
+            st.add({"__name__": "req_duration_bucket", "le": le, "job": "api"},
+                   t, np.full(40, 100.0 * frac))
+        eng = Engine(st)
+        blk = run(eng, "histogram_quantile(0.5, req_duration_bucket)")
+        assert blk.n_series == 1
+        # rank 50 falls in the first bucket: 0 + 0.1 * (50/60)
+        np.testing.assert_allclose(blk.values[0], 0.1 * 50 / 60, rtol=1e-9)
+        blk = run(eng, "histogram_quantile(0.99, req_duration_bucket)")
+        # above 90% -> +Inf bucket -> returns lower bound 0.5
+        np.testing.assert_allclose(blk.values[0], 0.5)
