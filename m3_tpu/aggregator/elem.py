@@ -184,14 +184,17 @@ class Elem:
         flush_fn(self._output_id(at), dp.time_nanos, dp.value, self.key.storage_policy)
 
     def _output_id(self, at: magg.AggType) -> bytes:
-        """Aggregated output ID: base id + '.' + type suffix, suppressed when
-        the type is the metric type's single default (types_options.go
-        default type strings; counters default to bare 'id' for Sum,
-        gauges for Last)."""
+        """Aggregated output ID: metric name + '.' + type suffix, suppressed
+        when the type is the metric type's single default (types_options.go
+        default type strings; counters default to bare 'id' for Sum, gauges
+        for Last). The suffix lands on the NAME component of a canonical
+        'name;tag=v' ID (metrics/id.py) so tag values stay intact."""
         defaults = magg.default_types_for(self.metric_type)
         if len(defaults) == 1 and self.agg_types == tuple(defaults):
             return self.key.metric_id
-        return self.key.metric_id + b"." + at.type_string.encode()
+        name, sep, rest = self.key.metric_id.partition(b";")
+        suffixed = name + b"." + at.type_string.encode()
+        return suffixed + sep + rest if rest else suffixed
 
 
 def _stat_value(at: magg.AggType, stats: Dict[str, float]) -> float:

@@ -1,0 +1,86 @@
+"""Embedded downsampler: the aggregator running inside the coordinator
+(reference: src/cmd/services/m3coordinator/downsample/{downsampler,
+metrics_appender,flush_handler,leader_local}.go).
+
+Every incoming write is matched against the KV rule sets; matched samples
+feed a local leaderless aggregator whose flush handler writes the
+aggregated output back into storage under its aggregated namespace."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Sequence
+
+from ..aggregator import Aggregator, CallbackHandler
+from ..metrics import id as metric_id
+from ..metrics.matcher import Matcher
+from ..metrics.metric import MetricType, MetricUnion
+from ..metrics.policy import DropPolicy
+
+
+class Downsampler:
+    def __init__(self, matcher: Matcher,
+                 write_aggregated: Callable,
+                 clock: Optional[Callable[[], int]] = None,
+                 num_shards: int = 16):
+        """write_aggregated(id_bytes, tags_dict, time_nanos, value,
+        storage_policy) persists one aggregated sample (flush_handler.go
+        downsamplerFlushHandlerWriter.Write)."""
+        self._matcher = matcher
+        self._write = write_aggregated
+        # Local leader: the embedded aggregator always flushes
+        # (downsample/leader_local.go — a single-instance election).
+        self._agg = Aggregator(
+            num_shards=num_shards, clock=clock,
+            flush_handler=CallbackHandler(self._on_flushed))
+        self.samples_matched = 0
+        self.samples_dropped = 0
+
+    def write(self, tags: Dict[bytes, bytes], t_nanos: int, value: float,
+              metric_type: MetricType = MetricType.GAUGE) -> bool:
+        """metrics_appender.go SamplesAppender: match + append."""
+        name = tags.get(b"__name__", b"")
+        mid = metric_id.encode(name, {k: v for k, v in tags.items()
+                                      if k != b"__name__"})
+        result = self._matcher.match(mid)
+        if result is None:
+            return False
+        wrote = False
+        metadatas = result.for_existing_id
+        if _must_drop(metadatas):
+            self.samples_dropped += 1
+            return True
+        if any(sm.metadata.pipelines for sm in metadatas):
+            mu = _to_union(metric_type, mid, value)
+            wrote = self._agg.add_untimed(mu, metadatas) or wrote
+        for idm in result.for_new_rollup_ids:
+            mu = _to_union(metric_type, idm.id, value)
+            wrote = self._agg.add_untimed(mu, idm.metadatas) or wrote
+        if wrote:
+            self.samples_matched += 1
+        return wrote
+
+    def flush(self, now_nanos: Optional[int] = None) -> int:
+        return self._agg.flush(now_nanos)
+
+    def _on_flushed(self, metric):
+        name, tags = metric_id.decode(metric.id)
+        if name:
+            tags = {b"__name__": name, **tags}
+        self._write(metric.id, tags, metric.time_nanos, metric.value,
+                    metric.storage_policy)
+
+
+def _to_union(metric_type: MetricType, mid: bytes, value: float) -> MetricUnion:
+    if metric_type == MetricType.COUNTER:
+        return MetricUnion.counter(mid, int(value))
+    if metric_type == MetricType.TIMER:
+        return MetricUnion.batch_timer(mid, [value])
+    return MetricUnion.gauge(mid, value)
+
+
+def _must_drop(metadatas) -> bool:
+    for sm in metadatas:
+        pipes = sm.metadata.pipelines
+        if pipes and all(p.drop_policy == DropPolicy.DROP_MUST for p in pipes):
+            return True
+    return False

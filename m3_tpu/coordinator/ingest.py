@@ -1,0 +1,72 @@
+"""Coordinator ingest: dual-path downsample-and/or-write (reference:
+src/cmd/services/m3coordinator/ingest/write.go:78-337
+DownsamplerAndWriter — every incoming sample goes to the downsampler
+(rule-matched aggregation) and/or directly to unaggregated storage) and
+the m3msg ingester (ingest/m3msg/ingest.go) consuming aggregated metrics
+published by a standalone aggregator tier."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Sequence
+
+from ..aggregator.handler import decode_aggregated
+from ..metrics.metric import MetricType
+from .downsample import Downsampler
+
+
+class DownsamplerAndWriter:
+    def __init__(self, storage, downsampler: Optional[Downsampler] = None):
+        """storage: query-storage-like .write(series_id, tags, t, v)."""
+        self._storage = storage
+        self._downsampler = downsampler
+        self.written = 0
+        self.downsampled = 0
+
+    def write(self, tags: Dict[bytes, bytes], t_nanos: int, value: float,
+              metric_type: MetricType = MetricType.GAUGE,
+              downsample: bool = True, write_unaggregated: bool = True):
+        """write.go WriteBatch dual path."""
+        if downsample and self._downsampler is not None:
+            if self._downsampler.write(tags, t_nanos, value, metric_type):
+                self.downsampled += 1
+        if write_unaggregated:
+            sid = _series_id(tags)
+            self._storage.write(sid, tags, t_nanos, value)
+            self.written += 1
+
+    def write_batch(self, samples: Sequence[tuple], **kw):
+        for tags, t_nanos, value in samples:
+            self.write(tags, t_nanos, value, **kw)
+
+
+class M3MsgIngester:
+    """Handler for the m3msg consumer: decodes aggregated metrics published
+    by the aggregator tier's ProducerHandler and writes them to storage,
+    choosing the namespace for the sample's storage policy
+    (ingest/m3msg/ingest.go -> storage write)."""
+
+    def __init__(self, storage_for_policy: Callable):
+        """storage_for_policy(storage_policy) -> storage with .write(...)."""
+        self._storage_for = storage_for_policy
+        self.ingested = 0
+
+    def __call__(self, shard: int, payload: bytes):
+        from ..metrics import id as metric_id
+
+        m = decode_aggregated(payload)
+        storage = self._storage_for(m.storage_policy)
+        if storage is None:
+            return
+        name, tags = metric_id.decode(m.id)
+        if name:
+            tags = {b"__name__": name, **tags}
+        storage.write(m.id, tags, m.time_nanos, m.value)
+        self.ingested += 1
+
+
+def _series_id(tags: Dict[bytes, bytes]) -> bytes:
+    from ..metrics import id as metric_id
+
+    name = tags.get(b"__name__", b"")
+    return metric_id.encode(name, {k: v for k, v in tags.items()
+                                   if k != b"__name__"})

@@ -1,0 +1,96 @@
+"""Coordinator server assembly (reference: src/query/server/server.go:115
+Run — wires storage backend, downsampler, engine, and the HTTP handler).
+
+run_embedded() builds the whole read+write coordinator over an in-process
+database (the m3dbnode embedded-coordinator mode, cmd/services/m3dbnode/
+main.go:69); run_clustered() goes through the replicating client session."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+from ..cluster import kv as cluster_kv
+from ..metrics.matcher import Matcher, RuleSetStore
+from ..metrics.policy import StoragePolicy
+from ..query import Engine, LocalStorage, SessionStorage
+from .admin import AdminAPI
+from .downsample import Downsampler
+from .http_api import HTTPApi
+from .ingest import DownsamplerAndWriter
+
+
+@dataclasses.dataclass
+class Coordinator:
+    engine: Engine
+    writer: DownsamplerAndWriter
+    api: HTTPApi
+    downsampler: Optional[Downsampler]
+    admin: AdminAPI
+
+    @property
+    def endpoint(self) -> str:
+        return self.api.endpoint
+
+    def flush_downsampler(self, now_nanos: Optional[int] = None) -> int:
+        return self.downsampler.flush(now_nanos) if self.downsampler else 0
+
+    def close(self):
+        self.api.close()
+
+
+def _build(storage, aggregated_storages: Dict[StoragePolicy, object],
+           kv_store: Optional[cluster_kv.MemStore],
+           rules_namespace: bytes, clock, create_namespace) -> Coordinator:
+    downsampler = None
+    if kv_store is not None:
+        matcher = Matcher(RuleSetStore(kv_store), rules_namespace, clock=clock)
+
+        def write_aggregated(mid, tags, t_ns, value, policy):
+            target = aggregated_storages.get(policy, storage)
+            target.write(mid, tags, t_ns, value)
+
+        downsampler = Downsampler(matcher, write_aggregated, clock=clock)
+    writer = DownsamplerAndWriter(storage, downsampler)
+    engine = Engine(storage)
+    admin = AdminAPI(kv_store if kv_store is not None else cluster_kv.MemStore(),
+                     create_namespace=create_namespace)
+    api = HTTPApi(engine, writer, admin=admin).serve()
+    return Coordinator(engine, writer, api, downsampler, admin)
+
+
+def run_embedded(db, namespace: bytes = b"default",
+                 kv_store: Optional[cluster_kv.MemStore] = None,
+                 rules_namespace: bytes = b"default",
+                 aggregated_namespaces: Optional[Dict[StoragePolicy, bytes]] = None,
+                 clock=None) -> Coordinator:
+    storage = LocalStorage(db, namespace)
+    agg = {
+        policy: LocalStorage(db, ns)
+        for policy, ns in (aggregated_namespaces or {}).items()
+    }
+
+    def create_namespace(name: bytes, retention_ns: int):
+        from ..index.namespace_index import NamespaceIndex
+        from ..storage.namespace import NamespaceOptions
+
+        if name not in db.namespaces:
+            db.create_namespace(
+                name, NamespaceOptions(retention_ns=retention_ns),
+                index=NamespaceIndex(clock=db.clock))
+
+    return _build(storage, agg, kv_store, rules_namespace, clock,
+                  create_namespace)
+
+
+def run_clustered(session, namespace: bytes = b"default",
+                  kv_store: Optional[cluster_kv.MemStore] = None,
+                  rules_namespace: bytes = b"default",
+                  aggregated_namespaces: Optional[Dict[StoragePolicy, bytes]] = None,
+                  clock=None) -> Coordinator:
+    storage = SessionStorage(session, namespace)
+    agg = {
+        policy: SessionStorage(session, ns)
+        for policy, ns in (aggregated_namespaces or {}).items()
+    }
+    return _build(storage, agg, kv_store, rules_namespace, clock, None)

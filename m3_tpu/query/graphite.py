@@ -1,0 +1,470 @@
+"""Graphite query language: expression parser + function evaluator over
+blocks (reference: src/query/graphite — lexer/compiler in graphite/native,
+~100 builtin functions in native/builtin_functions.go, storage adapter in
+graphite/storage).
+
+Path globs compile to per-component matchers on the __gN__ tags written by
+carbon ingestion (m3_tpu.metrics.carbon.path_to_tags). Series math runs on
+the same dense [series x steps] blocks as PromQL; functions are a curated
+core of the reference's builtins, organized for easy widening."""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..metrics.carbon import GRAPHITE_TAG_FMT, tags_to_path
+from ..ops import temporal
+from .block import Block, BlockMeta
+from .executor import QueryParams
+from .model import Matcher, MatchType, Tags
+
+S = 1_000_000_000
+
+
+# ---------------------------------------------------------------- parsing
+
+_TOKEN = re.compile(r"""
+    (?P<WS>\s+)
+  | (?P<NUMBER>-?[0-9]*\.?[0-9]+(?:[eE][+-]?[0-9]+)?)
+  | (?P<STRING>"[^"]*"|'[^']*')
+  | (?P<NAME>[a-zA-Z_][a-zA-Z0-9_]*(?=\s*\())
+  | (?P<PATH>(?:[a-zA-Z0-9_*?.:\[\]\-$%+]|\{[^}]*\})+)
+  | (?P<LPAREN>\()|(?P<RPAREN>\))|(?P<COMMA>,)
+""", re.VERBOSE)
+
+
+class GraphiteParseError(ValueError):
+    pass
+
+
+def _lex(s: str):
+    out, i = [], 0
+    while i < len(s):
+        m = _TOKEN.match(s, i)
+        if not m:
+            raise GraphiteParseError(f"bad character {s[i]!r} at {i}")
+        if m.lastgroup != "WS":
+            out.append((m.lastgroup, m.group()))
+        i = m.end()
+    out.append(("EOF", ""))
+    return out
+
+
+class _Expr:
+    pass
+
+
+class PathExpr(_Expr):
+    def __init__(self, path: str):
+        self.path = path
+
+
+class CallExpr(_Expr):
+    def __init__(self, func: str, args: List):
+        self.func = func
+        self.args = args
+
+
+class Literal(_Expr):
+    def __init__(self, value):
+        self.value = value
+
+
+def parse_target(s: str) -> _Expr:
+    """graphite/native/compiler.go: one render target expression."""
+    toks = _lex(s)
+    pos = [0]
+
+    def peek():
+        return toks[pos[0]]
+
+    def nxt():
+        t = toks[pos[0]]
+        pos[0] += 1
+        return t
+
+    def expr():
+        kind, text = peek()
+        if kind == "NAME":
+            nxt()
+            if nxt()[0] != "LPAREN":
+                raise GraphiteParseError("expected (")
+            args = []
+            while peek()[0] != "RPAREN":
+                args.append(expr())
+                if peek()[0] == "COMMA":
+                    nxt()
+            nxt()
+            return CallExpr(text, args)
+        if kind == "NUMBER":
+            nxt()
+            return Literal(float(text))
+        if kind == "STRING":
+            nxt()
+            return Literal(text[1:-1])
+        if kind == "PATH":
+            nxt()
+            return PathExpr(text)
+        raise GraphiteParseError(f"unexpected {text!r}")
+
+    node = expr()
+    if peek()[0] != "EOF":
+        raise GraphiteParseError(f"trailing input {peek()[1]!r}")
+    return node
+
+
+def path_to_matchers(path: str) -> Tuple[Matcher, ...]:
+    """Glob path -> per-component __gN__ matchers (graphite/storage/
+    converter.go equivalent): literal components match exactly, glob
+    components compile to regexes."""
+    out = []
+    parts = path.split(".")
+    for i, part in enumerate(parts):
+        name = GRAPHITE_TAG_FMT % i
+        if any(c in part for c in "*?{["):
+            out.append(Matcher(MatchType.REGEXP, name, _glob_regex(part).encode()))
+        else:
+            out.append(Matcher(MatchType.EQUAL, name, part.encode()))
+    # Exact depth: the next component must not exist.
+    out.append(Matcher(MatchType.NOT_REGEXP, GRAPHITE_TAG_FMT % len(parts),
+                       b".+"))
+    return tuple(out)
+
+
+def _glob_regex(part: str) -> str:
+    out = []
+    i = 0
+    while i < len(part):
+        c = part[i]
+        if c == "*":
+            out.append("[^.]*")
+        elif c == "?":
+            out.append("[^.]")
+        elif c == "{":
+            j = part.find("}", i)
+            if j < 0:
+                raise GraphiteParseError(f"unterminated {{ in {part!r}")
+            alts = part[i + 1:j].split(",")
+            out.append("(?:" + "|".join(re.escape(a) for a in alts) + ")")
+            i = j
+        elif c == "[":
+            j = part.find("]", i)
+            out.append(part[i:j + 1])
+            i = j
+        else:
+            out.append(re.escape(c))
+        i += 1
+    return "".join(out)
+
+
+# ---------------------------------------------------------------- engine
+
+class GraphiteEngine:
+    """Evaluate render targets (graphite/native/engine.go)."""
+
+    def __init__(self, storage, step_ns: int = 10 * S):
+        self.storage = storage
+        self.step_ns = step_ns
+
+    def render(self, target: str, start_ns: int, end_ns: int,
+               step_ns: Optional[int] = None) -> Block:
+        params = QueryParams(start_ns, end_ns, step_ns or self.step_ns)
+        return self._eval(parse_target(target), params)
+
+    # -- evaluation -------------------------------------------------------
+
+    def _eval(self, node: _Expr, params: QueryParams) -> Block:
+        if isinstance(node, PathExpr):
+            return self._fetch(node.path, params)
+        if isinstance(node, CallExpr):
+            fn = _FUNCTIONS.get(node.func)
+            if fn is None:
+                raise GraphiteParseError(f"unknown function {node.func!r}")
+            return fn(self, node.args, params)
+        raise GraphiteParseError("bare literal is not a series")
+
+    def _eval_arg(self, node, params):
+        if isinstance(node, Literal):
+            return node.value
+        return self._eval(node, params)
+
+    def _fetch(self, path: str, params: QueryParams) -> Block:
+        from .block import consolidate
+
+        series = self.storage.fetch_raw(
+            path_to_matchers(path), params.start_ns - params.step_ns,
+            params.end_ns + 1)
+        meta = params.meta()
+        tags_list, rows = [], []
+        for sid, entry in sorted(series.items()):
+            tags_list.append(Tags.of(dict(entry["tags"])))
+            rows.append(consolidate(
+                np.asarray(entry["t"], np.int64), np.asarray(entry["v"]),
+                meta, params.step_ns))
+        vals = np.stack(rows) if rows else np.zeros((0, meta.steps))
+        return Block(meta, tags_list, vals)
+
+
+def series_name(tags: Tags) -> bytes:
+    """Render name for output: the dotted path (or the alias tag)."""
+    alias = tags.get(b"__alias__")
+    if alias is not None:
+        return alias
+    return tags_to_path(tags.as_dict())
+
+
+# ---------------------------------------------------------------- functions
+
+_FUNCTIONS: Dict[str, Callable] = {}
+
+
+def _register(*names):
+    def deco(fn):
+        for n in names:
+            _FUNCTIONS[n] = fn
+        return fn
+
+    return deco
+
+
+def _combine(eng, args, params, reducer, name):
+    blocks = [eng._eval(a, params) for a in args]
+    vals = np.concatenate([b.values for b in blocks]) if blocks else \
+        np.zeros((0, params.steps))
+    meta = blocks[0].meta if blocks else params.meta()
+    with np.errstate(invalid="ignore"):
+        row = reducer(vals)
+    tags = Tags.of({b"__alias__": name.encode()})
+    return Block(meta, [tags], row[None, :])
+
+
+@_register("sumSeries", "sum")
+def _sum_series(eng, args, params):
+    return _combine(eng, args, params, lambda v: np.nansum(v, axis=0),
+                    "sumSeries")
+
+
+@_register("averageSeries", "avg")
+def _avg_series(eng, args, params):
+    return _combine(eng, args, params, lambda v: np.nanmean(v, axis=0),
+                    "averageSeries")
+
+
+@_register("maxSeries")
+def _max_series(eng, args, params):
+    return _combine(eng, args, params, lambda v: np.nanmax(v, axis=0), "maxSeries")
+
+
+@_register("minSeries")
+def _min_series(eng, args, params):
+    return _combine(eng, args, params, lambda v: np.nanmin(v, axis=0), "minSeries")
+
+
+@_register("scale")
+def _scale(eng, args, params):
+    block = eng._eval(args[0], params)
+    factor = args[1].value
+    return block.with_values(block.values * factor)
+
+
+@_register("offset")
+def _offset(eng, args, params):
+    block = eng._eval(args[0], params)
+    return block.with_values(block.values + args[1].value)
+
+
+@_register("absolute")
+def _absolute(eng, args, params):
+    block = eng._eval(args[0], params)
+    return block.with_values(np.abs(block.values))
+
+
+@_register("alias")
+def _alias(eng, args, params):
+    block = eng._eval(args[0], params)
+    name = args[1].value.encode()
+    return block.with_values(
+        block.values, [t.with_tag(b"__alias__", name) for t in block.series_tags])
+
+
+@_register("aliasByNode")
+def _alias_by_node(eng, args, params):
+    block = eng._eval(args[0], params)
+    nodes = [int(a.value) for a in args[1:]]
+    tags = []
+    for t in block.series_tags:
+        parts = tags_to_path(t.as_dict()).split(b".")
+        picked = b".".join(parts[n] for n in nodes if -len(parts) <= n < len(parts))
+        tags.append(t.with_tag(b"__alias__", picked))
+    return block.with_values(block.values, tags)
+
+
+@_register("derivative")
+def _derivative(eng, args, params):
+    block = eng._eval(args[0], params)
+    v = block.values
+    out = np.full_like(v, np.nan)
+    out[:, 1:] = v[:, 1:] - v[:, :-1]
+    return block.with_values(out)
+
+
+@_register("perSecond")
+def _per_second(eng, args, params):
+    block = eng._eval(args[0], params)
+    v = block.values
+    d = np.full_like(v, np.nan)
+    d[:, 1:] = (v[:, 1:] - v[:, :-1]) / (params.step_ns / S)
+    d[d < 0] = np.nan  # counter wrap guard (builtin_functions.go perSecond)
+    return block.with_values(d)
+
+
+@_register("nonNegativeDerivative")
+def _non_negative_derivative(eng, args, params):
+    block = eng._eval(args[0], params)
+    v = block.values
+    d = np.full_like(v, np.nan)
+    d[:, 1:] = v[:, 1:] - v[:, :-1]
+    d[d < 0] = np.nan
+    return block.with_values(d)
+
+
+@_register("movingAverage")
+def _moving_average(eng, args, params):
+    w = args[1].value
+    if isinstance(w, str):
+        from .promql import parse_duration_ns
+
+        W = max(1, parse_duration_ns(w) // params.step_ns)
+    else:
+        W = max(1, int(w))
+    # Shift the fetch window back W-1 steps so the first output point has a
+    # full window of history (graphite-web movingAverage semantics), then
+    # reduce every window via the batched temporal kernel (device path).
+    ext = QueryParams(params.start_ns - (W - 1) * params.step_ns,
+                      params.end_ns, params.step_ns)
+    block = eng._eval(args[0], ext)
+    out = temporal.over_time(block.values, W, "avg")
+    return Block(params.meta(), block.series_tags, out)
+
+
+@_register("keepLastValue")
+def _keep_last_value(eng, args, params):
+    block = eng._eval(args[0], params)
+    v = block.values.copy()
+    for row in v:
+        finite = np.isfinite(row)
+        if not finite.any():
+            continue
+        idx = np.where(finite, np.arange(row.size), -1)
+        run = np.maximum.accumulate(idx)
+        valid = run >= 0
+        row[valid] = row[run[valid]]
+    return block.with_values(v)
+
+
+@_register("sortByName")
+def _sort_by_name(eng, args, params):
+    block = eng._eval(args[0], params)
+    order = np.argsort([series_name(t) for t in block.series_tags], kind="stable")
+    return block.with_values(block.values[order],
+                             [block.series_tags[i] for i in order])
+
+
+@_register("limit")
+def _limit(eng, args, params):
+    block = eng._eval(args[0], params)
+    n = int(args[1].value)
+    return block.with_values(block.values[:n], block.series_tags[:n])
+
+
+@_register("exclude")
+def _exclude(eng, args, params):
+    block = eng._eval(args[0], params)
+    pat = re.compile(args[1].value.encode())
+    keep = [i for i, t in enumerate(block.series_tags)
+            if not pat.search(series_name(t))]
+    return block.with_values(block.values[keep],
+                             [block.series_tags[i] for i in keep])
+
+
+@_register("grep")
+def _grep(eng, args, params):
+    block = eng._eval(args[0], params)
+    pat = re.compile(args[1].value.encode())
+    keep = [i for i, t in enumerate(block.series_tags)
+            if pat.search(series_name(t))]
+    return block.with_values(block.values[keep],
+                             [block.series_tags[i] for i in keep])
+
+
+@_register("highestCurrent")
+def _highest_current(eng, args, params):
+    block = eng._eval(args[0], params)
+    n = int(args[1].value) if len(args) > 1 else 1
+    last = np.where(np.isfinite(block.values), block.values, -np.inf)
+    cur = np.full(block.n_series, -np.inf)
+    for i in range(block.n_series):
+        finite = np.flatnonzero(np.isfinite(block.values[i]))
+        if finite.size:
+            cur[i] = block.values[i][finite[-1]]
+    order = np.argsort(-cur, kind="stable")[:n]
+    return block.with_values(block.values[order],
+                             [block.series_tags[i] for i in order])
+
+
+@_register("averageAbove")
+def _average_above(eng, args, params):
+    block = eng._eval(args[0], params)
+    thresh = args[1].value
+    with np.errstate(invalid="ignore"):
+        mean = np.nanmean(np.where(np.isfinite(block.values), block.values,
+                                   np.nan), axis=1)
+    keep = np.flatnonzero(mean > thresh)
+    return block.with_values(block.values[keep],
+                             [block.series_tags[i] for i in keep])
+
+
+@_register("groupByNode")
+def _group_by_node(eng, args, params):
+    block = eng._eval(args[0], params)
+    node = int(args[1].value)
+    agg = args[2].value if len(args) > 2 else "sum"
+    reducers = {"sum": np.nansum, "avg": np.nanmean, "average": np.nanmean,
+                "max": np.nanmax, "min": np.nanmin}
+    reducer = reducers[agg]
+    groups: Dict[bytes, List[int]] = {}
+    for i, t in enumerate(block.series_tags):
+        parts = tags_to_path(t.as_dict()).split(b".")
+        key = parts[node] if -len(parts) <= node < len(parts) else b""
+        groups.setdefault(key, []).append(i)
+    tags_out, rows = [], []
+    for key, idxs in sorted(groups.items()):
+        with np.errstate(invalid="ignore"):
+            rows.append(reducer(block.values[idxs], axis=0))
+        tags_out.append(Tags.of({b"__alias__": key}))
+    vals = np.stack(rows) if rows else np.zeros((0, block.meta.steps))
+    return Block(block.meta, tags_out, vals)
+
+
+@_register("summarize")
+def _summarize(eng, args, params):
+    from .promql import parse_duration_ns
+
+    block = eng._eval(args[0], params)
+    bucket_ns = parse_duration_ns(args[1].value)
+    agg = args[2].value if len(args) > 2 else "sum"
+    factor = max(1, bucket_ns // params.step_ns)
+    steps = block.meta.steps // factor
+    if steps == 0:
+        return block
+    v = block.values[:, : steps * factor].reshape(block.n_series, steps, factor)
+    reducers = {"sum": np.nansum, "avg": np.nanmean, "max": np.nanmax,
+                "min": np.nanmin, "last": lambda a, axis: a[..., -1]}
+    with np.errstate(invalid="ignore"):
+        out = reducers[agg](v, axis=2)
+    meta = BlockMeta(block.meta.start_ns, bucket_ns, steps)
+    return Block(meta, block.series_tags, out)

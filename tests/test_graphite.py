@@ -1,0 +1,164 @@
+"""Carbon ingestion + graphite query language tests (reference:
+src/metrics/carbon/parser.go, src/query/graphite/native builtins, the
+carbon docker integration test flow: line in -> render out)."""
+
+import json
+import socket
+import time
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from m3_tpu.cluster import kv as cluster_kv
+from m3_tpu.coordinator import run_embedded
+from m3_tpu.coordinator.carbon_ingest import CarbonServer
+from m3_tpu.index.namespace_index import NamespaceIndex
+from m3_tpu.metrics import carbon
+from m3_tpu.parallel.sharding import ShardSet
+from m3_tpu.query.graphite import (
+    GraphiteEngine,
+    parse_target,
+    path_to_matchers,
+    series_name,
+)
+from m3_tpu.storage.database import Database
+from m3_tpu.storage.namespace import NamespaceOptions
+
+S = 1_000_000_000
+T0 = 1_600_000_000 * S
+
+
+class TestCarbonParser:
+    def test_parse_valid(self):
+        assert carbon.parse_line(b"servers.web01.cpu 42.5 1600000000") == (
+            b"servers.web01.cpu", 42.5, 1600000000)
+
+    def test_parse_rejects_malformed(self):
+        for bad in [b"", b"onlypath", b"a.b 1.0", b"a.b x 123",
+                    b".lead 1 2", b"trail. 1 2", b"a.b nan 123"]:
+            assert carbon.parse_line(bad) is None
+
+    def test_path_tags_roundtrip(self):
+        tags = carbon.path_to_tags(b"a.b.c")
+        assert tags == {b"__g0__": b"a", b"__g1__": b"b", b"__g2__": b"c"}
+        assert carbon.tags_to_path(tags) == b"a.b.c"
+
+
+class TestPathMatchers:
+    def test_literal_and_glob(self):
+        ms = path_to_matchers("servers.*.cpu")
+        assert ms[0].value == b"servers"
+        assert ms[1].type.name == "REGEXP"
+        # depth guard: no __g3__ allowed
+        assert ms[-1].name == b"__g3__"
+
+    def test_alternation(self):
+        ms = path_to_matchers("servers.{web01,web02}.cpu")
+        assert ms[1].matches(b"web01") and ms[1].matches(b"web02")
+        assert not ms[1].matches(b"web03")
+
+
+class TestTargetParser:
+    def test_nested_calls(self):
+        ast = parse_target('scale(sumSeries(servers.*.cpu), 0.5)')
+        assert ast.func == "scale"
+        assert ast.args[0].func == "sumSeries"
+        assert ast.args[1].value == 0.5
+
+
+@pytest.fixture
+def genv():
+    now = {"t": T0}
+    db = Database(ShardSet(8), clock=lambda: now["t"])
+    db.create_namespace(b"default", NamespaceOptions(),
+                        index=NamespaceIndex(clock=lambda: now["t"]))
+    c = run_embedded(db, clock=lambda: now["t"])
+    yield c, db, now
+    c.close()
+
+
+def ingest_paths(c, now, paths_values):
+    for i in range(12):
+        now["t"] = T0 + i * 10 * S
+        for path, base in paths_values:
+            tags = carbon.path_to_tags(path)
+            c.writer.write(tags, T0 + i * 10 * S, base + i)
+
+
+class TestGraphiteEngine:
+    def test_glob_fetch_and_sum(self, genv):
+        c, db, now = genv
+        ingest_paths(c, now, [(b"servers.web01.cpu", 10.0),
+                              (b"servers.web02.cpu", 20.0),
+                              (b"servers.web01.mem", 99.0)])
+        eng = GraphiteEngine(c.engine.storage)
+        blk = eng.render("servers.*.cpu", T0 + 30 * S, T0 + 110 * S, 10 * S)
+        assert blk.n_series == 2
+        blk = eng.render("sumSeries(servers.*.cpu)", T0 + 30 * S, T0 + 110 * S,
+                         10 * S)
+        assert blk.n_series == 1
+        np.testing.assert_allclose(blk.values[0][0], 10 + 20 + 2 * 3)
+
+    def test_alias_by_node_and_scale(self, genv):
+        c, db, now = genv
+        ingest_paths(c, now, [(b"servers.web01.cpu", 10.0)])
+        eng = GraphiteEngine(c.engine.storage)
+        blk = eng.render("aliasByNode(scale(servers.web01.cpu, 2), 1)",
+                         T0 + 30 * S, T0 + 60 * S, 10 * S)
+        assert series_name(blk.series_tags[0]) == b"web01"
+        np.testing.assert_allclose(blk.values[0][0], 2 * 13.0)
+
+    def test_group_by_node(self, genv):
+        c, db, now = genv
+        ingest_paths(c, now, [(b"dc1.web01.cpu", 1.0), (b"dc1.web02.cpu", 2.0),
+                              (b"dc2.web03.cpu", 5.0)])
+        eng = GraphiteEngine(c.engine.storage)
+        blk = eng.render('groupByNode(*.*.cpu, 0, "sum")',
+                         T0 + 30 * S, T0 + 30 * S, 10 * S)
+        got = {series_name(t): v[0] for t, v in zip(blk.series_tags, blk.values)}
+        assert got[b"dc1"] == (1 + 3) + (2 + 3)
+        assert got[b"dc2"] == 5 + 3
+
+    def test_per_second_and_moving_average(self, genv):
+        c, db, now = genv
+        ingest_paths(c, now, [(b"counters.reqs", 0.0)])
+        eng = GraphiteEngine(c.engine.storage)
+        blk = eng.render("perSecond(counters.reqs)", T0 + 30 * S, T0 + 80 * S,
+                         10 * S)
+        np.testing.assert_allclose(blk.values[0][1:], 0.1)  # +1 per 10s
+        blk = eng.render("movingAverage(counters.reqs, 3)", T0 + 30 * S,
+                         T0 + 80 * S, 10 * S)
+        np.testing.assert_allclose(blk.values[0][0], (1 + 2 + 3) / 3)
+
+
+class TestCarbonServerEndToEnd:
+    def test_tcp_lines_to_graphite_render(self, genv):
+        c, db, now = genv
+        srv = CarbonServer(c.writer).start()
+        try:
+            host, _, port = srv.endpoint.rpartition(":")
+            lines = []
+            for i in range(6):
+                lines.append(b"foo.bar.baz %f %d" % (float(i), (T0 + i * 10 * S) // S))
+            now["t"] = T0 + 60 * S
+            with socket.create_connection((host, int(port))) as sock:
+                sock.sendall(b"\n".join(lines) + b"\nbad line\n")
+            deadline = time.time() + 5
+            while srv.lines_ingested < 6 and time.time() < deadline:
+                time.sleep(0.02)
+            assert srv.lines_ingested == 6
+            assert srv.lines_malformed == 1
+            # Render through the HTTP API.
+            q = urllib.parse.urlencode(
+                {"target": "foo.bar.baz", "from": T0 / S, "until": T0 / S + 50,
+                 "step": "10"})
+            with urllib.request.urlopen(
+                    f"{c.endpoint}/api/v1/graphite/render?{q}") as resp:
+                out = json.loads(resp.read())
+            assert out[0]["target"] == "foo.bar.baz"
+            vals = [v for v, _ in out[0]["datapoints"] if v is not None]
+            assert vals == [0.0, 1.0, 2.0, 3.0, 4.0, 5.0]
+        finally:
+            srv.close()
