@@ -1,0 +1,202 @@
+"""Aggregator network ingestion server (reference:
+src/aggregator/server/rawtcp/server.go:122 — raw TCP connections carrying
+unaggregated metrics with their staged metadatas; the msgpack/protobuf
+migration iterator is replaced by the framed binary codec shared with the
+rest of the data plane, m3_tpu.rpc.wire).
+
+Wire frames:
+  {"t": "untimed", "mtype": i64, "id": bytes, "value": f64|i64|list,
+   "metadatas": [...]}
+  {"t": "timed", "mtype": i64, "id": bytes, "time": i64, "value": f64,
+   "policy": str, "agg_id": i64}
+A batch frame {"t": "batch", "entries": [...]} carries many at once.
+"""
+
+from __future__ import annotations
+
+import socketserver
+import threading
+from typing import List, Optional, Sequence
+
+from ..metrics.metadata import Metadata, PipelineMetadata, StagedMetadata
+from ..metrics.matcher import pipeline_from_json, pipeline_to_json
+from ..metrics.metric import MetricType, MetricUnion
+from ..metrics.policy import StoragePolicy
+from ..rpc import wire
+from .aggregator import Aggregator
+
+
+def metadatas_to_wire(metadatas: Sequence[StagedMetadata]) -> list:
+    return [
+        {
+            "cutover": sm.cutover_nanos,
+            "tombstoned": sm.tombstoned,
+            "pipelines": [
+                {
+                    "agg_id": pm.aggregation_id,
+                    "policies": [str(p) for p in pm.storage_policies],
+                    "pipeline": pipeline_to_json(pm.pipeline),
+                    "drop": pm.drop_policy,
+                }
+                for pm in sm.metadata.pipelines
+            ],
+        }
+        for sm in metadatas
+    ]
+
+
+def metadatas_from_wire(obj: list) -> tuple:
+    return tuple(
+        StagedMetadata(
+            d["cutover"], d["tombstoned"],
+            Metadata(tuple(
+                PipelineMetadata(
+                    p["agg_id"],
+                    tuple(StoragePolicy.parse(s) for s in p["policies"]),
+                    pipeline_from_json(p["pipeline"]),
+                    p["drop"],
+                )
+                for p in d["pipelines"]
+            )),
+        )
+        for d in obj
+    )
+
+
+def union_to_wire(mu: MetricUnion, metadatas: Sequence[StagedMetadata]) -> dict:
+    if mu.type == MetricType.TIMER:
+        value = list(mu.batch_timer_val)
+    elif mu.type == MetricType.COUNTER:
+        value = mu.counter_val
+    else:
+        value = mu.gauge_val
+    return {"t": "untimed", "mtype": int(mu.type), "id": mu.id,
+            "value": value, "metadatas": metadatas_to_wire(metadatas)}
+
+
+def union_from_wire(frame: dict):
+    mt = MetricType(frame["mtype"])
+    mid = frame["id"]
+    value = frame["value"]
+    if mt == MetricType.TIMER:
+        mu = MetricUnion.batch_timer(mid, [float(v) for v in value])
+    elif mt == MetricType.COUNTER:
+        mu = MetricUnion.counter(mid, int(value))
+    else:
+        mu = MetricUnion.gauge(mid, float(value))
+    return mu, metadatas_from_wire(frame["metadatas"])
+
+
+class RawTCPServer:
+    """Accepts connections from aggregator clients; every frame feeds the
+    local Aggregator (rawtcp/server.go handleConnection)."""
+
+    def __init__(self, aggregator: Aggregator, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.aggregator = aggregator
+        self.frames = 0
+        self.errors = 0
+        outer = self
+
+        class _Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        frame = wire.read_frame(self.request)
+                        entries = (frame["entries"] if frame.get("t") == "batch"
+                                   else [frame])
+                        for e in entries:
+                            outer._handle(e)
+                        outer.frames += len(entries)
+                except (ConnectionError, OSError):
+                    pass
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = _Server((host, port), _Handler)
+
+    def _handle(self, e: dict):
+        try:
+            if e["t"] == "untimed":
+                mu, metadatas = union_from_wire(e)
+                self.aggregator.add_untimed(mu, metadatas)
+            elif e["t"] == "timed":
+                self.aggregator.add_timed(
+                    MetricType(e["mtype"]), e["id"], e["time"], e["value"],
+                    StoragePolicy.parse(e["policy"]), e.get("agg_id", 0))
+        except Exception:  # noqa: BLE001 - bad frame must not kill the conn
+            self.errors += 1
+
+    @property
+    def endpoint(self) -> str:
+        h, p = self._server.server_address
+        return f"{h}:{p}"
+
+    def start(self) -> "RawTCPServer":
+        threading.Thread(target=self._server.serve_forever, daemon=True).start()
+        return self
+
+    def close(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class TCPTransport:
+    """Client-side connection to one aggregator instance, usable as an
+    AggregatorClient transport (aggregator/client queue.go: buffered
+    connection with reconnect)."""
+
+    def __init__(self, endpoint: str, batch_size: int = 64):
+        self._endpoint = endpoint
+        self._sock = None
+        self._lock = threading.Lock()
+        self._batch: List[dict] = []
+        self._batch_size = batch_size
+
+    def __call__(self, mu: MetricUnion, metadatas: Sequence[StagedMetadata]) -> bool:
+        entry = union_to_wire(mu, metadatas)
+        with self._lock:
+            self._batch.append(entry)
+            if len(self._batch) < self._batch_size:
+                return True
+            batch, self._batch = self._batch, []
+        return self._send_batch(batch)
+
+    def flush(self) -> bool:
+        with self._lock:
+            batch, self._batch = self._batch, []
+        return self._send_batch(batch) if batch else True
+
+    def _send_batch(self, batch: List[dict]) -> bool:
+        frame = {"t": "batch", "entries": batch}
+        for _ in range(2):  # one reconnect attempt
+            try:
+                sock = self._ensure_conn()
+                wire.write_frame(sock, frame)
+                return True
+            except OSError:
+                self._drop_conn()
+        return False
+
+    def _ensure_conn(self):
+        if self._sock is None:
+            import socket as _socket
+
+            host, _, port = self._endpoint.rpartition(":")
+            self._sock = _socket.create_connection((host, int(port)), timeout=5.0)
+            self._sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+        return self._sock
+
+    def _drop_conn(self):
+        sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def close(self):
+        self.flush()
+        self._drop_conn()

@@ -1,0 +1,146 @@
+"""Environment manager: real-process cluster orchestration for dtests
+(reference: src/m3em — gRPC Operator agents doing build/config push with
+checksummed transfer, process lifecycle, heartbeating;
+m3em/cluster/cluster.go placement-aware setup/teardown).
+
+Agents here manage local subprocesses of the real service CLIs
+(`python -m m3_tpu.services ...`); the same Operator surface
+(setup/start/stop/teardown/heartbeat) applies to a remote-agent transport."""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+
+def checksum(path: str) -> str:
+    """m3em/checksum: verify pushed artifacts."""
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+@dataclasses.dataclass
+class ProcessSpec:
+    """m3em/build + os process abstraction: what to run and with what
+    config."""
+
+    service: str                 # dbnode | aggregator
+    config_yaml: str             # config file contents
+    workdir: str
+
+
+class Operator:
+    """One host's agent (m3em/agent agent.go): setup pushes config (with
+    checksum verification), start/stop manage the process, heartbeat
+    reports liveness."""
+
+    def __init__(self, workdir: str):
+        self.workdir = workdir
+        self._spec: Optional[ProcessSpec] = None
+        self._proc: Optional[subprocess.Popen] = None
+        self._config_path: Optional[str] = None
+        self.endpoint: Optional[str] = None
+
+    def setup(self, spec: ProcessSpec) -> str:
+        """Push config; returns its checksum (agent Setup RPC)."""
+        os.makedirs(spec.workdir, exist_ok=True)
+        self._spec = spec
+        self._config_path = os.path.join(spec.workdir, "config.yml")
+        with open(self._config_path, "w") as f:
+            f.write(spec.config_yaml)
+        return checksum(self._config_path)
+
+    def start(self, timeout_s: float = 30.0):
+        """Start the service and wait for its listen line (agent Start)."""
+        assert self._spec is not None, "setup first"
+        self._proc = subprocess.Popen(
+            [sys.executable, "-m", "m3_tpu.services", self._spec.service,
+             "-f", self._config_path],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            cwd=os.path.dirname(os.path.abspath(__file__)) + "/..",
+            text=True)
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            line = self._proc.stdout.readline()
+            if "listening on" in line:
+                self.endpoint = line.rsplit(" ", 1)[-1].strip()
+                return self.endpoint
+            if self._proc.poll() is not None:
+                raise RuntimeError(
+                    f"service exited rc={self._proc.returncode}: {line}")
+        raise TimeoutError("service did not report a listen address")
+
+    def heartbeat(self) -> bool:
+        """agent heartbeat.go: is the process alive."""
+        return self._proc is not None and self._proc.poll() is None
+
+    def stop(self, grace_s: float = 5.0):
+        if self._proc is None:
+            return
+        self._proc.send_signal(signal.SIGTERM)
+        try:
+            self._proc.wait(timeout=grace_s)
+        except subprocess.TimeoutExpired:
+            self._proc.kill()
+            self._proc.wait(timeout=grace_s)
+        self._proc = None
+
+    def kill(self):
+        """Hard-kill for fault injection (dtest kill scenarios)."""
+        if self._proc is not None:
+            self._proc.kill()
+            self._proc.wait(timeout=10)
+            self._proc = None
+
+    def teardown(self):
+        self.stop()
+        self._spec = None
+
+
+class EMCluster:
+    """m3em/cluster: placement-aware multi-node setup/teardown over
+    operators."""
+
+    def __init__(self, base_dir: str):
+        self.base_dir = base_dir
+        self.operators: Dict[str, Operator] = {}
+
+    def add_node(self, node_id: str, service: str = "dbnode",
+                 config_yaml: str = "") -> Operator:
+        workdir = os.path.join(self.base_dir, node_id)
+        op = Operator(workdir)
+        op.setup(ProcessSpec(service, config_yaml or _default_dbnode_yaml(workdir),
+                             workdir))
+        self.operators[node_id] = op
+        return op
+
+    def start_all(self) -> Dict[str, str]:
+        return {nid: op.start() for nid, op in self.operators.items()}
+
+    def alive(self) -> Dict[str, bool]:
+        return {nid: op.heartbeat() for nid, op in self.operators.items()}
+
+    def teardown(self):
+        for op in self.operators.values():
+            op.teardown()
+        self.operators.clear()
+
+
+def _default_dbnode_yaml(workdir: str) -> str:
+    return (
+        "listen_address: 127.0.0.1:0\n"
+        f"data_dir: {workdir}/data\n"
+        "num_shards: 8\n"
+        "namespaces:\n"
+        "  - name: default\n"
+        "    retention: 2h\n"
+    )

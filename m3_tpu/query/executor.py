@@ -60,15 +60,31 @@ class Engine:
     """executor/engine.go: compile -> plan -> execute. Storage is anything
     with fetch_raw(matchers, start_ns, end_ns) -> {id: {tags, t, v}}."""
 
-    def __init__(self, storage, lookback_ns: int = DEFAULT_LOOKBACK_NS):
+    def __init__(self, storage, lookback_ns: int = DEFAULT_LOOKBACK_NS,
+                 cost_enforcer=None, per_query_cost_limit=None):
         self.storage = storage
         self.lookback_ns = lookback_ns
+        # Per-process datapoint budget (x/cost/enforcer.go). Each query
+        # charges a scoped child enforcer whose total is released when the
+        # query finishes, so the global budget tracks only in-flight work.
+        self.cost_enforcer = cost_enforcer
+        self.per_query_cost_limit = per_query_cost_limit
+        self._active_enforcer = None
 
     def execute_range(self, query: str, start_ns: int, end_ns: int,
                       step_ns: int) -> Block:
         ast = promql.parse(query)
         params = QueryParams(start_ns, end_ns, step_ns)
-        val = self._eval(ast, params)
+        if self.cost_enforcer is not None:
+            child = self.cost_enforcer.child(self.per_query_cost_limit)
+            self._active_enforcer = child
+            try:
+                val = self._eval(ast, params)
+            finally:
+                self._active_enforcer = None
+                child.release(child.current())
+        else:
+            val = self._eval(ast, params)
         return _to_block(val, params)
 
     def execute_instant(self, query: str, t_ns: int) -> Block:
@@ -99,8 +115,12 @@ class Engine:
     # -- selectors ---------------------------------------------------------
 
     def _fetch(self, sel: VectorSelector, start_ns: int, end_ns: int):
-        return self.storage.fetch_raw(
+        series = self.storage.fetch_raw(
             promql.selector_matchers(sel), start_ns, end_ns)
+        if self._active_enforcer is not None:
+            points = sum(len(e["t"]) for e in series.values())
+            self._active_enforcer.add(points)
+        return series
 
     def _eval_instant_selector(self, sel: VectorSelector,
                                params: QueryParams) -> Block:

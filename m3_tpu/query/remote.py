@@ -1,0 +1,145 @@
+"""Coordinator-to-coordinator federation: remote query storage over the
+framed wire (reference: src/query/tsdb/remote/{client,server}.go + the
+rpcpb protobuf service — a coordinator exposes its storage so sibling
+coordinators can fan out fetches across clusters/regions).
+
+The reference speaks gRPC; this build rides the same framed binary codec
+as the node RPC (m3_tpu.rpc.wire) so fetched columns stay numpy end to
+end."""
+
+from __future__ import annotations
+
+import socketserver
+import threading
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from ..rpc import wire
+from .model import Matcher, MatchType
+
+
+def _matchers_to_wire(matchers: Sequence[Matcher]) -> list:
+    return [{"t": int(m.type), "n": m.name, "v": m.value} for m in matchers]
+
+
+def _matchers_from_wire(obj: list):
+    return tuple(Matcher(MatchType(d["t"]), d["n"], d["v"]) for d in obj)
+
+
+class RemoteStorageServer:
+    """Serves fetch_raw over TCP (tsdb/remote/server.go)."""
+
+    def __init__(self, storage, host: str = "127.0.0.1", port: int = 0):
+        self.storage = storage
+        outer = self
+
+        class _Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        req = wire.read_frame(self.request)
+                        try:
+                            resp = outer._dispatch(req)
+                        except Exception as e:  # noqa: BLE001
+                            resp = {"err": str(e)}
+                        wire.write_frame(self.request, resp)
+                except (ConnectionError, OSError):
+                    pass
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = _Server((host, port), _Handler)
+
+    def _dispatch(self, req: dict) -> dict:
+        if req["method"] == "fetch_raw":
+            series = self.storage.fetch_raw(
+                _matchers_from_wire(req["matchers"]), req["start"], req["end"])
+            return {"series": [
+                {"id": sid, "tags": entry["tags"],
+                 "times": np.asarray(entry["t"], np.int64),
+                 "values": np.asarray(entry["v"], np.float64)}
+                for sid, entry in series.items()
+            ]}
+        if req["method"] == "write":
+            self.storage.write(req["id"], req["tags"], req["time"], req["value"])
+            return {"ok": True}
+        raise ValueError(f"unknown method {req['method']!r}")
+
+    @property
+    def endpoint(self) -> str:
+        h, p = self._server.server_address
+        return f"{h}:{p}"
+
+    def start(self) -> "RemoteStorageServer":
+        threading.Thread(target=self._server.serve_forever, daemon=True).start()
+        return self
+
+    def close(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class RemoteStorage:
+    """Client side: a query-storage implementation backed by a remote
+    coordinator (tsdb/remote/client.go); drop it into FanoutStorage next
+    to local stores for cross-cluster reads."""
+
+    def __init__(self, endpoint: str, timeout_s: float = 10.0):
+        self._endpoint = endpoint
+        self._timeout_s = timeout_s
+        self._lock = threading.Lock()
+        self._sock = None
+
+    def _call(self, req: dict) -> dict:
+        with self._lock:
+            for _ in range(2):
+                try:
+                    sock = self._ensure_conn()
+                    wire.write_frame(sock, req)
+                    resp = wire.read_frame(sock)
+                    break
+                except OSError:
+                    self._drop_conn()
+            else:
+                raise ConnectionError(f"remote storage {self._endpoint} unreachable")
+        if "err" in resp:
+            raise RuntimeError(f"remote storage error: {resp['err']}")
+        return resp
+
+    def fetch_raw(self, matchers: Sequence[Matcher], start_ns: int,
+                  end_ns: int) -> Dict[bytes, dict]:
+        resp = self._call({"method": "fetch_raw",
+                           "matchers": _matchers_to_wire(matchers),
+                           "start": start_ns, "end": end_ns})
+        return {
+            e["id"]: {"tags": e["tags"], "t": e["times"], "v": e["values"]}
+            for e in resp["series"]
+        }
+
+    def write(self, series_id: bytes, tags, t_ns: int, value: float):
+        self._call({"method": "write", "id": series_id, "tags": dict(tags),
+                    "time": t_ns, "value": value})
+
+    def _ensure_conn(self):
+        if self._sock is None:
+            import socket as _socket
+
+            host, _, port = self._endpoint.rpartition(":")
+            self._sock = _socket.create_connection(
+                (host, int(port)), timeout=self._timeout_s)
+            self._sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+        return self._sock
+
+    def _drop_conn(self):
+        sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def close(self):
+        self._drop_conn()

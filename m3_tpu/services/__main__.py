@@ -1,0 +1,57 @@
+"""Service CLI: `python -m m3_tpu.services <service> -f config.yml`
+(reference: src/cmd/services/*/main/main.go — one '-f' flag per binary)."""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="m3_tpu.services")
+    parser.add_argument("service",
+                        choices=["dbnode", "coordinator", "aggregator",
+                                 "collector"])
+    parser.add_argument("-f", "--config", required=False, default=None,
+                        help="yaml config file (defaults apply if omitted)")
+    args = parser.parse_args(argv)
+
+    from . import config as cfgmod
+    from . import run as runmod
+
+    if args.config:
+        cfg = cfgmod.load_file(args.config, args.service)
+    else:
+        cfg = cfgmod.load_dict({}, args.service)
+
+    if args.service == "dbnode":
+        handle = runmod.run_dbnode(cfg)
+        print(f"m3_tpu dbnode listening on {handle.endpoint}", flush=True)
+        if handle.coordinator is not None:
+            print(f"embedded coordinator on {handle.coordinator.endpoint}",
+                  flush=True)
+    elif args.service == "aggregator":
+        handle = runmod.run_aggregator(cfg)
+        print(f"m3_tpu aggregator listening on {handle.endpoint}", flush=True)
+    elif args.service == "coordinator":
+        print("standalone coordinator requires a dbnode session; "
+              "use dbnode with a coordinator section for the single-binary "
+              "quickstart", file=sys.stderr)
+        return 2
+    else:
+        print("collector runs embedded; see m3_tpu.services.run.run_collector",
+              file=sys.stderr)
+        return 2
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()
+    handle.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,127 @@
+"""YAML service configuration (reference: each binary takes a single
+'-f config.yml' flag parsed into validated structs via m3x/config,
+src/cmd/services/m3dbnode/config/config.go etc.).
+
+Configs are plain dataclasses hydrated from YAML with unknown-key
+validation, mirroring the reference's strict unmarshal."""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, List, Optional
+
+import yaml
+
+from ..query.promql import parse_duration_ns
+
+
+class ConfigError(ValueError):
+    pass
+
+
+@dataclasses.dataclass
+class NamespaceConfig:
+    name: str = "default"
+    retention: str = "48h"
+    block_size: str = "2h"
+    index_enabled: bool = True
+
+    @property
+    def retention_ns(self) -> int:
+        return parse_duration_ns(self.retention)
+
+    @property
+    def block_size_ns(self) -> int:
+        return parse_duration_ns(self.block_size)
+
+
+@dataclasses.dataclass
+class DBNodeConfig:
+    host_id: str = "m3db_local"
+    listen_address: str = "127.0.0.1:0"
+    http_listen_address: str = ""
+    data_dir: str = "/tmp/m3_tpu_data"
+    num_shards: int = 64
+    replication_factor: int = 1
+    namespaces: List[NamespaceConfig] = dataclasses.field(
+        default_factory=lambda: [NamespaceConfig()])
+    commitlog_enabled: bool = True
+    kv_path: str = ""          # FileStore path; empty = in-memory
+    coordinator: Optional["CoordinatorConfig"] = None  # embedded mode
+
+
+@dataclasses.dataclass
+class CoordinatorConfig:
+    listen_address: str = "127.0.0.1:0"
+    namespace: str = "default"
+    rules_namespace: str = "default"
+    carbon_listen_address: str = ""    # empty = disabled
+    remotes: List[str] = dataclasses.field(default_factory=list)
+    lookback: str = "5m"
+
+
+@dataclasses.dataclass
+class AggregatorConfig:
+    instance_id: str = "agg_local"
+    listen_address: str = "127.0.0.1:0"
+    num_shards: int = 64
+    shard_set_id: str = "shardset-0"
+    election_id: str = "agg-election"
+    flush_interval: str = "1s"
+    kv_path: str = ""
+    topic: str = "aggregated_metrics"
+
+
+@dataclasses.dataclass
+class CollectorConfig:
+    num_shards: int = 64
+    rules_namespace: str = "default"
+    kv_path: str = ""
+
+
+_SERVICES = {
+    "dbnode": DBNodeConfig,
+    "coordinator": CoordinatorConfig,
+    "aggregator": AggregatorConfig,
+    "collector": CollectorConfig,
+}
+
+
+def _hydrate(cls, obj: Dict[str, Any]):
+    if obj is None:
+        obj = {}
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    unknown = set(obj) - set(fields)
+    if unknown:
+        raise ConfigError(
+            f"unknown config keys for {cls.__name__}: {sorted(unknown)}")
+    kwargs = {}
+    for name, value in obj.items():
+        f = fields[name]
+        if name == "namespaces":
+            kwargs[name] = [_hydrate(NamespaceConfig, v) for v in value]
+        elif name == "coordinator" and value is not None:
+            kwargs[name] = _hydrate(CoordinatorConfig, value)
+        else:
+            kwargs[name] = value
+    return cls(**kwargs)
+
+
+def load_file(path: str, service: str):
+    """xconfig.LoadFile equivalent: YAML -> validated config dataclass.
+    The file may either be the service config directly or contain a
+    top-level key per service (the reference's m3dbnode config embeds a
+    'coordinator' section the same way)."""
+    with open(path) as f:
+        raw = yaml.safe_load(f) or {}
+    return load_dict(raw, service)
+
+
+def load_dict(raw: Dict[str, Any], service: str):
+    cls = _SERVICES.get(service)
+    if cls is None:
+        raise ConfigError(f"unknown service {service!r}")
+    if service in raw and isinstance(raw[service], dict):
+        raw = raw[service]
+    return _hydrate(cls, raw)

@@ -1,0 +1,191 @@
+"""Service assembly + lifecycle (reference: src/dbnode/server/server.go:122
+Run, src/query/server/server.go:115 Run, m3aggregator/main, m3collector —
+each binary is a thin main() over a library run function; here each
+run_* returns a handle with .close()).
+
+An embedded coordinator inside the dbnode mirrors the reference's
+`m3dbnode -f cfg` with a coordinator section (main.go:69)."""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from typing import Optional
+
+from ..aggregator import Aggregator, ElectionManager, FlushTimesManager, ProducerHandler
+from ..aggregator.server import RawTCPServer
+from ..cluster import kv as cluster_kv
+from ..cluster.services import LeaderService
+from ..index.namespace_index import NamespaceIndex
+from ..parallel.sharding import ShardSet
+from ..persist.commitlog import CommitLog
+from ..persist.fs import PersistManager
+from ..query.promql import parse_duration_ns
+from ..rpc.node_server import NodeServer, NodeService
+from ..storage.database import Database
+from ..storage.namespace import NamespaceOptions
+from .config import (
+    AggregatorConfig,
+    CollectorConfig,
+    CoordinatorConfig,
+    DBNodeConfig,
+)
+
+
+def _kv_store(path: str) -> cluster_kv.MemStore:
+    if path:
+        return cluster_kv.FileStore(path)
+    return cluster_kv.MemStore()
+
+
+def _host_port(addr: str):
+    host, _, port = addr.rpartition(":")
+    return host or "127.0.0.1", int(port or 0)
+
+
+@dataclasses.dataclass
+class DBNodeHandle:
+    db: Database
+    server: NodeServer
+    persist: PersistManager
+    coordinator: Optional[object] = None
+    kv: Optional[cluster_kv.MemStore] = None
+    lock: Optional[object] = None
+
+    @property
+    def endpoint(self) -> str:
+        return self.server.endpoint
+
+    def close(self):
+        if self.coordinator is not None:
+            self.coordinator.close()
+        self.server.close()
+        if self.lock is not None:
+            self.lock.release()
+
+
+def run_dbnode(cfg: DBNodeConfig, clock=None) -> DBNodeHandle:
+    """dbnode/server/server.go Run: config -> db -> listeners."""
+    os.makedirs(cfg.data_dir, exist_ok=True)
+    # One process per data dir (x/lockfile; server.go takes it on startup).
+    from ..utils.lockfile import Lockfile
+
+    lock = Lockfile(os.path.join(cfg.data_dir, "node.lock")).acquire()
+    commitlog = None
+    if cfg.commitlog_enabled:
+        commitlog = CommitLog(os.path.join(cfg.data_dir, "commitlog"))
+    db = Database(ShardSet(cfg.num_shards), commitlog=commitlog, clock=clock)
+    for ns_cfg in cfg.namespaces:
+        index = NamespaceIndex(clock=db.clock) if ns_cfg.index_enabled else None
+        db.create_namespace(
+            ns_cfg.name.encode(),
+            NamespaceOptions(retention_ns=ns_cfg.retention_ns,
+                             block_size_ns=ns_cfg.block_size_ns,
+                             index_enabled=ns_cfg.index_enabled),
+            index=index)
+    db.mark_bootstrapped()
+    host, port = _host_port(cfg.listen_address)
+    server = NodeServer(NodeService(db), host=host, port=port).start()
+    persist = PersistManager(os.path.join(cfg.data_dir, "data"))
+    kv = _kv_store(cfg.kv_path)
+    coordinator = None
+    if cfg.coordinator is not None:
+        from ..coordinator import run_embedded
+
+        coordinator = run_embedded(
+            db, namespace=cfg.coordinator.namespace.encode(), kv_store=kv,
+            rules_namespace=cfg.coordinator.rules_namespace.encode(),
+            clock=db.clock)
+    return DBNodeHandle(db, server, persist, coordinator, kv, lock)
+
+
+@dataclasses.dataclass
+class AggregatorHandle:
+    aggregator: Aggregator
+    server: RawTCPServer
+    flush_thread: Optional[threading.Thread]
+    kv: cluster_kv.MemStore
+    _stop: threading.Event = dataclasses.field(default_factory=threading.Event)
+
+    @property
+    def endpoint(self) -> str:
+        return self.server.endpoint
+
+    def close(self):
+        self._stop.set()
+        self.server.close()
+
+
+def run_aggregator(cfg: AggregatorConfig, flush_handler=None,
+                   clock=None) -> AggregatorHandle:
+    """m3aggregator assembly: rawtcp server + election-managed flush loop."""
+    kv = _kv_store(cfg.kv_path)
+    clock = clock or time.time_ns
+    leader = LeaderService(kv, cfg.election_id, cfg.instance_id, clock=clock)
+    election = ElectionManager(leader)
+    flush_times = FlushTimesManager(kv, cfg.shard_set_id)
+    agg = Aggregator(num_shards=cfg.num_shards, clock=clock,
+                     flush_handler=flush_handler, election=election,
+                     flush_times=flush_times)
+    host, port = _host_port(cfg.listen_address)
+    server = RawTCPServer(agg, host=host, port=port).start()
+    handle = AggregatorHandle(agg, server, None, kv)
+    interval_s = parse_duration_ns(cfg.flush_interval) / 1e9
+
+    def flush_loop():
+        while not handle._stop.wait(interval_s):
+            try:
+                agg.flush()
+            except Exception:  # noqa: BLE001 - keep the loop alive
+                pass
+
+    handle.flush_thread = threading.Thread(target=flush_loop, daemon=True)
+    handle.flush_thread.start()
+    return handle
+
+
+def run_coordinator(cfg: CoordinatorConfig, session=None, db=None,
+                    kv_store=None, clock=None):
+    """Standalone coordinator over a client session (or an in-process db
+    for tests); returns the Coordinator handle with HTTP serving."""
+    from ..coordinator import run_clustered, run_embedded
+    from ..coordinator.carbon_ingest import CarbonServer
+    from ..query.remote import RemoteStorage
+    from ..query.storage import FanoutStorage
+
+    if (session is None) == (db is None):
+        raise ValueError("exactly one of session/db required")
+    if db is not None:
+        coord = run_embedded(db, namespace=cfg.namespace.encode(),
+                             kv_store=kv_store,
+                             rules_namespace=cfg.rules_namespace.encode(),
+                             clock=clock)
+    else:
+        coord = run_clustered(session, namespace=cfg.namespace.encode(),
+                              kv_store=kv_store,
+                              rules_namespace=cfg.rules_namespace.encode(),
+                              clock=clock)
+    if cfg.remotes:
+        stores = [coord.engine.storage] + [RemoteStorage(r) for r in cfg.remotes]
+        coord.engine.storage = FanoutStorage(stores)
+    if cfg.carbon_listen_address:
+        host, port = _host_port(cfg.carbon_listen_address)
+        carbon = CarbonServer(coord.writer, host=host, port=port).start()
+        coord.carbon = carbon  # attach for lifecycle
+    return coord
+
+
+def run_collector(cfg: CollectorConfig, placement_getter, transports,
+                  clock=None):
+    """m3collector: matcher + shard-aware aggregator client + reporter."""
+    from ..aggregator.client import AggregatorClient
+    from ..collector import Reporter
+    from ..metrics.matcher import Matcher, RuleSetStore
+
+    kv = _kv_store(cfg.kv_path)
+    matcher = Matcher(RuleSetStore(kv), cfg.rules_namespace.encode(),
+                      clock=clock)
+    client = AggregatorClient(cfg.num_shards, placement_getter, transports)
+    return Reporter(matcher, client), kv

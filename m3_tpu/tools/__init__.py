@@ -1,0 +1,3 @@
+"""Ops tools (reference: src/cmd/tools — fileset inspection / verification
+CLIs built on the persist readers). Run as
+`python -m m3_tpu.tools <tool> [args]`."""

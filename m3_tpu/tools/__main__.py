@@ -1,0 +1,70 @@
+"""Tools CLI dispatcher (reference: src/cmd/tools/*/main):
+python -m m3_tpu.tools read_data_files --root R --namespace ns --shard 0
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import fileset_tools as ft
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="m3_tpu.tools")
+    sub = p.add_subparsers(dest="tool", required=True)
+
+    def common(sp, commitlog=False):
+        if commitlog:
+            sp.add_argument("--dir", required=True)
+            return
+        sp.add_argument("--root", required=True)
+        sp.add_argument("--namespace", required=True)
+        sp.add_argument("--shard", type=int, required=True)
+        sp.add_argument("--block-start", type=int, default=None)
+
+    common(sub.add_parser("read_data_files"))
+    common(sub.add_parser("read_index_files"))
+    common(sub.add_parser("read_ids"))
+    common(sub.add_parser("verify_index_files"))
+    common(sub.add_parser("verify_commitlogs"), commitlog=True)
+    cp = sub.add_parser("clone_fileset")
+    common(cp)
+    cp.add_argument("--dst", required=True)
+
+    args = p.parse_args(argv)
+    ns = args.namespace.encode() if hasattr(args, "namespace") else None
+
+    if args.tool == "read_data_files":
+        for sid, t, v in ft.read_data_files(args.root, ns, args.shard,
+                                            args.block_start):
+            for ts, val in zip(t, v):
+                print(f"{sid.decode(errors='replace')} {ts} {val}")
+    elif args.tool == "read_ids":
+        for sid in ft.read_ids(args.root, ns, args.shard):
+            print(sid.decode(errors="replace"))
+    elif args.tool == "read_index_files":
+        out = ft.read_index_files(args.root, ns, args.shard)
+        for fs in out:
+            print(json.dumps({**fs, "entries": [
+                {**e, "id": e["id"].decode(errors="replace")}
+                for e in fs["entries"]]}))
+    elif args.tool == "verify_index_files":
+        out = ft.verify_index_files(args.root, ns, args.shard)
+        print(json.dumps(out))
+        return 1 if out["corrupt"] else 0
+    elif args.tool == "verify_commitlogs":
+        out = ft.verify_commitlogs(args.dir)
+        print(json.dumps(out))
+        return 1 if out["errors"] else 0
+    elif args.tool == "clone_fileset":
+        cloned = ft.clone_fileset(args.root, args.dst, ns, args.shard,
+                                  args.block_start)
+        for path in cloned:
+            print(path)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -24,6 +24,11 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: spawns real service subprocesses")
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(12345)

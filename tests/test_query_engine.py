@@ -314,6 +314,30 @@ class TestAgainstRealStorage:
         np.testing.assert_allclose(blk.values[0], 15 / 15, rtol=1e-9)
 
 
+class TestCostEnforcement:
+    def test_per_query_budget_released_between_queries(self, storage):
+        from m3_tpu.utils.cost import CostLimitExceeded, Enforcer
+
+        glob = Enforcer(limit=500, name="global")
+        eng = Engine(storage, cost_enforcer=glob)
+        # Each query fetches well under the limit; many in sequence must NOT
+        # exhaust the global budget (charges are released per query).
+        for _ in range(20):
+            run(eng, "memory_bytes")
+        assert glob.current() == 0
+
+    def test_over_limit_query_rejected_and_rolled_back(self, storage):
+        from m3_tpu.utils.cost import CostLimitExceeded, Enforcer
+
+        glob = Enforcer(limit=10_000, name="global")
+        eng = Engine(storage, cost_enforcer=glob, per_query_cost_limit=10)
+        with pytest.raises(CostLimitExceeded):
+            run(eng, "http_requests_total")  # 3 series x 40 points > 10
+        assert glob.current() == 0  # failed query leaves no residue
+        eng2 = Engine(storage, cost_enforcer=glob)
+        run(eng2, "memory_bytes")  # global budget unaffected
+
+
 class TestHistogramQuantile:
     def test_le_buckets(self):
         st = MemStorage()

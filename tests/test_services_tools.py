@@ -1,0 +1,247 @@
+"""Service assembly, network aggregation path, remote federation, tools,
+load generator, and the process-level environment manager (reference:
+src/cmd/services mains, src/aggregator/server/rawtcp, src/query/tsdb/remote,
+src/cmd/tools, src/m3nsch, src/m3em)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from m3_tpu import nsch
+from m3_tpu.aggregator import Aggregator, CaptureHandler
+from m3_tpu.aggregator.server import RawTCPServer, TCPTransport
+from m3_tpu.metrics.metadata import Metadata, PipelineMetadata, StagedMetadata
+from m3_tpu.metrics.metric import MetricType, MetricUnion
+from m3_tpu.metrics.policy import StoragePolicy
+from m3_tpu.services import config as svc_config
+from m3_tpu.services import run as svc_run
+from m3_tpu.testing.cluster import SettableClock
+from m3_tpu.tools import fileset_tools as ft
+
+S = 1_000_000_000
+TEN_S = StoragePolicy.of("10s", "2d")
+T0 = 1_600_000_000 * S
+
+
+def _await(cond, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+class TestConfig:
+    def test_yaml_roundtrip(self, tmp_path):
+        cfg_file = tmp_path / "cfg.yml"
+        cfg_file.write_text(
+            "listen_address: 127.0.0.1:0\n"
+            f"data_dir: {tmp_path}/data\n"
+            "num_shards: 16\n"
+            "namespaces:\n"
+            "  - name: metrics\n"
+            "    retention: 24h\n"
+            "coordinator:\n"
+            "  namespace: metrics\n")
+        cfg = svc_config.load_file(str(cfg_file), "dbnode")
+        assert cfg.num_shards == 16
+        assert cfg.namespaces[0].retention_ns == 24 * 3600 * S
+        assert cfg.coordinator.namespace == "metrics"
+
+    def test_unknown_key_rejected(self):
+        with pytest.raises(svc_config.ConfigError):
+            svc_config.load_dict({"bogus_key": 1}, "dbnode")
+
+
+class TestDBNodeService:
+    def test_run_with_embedded_coordinator(self, tmp_path):
+        cfg = svc_config.load_dict({
+            "data_dir": str(tmp_path / "d"),
+            "num_shards": 8,
+            "coordinator": {"namespace": "default"},
+        }, "dbnode")
+        clock = SettableClock(T0)
+        handle = svc_run.run_dbnode(cfg, clock=clock)
+        try:
+            assert handle.endpoint
+            # Write through the coordinator ingest, read via PromQL.
+            for i in range(10):
+                clock.advance(10 * S)
+                handle.coordinator.writer.write(
+                    {b"__name__": b"svc_metric"}, clock(), float(i))
+            blk = handle.coordinator.engine.execute_range(
+                "svc_metric", T0 + 50 * S, T0 + 100 * S, 10 * S)
+            assert blk.n_series == 1
+        finally:
+            handle.close()
+
+
+class TestAggregatorNetworkPath:
+    def test_rawtcp_ingest_to_flush(self):
+        clock = SettableClock(100 * S)
+        cap = CaptureHandler()
+        agg = Aggregator(num_shards=8, clock=clock, flush_handler=cap)
+        srv = RawTCPServer(agg).start()
+        try:
+            transport = TCPTransport(srv.endpoint, batch_size=4)
+            md = (StagedMetadata(0, False, Metadata(
+                (PipelineMetadata(0, (TEN_S,)),))),)
+            for i in range(8):
+                assert transport(MetricUnion.counter(b"net_metric", 1), md)
+            transport.flush()
+            assert _await(lambda: agg.num_entries() == 1)
+            clock.advance(10 * S)
+            agg.flush()
+            out = cap.by_id(b"net_metric")
+            assert len(out) == 1 and out[0].value == 8.0
+        finally:
+            srv.close()
+
+    def test_aggregator_service_flush_loop(self):
+        cap = CaptureHandler()
+        cfg = svc_config.load_dict(
+            {"flush_interval": "50ms", "num_shards": 8}, "aggregator")
+        handle = svc_run.run_aggregator(cfg, flush_handler=cap)
+        try:
+            transport = TCPTransport(handle.endpoint, batch_size=1)
+            md = (StagedMetadata(0, False, Metadata(
+                (PipelineMetadata(0, (StoragePolicy.of("100ms", "2d"),)),))),)
+            transport(MetricUnion.gauge(b"live_metric", 3.5), md)
+            assert _await(lambda: len(cap.by_id(b"live_metric")) >= 1)
+            assert cap.by_id(b"live_metric")[0].value == 3.5
+        finally:
+            handle.close()
+
+
+class TestRemoteFederation:
+    def test_fanout_across_remote(self):
+        from m3_tpu.query.remote import RemoteStorage, RemoteStorageServer
+        from m3_tpu.query.storage import FanoutStorage
+        from m3_tpu.query import Engine
+        from tests.test_query_engine import MemStorage
+
+        local = MemStorage()
+        remote_backing = MemStorage()
+        t = np.arange(0, 40) * 15 * S
+        local.add({"__name__": "m", "dc": "local"}, t, np.full(40, 1.0))
+        remote_backing.add({"__name__": "m", "dc": "remote"}, t, np.full(40, 2.0))
+        srv = RemoteStorageServer(remote_backing).start()
+        try:
+            fanout = FanoutStorage([local, RemoteStorage(srv.endpoint)])
+            eng = Engine(fanout)
+            blk = eng.execute_range("m", 5 * 60 * S, 9 * 60 * S, 30 * S)
+            got = {t.as_dict()[b"dc"]: v[0] for t, v in
+                   zip(blk.series_tags, blk.values)}
+            assert got == {b"local": 1.0, b"remote": 2.0}
+        finally:
+            srv.close()
+
+
+class TestTools:
+    def _seed(self, tmp_path):
+        """Write one shard's fileset through the real engine + persist."""
+        from m3_tpu.parallel.sharding import ShardSet
+        from m3_tpu.persist.fs import PersistManager
+        from m3_tpu.storage.database import Database
+        from m3_tpu.storage.namespace import NamespaceOptions
+
+        clock = SettableClock(T0)
+        db = Database(ShardSet(4), clock=clock)
+        db.create_namespace(b"default", NamespaceOptions(index_enabled=False,
+                                                         block_size_ns=600 * S))
+        for i in range(30):
+            clock.advance(10 * S)
+            db.write(b"default", b"tool.series.%d" % (i % 3), clock(),
+                     float(i))
+        clock.advance(1800 * S)
+        db.tick()  # seal cold blocks so they become flushable
+        pm = PersistManager(str(tmp_path / "data"))
+        assert db.flush(pm) > 0
+        return db, pm
+
+    def test_read_and_verify(self, tmp_path):
+        db, pm = self._seed(tmp_path)
+        shards = [s for s in range(4)
+                  if pm.list_filesets(b"default", s)]
+        assert shards
+        shard = shards[0]
+        ids = ft.read_ids(str(tmp_path / "data"), b"default", shard)
+        assert ids and all(i.startswith(b"tool.series") for i in ids)
+        rows = list(ft.read_data_files(str(tmp_path / "data"), b"default", shard))
+        assert rows and all(len(t) > 0 for _, t, _ in rows)
+        out = ft.verify_index_files(str(tmp_path / "data"), b"default", shard)
+        assert out["ok"] and not out["corrupt"]
+
+    def test_clone_and_corruption_detection(self, tmp_path):
+        db, pm = self._seed(tmp_path)
+        shard = next(s for s in range(4) if pm.list_filesets(b"default", s))
+        cloned = ft.clone_fileset(str(tmp_path / "data"), str(tmp_path / "clone"),
+                                  b"default", shard)
+        assert cloned
+        out = ft.verify_index_files(str(tmp_path / "clone"), b"default", shard)
+        assert out["ok"]
+        # Corrupt a data file; verification must flag it.
+        data_file = os.path.join(cloned[0], "data.bin")
+        with open(data_file, "r+b") as f:
+            f.seek(0)
+            f.write(b"\xff\xff\xff\xff")
+        out = ft.verify_index_files(str(tmp_path / "clone"), b"default", shard)
+        assert out["corrupt"]
+
+
+class TestNsch:
+    def test_agent_bounded_run_and_verify(self):
+        writes = []
+        w = nsch.Workload(cardinality=10, ingress_qps=100000,
+                          datum=nsch.CounterDatum(rate=5.0))
+        agent = nsch.Agent(w, lambda ns, sid, tags, t, v:
+                           writes.append((sid, v)), clock=lambda: T0)
+        agent.run_for(25)
+        assert agent.written == 25
+        # Deterministic datum: series 0 tick 0 -> 0, tick 1 -> 5, tick 2 -> 10
+        s0 = [v for sid, v in writes if sid == w.series_id(0)]
+        assert s0 == [0.0, 5.0, 10.0]
+
+    def test_coordinator_fleet(self):
+        sink = []
+        coord = nsch.NschCoordinator()
+        w = nsch.Workload(cardinality=5, ingress_qps=50000)
+        coord.init(w, [lambda ns, sid, tags, t, v: sink.append(sid)
+                       for _ in range(3)])
+        coord.start()
+        assert _await(lambda: coord.status()["total_written"] > 300)
+        coord.stop()
+        st = coord.status()
+        assert st["total_errors"] == 0
+        assert len(st["agents"]) == 3
+        coord.modify(ingress_qps=1)
+        assert all(a.workload.ingress_qps == 1 for a in coord._agents)
+
+
+@pytest.mark.slow
+class TestEMCluster:
+    def test_real_process_lifecycle(self, tmp_path):
+        from m3_tpu.em import EMCluster
+
+        cluster = EMCluster(str(tmp_path))
+        try:
+            cluster.add_node("node0")
+            endpoints = cluster.start_all()
+            assert "node0" in endpoints and ":" in endpoints["node0"]
+            assert cluster.alive()["node0"]
+            # Write through the real TCP RPC of the spawned process.
+            from m3_tpu.rpc import wire
+            import socket
+
+            host, _, port = endpoints["node0"].rpartition(":")
+            with socket.create_connection((host, int(port)), timeout=5) as sock:
+                wire.write_frame(sock, {"m": "health", "a": {}, "id": 1})
+                resp = wire.read_frame(sock)
+            assert resp["ok"]
+            cluster.operators["node0"].kill()
+            assert not cluster.alive()["node0"]
+        finally:
+            cluster.teardown()

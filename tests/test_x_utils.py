@@ -1,0 +1,136 @@
+"""x-utility tests: cost enforcer, lockfile, panicmon, tag serialization,
+runtime options manager (reference: src/x/{cost,lockfile,panicmon,
+serialize}, src/dbnode/runtime + kvconfig)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from m3_tpu.cluster import kv as cluster_kv
+from m3_tpu.storage.runtime import (
+    RuntimeOptions,
+    RuntimeOptionsManager,
+    WRITE_NEW_SERIES_LIMIT_PER_SECOND,
+    watch_kv_runtime_options,
+)
+from m3_tpu.utils import serialize
+from m3_tpu.utils.cost import CostLimitExceeded, Enforcer
+from m3_tpu.utils.lockfile import Lockfile, LockError
+from m3_tpu.utils.panicmon import Panicmon
+
+
+class TestCostEnforcer:
+    def test_limit_enforced(self):
+        e = Enforcer(limit=100)
+        e.add(60)
+        with pytest.raises(CostLimitExceeded):
+            e.add(50)
+
+    def test_child_chains_to_parent(self):
+        glob = Enforcer(limit=100, name="global")
+        q1 = glob.child(limit=80, name="q1")
+        q2 = glob.child(limit=80, name="q2")
+        q1.add(60)
+        with pytest.raises(CostLimitExceeded):
+            q2.add(50)  # under q2's own limit, over global
+        q1.release(60)
+        q2.add(50)
+
+    def test_release(self):
+        e = Enforcer(limit=10)
+        e.add(8)
+        e.release(8)
+        e.add(9)
+        assert e.current() == 9
+
+
+class TestLockfile:
+    def test_exclusive(self, tmp_path):
+        path = str(tmp_path / "node.lock")
+        with Lockfile(path):
+            # A second process must fail to take it.
+            rc = subprocess.run(
+                [sys.executable, "-c",
+                 "import sys; sys.path.insert(0, '.');"
+                 "from m3_tpu.utils.lockfile import Lockfile, LockError\n"
+                 "try:\n"
+                 f"    Lockfile({path!r}).acquire()\n"
+                 "    sys.exit(0)\n"
+                 "except LockError:\n"
+                 "    sys.exit(42)"],
+                cwd="/root/repo").returncode
+            assert rc == 42
+        # Released: take it again.
+        Lockfile(path).acquire().release()
+
+
+@pytest.mark.slow
+class TestPanicmon:
+    def test_restart_on_crash(self):
+        mon = Panicmon([sys.executable, "-c", "import sys; sys.exit(3)"],
+                       restart_on_crash=True, max_restarts=2, backoff_s=0.05)
+        mon.start()
+        deadline = time.time() + 10
+        while mon.restarts < 2 and time.time() < deadline:
+            time.sleep(0.05)
+        mon.stop()
+        assert mon.restarts == 2
+        assert all(rc == 3 for rc in mon.exit_codes[:3])
+
+    def test_clean_exit_no_restart(self):
+        mon = Panicmon([sys.executable, "-c", "pass"],
+                       restart_on_crash=True, backoff_s=0.05)
+        mon.start()
+        deadline = time.time() + 10
+        while not mon.exit_codes and time.time() < deadline:
+            time.sleep(0.05)
+        mon.stop()
+        assert mon.exit_codes[0] == 0
+        assert mon.restarts == 0
+
+
+class TestTagSerialize:
+    def test_roundtrip(self):
+        tags = {b"host": b"web-01", b"dc": b"east", b"empty": b""}
+        buf = serialize.encode_tags(tags)
+        assert serialize.decode_tags(buf) == tags
+
+    def test_header_validated(self):
+        with pytest.raises(serialize.TagEncodeError):
+            serialize.decode_tags(b"\x00\x00\x00\x00")
+        buf = serialize.encode_tags({b"a": b"b"})
+        with pytest.raises(serialize.TagEncodeError):
+            serialize.decode_tags(buf[:-1])  # truncated
+        with pytest.raises(serialize.TagEncodeError):
+            serialize.decode_tags(buf + b"x")  # trailing
+
+    def test_deterministic_sorted(self):
+        b1 = serialize.encode_tags({b"b": b"2", b"a": b"1"})
+        b2 = serialize.encode_tags({b"a": b"1", b"b": b"2"})
+        assert b1 == b2
+
+
+class TestRuntimeOptions:
+    def test_listeners_fire_on_update(self):
+        mgr = RuntimeOptionsManager()
+        seen = []
+        mgr.register_listener(lambda o: seen.append(o.write_new_series_limit_per_second))
+        assert seen == [0]  # fired with current on register
+        mgr.update(write_new_series_limit_per_second=500)
+        assert seen[-1] == 500
+
+    def test_kv_watch_folds_keys(self):
+        store = cluster_kv.MemStore()
+        mgr = RuntimeOptionsManager()
+        watch_kv_runtime_options(store, mgr)
+        store.set(f"_kvconfig/{WRITE_NEW_SERIES_LIMIT_PER_SECOND}",
+                  json.dumps(1234).encode())
+        assert mgr.get().write_new_series_limit_per_second == 1234
+        # Pre-existing value applies on (re)wire.
+        mgr2 = RuntimeOptionsManager()
+        watch_kv_runtime_options(store, mgr2)
+        assert mgr2.get().write_new_series_limit_per_second == 1234
