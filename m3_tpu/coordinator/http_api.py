@@ -54,6 +54,7 @@ class HTTPApi:
             ("POST", r"/api/v1/graphite/render", self.graphite_render),
             ("GET", r"/api/v1/graphite/find", self.graphite_find),
             ("GET", r"/routes", self.list_routes),
+            ("GET", r"/debug/vars", self.debug_vars),
         ]
         if admin is not None:
             self.routes += [
@@ -76,6 +77,13 @@ class HTTPApi:
 
     def list_routes(self, req) -> dict:
         return {"routes": [f"{m} {p}" for m, p, _ in self.routes]}
+
+    def debug_vars(self, req) -> dict:
+        """Process metrics snapshot (the reference exposes pprof + tally;
+        dbnode/server/server.go:575 debug listener)."""
+        from ..utils.instrument import ROOT
+
+        return {"metrics": ROOT.snapshot()}
 
     def query_range(self, req) -> dict:
         q = req.param("query")

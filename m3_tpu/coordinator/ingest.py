@@ -11,7 +11,10 @@ from typing import Callable, Dict, Optional, Sequence
 
 from ..aggregator.handler import decode_aggregated
 from ..metrics.metric import MetricType
+from ..utils.instrument import ROOT
 from .downsample import Downsampler
+
+_scope = ROOT.sub_scope("coordinator.ingest")
 
 
 class DownsamplerAndWriter:
@@ -29,10 +32,12 @@ class DownsamplerAndWriter:
         if downsample and self._downsampler is not None:
             if self._downsampler.write(tags, t_nanos, value, metric_type):
                 self.downsampled += 1
+                _scope.counter("downsampled").inc()
         if write_unaggregated:
             sid = _series_id(tags)
             self._storage.write(sid, tags, t_nanos, value)
             self.written += 1
+            _scope.counter("written").inc()
 
     def write_batch(self, samples: Sequence[tuple], **kw):
         for tags, t_nanos, value in samples:

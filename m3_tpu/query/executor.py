@@ -73,6 +73,15 @@ class Engine:
 
     def execute_range(self, query: str, start_ns: int, end_ns: int,
                       step_ns: int) -> Block:
+        from ..utils.instrument import ROOT
+
+        ROOT.counter("query.executed").inc()
+        timer = ROOT.timer("query.latency_s")
+        with timer:
+            return self._execute_range(query, start_ns, end_ns, step_ns)
+
+    def _execute_range(self, query: str, start_ns: int, end_ns: int,
+                       step_ns: int) -> Block:
         ast = promql.parse(query)
         params = QueryParams(start_ns, end_ns, step_ns)
         if self.cost_enforcer is not None:

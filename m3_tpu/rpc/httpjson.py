@@ -1,0 +1,95 @@
+"""HTTP/JSON mirror of the node RPC (reference:
+src/dbnode/network/server/httpjson — every thrift method exposed as POST
+/<method> with a JSON body, used for debugging and simple integrations;
+server.go:555 wires it next to the tchannel listener).
+
+Numpy columns serialize as lists; bytes as latin-1-safe strings."""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+
+import numpy as np
+
+from .node_server import NodeService
+
+
+def _to_json(v: Any):
+    if isinstance(v, dict):
+        return {_key(k): _to_json(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_to_json(x) for x in v]
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    if isinstance(v, bytes):
+        return {"b64": base64.b64encode(v).decode()}
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    return v
+
+
+def _key(k):
+    return k.decode(errors="replace") if isinstance(k, bytes) else k
+
+
+def _from_json(v: Any):
+    if isinstance(v, dict):
+        if set(v) == {"b64"}:
+            return base64.b64decode(v["b64"])
+        return {k: _from_json(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [_from_json(x) for x in v]
+    return v
+
+
+class HTTPJSONServer:
+    def __init__(self, service: NodeService, host: str = "127.0.0.1",
+                 port: int = 0):
+        svc = service
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                method = self.path.strip("/")
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b"{}"
+                try:
+                    args = _from_json(json.loads(body or b"{}"))
+                    # JSON callers pass strings where the wire uses bytes.
+                    args = {k: (v.encode() if isinstance(v, str) and
+                                k in ("ns", "id") else v)
+                            for k, v in args.items()}
+                    result = svc.dispatch(method, args)
+                    out = {"ok": True, "r": _to_json(result)}
+                    code = 200
+                except Exception as e:  # noqa: BLE001
+                    out, code = {"ok": False, "err": str(e)}, 400
+                data = json.dumps(out).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+
+    @property
+    def endpoint(self) -> str:
+        h, p = self._server.server_address
+        return f"http://{h}:{p}"
+
+    def start(self) -> "HTTPJSONServer":
+        threading.Thread(target=self._server.serve_forever, daemon=True).start()
+        return self
+
+    def close(self):
+        self._server.shutdown()
+        self._server.server_close()

@@ -53,6 +53,7 @@ class DBNodeHandle:
     coordinator: Optional[object] = None
     kv: Optional[cluster_kv.MemStore] = None
     lock: Optional[object] = None
+    httpjson: Optional[object] = None
 
     @property
     def endpoint(self) -> str:
@@ -61,6 +62,8 @@ class DBNodeHandle:
     def close(self):
         if self.coordinator is not None:
             self.coordinator.close()
+        if self.httpjson is not None:
+            self.httpjson.close()
         self.server.close()
         if self.lock is not None:
             self.lock.release()
@@ -87,7 +90,14 @@ def run_dbnode(cfg: DBNodeConfig, clock=None) -> DBNodeHandle:
             index=index)
     db.mark_bootstrapped()
     host, port = _host_port(cfg.listen_address)
-    server = NodeServer(NodeService(db), host=host, port=port).start()
+    service = NodeService(db)
+    server = NodeServer(service, host=host, port=port).start()
+    httpjson = None
+    if cfg.http_listen_address:
+        from ..rpc.httpjson import HTTPJSONServer
+
+        hhost, hport = _host_port(cfg.http_listen_address)
+        httpjson = HTTPJSONServer(service, host=hhost, port=hport).start()
     persist = PersistManager(os.path.join(cfg.data_dir, "data"))
     kv = _kv_store(cfg.kv_path)
     coordinator = None
@@ -98,7 +108,7 @@ def run_dbnode(cfg: DBNodeConfig, clock=None) -> DBNodeHandle:
             db, namespace=cfg.coordinator.namespace.encode(), kv_store=kv,
             rules_namespace=cfg.coordinator.rules_namespace.encode(),
             clock=db.clock)
-    return DBNodeHandle(db, server, persist, coordinator, kv, lock)
+    return DBNodeHandle(db, server, persist, coordinator, kv, lock, httpjson)
 
 
 @dataclasses.dataclass
