@@ -1,0 +1,34 @@
+"""m3_tpu: a TPU-native metrics platform (storage node, aggregator,
+PromQL/Graphite query engine) with the capabilities of the M3 reference —
+hot paths as batched JAX/XLA kernels, control plane on the host.
+
+Package map (see README.md for the full reference parity table):
+  ops/        device kernels: TSZ codec, window aggregation, temporal fns
+  storage/    db -> namespace -> shard -> buffer/blocks, bootstrap, repair
+  persist/    filesets + commitlog WAL
+  index/      inverted tag index
+  cluster/    KV, placement, elections, topology
+  client/     replicating quorum session
+  rpc/        framed binary wire + node server (+ http/json mirror)
+  metrics/    types, policies, rules, matchers, pipelines, carbon
+  aggregator/ windowed aggregation tier (+ raw TCP server, deploy)
+  msg/        sharded pub/sub with acks
+  collector/  rule-matched forwarding agent
+  query/      PromQL + Graphite engines, storage adapters, federation
+  coordinator/ HTTP API, ingest, downsampler, admin
+  services/   yaml-config service binaries
+  tools/      fileset/commitlog ops CLIs
+  parallel/   mesh sharding + the flagship sharded ingest step
+"""
+
+__version__ = "0.1.0"
+
+import os as _os
+
+if _os.environ.get("M3_TPU_JAX_PLATFORM"):
+    # Hard platform override (e.g. "cpu" for hermetic service runs/CI).
+    # The env var JAX_PLATFORMS alone does not stop out-of-tree plugin
+    # backends from initializing; the config update does.
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", _os.environ["M3_TPU_JAX_PLATFORM"])

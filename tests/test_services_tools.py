@@ -221,6 +221,31 @@ class TestNsch:
         assert all(a.workload.ingress_qps == 1 for a in coord._agents)
 
 
+class TestWriteBench:
+    def test_bench_against_embedded_coordinator(self):
+        from m3_tpu.cluster import kv as cluster_kv
+        from m3_tpu.coordinator import run_embedded
+        from m3_tpu.index.namespace_index import NamespaceIndex
+        from m3_tpu.parallel.sharding import ShardSet
+        from m3_tpu.storage.database import Database
+        from m3_tpu.storage.namespace import NamespaceOptions
+        from m3_tpu.tools.write_bench import run_write_bench
+
+        clock = SettableClock(T0)
+        db = Database(ShardSet(8), clock=clock)
+        db.create_namespace(b"default", NamespaceOptions(),
+                            index=NamespaceIndex(clock=clock))
+        c = run_embedded(db, clock=clock)
+        try:
+            out = run_write_bench(c.endpoint, cardinality=20, n_agents=2,
+                                  duration_s=1.0, clock=clock)
+            assert out["errors"] == 0
+            assert out["writes"] > 50
+            assert out["writes_per_sec"] > 50
+        finally:
+            c.close()
+
+
 @pytest.mark.slow
 class TestEMCluster:
     def test_real_process_lifecycle(self, tmp_path):
