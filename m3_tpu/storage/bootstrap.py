@@ -65,6 +65,12 @@ class FilesystemBootstrapper(Bootstrapper):
         claimed = ShardTimeRanges()
         if ctx.persist is None:
             return claimed
+        if ns.index is not None:
+            # Index phase: load persisted segments before data blocks
+            # (bootstrapper/base_index_step.go).
+            from ..index import persist as idx_persist
+
+            idx_persist.bootstrap_index(ctx.persist.root, ns.name, ns.index)
         bsz = ns.opts.block_size_ns
         for shard_id in shard_ranges.shards():
             shard = ns.shards.get(shard_id)

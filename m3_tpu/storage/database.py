@@ -107,6 +107,14 @@ class Database:
                     persist_manager.write_block(ns.name, shard.shard_id, shard.blocks[bs], shard.registry)
                     shard.mark_flushed(bs)
                     flushed += 1
+            if ns.index is not None:
+                # Persist cold index blocks next to the data filesets
+                # (persist_manager.go:193-332 index segment persist).
+                from ..index import persist as idx_persist
+
+                flushed += len(idx_persist.flush_index(
+                    persist_manager.root, ns.name, ns.index, now,
+                    ns.opts.retention_ns))
         if self.commitlog is not None and flushed:
             self.commitlog.rotate()
         return flushed
