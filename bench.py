@@ -12,16 +12,31 @@ bench_baseline.json (same kernels on the host platform — the "CPU M3TSZ
 encode baseline" config; the reference publishes no absolute throughput
 numbers, BASELINE.md). Also embeds bytes/datapoint (reference: 1.45,
 docs/m3db/architecture/engine.md:9) in the "extra" field.
+
+Robustness: the measurement runs in a child process (backend init state is
+not reliably retryable in-process once jax caches a failed backend), with
+bounded retries against the default (TPU) platform and a final CPU-platform
+fallback, so a flaky TPU tunnel yields a real number + a structured note
+rather than rc=1 with a traceback.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
 import numpy as np
+
+_ATTEMPTS = 3
+_RETRY_SLEEP_S = 10
+# TPU attempts get a bounded window: normal first-compile is 20-40s, so a
+# timeout here means the backend is hanging (observed axon-tunnel failure
+# mode) and retrying would hang again — go straight to the CPU fallback.
+_TPU_TIMEOUT_S = int(os.environ.get("BENCH_TPU_TIMEOUT_S", "360"))
+_CPU_TIMEOUT_S = int(os.environ.get("BENCH_CPU_TIMEOUT_S", "900"))
 
 
 def run(n_series: int, window: int, iters: int):
@@ -54,15 +69,83 @@ def run(n_series: int, window: int, iters: int):
     total_points = n_series * window
     dps = total_points * iters / dt
     bytes_per_dp = float(np.asarray(nbits, dtype=np.int64).sum()) / 8.0 / total_points
-    return dps, bytes_per_dp
+    platform = jax.devices()[0].platform
+    return dps, bytes_per_dp, platform
 
 
-def main():
+def _child_main():
     n_series = int(os.environ.get("BENCH_SERIES", "100000"))
     window = int(os.environ.get("BENCH_WINDOW", "120"))
     iters = int(os.environ.get("BENCH_ITERS", "5"))
+    if os.environ.get("BENCH_FORCE_CPU"):
+        import jax
 
-    dps, bytes_per_dp = run(n_series, window, iters)
+        jax.config.update("jax_platforms", "cpu")
+    dps, bytes_per_dp, platform = run(n_series, window, iters)
+    print(
+        json.dumps(
+            {
+                "dps": dps,
+                "bytes_per_dp": bytes_per_dp,
+                "platform": platform,
+                "series": n_series,
+                "window": window,
+            }
+        )
+    )
+
+
+def _spawn_child(force_cpu: bool):
+    env = dict(os.environ)
+    if force_cpu:
+        env["BENCH_FORCE_CPU"] = "1"
+        env["JAX_PLATFORMS"] = "cpu"
+    timeout_s = _CPU_TIMEOUT_S if force_cpu else _TPU_TIMEOUT_S
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"timeout after {timeout_s}s"
+    if proc.returncode != 0:
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-8:]
+        return None, f"rc={proc.returncode}: " + " | ".join(tail)
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            return json.loads(line), None
+        except json.JSONDecodeError:
+            continue
+    return None, "no JSON line in child output"
+
+
+def main():
+    if "--child" in sys.argv:
+        _child_main()
+        return 0
+
+    errors = []
+    result = None
+    for attempt in range(_ATTEMPTS):
+        result, err = _spawn_child(force_cpu=False)
+        if result is not None:
+            break
+        errors.append(f"attempt {attempt + 1}: {err}")
+        print(f"warning: bench {errors[-1]}", file=sys.stderr)
+        if err.startswith("timeout after"):
+            break  # backend hang: retrying hangs again, fall back now
+        if attempt < _ATTEMPTS - 1:
+            time.sleep(_RETRY_SLEEP_S)
+    if result is None:
+        # Final fallback: the kernels are platform-agnostic; a CPU number is
+        # a real measurement (and vs_baseline~=1.0 documents TPU was down).
+        result, err = _spawn_child(force_cpu=True)
+        if result is None:
+            errors.append(f"cpu fallback: {err}")
 
     baseline_dps = None
     try:
@@ -70,8 +153,33 @@ def main():
             baseline_dps = json.load(f)["cpu_dps"]
     except Exception as e:
         print(f"warning: no usable bench_baseline.json ({e})", file=sys.stderr)
-    vs = dps / baseline_dps if baseline_dps else None
 
+    if result is None:
+        print(
+            json.dumps(
+                {
+                    "metric": "m3tsz_encode_1m_rollup",
+                    "value": 0.0,
+                    "unit": "datapoints/sec",
+                    "vs_baseline": None,
+                    "error": "; ".join(errors),
+                }
+            )
+        )
+        return 0
+
+    dps = result["dps"]
+    vs = dps / baseline_dps if baseline_dps else None
+    extra = {
+        "bytes_per_datapoint": round(result["bytes_per_dp"], 3),
+        "reference_bytes_per_datapoint": 1.45,
+        "series": result["series"],
+        "window": result["window"],
+        "cpu_baseline_dps": baseline_dps,
+        "platform": result["platform"],
+    }
+    if errors:
+        extra["retries"] = errors
     print(
         json.dumps(
             {
@@ -79,16 +187,11 @@ def main():
                 "value": round(dps, 1),
                 "unit": "datapoints/sec",
                 "vs_baseline": round(vs, 3) if vs is not None else None,
-                "extra": {
-                    "bytes_per_datapoint": round(bytes_per_dp, 3),
-                    "reference_bytes_per_datapoint": 1.45,
-                    "series": n_series,
-                    "window": window,
-                    "cpu_baseline_dps": baseline_dps,
-                },
+                "extra": extra,
             }
         )
     )
+    return 0
 
 
 if __name__ == "__main__":

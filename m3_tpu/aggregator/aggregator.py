@@ -169,7 +169,7 @@ class Aggregator:
                 commits.append(commit)
             else:
                 jobs.extend(plan_jobs(shard.lists, now, self._buffer_past_ns,
-                                      self._flush_handler, self._forward))
+                                      self._flush_handler, self._forward)[0])
         total = reduce_and_emit(jobs)
         for commit in commits:
             commit()

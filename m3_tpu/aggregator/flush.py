@@ -86,8 +86,14 @@ class FlushManager:
 
     def _plan_as_leader(self, now_nanos: int):
         flushed = self._flush_times.get(self._shard_id)
-        jobs = plan_jobs(self._lists, now_nanos, self._buffer_past_ns,
-                         self._flush_fn, self._forward_fn)
+        # Windows the previous leader already flushed (per KV flush times)
+        # are discarded, not re-emitted: a promoted follower may still hold
+        # closed windows it had not yet discarded, and re-emitting them would
+        # double-count in forwarded rollup pipelines.
+        jobs, stale = plan_jobs(self._lists, now_nanos, self._buffer_past_ns,
+                                self._flush_fn, self._forward_fn,
+                                flushed=flushed)
+        self.windows_discarded += stale
         for lst in self._lists.lists():
             res = lst.resolution_ns
             target = (now_nanos - self._buffer_past_ns) // res * res
@@ -124,16 +130,25 @@ class FlushManager:
 
 
 def plan_jobs(lists: MetricLists, now_nanos: int, buffer_past_ns: int,
-              flush_fn: Callable, forward_fn: Optional[Callable]):
+              flush_fn: Callable, forward_fn: Optional[Callable],
+              flushed: Optional[Dict[int, int]] = None):
     """Collect closed-window reduce jobs for every list, with the flush
     target aligned down to each resolution boundary (list.go flush-before
-    alignment). Shared by the managed (leader) and leaderless paths."""
+    alignment). Shared by the managed (leader) and leaderless paths.
+
+    With `flushed` (per-resolution flushed-up-to times from KV), windows
+    already covered by a previous leader's persisted flush are dropped.
+    Returns (jobs, n_dropped).
+    """
     jobs = []
+    dropped = 0
     for lst in lists.lists():
         res = lst.resolution_ns
         target = (now_nanos - buffer_past_ns) // res * res
-        jobs.extend(
-            (elem, start, vals, flush_fn, forward_fn)
-            for elem, start, vals in lst.collect(target)
-        )
-    return jobs
+        already = flushed.get(res, 0) if flushed else 0
+        for elem, start, vals in lst.collect(target):
+            if start + res <= already:
+                dropped += 1
+                continue
+            jobs.append((elem, start, vals, flush_fn, forward_fn))
+    return jobs, dropped

@@ -137,6 +137,12 @@ class MetricList:
         e = self._elems.get(key)
         if e is None:
             e = self._elems[key] = factory()
+        elif e.tombstoned:
+            # A metadata change removed this key and a later change re-added
+            # it before GC drained the elem: revive it, otherwise collect()
+            # drops it from the list and cached Entry references write into
+            # an orphan that never flushes.
+            e.tombstoned = False
         return e
 
     def __len__(self):

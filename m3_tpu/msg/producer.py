@@ -243,9 +243,21 @@ class Producer:
             msg = _Message(mid, shard, value, refs=len(self._service_writers))
             self._order[mid] = msg
             self._buffered_bytes += msg.size
-        self._enforce_buffer()
         for w in self._service_writers:
             w.write(msg)
+        # Enforce after the writes: if this (or any) message is evicted by
+        # drop-oldest, _enforce_buffer forgets it from every writer queue as
+        # well, so an over-cap message is not retried-until-acked and the
+        # memory bound holds.
+        self._enforce_buffer()
+        # The writes above run outside the lock, so a concurrent publisher's
+        # _enforce_buffer may have evicted-and-forgotten this id before the
+        # writes landed; if so, forget the now-untracked copies.
+        with self._lock:
+            evicted = mid not in self._order
+        if evicted:
+            for w in self._service_writers:
+                w.forget(mid)
         return mid
 
     def _message_acked(self, msg: _Message):

@@ -8,7 +8,10 @@ from __future__ import annotations
 import struct
 from typing import Dict, Iterator, Tuple
 
-HEADER_MAGIC = 0x4C56  # matches the reference's header marker (encoder.go)
+# Same u16 header marker value as the reference (encoder.go
+# headerMagicNumber = 10101); the surrounding format is reference-shaped
+# (little-endian u16 lengths), not byte-for-byte identical.
+HEADER_MAGIC = 10101
 _U16 = struct.Struct("<H")
 
 MAX_TAGS = 0xFFFF

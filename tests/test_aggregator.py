@@ -373,3 +373,74 @@ class TestBatchedReduceParity:
             assert qrow[0.5] == pytest.approx(s[max(1, int(np.ceil(0.5 * len(b)))) - 1])
             if len(b) > 1:
                 assert srow["m2"] == pytest.approx(((b - b.mean()) ** 2).sum(), rel=1e-6)
+
+
+class TestLeaderPromotionStaleWindows:
+    def test_promoted_leader_discards_windows_old_leader_flushed(self):
+        """Regression (ADVICE r1): a follower that had NOT yet discarded its
+        closed windows must not re-emit them on promotion when the KV flush
+        times show the old leader already flushed those window starts."""
+        store = cluster_kv.MemStore()
+        clock = SettableClock(100 * S)
+        cap_a, cap_b = CaptureHandler(), CaptureHandler()
+
+        def mk(instance_id, cap):
+            leader = LeaderService(store, "agg-election", instance_id,
+                                   lease_ttl_ns=30 * S, clock=clock)
+            return (make_agg(clock, flush_handler=cap,
+                             election=ElectionManager(leader),
+                             flush_times=FlushTimesManager(store, "ss")),
+                    leader)
+
+        agg_a, lead_a = mk("a", cap_a)
+        agg_b, _ = mk("b", cap_b)
+        mid = b"failover_metric"
+        md = meta(PipelineMetadata(0, (TEN_S,)))
+        for i in range(3):
+            agg_a.add_untimed(MetricUnion.counter(mid, 1), md)
+            agg_b.add_untimed(MetricUnion.counter(mid, 1), md)
+            clock.advance(10 * S)
+            agg_a.flush()  # leader flushes; B never runs a follower pass
+        assert len(cap_a.by_id(mid)) == 3
+
+        # A dies; B is promoted while still holding all 3 closed windows.
+        agg_a._election.resign()
+        clock.advance(31 * S)
+        agg_b.add_untimed(MetricUnion.counter(mid, 1), md)
+        clock.advance(10 * S)
+        agg_b.flush()
+        assert agg_b._election.state == ElectionState.LEADER
+        emitted = cap_b.by_id(mid)
+        old_times = {m.time_nanos for m in cap_a.by_id(mid)}
+        assert all(m.time_nanos not in old_times for m in emitted)
+        assert len(emitted) == 1  # only the post-failover window
+
+
+class TestTombstoneRevive:
+    def test_readded_key_revives_tombstoned_elem(self):
+        """Regression (ADVICE r1): metadata change removes a policy, a later
+        change re-adds it before the list GCs the elem — samples must land in
+        a live (revived) elem, not an orphan collect() silently drops."""
+        clock = SettableClock(600 * S)
+        agg = make_agg(clock)
+        mid = b"revive_metric"
+        md_both = meta(PipelineMetadata(0, (TEN_S, ONE_M,)))
+        md_one = meta(PipelineMetadata(0, (ONE_M,)))
+        agg.add_untimed(MetricUnion.counter(mid, 1), md_both)
+        # Remove the 10s policy (tombstones its elem in the list), then
+        # re-add it before any flush ran a GC pass.
+        agg.add_untimed(MetricUnion.counter(mid, 1), md_one)
+        agg.add_untimed(MetricUnion.counter(mid, 1), md_both)
+        clock.advance(10 * S)
+        agg.flush()
+        ten_s = [m for m in agg._flush_handler.by_id(mid)
+                 if m.storage_policy == TEN_S]
+        assert len(ten_s) == 1
+        # Another window keeps flowing through the revived elem.
+        agg.add_untimed(MetricUnion.counter(mid, 5), md_both)
+        clock.advance(10 * S)
+        agg.flush()
+        ten_s = [m for m in agg._flush_handler.by_id(mid)
+                 if m.storage_policy == TEN_S]
+        assert len(ten_s) == 2
+        assert ten_s[-1].value == 5.0
