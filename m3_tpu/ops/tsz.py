@@ -37,14 +37,16 @@ from .bits64 import U32
 
 I32 = jnp.int32
 
-HEADER_BITS = 1 + 3 + 64 + 64  # mode, k, t0, v0
-# Worst case per point: ts '1111'+32 = 36 bits, float rewrite 2+6+6+64 = 78.
-MAX_POINT_BITS = 36 + 78
+# v2 header worst case: 8 flag bits + t0 (64) in slot 0; delta0 (32) + v0
+# (64) in slot 1 (see ref_codec module docstring for the layout).
+HEADER_MAX_BITS = (8 + 64) + (32 + 64)
+# Worst case per point: ts '1111111'+32 = 39 bits, float rewrite 3+6+6+64 = 79.
+MAX_POINT_BITS = 39 + 79
 
 
 def max_words_for(window: int) -> int:
     """Conservative packed-words bound for a block of `window` points."""
-    bits = HEADER_BITS + max(window - 1, 0) * MAX_POINT_BITS
+    bits = HEADER_MAX_BITS + max(window - 1, 0) * MAX_POINT_BITS
     return (bits + 31) // 32 + 1
 
 
@@ -99,14 +101,24 @@ def _append_u32(chunk, cn, value, vbits):
 
 
 def _ts_chunks(dod, valid):
-    """Timestamp DoD chunks for columns >= 1. dod, valid: [N, W]."""
+    """Timestamp DoD chunks for columns >= 1. dod, valid: [N, W].
+
+    v2 buckets: '0' | '10'+4 | '110'+7 | '1110'+9 | '11110'+12 |
+    '111110'+16 | '1111110'+20 | '1111111'+32 (two's complement payloads).
+    """
     z = dod == 0
+    f4 = (dod >= -8) & (dod < 8)
     f7 = (dod >= -64) & (dod < 64)
     f9 = (dod >= -256) & (dod < 256)
     f12 = (dod >= -2048) & (dod < 2048)
-    ctrl = jnp.where(z, 0, jnp.where(f7, 0b10, jnp.where(f9, 0b110, jnp.where(f12, 0b1110, 0b1111))))
-    ctrl_len = jnp.where(z, 1, jnp.where(f7, 2, jnp.where(f9, 3, 4)))
-    pay_len = jnp.where(z, 0, jnp.where(f7, 7, jnp.where(f9, 9, jnp.where(f12, 12, 32))))
+    f16 = (dod >= -(1 << 15)) & (dod < (1 << 15))
+    f20 = (dod >= -(1 << 19)) & (dod < (1 << 19))
+    sel = lambda vals: jnp.where(z, vals[0], jnp.where(f4, vals[1], jnp.where(
+        f7, vals[2], jnp.where(f9, vals[3], jnp.where(f12, vals[4], jnp.where(
+            f16, vals[5], jnp.where(f20, vals[6], vals[7])))))))
+    ctrl = sel((0, 0b10, 0b110, 0b1110, 0b11110, 0b111110, 0b1111110, 0b1111111))
+    ctrl_len = sel((1, 2, 3, 4, 5, 6, 7, 7))
+    pay_len = sel((0, 4, 7, 9, 12, 16, 20, 32))
     vmask = valid.astype(I32)
     chunk, cn = chunk_empty(dod.shape)
     chunk, cn = _append_u32(chunk, cn, ctrl.astype(U32), ctrl_len * vmask)
@@ -115,16 +127,24 @@ def _ts_chunks(dod, valid):
 
 
 def _int_value_chunks(zz, valid):
-    """Int-mode zigzag(vdod) chunks. zz: u32 pair [N, W]."""
+    """Int-mode zigzag(vdod) chunks. zz: u32 pair [N, W].
+
+    v2 buckets: '0' | '10'+4 | '110'+7 | '1110'+12 | '11110'+20 |
+    '111110'+32 | '111111'+64.
+    """
     blen = b64.bitlen64(zz)
     z = blen == 0
+    f4 = blen <= 4
     f7 = blen <= 7
     f12 = blen <= 12
     f20 = blen <= 20
     f32 = blen <= 32
-    ctrl = jnp.where(z, 0, jnp.where(f7, 0b10, jnp.where(f12, 0b110, jnp.where(f20, 0b1110, jnp.where(f32, 0b11110, 0b11111)))))
-    ctrl_len = jnp.where(z, 1, jnp.where(f7, 2, jnp.where(f12, 3, jnp.where(f20, 4, 5))))
-    pay_len = jnp.where(z, 0, jnp.where(f7, 7, jnp.where(f12, 12, jnp.where(f20, 20, jnp.where(f32, 32, 64)))))
+    sel = lambda vals: jnp.where(z, vals[0], jnp.where(f4, vals[1], jnp.where(
+        f7, vals[2], jnp.where(f12, vals[3], jnp.where(f20, vals[4], jnp.where(
+            f32, vals[5], vals[6]))))))
+    ctrl = sel((0, 0b10, 0b110, 0b1110, 0b11110, 0b111110, 0b111111))
+    ctrl_len = sel((1, 2, 3, 4, 5, 6, 6))
+    pay_len = sel((0, 4, 7, 12, 20, 32, 64))
     vmask = valid.astype(I32)
     chunk, cn = chunk_empty(blen.shape)
     chunk, cn = _append_u32(chunk, cn, ctrl.astype(U32), ctrl_len * vmask)
@@ -133,45 +153,63 @@ def _int_value_chunks(zz, valid):
 
 
 def _float_window_scan(xor_hi, xor_lo, valid):
-    """Sequential Gorilla window state over the point axis.
+    """Sequential two-window state over the point axis (window A = latest
+    rewrite, window B = the one before; see ref_codec float-mode docs).
 
-    Inputs [N, W] (column 0 ignored). Returns per-column (reuse, rewrite,
-    xor0, lead_used, mlen_used, trail_shift) with the window state threaded.
+    Inputs [N, W] (column 0 ignored). Returns per-column (use_a, use_b,
+    rewrite, lead_used, mlen_used, trail_shift) with windows threaded.
     """
     lz = b64.clz64((xor_hi, xor_lo))
     tz = b64.ctz64((xor_hi, xor_lo))
     xor0 = (xor_hi | xor_lo) == 0
+    inf = I32(1 << 20)
 
     def step(carry, xs):
-        lead, mlen = carry
+        la, ma, lb, mb = carry
         lz_i, tz_i, xor0_i, valid_i = xs
-        trail_w = 64 - lead - mlen
-        reuse = (lead >= 0) & (lz_i >= lead) & (tz_i >= trail_w) & ~xor0_i & valid_i
-        rewrite = ~xor0_i & ~reuse & valid_i
-        lead_used = jnp.where(reuse, lead, lz_i)
-        mlen_used = jnp.where(reuse, mlen, 64 - lz_i - tz_i)
-        shift = jnp.where(reuse, trail_w, tz_i)
-        lead_n = jnp.where(rewrite, lz_i, lead)
-        mlen_n = jnp.where(rewrite, 64 - lz_i - tz_i, mlen)
-        return (lead_n, mlen_n), (reuse, rewrite, lead_used, mlen_used, shift)
+        tight = 64 - lz_i - tz_i
+        fits_a = (la >= 0) & (lz_i >= la) & (tz_i >= 64 - la - ma)
+        fits_b = (lb >= 0) & (lz_i >= lb) & (tz_i >= 64 - lb - mb)
+        cost_a = jnp.where(fits_a, 2 + ma, inf)
+        cost_b = jnp.where(fits_b, 3 + mb, inf)
+        reuse_cost = jnp.minimum(cost_a, cost_b)
+        live = ~xor0_i & valid_i
+        # Policy must match ref_codec exactly: rewrite when nothing fits or
+        # the cheapest window wastes > REWRITE_THRESHOLD bits vs tight.
+        rewrite = live & ((reuse_cost >= inf) | (reuse_cost - (2 + tight) > 8))
+        use_a = live & ~rewrite & (cost_a <= cost_b)
+        use_b = live & ~rewrite & ~use_a
+        lead_used = jnp.where(rewrite, lz_i, jnp.where(use_a, la, lb))
+        mlen_used = jnp.where(rewrite, tight, jnp.where(use_a, ma, mb))
+        shift = 64 - lead_used - mlen_used
+        la2 = jnp.where(rewrite, lz_i, la)
+        ma2 = jnp.where(rewrite, tight, ma)
+        lb2 = jnp.where(rewrite, la, lb)
+        mb2 = jnp.where(rewrite, ma, mb)
+        return (la2, ma2, lb2, mb2), (use_a, use_b, rewrite, lead_used, mlen_used, shift)
 
     n = xor_hi.shape[0]
-    init = (jnp.full((n,), -1, I32), jnp.full((n,), -1, I32))
+    neg = jnp.full((n,), -1, I32)
+    init = (neg, neg, neg, neg)
     xs = (lz.T, tz.T, xor0.T, valid.T)
     _, outs = jax.lax.scan(step, init, xs)
-    reuse, rewrite, lead_used, mlen_used, shift = (o.T for o in outs)
-    return reuse, rewrite, xor0, lead_used, mlen_used, shift
+    use_a, use_b, rewrite, lead_used, mlen_used, shift = (o.T for o in outs)
+    return use_a, use_b, rewrite, xor0, lead_used, mlen_used, shift
 
 
 def _float_value_chunks(vhi, vlo, valid):
-    """Float-mode XOR chunks for columns >= 1. vhi/vlo: raw f64 bits [N, W]."""
+    """Float-mode XOR chunks for columns >= 1. vhi/vlo: raw f64 bits [N, W].
+
+    v2 ctrl: '0' zero-xor | '10' reuse A | '110' reuse B | '111' rewrite.
+    """
     xhi = vhi ^ jnp.roll(vhi, 1, axis=1)
     xlo = vlo ^ jnp.roll(vlo, 1, axis=1)
-    reuse, rewrite, xor0, lead_u, mlen_u, shift = _float_window_scan(xhi, xlo, valid)
+    use_a, use_b, rewrite, xor0, lead_u, mlen_u, shift = _float_window_scan(
+        xhi, xlo, valid)
     vmask = valid.astype(I32)
     emit0 = xor0 & valid  # '0' control bit
-    ctrl = jnp.where(emit0, 0, jnp.where(reuse, 0b10, 0b11))
-    ctrl_len = jnp.where(emit0, 1, 2) * vmask
+    ctrl = jnp.where(emit0, 0, jnp.where(use_a, 0b10, jnp.where(use_b, 0b110, 0b111)))
+    ctrl_len = jnp.where(emit0, 1, jnp.where(use_a, 2, 3)) * vmask
     payload = b64.shr64((xhi, xlo), shift.astype(U32))
     chunk, cn = chunk_empty(vhi.shape)
     chunk, cn = _append_u32(chunk, cn, ctrl.astype(U32), ctrl_len)
@@ -182,8 +220,9 @@ def _float_value_chunks(vhi, vlo, valid):
 
 
 @functools.partial(jax.jit, static_argnames=("max_words",))
-def encode_batch(dt, t0, vhi, vlo, int_mode, k, npoints, *, max_words):
-    """Encode a batch of series blocks.
+def encode_batch(dt, t0, vhi, vlo, int_mode, k, npoints, ts_regular=None,
+                 delta0=None, *, max_words):
+    """Encode a batch of series blocks (wire format v2, see ref_codec).
 
     Args:
       dt: int32 [N, W] timestamp deltas, dt[:, 0] == 0.
@@ -192,6 +231,9 @@ def encode_batch(dt, t0, vhi, vlo, int_mode, k, npoints, *, max_words):
         complement int64 of m = rint(v * 10^k) (int mode).
       int_mode: bool [N]; k: int32 [N] decimal exponent.
       npoints: int32 [N] valid points per series (>= 1).
+      ts_regular: bool [N] — every valid delta equals delta0, so per-point
+        timestamp codes are omitted (None -> computed here).
+      delta0: int32 [N] — dt[:, 1] where npoints > 1 else 0 (None -> computed).
       max_words: static output row width in u32 words.
 
     Returns: (words u32 [N, max_words], nbits int32 [N]).
@@ -200,9 +242,14 @@ def encode_batch(dt, t0, vhi, vlo, int_mode, k, npoints, *, max_words):
     cols = jnp.arange(w, dtype=I32)[None, :]
     valid = (cols < npoints[:, None]) & (cols >= 1)
 
-    # Timestamp chunks.
+    if delta0 is None:
+        delta0 = jnp.where(npoints > 1, dt[:, 1] if w > 1 else 0, 0).astype(I32)
+    if ts_regular is None:
+        ts_regular = jnp.where(valid, dt == delta0[:, None], True).all(axis=1)
+
+    # Timestamp chunks (suppressed entirely for regular series).
     dod = dt - jnp.roll(dt, 1, axis=1)
-    ts_chunk, ts_bits = _ts_chunks(dod, valid)
+    ts_chunk, ts_bits = _ts_chunks(dod, valid & ~ts_regular[:, None])
 
     # Int-mode value chunks: vdod of m.
     m = (vhi, vlo)
@@ -222,13 +269,30 @@ def encode_batch(dt, t0, vhi, vlo, int_mode, k, npoints, *, max_words):
     val_chunk = tuple(jnp.where(im, ic, fc) for ic, fc in zip(int_chunk, flt_chunk))
     val_bits = jnp.where(im, int_bits, flt_bits)
 
-    # Header chunks in slots 0 (ts stream) and 1 (value stream) of column 0.
+    # Header chunks in slots 0 (ts stream) and 1 (value stream) of column 0:
+    # slot 0 = 8 flag bits + t0, slot 1 = [delta0] + v0 (ref_codec layout).
+    ones = jnp.ones((n,), I32)
+    t0zz = b64.zigzag64(t0)
+    t0c = (t0zz[0] != 0).astype(I32)
+    dzz = b64.zigzag64(b64.i32_to_pair(delta0))
+    dc = (ts_regular & (dzz[1] >= 256)).astype(I32)
+    m0zz = b64.zigzag64((vhi[:, 0], vlo[:, 0]))
+    vc = (int_mode & (m0zz[0] != 0)).astype(I32)
+    imode = int_mode.astype(U32)
+    flags = (
+        (imode << 7) | (k.astype(U32) << 4) | (ts_regular.astype(U32) << 3)
+        | (t0c.astype(U32) << 2) | (vc.astype(U32) << 1) | dc.astype(U32)
+    )
     hdr0, hn0 = chunk_empty((n,))
-    hdr0, hn0 = _append_u32(hdr0, hn0, int_mode.astype(U32), jnp.full((n,), 1, I32))
-    hdr0, hn0 = _append_u32(hdr0, hn0, k.astype(U32), jnp.full((n,), 3, I32))
-    hdr0, hn0 = chunk_append(hdr0, hn0, t0, jnp.full((n,), 64, I32))
+    hdr0, hn0 = _append_u32(hdr0, hn0, flags, 8 * ones)
+    hdr0, hn0 = chunk_append(hdr0, hn0, t0zz, 32 + 32 * t0c)
     hdr1, hn1 = chunk_empty((n,))
-    hdr1, hn1 = chunk_append(hdr1, hn1, (vhi[:, 0], vlo[:, 0]), jnp.full((n,), 64, I32))
+    hdr1, hn1 = chunk_append(
+        hdr1, hn1, dzz, ts_regular.astype(I32) * (8 + 24 * dc))
+    v0pair = tuple(jnp.where(int_mode, a, b)
+                   for a, b in zip(m0zz, (vhi[:, 0], vlo[:, 0])))
+    v0bits = jnp.where(int_mode, 32 + 32 * vc, 64)
+    hdr1, hn1 = chunk_append(hdr1, hn1, v0pair, v0bits)
 
     # Interleave into slot arrays [N, 2W]: slot 2i = ts chunk of point i,
     # slot 2i+1 = value chunk (point 0 slots carry the header).
@@ -304,64 +368,84 @@ def decode_batch(words, npoints, *, window):
     """
     n = words.shape[0]
     zero = jnp.zeros((n,), I32)
-    int_mode = (_read32(words, zero) >> 31) == 1
-    kexp = ((_read32(words, zero) >> 28) & 7).astype(I32)
-    t0 = _read64(words, zero + 4)
-    v0 = _read64(words, zero + 68)
-    pos0 = zero + HEADER_BITS
+    b0 = _read32(words, zero)
+    int_mode = (b0 >> 31) == 1
+    kexp = ((b0 >> 28) & 7).astype(I32)
+    ts_regular = ((b0 >> 27) & 1) == 1
+    t0c = ((b0 >> 26) & 1).astype(I32)
+    vc = ((b0 >> 25) & 1).astype(I32)
+    dc = ((b0 >> 24) & 1).astype(I32)
+    nt0 = 32 + 32 * t0c
+    t0 = b64.unzigzag64(
+        b64.shr64(_read64(words, zero + 8), (64 - nt0).astype(U32)))
+    pos = zero + 8 + nt0
+    nd = jnp.where(ts_regular, 8 + 24 * dc, 0)
+    dzz = b64.shr64(_read64(words, pos), (64 - nd).astype(U32))
+    delta0 = jnp.where(ts_regular, b64.pair_to_i32(b64.unzigzag64(dzz)), 0)
+    pos = pos + nd
+    nv = jnp.where(int_mode, 32 + 32 * vc, 64)
+    vraw = b64.shr64(_read64(words, pos), (64 - nv).astype(U32))
+    v0un = b64.unzigzag64(vraw)
+    v0 = tuple(jnp.where(int_mode, a, b) for a, b in zip(v0un, vraw))
+    pos0 = pos + nv
+
+    ts_payload = jnp.array([0, 4, 7, 9, 12, 16, 20, 32], I32)
+    int_payload = jnp.array([0, 4, 7, 12, 20, 32, 64], I32)
 
     def step(carry, i):
-        pos, prev_delta, pvd_hi, pvd_lo, pv_hi, pv_lo, lead, mlen = carry
+        (pos, prev_delta, pvd_hi, pvd_lo, pv_hi, pv_lo,
+         la, ma, lb, mb) = carry
 
-        # --- timestamp ---
+        # --- timestamp: leading-ones prefix selects the payload width ---
         cw = _read32(words, pos)
-        top4 = cw >> 28
-        is0 = top4 < 8
-        f7 = (top4 >= 8) & (top4 < 12)
-        f9 = (top4 >= 12) & (top4 < 14)
-        f12 = top4 == 14
-        plen = jnp.where(f7, 2, jnp.where(f9, 3, 4))
-        nbits = jnp.where(f7, 7, jnp.where(f9, 9, jnp.where(f12, 12, 32)))
+        ones_t = jnp.minimum(b64.clz32(~cw), 7)
+        is0 = ones_t == 0
+        plen = jnp.where(is0, 1, jnp.where(ones_t <= 5, ones_t + 1, 7))
+        nbits = jnp.take(ts_payload, ones_t)
         pw = _read32(words, pos + plen)
         pay = _shr32(pw, (U32(32) - nbits.astype(U32)))
-        dod = jnp.where(is0, 0, _sext(pay, nbits))
+        dod = jnp.where(is0 | ts_regular, 0, _sext(pay, jnp.maximum(nbits, 1)))
         delta = prev_delta + dod
-        pos1 = pos + jnp.where(is0, 1, plen + nbits)
+        pos1 = pos + jnp.where(ts_regular, 0, jnp.where(is0, 1, plen + nbits))
 
-        # --- value: float path ---
+        # --- value: float path ('0' | '10' A | '110' B | '111' rewrite) ---
         cf = _read32(words, pos1)
-        ftop2 = cf >> 30
-        fxor0 = ftop2 < 2
-        freuse = ftop2 == 2
-        # reuse: payload mlen bits at pos1+2, shifted back by window trail
-        trail_w = 64 - lead - mlen
-        p64r = _read64(words, pos1 + 2)
-        xor_r = b64.shl64(b64.shr64(p64r, (64 - mlen).astype(U32)), trail_w.astype(U32))
-        # rewrite: lead(6) mlen-1(6) payload
-        lead_n = ((cf >> 24) & 63).astype(I32)
-        mlen_n = (((cf >> 18) & 63) + 1).astype(I32)
-        p64w = _read64(words, pos1 + 14)
+        fxor0 = (cf >> 31) == 0
+        fa = (cf >> 30) == 0b10
+        fb = (cf >> 29) == 0b110
+        frw = ~fxor0 & ~fa & ~fb
+        # reuse A: payload mlenA bits at pos1+2; reuse B: mlenB at pos1+3.
+        p64a = _read64(words, pos1 + 2)
+        xor_a = b64.shl64(
+            b64.shr64(p64a, (64 - ma).astype(U32)), (64 - la - ma).astype(U32))
+        p64b = _read64(words, pos1 + 3)
+        xor_b = b64.shl64(
+            b64.shr64(p64b, (64 - mb).astype(U32)), (64 - lb - mb).astype(U32))
+        # rewrite: lead(6) mlen-1(6) payload at pos1+15
+        lead_n = ((cf >> 23) & 63).astype(I32)
+        mlen_n = (((cf >> 17) & 63) + 1).astype(I32)
+        p64w = _read64(words, pos1 + 15)
         xor_w = b64.shl64(
             b64.shr64(p64w, (64 - mlen_n).astype(U32)), (64 - lead_n - mlen_n).astype(U32)
         )
         xor = tuple(
-            jnp.where(fxor0, 0, jnp.where(freuse, r, w_)) for r, w_ in zip(xor_r, xor_w)
+            jnp.where(fxor0, 0, jnp.where(fa, a, jnp.where(fb, b_, w_)))
+            for a, b_, w_ in zip(xor_a, xor_b, xor_w)
         )
         fval = b64.xor64((pv_hi, pv_lo), xor)
-        fconsumed = jnp.where(fxor0, 1, jnp.where(freuse, 2 + mlen, 14 + mlen_n))
-        lead2 = jnp.where(~fxor0 & ~freuse, lead_n, lead)
-        mlen2 = jnp.where(~fxor0 & ~freuse, mlen_n, mlen)
+        fconsumed = jnp.where(
+            fxor0, 1, jnp.where(fa, 2 + ma, jnp.where(fb, 3 + mb, 15 + mlen_n)))
+        la2 = jnp.where(frw, lead_n, la)
+        ma2 = jnp.where(frw, mlen_n, ma)
+        lb2 = jnp.where(frw, la, lb)
+        mb2 = jnp.where(frw, ma, mb)
 
-        # --- value: int path ---
+        # --- value: int path (leading-ones prefix, v2 buckets) ---
         ci = _read32(words, pos1)
-        top5 = ci >> 27
-        iz = top5 < 16
-        i7 = (top5 >= 16) & (top5 < 24)
-        i12 = (top5 >= 24) & (top5 < 28)
-        i20 = (top5 >= 28) & (top5 < 30)
-        i32b = top5 == 30
-        iplen = jnp.where(i7, 2, jnp.where(i12, 3, jnp.where(i20, 4, 5)))
-        inb = jnp.where(i7, 7, jnp.where(i12, 12, jnp.where(i20, 20, jnp.where(i32b, 32, 64))))
+        ones_i = jnp.minimum(b64.clz32(~ci), 6)
+        iz = ones_i == 0
+        iplen = jnp.where(iz, 1, jnp.where(ones_i <= 4, ones_i + 1, 6))
+        inb = jnp.take(int_payload, ones_i)
         p64i = _read64(words, pos1 + iplen)
         zz = b64.shr64(p64i, (64 - inb).astype(U32))
         vdod = b64.unzigzag64(zz)
@@ -379,19 +463,24 @@ def decode_batch(words, npoints, *, window):
         val = tuple(jnp.where(active, v, p) for v, p in zip(val, (pv_hi, pv_lo)))
         prev_delta2 = jnp.where(active, delta, prev_delta)
         nvd = tuple(jnp.where(active & int_mode, x, p) for x, p in zip(nvd, (pvd_hi, pvd_lo)))
-        lead2 = jnp.where(active, lead2, lead)
-        mlen2 = jnp.where(active, mlen2, mlen)
+        la2 = jnp.where(active, la2, la)
+        ma2 = jnp.where(active, ma2, ma)
+        lb2 = jnp.where(active, lb2, lb)
+        mb2 = jnp.where(active, mb2, mb)
 
-        carry2 = (pos2, prev_delta2, nvd[0], nvd[1], val[0], val[1], lead2, mlen2)
+        carry2 = (pos2, prev_delta2, nvd[0], nvd[1], val[0], val[1],
+                  la2, ma2, lb2, mb2)
         return carry2, (delta_o, val[0], val[1])
 
     init = (
         pos0,
-        zero,
+        jnp.where(ts_regular, delta0, zero),
         jnp.zeros((n,), U32),
         jnp.zeros((n,), U32),
         v0[0],
         v0[1],
+        jnp.full((n,), -1, I32),
+        jnp.full((n,), -1, I32),
         jnp.full((n,), -1, I32),
         jnp.full((n,), -1, I32),
     )
@@ -451,6 +540,10 @@ def prepare_encode_inputs(timestamps: np.ndarray, values: np.ndarray, npoints: n
     bits = np.where(int_mode[:, None], mbits, fbits)
     vhi, vlo = b64.from_u64_np(bits)
     t0hi, t0lo = b64.from_u64_np(ts[:, 0])
+    w = ts.shape[1]
+    delta0 = (dt[:, 1] if w > 1 else np.zeros(len(dt), np.int32)) * (npts > 1)
+    cols1 = np.arange(w)[None, :] >= 1
+    ts_regular = np.where(valid & cols1, dt == delta0[:, None], True).all(axis=1)
     return dict(
         dt=dt,
         t0=(t0hi, t0lo),
@@ -459,6 +552,8 @@ def prepare_encode_inputs(timestamps: np.ndarray, values: np.ndarray, npoints: n
         int_mode=int_mode,
         k=k.astype(np.int32),
         npoints=npts,
+        ts_regular=ts_regular,
+        delta0=delta0.astype(np.int32),
     )
 
 
@@ -478,6 +573,8 @@ def encode(timestamps: np.ndarray, values: np.ndarray, npoints=None, max_words: 
         inp["int_mode"],
         inp["k"],
         inp["npoints"],
+        inp["ts_regular"],
+        inp["delta0"],
         max_words=max_words,
     )
     if max_words < max_words_for(ts.shape[1]) and int(jnp.max(nbits)) > 32 * max_words:

@@ -56,6 +56,8 @@ class IngestBatch(NamedTuple):
     int_mode: jax.Array  # bool [...]
     k: jax.Array         # int32 [...] decimal exponent
     npoints: jax.Array   # int32 [...] valid points
+    ts_regular: jax.Array  # bool [...] all deltas equal delta0
+    delta0: jax.Array    # int32 [...] common scrape interval (ticks)
     values: jax.Array    # f32 [..., W] raw values for aggregation
 
 
@@ -73,6 +75,8 @@ def ingest_step(batch: IngestBatch, *, rollup_factor: int, max_words: int, quant
         batch.int_mode,
         batch.k,
         batch.npoints,
+        batch.ts_regular,
+        batch.delta0,
         max_words=max_words,
     )
     w = batch.values.shape[-1]
@@ -112,10 +116,13 @@ def make_sharded_ingest(mesh: Mesh, *, rollup_factor: int, max_words: int, quant
     per_series = P("time", "shard")
     merged = P("shard")
 
-    def local_step(dt, t0_hi, t0_lo, vhi, vlo, int_mode, k, npoints, values):
+    def local_step(dt, t0_hi, t0_lo, vhi, vlo, int_mode, k, npoints,
+                   ts_regular, delta0, values):
         # Each device sees [1, N_local, W_chunk]: its own block of its shard.
         squeeze = lambda a: a.reshape(a.shape[1:])
-        batch = IngestBatch(*(squeeze(a) for a in (dt, t0_hi, t0_lo, vhi, vlo, int_mode, k, npoints, values)))
+        batch = IngestBatch(*(squeeze(a) for a in (
+            dt, t0_hi, t0_lo, vhi, vlo, int_mode, k, npoints, ts_regular,
+            delta0, values)))
         words, nbits, roll, blk, qtl = ingest_step(
             batch, rollup_factor=rollup_factor, max_words=max_words, quantile_qs=quantile_qs
         )
@@ -163,7 +170,8 @@ def make_sharded_ingest(mesh: Mesh, *, rollup_factor: int, max_words: int, quant
     fn = jax.shard_map(
         local_step,
         mesh=mesh,
-        in_specs=(chunk, per_series, per_series, chunk, chunk, per_series, per_series, per_series, chunk),
+        in_specs=(chunk, per_series, per_series, chunk, chunk, per_series,
+                  per_series, per_series, per_series, per_series, chunk),
         out_specs=(chunk, per_series, chunk, chunk, merged, P()),
         check_vma=False,
     )
@@ -206,6 +214,8 @@ def make_example_batch(n: int, w: int, rng: np.random.Generator, *, chunks: int 
             int_mode=inp["int_mode"],
             k=inp["k"],
             npoints=inp["npoints"],
+            ts_regular=inp["ts_regular"],
+            delta0=inp["delta0"],
             values=v2.astype(np.float32),
         )
 
@@ -221,6 +231,7 @@ def shard_batch(batch: IngestBatch, mesh: Mesh) -> IngestBatch:
     per_series = NamedSharding(mesh, P("time", "shard"))
     specs = IngestBatch(
         dt=chunk, t0_hi=per_series, t0_lo=per_series, vhi=chunk, vlo=chunk,
-        int_mode=per_series, k=per_series, npoints=per_series, values=chunk,
+        int_mode=per_series, k=per_series, npoints=per_series,
+        ts_regular=per_series, delta0=per_series, values=chunk,
     )
     return IngestBatch(*(jax.device_put(a, s) for a, s in zip(batch, specs)))
