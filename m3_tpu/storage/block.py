@@ -126,28 +126,43 @@ def encode_block(block_start: int, series_indices, tdense, vdense, npoints,
 
 class WiredList:
     """Capacity-bounded LRU over blocks paged in from disk
-    (block/wired_list.go:77): evicts least-recently-read whole blocks."""
+    (block/wired_list.go:77): evicts least-recently-read whole blocks.
+    Thread-safe — serving threads share one list."""
 
     def __init__(self, max_bytes: int = 1 << 30):
+        import threading
+
         self.max_bytes = max_bytes
         self._items: "OrderedDict[Tuple, SealedBlock]" = OrderedDict()
         self._bytes = 0
+        self._lock = threading.Lock()
 
     def get(self, key) -> Optional[SealedBlock]:
-        blk = self._items.get(key)
-        if blk is not None:
-            self._items.move_to_end(key)
-        return blk
+        with self._lock:
+            blk = self._items.get(key)
+            if blk is not None:
+                self._items.move_to_end(key)
+            return blk
 
     def put(self, key, blk: SealedBlock):
-        if key in self._items:
-            self._items.move_to_end(key)
-            return
-        self._items[key] = blk
-        self._bytes += blk.nbytes()
-        while self._bytes > self.max_bytes and len(self._items) > 1:
-            _, old = self._items.popitem(last=False)
-            self._bytes -= old.nbytes()
+        with self._lock:
+            if key in self._items:
+                self._items.move_to_end(key)
+                return
+            self._items[key] = blk
+            self._bytes += blk.nbytes()
+            while self._bytes > self.max_bytes and len(self._items) > 1:
+                _, old = self._items.popitem(last=False)
+                self._bytes -= old.nbytes()
+
+    def drop(self, pred) -> int:
+        """Remove entries whose key matches `pred` (fileset invalidation)."""
+        with self._lock:
+            doomed = [k for k in self._items if pred(k)]
+            for k in doomed:
+                self._bytes -= self._items.pop(k).nbytes()
+            return len(doomed)
 
     def __len__(self):
-        return len(self._items)
+        with self._lock:
+            return len(self._items)

@@ -17,11 +17,14 @@ from .namespace import Namespace, NamespaceOptions
 
 
 class Database:
-    def __init__(self, shard_set, commitlog=None, clock: Callable[[], int] = None):
-        """shard_set: m3_tpu.sharding.ShardSet; commitlog: persist.CommitLog."""
+    def __init__(self, shard_set, commitlog=None, clock: Callable[[], int] = None,
+                 retriever=None):
+        """shard_set: m3_tpu.sharding.ShardSet; commitlog: persist.CommitLog;
+        retriever: storage.retriever.BlockRetriever for disk-backed reads."""
         self.shard_set = shard_set
         self.commitlog = commitlog
         self.clock = clock or (lambda: time.time_ns())
+        self.retriever = retriever
         self.namespaces: Dict[bytes, Namespace] = {}
         self._bootstrapped = False
 
@@ -31,9 +34,17 @@ class Database:
                          index=None) -> Namespace:
         if name in self.namespaces:
             raise ValueError(f"namespace {name!r} already exists")
-        ns = Namespace(name, opts, self.shard_set.all_shard_ids(), index=index)
+        ns = Namespace(name, opts, self.shard_set.all_shard_ids(), index=index,
+                       retriever=self.retriever)
         self.namespaces[name] = ns
         return ns
+
+    def set_retriever(self, retriever):
+        """Attach a disk retriever (serving-path cold reads) to every
+        namespace, current and future."""
+        self.retriever = retriever
+        for ns in self.namespaces.values():
+            ns.set_retriever(retriever)
 
     def namespace(self, name: bytes) -> Namespace:
         ns = self.namespaces.get(name)
@@ -103,10 +114,14 @@ class Database:
         flushed = 0
         for ns in self.namespaces.values():
             for shard in ns.shards.values():
+                wrote = False
                 for bs in shard.flushable(now):
                     persist_manager.write_block(ns.name, shard.shard_id, shard.blocks[bs], shard.registry)
                     shard.mark_flushed(bs)
                     flushed += 1
+                    wrote = True
+                if wrote and self.retriever is not None:
+                    self.retriever.invalidate(ns.name, shard.shard_id)
             if ns.index is not None:
                 # Persist cold index blocks next to the data filesets
                 # (persist_manager.go:193-332 index segment persist).
@@ -118,6 +133,18 @@ class Database:
         if self.commitlog is not None and flushed:
             self.commitlog.rotate()
         return flushed
+
+    def evict_flushed(self) -> int:
+        """Drop in-memory copies of durably-flushed blocks; reads fall
+        through to the retriever. No-op without a retriever (evicting would
+        lose the only copy until retention expiry)."""
+        if self.retriever is None:
+            return 0
+        evicted = 0
+        for ns in self.namespaces.values():
+            for shard in ns.shards.values():
+                evicted += shard.evict_flushed()
+        return evicted
 
     def mark_bootstrapped(self):
         self._bootstrapped = True

@@ -79,10 +79,16 @@ class Mediator:
         for ns in self.db.namespaces.values():
             cutoff = now_ns - ns.opts.retention_ns
             for shard_id in ns.shards:
+                shard_removed = 0
                 for bs, path in self.persist.list_filesets(ns.name, shard_id):
                     if bs + ns.opts.block_size_ns <= cutoff:
                         shutil.rmtree(path, ignore_errors=True)
-                        removed += 1
+                        shard_removed += 1
+                if shard_removed and getattr(self.db, "retriever", None) is not None:
+                    # Cached listings/seekers/wired rows now point at deleted
+                    # directories — drop them before the next cold read.
+                    self.db.retriever.invalidate(ns.name, shard_id)
+                removed += shard_removed
                 snaps = self.persist.list_snapshots(ns.name, shard_id)
                 newest: Dict[int, int] = {}
                 for bs, version, _p in snaps:

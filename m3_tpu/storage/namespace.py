@@ -37,10 +37,11 @@ class NamespaceOptions:
 
 class Namespace:
     def __init__(self, name: bytes, opts: NamespaceOptions, shard_ids: Iterable[int],
-                 index=None):
+                 index=None, retriever=None):
         self.name = name
         self.opts = opts
         self.index = index  # m3_tpu.index.NamespaceIndex when indexing enabled
+        self.retriever = retriever  # storage.retriever.BlockRetriever
         self.shards: Dict[int, Shard] = {}
         for sid in shard_ids:
             self.assign_shard(sid)
@@ -50,8 +51,16 @@ class Namespace:
         if shard_id in self.shards:
             return self.shards[shard_id]
         sh = Shard(shard_id, self.opts.shard_options(), on_new_series=self._on_new_series, state=state)
+        if self.retriever is not None:
+            sh.attach_retriever(self.retriever, self.name)
         self.shards[shard_id] = sh
         return sh
+
+    def set_retriever(self, retriever):
+        """Bind a disk retriever to this namespace and all current shards."""
+        self.retriever = retriever
+        for sh in self.shards.values():
+            sh.attach_retriever(retriever, self.name)
 
     def remove_shard(self, shard_id: int):
         self.shards.pop(shard_id, None)

@@ -61,6 +61,13 @@ class Shard:
         # — the namespace wires this to reverse-index insertion
         # (shard.go:769 writeAndIndex's index hook).
         self.on_new_series = on_new_series
+        # Disk retriever for cold reads (block/retriever_manager.go hook);
+        # bound by Namespace.assign_shard when the database has one.
+        self._retriever = None
+        self._retriever_ns: Optional[bytes] = None
+        # Updated each tick; disk reads never serve past it even if cleanup
+        # hasn't deleted the fileset yet (None until the first tick).
+        self._retention_cutoff: Optional[int] = None
 
     # ------------------------------------------------------------------ write
 
@@ -107,34 +114,67 @@ class Shard:
                 self.flush_states.setdefault(bs, FlushState.NOT_STARTED)
                 sealed += 1
         cutoff = now_ns - self.opts.retention_ns
+        self._retention_cutoff = cutoff
         for bs in [b for b in self.blocks if b + self.opts.block_size_ns <= cutoff]:
             del self.blocks[bs]
-            self.flush_states.pop(bs, None)
             expired += 1
+        # Flush states expire with retention even for blocks already evicted
+        # from memory (else the dict grows one entry per block forever).
+        for bs in [b for b in self.flush_states
+                   if b + self.opts.block_size_ns <= cutoff]:
+            del self.flush_states[bs]
         return {"sealed": sealed, "expired": expired}
 
     # ------------------------------------------------------------------- read
 
+    def attach_retriever(self, retriever, namespace_name: bytes):
+        """Hook a BlockRetriever for cold reads (series.go ReadEncoded's
+        fall-through to the block retriever when a block isn't cached)."""
+        self._retriever = retriever
+        self._retriever_ns = namespace_name
+
     def read(self, series_id: bytes, start_ns: int, end_ns: int) -> Tuple[np.ndarray, np.ndarray]:
-        """Merged datapoints from sealed blocks + mutable buffer in [start, end)."""
+        """Merged datapoints from sealed blocks + mutable buffer + disk in
+        [start, end).
+
+        Block starts resident in memory are served from `self.blocks`; block
+        starts only on disk fall through to the retriever (seek + WiredList),
+        mirroring series.go:292 ReadEncoded -> buffer, cached blocks, then
+        the retriever for everything else."""
         idx = self.registry.get(series_id)
-        if idx is None:
-            return np.zeros(0, np.int64), np.zeros(0, np.float64)
         parts_t: List[np.ndarray] = []
         parts_v: List[np.ndarray] = []
-        for bs in sorted(self.blocks):
-            if bs + self.opts.block_size_ns <= start_ns or bs >= end_ns:
-                continue
-            got = self.blocks[bs].read(idx)
-            if got is not None:
-                t, v = got
-                keep = (t >= start_ns) & (t < end_ns)
-                parts_t.append(t[keep])
-                parts_v.append(v[keep])
-        bt, bv = self.buffer.read(idx, start_ns, end_ns)
-        if len(bt):
-            parts_t.append(bt)
-            parts_v.append(bv)
+
+        def overlaps(bs: int) -> bool:
+            return not (bs + self.opts.block_size_ns <= start_ns or bs >= end_ns)
+
+        def clip_append(got) -> None:
+            if got is None:
+                return
+            t, v = got
+            keep = (t >= start_ns) & (t < end_ns)
+            parts_t.append(t[keep])
+            parts_v.append(v[keep])
+
+        if idx is not None:
+            for bs in sorted(self.blocks):
+                if overlaps(bs):
+                    clip_append(self.blocks[bs].read(idx))
+        if self._retriever is not None:
+            on_disk = self._retriever.block_starts(self._retriever_ns, self.shard_id)
+            for bs in sorted(on_disk):
+                if bs in self.blocks or not overlaps(bs):
+                    continue
+                if (self._retention_cutoff is not None
+                        and bs + self.opts.block_size_ns <= self._retention_cutoff):
+                    continue  # past retention; cleanup just hasn't run yet
+                clip_append(self._retriever.retrieve(
+                    self._retriever_ns, self.shard_id, bs, series_id))
+        if idx is not None:
+            bt, bv = self.buffer.read(idx, start_ns, end_ns)
+            if len(bt):
+                parts_t.append(bt)
+                parts_v.append(bv)
         if not parts_t:
             return np.zeros(0, np.int64), np.zeros(0, np.float64)
         t = np.concatenate(parts_t)
@@ -153,6 +193,25 @@ class Shard:
 
     def mark_flushed(self, block_start: int, ok: bool = True):
         self.flush_states[block_start] = FlushState.SUCCESS if ok else FlushState.FAILED
+
+    def evict_flushed(self) -> int:
+        """Drop in-memory blocks whose fileset is durable; subsequent reads
+        go through the retriever (the CacheNone/LRU cache policies of
+        series/policy.go:32-48 — memory holds only what isn't yet on disk).
+
+        A block is only evicted when its fileset is actually present on
+        disk: load_block marks peer-bootstrapped blocks FlushState.SUCCESS
+        (they're durable on the *peer*), but locally the in-memory copy may
+        be the only one."""
+        if self._retriever is None:
+            return 0
+        on_disk = self._retriever.block_starts(self._retriever_ns, self.shard_id)
+        evicted = 0
+        for bs in [b for b, st in self.flush_states.items()
+                   if st == FlushState.SUCCESS and b in self.blocks and b in on_disk]:
+            del self.blocks[bs]
+            evicted += 1
+        return evicted
 
     def load_block(self, blk: SealedBlock, remap: Optional[np.ndarray] = None):
         """Install a bootstrapped/streamed block (bootstrap result merge).
