@@ -159,7 +159,7 @@ def main():
             json.dumps(
                 {
                     "metric": "m3tsz_encode_1m_rollup",
-                    "value": 0.0,
+                    "value": None,
                     "unit": "datapoints/sec",
                     "vs_baseline": None,
                     "error": "; ".join(errors),

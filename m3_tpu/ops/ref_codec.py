@@ -151,6 +151,11 @@ def detect_int_mode(values: np.ndarray) -> tuple[bool, int]:
     v = np.asarray(values, dtype=np.float64)
     if not np.isfinite(v).all():
         return False, 0
+    if np.any((v == 0.0) & np.signbit(v)):
+        # -0.0 would canonicalize to +0.0 through the integer path; float/XOR
+        # mode round-trips the raw sign bit, so force it to keep the exact
+        # float64 roundtrip invariant.
+        return False, 0
     for k in range(MAX_DECIMAL_EXP + 1):
         scale = np.float64(10.0**k)
         m = np.rint(v * scale)

@@ -34,6 +34,7 @@ import numpy as np
 
 from . import bits64 as b64
 from .bits64 import U32
+from .ref_codec import REWRITE_THRESHOLD
 
 I32 = jnp.int32
 
@@ -176,7 +177,9 @@ def _float_window_scan(xor_hi, xor_lo, valid):
         live = ~xor0_i & valid_i
         # Policy must match ref_codec exactly: rewrite when nothing fits or
         # the cheapest window wastes > REWRITE_THRESHOLD bits vs tight.
-        rewrite = live & ((reuse_cost >= inf) | (reuse_cost - (2 + tight) > 8))
+        rewrite = live & (
+            (reuse_cost >= inf)
+            | (reuse_cost - (2 + tight) > REWRITE_THRESHOLD))
         use_a = live & ~rewrite & (cost_a <= cost_b)
         use_b = live & ~rewrite & ~use_a
         lead_used = jnp.where(rewrite, lz_i, jnp.where(use_a, la, lb))
@@ -504,6 +507,10 @@ def detect_int_mode_batch(values: np.ndarray, npoints: np.ndarray):
     n, w = v.shape
     cols = np.arange(w)[None, :] < np.asarray(npoints)[:, None]
     finite = np.where(cols, np.isfinite(v), True).all(axis=1)
+    # -0.0 only survives the float/XOR path (int path canonicalizes it to
+    # +0.0), so its presence forces float mode — mirrors detect_int_mode.
+    no_negzero = ~(np.where(cols, (v == 0.0) & np.signbit(v), False).any(axis=1))
+    finite &= no_negzero
     best_k = np.full(n, -1, dtype=np.int32)
     for k in range(MAX_DECIMAL_EXP, -1, -1):
         scale = np.float64(10.0**k)

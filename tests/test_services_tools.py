@@ -92,7 +92,11 @@ class TestAggregatorNetworkPath:
             for i in range(8):
                 assert transport(MetricUnion.counter(b"net_metric", 1), md)
             transport.flush()
-            assert _await(lambda: agg.num_entries() == 1)
+            # Await all 8 frames (server bumps .frames only after handling a
+            # whole batch) — awaiting just num_entries()==1 raced the flush
+            # against writes 2..8 still being ingested.
+            assert _await(lambda: srv.frames >= 8)
+            assert agg.num_entries() == 1
             clock.advance(10 * S)
             agg.flush()
             out = cap.by_id(b"net_metric")

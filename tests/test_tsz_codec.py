@@ -42,12 +42,11 @@ def ref_encode_all(ts, vals, npoints):
 
 
 def assert_values_equal(a, b):
-    """Bitwise equality except int-mode may canonicalize -0.0 to 0.0."""
+    """Exact bitwise equality: -0.0 blocks are routed to float mode by
+    detect_int_mode, so even the sign of zero round-trips."""
     ab = np.asarray(a, np.float64).view(np.uint64)
     bb = np.asarray(b, np.float64).view(np.uint64)
-    eq = ab == bb
-    both_zero = (np.asarray(a) == 0) & (np.asarray(b) == 0)
-    assert (eq | both_zero).all()
+    assert (ab == bb).all()
 
 
 class TestScalarOracle:
@@ -145,6 +144,72 @@ class TestBatchedVsOracle:
         ts, vals = make_workload(rng, 2, 40)
         with pytest.raises(ValueError, match="max_words"):
             tsz.encode(ts, vals, max_words=4)
+
+    def test_negative_zero_roundtrips_exactly(self):
+        """-0.0 forces float mode (int path would canonicalize to +0.0)."""
+        ts = np.array([[100, 110, 120, 130]], dtype=np.int64)
+        vals = np.array([[1.0, -0.0, 2.0, -0.0]])
+        int_mode, _ = tsz.detect_int_mode_batch(vals, np.array([4], np.int32))
+        assert not int_mode[0]
+        assert rc.detect_int_mode(vals[0]) == (False, 0)
+        words, nbits = tsz.encode(ts, vals)
+        t2, v2 = tsz.decode(words, np.array([4], np.int32), 4)
+        assert np.array_equal(ts, t2)
+        assert_values_equal(vals, v2)
+        blk = rc.encode(ts[0], vals[0])
+        assert blk.nbits == int(np.asarray(nbits)[0])
+        _, v3 = rc.decode(blk)
+        assert_values_equal(vals[0], v3)
+
+    def _parity(self, ts, vals):
+        """Batched encode must be bit-exact vs oracle and roundtrip."""
+        ts = np.asarray(ts, np.int64)
+        vals = np.asarray(vals, np.float64)
+        n, w = ts.shape
+        npoints = np.full(n, w, dtype=np.int32)
+        words, nbits = tsz.encode(ts, vals, npoints)
+        words, nbits = np.asarray(words), np.asarray(nbits)
+        for i, blk in enumerate(ref_encode_all(ts, vals, npoints)):
+            assert nbits[i] == blk.nbits, f"series {i} nbits"
+            nw = (blk.nbits + 31) // 32
+            assert np.array_equal(words[i, :nw], blk.words), f"series {i}"
+        t2, v2 = tsz.decode(words, npoints, w)
+        assert np.array_equal(ts, t2)
+        assert_values_equal(vals, v2)
+
+    def test_wide_t0_64bit_header(self):
+        """t0 whose zigzag needs >32 bits selects the wide t0c path."""
+        big = np.int64(2**40)  # zigzag(2^40) >= 2^32 -> 64-bit t0 payload
+        ts = big + np.arange(5, dtype=np.int64)[None, :] * 10
+        vals = np.array([[1.0, 2.0, 3.0, 4.0, 5.0]])
+        self._parity(ts, vals)
+        neg = np.int64(-(2**40)) + np.arange(5, dtype=np.int64)[None, :] * 10
+        self._parity(neg, vals)
+
+    def test_wide_delta0_32bit_header(self):
+        """Regular timestamps with delta0 too large for the 8-bit payload."""
+        delta = np.int64(1 << 20)  # zigzag needs > 8 bits -> dc=1 (32-bit)
+        ts = 1_000_000 + np.arange(6, dtype=np.int64)[None, :] * delta
+        vals = np.array([[5.0, 5.0, 6.0, 6.0, 7.0, 7.0]])
+        self._parity(ts, vals)
+
+    def test_wide_int_v0_64bit_header(self):
+        """Int-mode v0 with |zigzag(m0)| >= 2^32 selects the wide vc path."""
+        v0 = float(2**40)  # integral, needs 64-bit payload
+        ts = np.arange(4, dtype=np.int64)[None, :] * 10 + 100
+        vals = np.array([[v0, v0 + 1, v0 + 3, v0 + 6]])
+        int_mode, k = tsz.detect_int_mode_batch(vals, np.array([4], np.int32))
+        assert int_mode[0] and k[0] == 0
+        self._parity(ts, vals)
+        self._parity(ts, -np.asarray(vals))
+
+    def test_wide_header_combined(self):
+        """All three wide-header flags at once, plus irregular timestamps."""
+        ts = np.array([[2**41, 2**41 + (1 << 19), 2**41 + (1 << 20),
+                        2**41 + (1 << 20) + 7]], dtype=np.int64)
+        vals = np.array([[float(2**42), float(2**42 - 5), 0.0,
+                          float(2**33)]])
+        self._parity(ts, vals)
 
     def test_compression_ratio(self, rng):
         """Production-like mix must stay near the reference's 1.45 B/dp
