@@ -1,27 +1,33 @@
-"""Headline benchmark: M3TSZ encode + 1m rollup datapoints/sec on one chip.
+"""Benchmarks for the four BASELINE.json configs, one JSON line each.
 
-Per BASELINE.json's north star, measures the per-shard ingest hot path —
-batched M3TSZ compression (delta-of-delta timestamps + XOR/int-optimized
-values, src/dbnode/encoding/m3tsz/encoder.go:113 semantics) fused with the
-10s->1m Counter/Gauge rollup (src/aggregator/aggregation) — over a
-100k-series shard, as one jitted XLA program per block window.
+Line 1 (the headline, per BASELINE.json's north star) measures the per-shard
+ingest hot path — batched M3TSZ-semantics compression (delta-of-delta
+timestamps + XOR/int-optimized values, src/dbnode/encoding/m3tsz/encoder.go:113)
+fused with the 10s->1m Counter/Gauge rollup (src/aggregator/aggregation) —
+over a 100k-series shard, as one jitted XLA program per block window.
+Subsequent lines cover BASELINE configs #3-#5: PromQL rate()/sum_over_time
+through the query executor (src/query/functions/temporal/rate.go), batched
+timer quantile rollups (src/aggregator/aggregation/timer.go), and the
+full-shard flush decode+merge+re-encode (src/dbnode/persist/fs merge path).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where
+Each line: {"metric", "value", "unit", "vs_baseline", "extra"} where
 vs_baseline compares against the recorded CPU baseline in
-bench_baseline.json (same kernels on the host platform — the "CPU M3TSZ
-encode baseline" config; the reference publishes no absolute throughput
-numbers, BASELINE.md). Also embeds bytes/datapoint (reference: 1.45,
-docs/m3db/architecture/engine.md:9) in the "extra" field.
+bench_baseline.json (same kernels on the host platform; the reference
+publishes no absolute throughput numbers, BASELINE.md).
 
 Robustness: the measurement runs in a child process (backend init state is
 not reliably retryable in-process once jax caches a failed backend), with
 bounded retries against the default (TPU) platform and a final CPU-platform
-fallback, so a flaky TPU tunnel yields a real number + a structured note
-rather than rc=1 with a traceback.
+fallback. The child stamps every phase (backend init / warmup / per-bench
+compile / steady state) to stderr so a hang is attributable, enables the
+persistent compilation cache so retries skip recompiles, and runs a
+tiny-shape warmup first so a hung tunnel fails fast instead of eating the
+whole timeout inside the big compile.
 """
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import subprocess
@@ -32,71 +38,307 @@ import numpy as np
 
 _ATTEMPTS = 3
 _RETRY_SLEEP_S = 10
-# TPU attempts get a bounded window: normal first-compile is 20-40s, so a
-# timeout here means the backend is hanging (observed axon-tunnel failure
+# TPU attempts get a bounded window: normal first-compile is 20-40s/program,
+# so a timeout means the backend is hanging (observed axon-tunnel failure
 # mode) and retrying would hang again — go straight to the CPU fallback.
-_TPU_TIMEOUT_S = int(os.environ.get("BENCH_TPU_TIMEOUT_S", "360"))
-_CPU_TIMEOUT_S = int(os.environ.get("BENCH_CPU_TIMEOUT_S", "900"))
+_TPU_TIMEOUT_S = int(os.environ.get("BENCH_TPU_TIMEOUT_S", "600"))
+_CPU_TIMEOUT_S = int(os.environ.get("BENCH_CPU_TIMEOUT_S", "1800"))
+
+_T0 = time.perf_counter()
 
 
-def run(n_series: int, window: int, iters: int):
+def _phase(msg: str):
+    print(f"bench-phase t+{time.perf_counter() - _T0:7.1f}s {msg}",
+          file=sys.stderr, flush=True)
+
+
+def _fetch1(out):
+    """Force completion via a host fetch: on remote-tunnel platforms
+    block_until_ready can return before the device has executed, so we pull
+    one value produced by the final dispatch (the device queue is in-order)."""
     import jax
 
-    from m3_tpu.parallel import ingest
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    np.asarray(leaf[:1])
 
-    rng = np.random.default_rng(7)
-    batch = ingest.make_example_batch(n_series, window, rng)
-    max_words = ingest.tsz.max_words_for(window)
-    batch = jax.device_put(batch)
 
-    import functools
-
-    step = jax.jit(
-        functools.partial(ingest.ingest_step, rollup_factor=6, max_words=max_words)
-    )
-    out = step(batch)
-    np.asarray(out[1][:1])  # compile + warm; host fetch forces completion
-    # NB: on remote-tunnel platforms block_until_ready can return before the
-    # device has executed, so completion is forced with a host fetch of a
-    # value produced by the final dispatch (the device queue is in-order).
+def _timed(fn, *args, iters: int):
+    out = fn(*args)
+    _fetch1(out)
     t0 = time.perf_counter()
     for _ in range(iters):
-        out = step(batch)
-    np.asarray(out[1][:1])
-    dt = time.perf_counter() - t0
+        out = fn(*args)
+    _fetch1(out)
+    return (time.perf_counter() - t0) / iters
 
-    words, nbits = out[0], out[1]
-    total_points = n_series * window
-    dps = total_points * iters / dt
-    bytes_per_dp = float(np.asarray(nbits, dtype=np.int64).sum()) / 8.0 / total_points
-    platform = jax.devices()[0].platform
-    return dps, bytes_per_dp, platform
+
+# ---------------------------------------------------------------------------
+# individual benches (run inside the child)
+# ---------------------------------------------------------------------------
+
+
+def bench_encode_rollup():
+    """North star: M3TSZ encode + 1m rollup dps over a 100k-series shard."""
+    import jax
+
+    from m3_tpu.ops import tsz
+    from m3_tpu.parallel import ingest
+
+    n = int(os.environ.get("BENCH_SERIES", "100000"))
+    w = int(os.environ.get("BENCH_WINDOW", "120"))
+    iters = int(os.environ.get("BENCH_ITERS", "10"))
+    rng = np.random.default_rng(7)
+    _phase("encode: building batch")
+    raw_ts, raw_vals, npoints = ingest.make_example_raw(n, w, rng)
+    t_prep0 = time.perf_counter()
+    batch = ingest.make_batch_from_raw(raw_ts, raw_vals, npoints)
+    host_prep_s = time.perf_counter() - t_prep0
+    max_words = ingest.tsz.max_words_for(w)
+    batch = jax.device_put(batch)
+    step = jax.jit(
+        functools.partial(ingest.ingest_step, rollup_factor=6, max_words=max_words))
+    _phase("encode: compiling")
+    dt = _timed(step, batch, iters=iters)
+    _phase("encode: steady state done")
+    out = step(batch)
+    nbits = np.asarray(out[1], dtype=np.int64)
+    points = n * w
+    dps = points / dt
+    # End-to-end rate charges the host-side prep (u32-pair conversion +
+    # int-mode detection) once per sealed block alongside the device step.
+    e2e_dps = points / (dt + host_prep_s)
+    return {
+        "metric": "m3tsz_encode_1m_rollup",
+        "value": round(dps, 1),
+        "unit": "datapoints/sec",
+        "extra": {
+            "bytes_per_datapoint": round(float(nbits.sum()) / 8.0 / points, 3),
+            "reference_bytes_per_datapoint": 1.45,
+            "series": n, "window": w,
+            "host_prep_ms": round(host_prep_s * 1000, 1),
+            "e2e_dps_with_host_prep": round(e2e_dps, 1),
+        },
+    }
+
+
+def bench_promql():
+    """BASELINE config #3: rate() + sum_over_time over 1h of 10s data."""
+    from m3_tpu.query import Engine
+
+    n = int(os.environ.get("BENCH_QUERY_SERIES", "10000"))
+    iters = int(os.environ.get("BENCH_QUERY_ITERS", "3"))
+    s_ns = 1_000_000_000
+    npts = 360  # 1h @ 10s
+    rng = np.random.default_rng(11)
+    t = (1_700_000_000 * s_ns + np.arange(npts, dtype=np.int64) * 10 * s_ns)
+    vals = np.cumsum(rng.poisson(5.0, (n, npts)), axis=1).astype(np.float64)
+
+    series = {}
+    for i in range(n):
+        sid = b"bench_metric{i=%d}" % i
+        series[sid] = {
+            "tags": {b"__name__": b"bench_metric", b"i": str(i).encode()},
+            "t": t, "v": vals[i],
+        }
+
+    class _Storage:
+        def fetch_raw(self, matchers, start_ns, end_ns):
+            return series
+
+    eng = Engine(_Storage())
+    start = int(t[30])
+    end = int(t[-1])
+    step = 30 * s_ns
+
+    def run_pair():
+        b1 = eng.execute_range("rate(bench_metric[5m])", start, end, step)
+        b2 = eng.execute_range("sum_over_time(bench_metric[5m])", start, end, step)
+        return b1, b2
+
+    _phase("promql: compiling")
+    b1, b2 = run_pair()
+    assert b1.n_series == n and b2.n_series == n
+    _phase("promql: steady state")
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        run_pair()
+    dt = (time.perf_counter() - t0) / iters
+    _phase("promql: done")
+    dps = 2 * n * npts / dt
+    return {
+        "metric": "promql_rate_sum_over_time_1h",
+        "value": round(dps, 1),
+        "unit": "datapoints/sec",
+        "extra": {"series": n, "points_per_series": npts,
+                  "queries": ["rate(bench_metric[5m])",
+                              "sum_over_time(bench_metric[5m])"],
+                  "steps": b1.meta.steps},
+    }
+
+
+def bench_timer_quantiles():
+    """BASELINE config #4: batched timer quantile rollups (exact sort-based
+    replacement for the reference's CM quantile sketches)."""
+    import jax
+    import jax.numpy as jnp
+
+    from m3_tpu.ops import aggregation as agg
+
+    n = int(os.environ.get("BENCH_TIMER_SERIES", "50000"))
+    w = 120
+    iters = int(os.environ.get("BENCH_TIMER_ITERS", "10"))
+    rng = np.random.default_rng(13)
+    values = jax.device_put(rng.lognormal(0, 1, (n, w)).astype(np.float32))
+    mask = jax.device_put(np.ones((n, w), dtype=bool))
+
+    @jax.jit
+    def timer_step(v, m):
+        q = agg.rollup_quantiles(v, m, 6, (0.5, 0.95, 0.99))
+        s = agg.rollup_stats(v, m, 6)
+        return q, s["sum"], s["count"], s["max"]
+
+    _phase("timer: compiling")
+    dt = _timed(timer_step, values, mask, iters=iters)
+    _phase("timer: done")
+    return {
+        "metric": "timer_quantile_rollup",
+        "value": round(n * w / dt, 1),
+        "unit": "datapoints/sec",
+        "extra": {"series": n, "window": w, "quantiles": [0.5, 0.95, 0.99]},
+    }
+
+
+def bench_flush_merge():
+    """BASELINE config #5: full-shard flush — decode two sealed half-blocks,
+    merge time-ordered, re-encode one compacted block (dbnode fs merge
+    semantics). Both halves come from one encoding epoch (shared int-mode/k),
+    so the merged stream must be bit-identical to encoding the full window —
+    asserted once outside the timing loop."""
+    import jax
+    import jax.numpy as jnp
+
+    from m3_tpu.ops import bits64 as b64
+    from m3_tpu.ops import tsz
+    from m3_tpu.parallel import ingest
+
+    n = int(os.environ.get("BENCH_FLUSH_SERIES", "100000"))
+    half = 60
+    w = 2 * half
+    iters = int(os.environ.get("BENCH_FLUSH_ITERS", "5"))
+    rng = np.random.default_rng(17)
+    raw_ts, raw_vals, npoints = ingest.make_example_raw(n, w, rng)
+    full = ingest.make_batch_from_raw(raw_ts, raw_vals, npoints)
+    mw_half = tsz.max_words_for(half)
+    mw_full = tsz.max_words_for(w)
+
+    def half_inputs(lo, hi):
+        dt = np.asarray(full.dt[:, lo:hi]).copy()
+        dt[:, 0] = 0
+        t0hi, t0lo = b64.from_u64_np(raw_ts[:, lo].astype(np.int64))
+        delta0 = dt[:, 1].copy()
+        ts_regular = (dt[:, 1:] == delta0[:, None]).all(axis=1)
+        return (dt, (t0hi, t0lo), np.asarray(full.vhi[:, lo:hi]),
+                np.asarray(full.vlo[:, lo:hi]), np.asarray(full.int_mode),
+                np.asarray(full.k), np.full(n, hi - lo, np.int32),
+                ts_regular, delta0)
+
+    enc_half = jax.jit(functools.partial(tsz.encode_batch, max_words=mw_half))
+    w1, _ = enc_half(*half_inputs(0, half))
+    w2, _ = enc_half(*half_inputs(half, w))
+    npts_half = jax.device_put(np.full(n, half, np.int32))
+    boundary = jax.device_put(
+        (raw_ts[:, half] - raw_ts[:, half - 1]).astype(np.int32))
+    imode = jax.device_put(np.asarray(full.int_mode))
+    kexp = jax.device_put(np.asarray(full.k))
+
+    @jax.jit
+    def merge_step(w1, w2, np1, np2, boundary, imode, kexp):
+        d1 = tsz.decode_batch(w1, np1, window=half)
+        d2 = tsz.decode_batch(w2, np2, window=half)
+        # Time-ordered concat (block 2 strictly after block 1); block 2's
+        # first delta becomes the cross-block boundary delta.
+        dt2 = d2["dt"].at[:, 0].set(boundary)
+        dt = jnp.concatenate([d1["dt"], dt2], axis=1)
+        vhi = jnp.concatenate([d1["vhi"], d2["vhi"]], axis=1)
+        vlo = jnp.concatenate([d1["vlo"], d2["vlo"]], axis=1)
+        return tsz.encode_batch(
+            dt, d1["t0"], vhi, vlo, imode, kexp, np1 + np2,
+            max_words=mw_full)
+
+    _phase("flush: compiling")
+    merged_words, merged_nbits = merge_step(
+        w1, w2, npts_half, npts_half, boundary, imode, kexp)
+    ref_words, ref_nbits = tsz.encode_batch(
+        full.dt, (full.t0_hi, full.t0_lo), full.vhi, full.vlo, full.int_mode,
+        full.k, full.npoints, full.ts_regular, full.delta0,
+        max_words=mw_full)
+    assert np.array_equal(np.asarray(merged_nbits), np.asarray(ref_nbits))
+    assert np.array_equal(np.asarray(merged_words), np.asarray(ref_words))
+    _phase("flush: merge bit-exact vs direct encode; timing")
+    dt = _timed(merge_step, w1, w2, npts_half, npts_half, boundary, imode,
+                kexp, iters=iters)
+    _phase("flush: done")
+    return {
+        "metric": "shard_flush_merge",
+        "value": round(n * w / dt, 1),
+        "unit": "datapoints/sec",
+        "extra": {"series": n, "points_merged": w, "merge_bit_exact": True},
+    }
+
+
+_BENCHES = [
+    ("m3tsz_encode_1m_rollup", bench_encode_rollup),
+    ("promql_rate_sum_over_time_1h", bench_promql),
+    ("timer_quantile_rollup", bench_timer_quantiles),
+    ("shard_flush_merge", bench_flush_merge),
+]
 
 
 def _child_main():
-    n_series = int(os.environ.get("BENCH_SERIES", "100000"))
-    window = int(os.environ.get("BENCH_WINDOW", "120"))
-    iters = int(os.environ.get("BENCH_ITERS", "5"))
+    _phase("child start")
+    import jax
+
+    cache_dir = os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     if os.environ.get("BENCH_FORCE_CPU"):
-        import jax
-
         jax.config.update("jax_platforms", "cpu")
-    dps, bytes_per_dp, platform = run(n_series, window, iters)
-    print(
-        json.dumps(
-            {
-                "dps": dps,
-                "bytes_per_dp": bytes_per_dp,
-                "platform": platform,
-                "series": n_series,
-                "window": window,
-            }
-        )
-    )
+    _phase("jax imported")
+    dev = jax.devices()[0]
+    _phase(f"backend init done: {dev.platform} ({dev.device_kind})")
+    # Tiny-shape warmup: catches a hung tunnel in seconds, not at minute 5
+    # of the big compile, and pre-touches dispatch + host transfer.
+    import jax.numpy as jnp
+
+    np.asarray(jnp.arange(8) * 2)[:1]
+    _phase("tiny warmup done")
+
+    # Each result is printed the moment its bench completes, so a later
+    # bench failing (or hanging into the parent's timeout) cannot destroy
+    # metrics already measured.
+    failed = []
+    for name, bench in _selected_benches():
+        try:
+            r = bench()
+        except Exception as e:  # noqa: BLE001 - isolate per-bench failures
+            _phase(f"{name} FAILED: {e!r}")
+            failed.append(name)
+            continue
+        r["metric"] = name
+        r["platform"] = dev.platform
+        print(json.dumps(r), flush=True)
+    _phase("child done" + (f" ({len(failed)} failed: {failed})" if failed else ""))
+    if failed:
+        raise SystemExit(1)
 
 
-def _spawn_child(force_cpu: bool):
+def _spawn_child(force_cpu: bool, only=None):
     env = dict(os.environ)
+    if only is not None:
+        env["BENCH_ONLY"] = ",".join(only)
     if force_cpu:
         env["BENCH_FORCE_CPU"] = "1"
         env["JAX_PLATFORMS"] = "cpu"
@@ -110,87 +352,128 @@ def _spawn_child(force_cpu: bool):
             text=True,
             timeout=timeout_s,
         )
-    except subprocess.TimeoutExpired:
-        return None, f"timeout after {timeout_s}s"
+    except subprocess.TimeoutExpired as e:
+        stderr = ((e.stderr or b"").decode() if isinstance(e.stderr, bytes)
+                  else (e.stderr or ""))
+        stdout = ((e.stdout or b"").decode() if isinstance(e.stdout, bytes)
+                  else (e.stdout or ""))
+        for line in stderr.splitlines():
+            if line.startswith("bench-phase"):
+                print(line, file=sys.stderr)
+        # Benches stream results as they complete: keep whatever finished
+        # before the hang.
+        results = _parse_results(stdout)
+        return (results or None), f"timeout after {timeout_s}s"
+    for line in (proc.stderr or "").splitlines():
+        if line.startswith("bench-phase"):
+            print(line, file=sys.stderr)
+    results = _parse_results(proc.stdout or "")
     if proc.returncode != 0:
         tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-8:]
-        return None, f"rc={proc.returncode}: " + " | ".join(tail)
-    for line in reversed(proc.stdout.strip().splitlines()):
+        return (results or None), f"rc={proc.returncode}: " + " | ".join(tail)
+    if not results:
+        return None, "no JSON lines in child output"
+    return results, None
+
+
+def _parse_results(stdout: str):
+    results = []
+    for line in stdout.strip().splitlines():
         try:
-            return json.loads(line), None
+            results.append(json.loads(line))
         except json.JSONDecodeError:
             continue
-    return None, "no JSON line in child output"
+    return results
+
+
+def _load_baselines():
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "bench_baseline.json")) as f:
+            base = json.load(f)
+    except Exception as e:
+        print(f"warning: no usable bench_baseline.json ({e})", file=sys.stderr)
+        return {}
+    out = dict(base.get("metrics", {}))
+    if "cpu_dps" in base:
+        out.setdefault("m3tsz_encode_1m_rollup", base["cpu_dps"])
+    return out
+
+
+def _selected_benches():
+    """(metric, fn) pairs matching BENCH_ONLY (comma-separated substrings of
+    the metric or function name); an empty match is a config error raised
+    before any backend init so it can't burn retries on a hung tunnel."""
+    only = [s for s in os.environ.get("BENCH_ONLY", "").split(",") if s]
+    selected = [
+        (name, fn) for name, fn in _BENCHES
+        if not only or any(s in name or s in fn.__name__ for s in only)
+    ]
+    if not selected:
+        names = ", ".join(name for name, _ in _BENCHES)
+        raise SystemExit(f"no bench matched BENCH_ONLY={only!r} (have: {names})")
+    return selected
 
 
 def main():
     if "--child" in sys.argv:
         _child_main()
         return 0
+    selected = [name for name, _ in _selected_benches()]
 
     errors = []
-    result = None
+    got = {}
     for attempt in range(_ATTEMPTS):
-        result, err = _spawn_child(force_cpu=False)
-        if result is not None:
+        missing = [n for n in selected if n not in got]
+        results, err = _spawn_child(force_cpu=False, only=missing)
+        for r in results or []:
+            got[r["metric"]] = r
+        if err is None:
             break
         errors.append(f"attempt {attempt + 1}: {err}")
         print(f"warning: bench {errors[-1]}", file=sys.stderr)
         if err.startswith("timeout after"):
             break  # backend hang: retrying hangs again, fall back now
-        if attempt < _ATTEMPTS - 1:
+        if attempt < _ATTEMPTS - 1 and len(got) < len(selected):
             time.sleep(_RETRY_SLEEP_S)
-    if result is None:
+        elif len(got) == len(selected):
+            break
+    missing = [n for n in selected if n not in got]
+    if missing:
         # Final fallback: the kernels are platform-agnostic; a CPU number is
         # a real measurement (and vs_baseline~=1.0 documents TPU was down).
-        result, err = _spawn_child(force_cpu=True)
-        if result is None:
+        results, err = _spawn_child(force_cpu=True, only=missing)
+        for r in results or []:
+            got[r["metric"]] = r
+        if err is not None:
             errors.append(f"cpu fallback: {err}")
 
-    baseline_dps = None
-    try:
-        with open(os.path.join(os.path.dirname(__file__), "bench_baseline.json")) as f:
-            baseline_dps = json.load(f)["cpu_dps"]
-    except Exception as e:
-        print(f"warning: no usable bench_baseline.json ({e})", file=sys.stderr)
-
-    if result is None:
-        print(
-            json.dumps(
-                {
-                    "metric": "m3tsz_encode_1m_rollup",
-                    "value": None,
-                    "unit": "datapoints/sec",
-                    "vs_baseline": None,
-                    "error": "; ".join(errors),
-                }
-            )
-        )
-        return 0
-
-    dps = result["dps"]
-    vs = dps / baseline_dps if baseline_dps else None
-    extra = {
-        "bytes_per_datapoint": round(result["bytes_per_dp"], 3),
-        "reference_bytes_per_datapoint": 1.45,
-        "series": result["series"],
-        "window": result["window"],
-        "cpu_baseline_dps": baseline_dps,
-        "platform": result["platform"],
-    }
-    if errors:
-        extra["retries"] = errors
-    print(
-        json.dumps(
-            {
-                "metric": "m3tsz_encode_1m_rollup",
-                "value": round(dps, 1),
+    baselines = _load_baselines()
+    for name in selected:
+        r = got.get(name)
+        if r is None:
+            print(json.dumps({
+                "metric": name,
+                "value": None,
                 "unit": "datapoints/sec",
-                "vs_baseline": round(vs, 3) if vs is not None else None,
-                "extra": extra,
-            }
-        )
-    )
+                "vs_baseline": None,
+                "error": "; ".join(errors) or "bench produced no result",
+            }))
+            continue
+        base = baselines.get(name)
+        extra = r.setdefault("extra", {})
+        extra["platform"] = r.pop("platform", None)
+        extra["cpu_baseline_dps"] = base
+        if errors:
+            extra["retries"] = errors
+        vs = (r["value"] / base) if (base and r["value"]) else None
+        print(json.dumps({
+            "metric": name,
+            "value": r["value"],
+            "unit": r["unit"],
+            "vs_baseline": round(vs, 3) if vs is not None else None,
+            "extra": extra,
+        }))
     return 0
 
 

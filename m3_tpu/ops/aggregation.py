@@ -58,9 +58,19 @@ def window_stats(values, mask, axis=-1):
     last_i = neg.max(axis=axis)
     pos = jnp.broadcast_to(jnp.where(mask, idx, values.shape[axis]), values.shape)
     first_i = pos.min(axis=axis)
-    take = lambda i: jnp.take_along_axis(
-        values, jnp.expand_dims(jnp.clip(i, 0, values.shape[axis] - 1), axis), axis=axis
-    ).squeeze(axis)
+    # first/last extracted with one-hot where-sums, NOT take_along_axis:
+    # per-row dynamic gathers serialize on TPU (measured ~100ms on a
+    # 100k-series shard vs ~1ms for the dense select). The sum runs over
+    # the raw bit pattern of the single selected element — a float sum
+    # would turn a selected -0.0 into +0.0 ((-0.0) + 0.0 == +0.0).
+    bits_ty = jnp.uint32 if values.dtype.itemsize == 4 else jnp.uint64
+    vbits = jax.lax.bitcast_convert_type(values, bits_ty)
+
+    def select_at(i_arr, cmp_arr):
+        sel = (cmp_arr == jnp.expand_dims(i_arr, axis)) & mask
+        picked = jnp.where(sel, vbits, 0).sum(axis=axis, dtype=bits_ty)
+        return jax.lax.bitcast_convert_type(picked, values.dtype)
+
     total = zero.sum(axis=axis)
     # Centered second moment: stdev from raw n*sumsq - sum^2 cancels
     # catastrophically in f32 for offset values (mean >> stdev), so a
@@ -73,23 +83,57 @@ def window_stats(values, mask, axis=-1):
         "count": cnt,
         "min": _masked(values, mask, jnp.inf).min(axis=axis),
         "max": _masked(values, mask, -jnp.inf).max(axis=axis),
-        "last": jnp.where(last_i >= 0, take(last_i), 0.0),
-        "first": jnp.where(first_i < values.shape[axis], take(first_i), 0.0),
+        "last": select_at(last_i, neg),
+        "first": select_at(first_i, pos),
         "m2": (dev * dev).sum(axis=axis),
     }
+
+
+def _rollup_slices(values, mask, factor: int):
+    """[..., W] -> `factor` pairs of ([..., W//factor] slice, mask slice).
+
+    A reshape to [..., W//f, f] would put the tiny factor axis in the TPU
+    lane dimension (padded 6 -> 128, a ~21x memory blowup) and force
+    reductions there; static per-phase slices keep every array at the
+    wide [..., W//f] shape instead.
+    """
+    w = values.shape[-1]
+    if w % factor:
+        raise ValueError(f"window {w} not divisible by rollup factor {factor}")
+    shape = values.shape[:-1] + (w // factor, factor)
+    v = values.reshape(shape)
+    m = jnp.broadcast_to(mask, values.shape).reshape(shape)
+    return [(v[..., i], m[..., i]) for i in range(factor)]
 
 
 def rollup_stats(values, mask, factor: int):
     """Roll a [..., W] window up into W//factor sub-windows of `factor` points.
 
     The 10s->1m/5m resolution rollup (src/aggregator/aggregator/list.go:296
-    flush consume) as a single reshape+reduce: returns stats shaped [..., W//factor].
+    flush consume), statically unrolled over the factor so every reduction
+    stays dense over the wide sub-window axis (no gathers, no lane-padded
+    factor axis). Returns stats shaped [..., W//factor].
     """
-    w = values.shape[-1]
-    if w % factor:
-        raise ValueError(f"window {w} not divisible by rollup factor {factor}")
-    shape = values.shape[:-1] + (w // factor, factor)
-    return window_stats(values.reshape(shape), jnp.broadcast_to(mask, values.shape).reshape(shape))
+    sl = _rollup_slices(values, mask, factor)
+    dt = values.dtype
+    cnt = sum(m.astype(dt) for _, m in sl)
+    total = sum(jnp.where(m, v, 0) for v, m in sl)
+    sumsq = sum(jnp.where(m, v * v, 0) for v, m in sl)
+    mn = functools.reduce(jnp.minimum, [_masked(v, m, jnp.inf) for v, m in sl])
+    mx = functools.reduce(jnp.maximum, [_masked(v, m, -jnp.inf) for v, m in sl])
+    last = jnp.zeros_like(sl[0][0])
+    first = jnp.zeros_like(sl[0][0])
+    seen = jnp.zeros_like(sl[0][1])
+    for v, m in sl:
+        last = jnp.where(m, v, last)
+        first = jnp.where(m & ~seen, v, first)
+        seen = seen | m
+    mu = jnp.where(cnt > 0, total / jnp.maximum(cnt, 1), 0.0)
+    m2 = sum(jnp.where(m, (v - mu) ** 2, 0) for v, m in sl)
+    return {
+        "sum": total, "sumsq": sumsq, "count": cnt, "min": mn, "max": mx,
+        "last": last, "first": first, "m2": m2,
+    }
 
 
 def merge_stats(a, b, b_is_later=True):
@@ -142,26 +186,68 @@ def quantiles(values, mask, qs: tuple):
 
     Rank semantics follow the CM stream's target rank ceil(q*n)
     (quantile/cm/stream.go:160) with q=0 -> min, q=1 -> max; empty windows
-    return 0 (stream.go:145-146).
+    return 0 (stream.go:145-146). NaN samples count as missing (a NaN timer
+    value carries no rank information — e.g. a Prometheus stale marker), so
+    they never contaminate the quantile and both quantile code paths agree.
     """
-    mask = jnp.broadcast_to(mask, values.shape)
+    mask = jnp.broadcast_to(mask, values.shape) & ~jnp.isnan(values)
     n = mask.sum(axis=-1)
     s = jnp.sort(_masked(values, mask, jnp.inf), axis=-1)
+    iota = jnp.arange(values.shape[-1])
     outs = []
     for q in qs:
         rank = jnp.ceil(q * n).astype(jnp.int32)
         idx = jnp.clip(jnp.maximum(rank, 1) - 1, 0, values.shape[-1] - 1)
-        v = jnp.take_along_axis(s, idx[..., None], axis=-1)[..., 0]
+        # one-hot select instead of take_along_axis (gathers serialize on TPU)
+        v = jnp.where(iota == idx[..., None], s, 0).sum(axis=-1)
         outs.append(jnp.where(n > 0, v, 0.0))
     return jnp.stack(outs, axis=-1)
 
 
+def _sorted_columns(cols):
+    """Sort a short list of same-shaped arrays elementwise across the list.
+
+    Odd-even transposition network: len(cols) rounds of adjacent
+    compare-exchanges, provably sorting for any length. Each CE is a dense
+    min/max pair on full-width arrays — no lane-padded sort axis, no
+    gathers.
+    """
+    xs = list(cols)
+    k = len(xs)
+    for rnd in range(k):
+        start = rnd & 1
+        for i in range(start, k - 1, 2):
+            lo = jnp.minimum(xs[i], xs[i + 1])
+            hi = jnp.maximum(xs[i], xs[i + 1])
+            xs[i], xs[i + 1] = lo, hi
+    return xs
+
+
 def rollup_quantiles(values, mask, factor: int, qs: tuple):
-    """Quantiles per rollup sub-window: [..., W] -> [..., W//factor, len(qs)]."""
-    w = values.shape[-1]
-    if w % factor:
-        raise ValueError(f"window {w} not divisible by rollup factor {factor}")
-    shape = values.shape[:-1] + (w // factor, factor)
-    return quantiles(
-        values.reshape(shape), jnp.broadcast_to(mask, values.shape).reshape(shape), qs
-    )
+    """Quantiles per rollup sub-window: [..., W] -> [..., W//factor, len(qs)].
+
+    For the small rollup factors this is used with (6 for 10s->1m), the sort
+    runs as an elementwise sorting network across the factor slices; large
+    factors fall back to the generic sort-based path. NaN samples count as
+    missing in both paths (see quantiles).
+    """
+    if factor > 16:
+        w = values.shape[-1]
+        if w % factor:
+            raise ValueError(f"window {w} not divisible by rollup factor {factor}")
+        shape = values.shape[:-1] + (w // factor, factor)
+        return quantiles(
+            values.reshape(shape), jnp.broadcast_to(mask, values.shape).reshape(shape), qs
+        )
+    sl = [(v, m & ~jnp.isnan(v)) for v, m in _rollup_slices(values, mask, factor)]
+    n = sum(m.astype(jnp.int32) for _, m in sl)
+    s = _sorted_columns([_masked(v, m, jnp.inf) for v, m in sl])
+    outs = []
+    for q in qs:
+        rank = jnp.ceil(q * n.astype(values.dtype)).astype(jnp.int32)
+        idx = jnp.clip(jnp.maximum(rank, 1) - 1, 0, factor - 1)
+        v = jnp.zeros_like(s[0])
+        for i, si in enumerate(s):
+            v = jnp.where(idx == i, si, v)
+        outs.append(jnp.where(n > 0, v, 0.0))
+    return jnp.stack(outs, axis=-1)

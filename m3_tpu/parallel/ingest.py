@@ -178,11 +178,11 @@ def make_sharded_ingest(mesh: Mesh, *, rollup_factor: int, max_words: int, quant
     return jax.jit(fn)
 
 
-def make_example_batch(n: int, w: int, rng: np.random.Generator, *, chunks: int | None = None, start=1_600_000_000):
-    """Synthetic shard data shaped like production metrics: regular 10s
-    timestamps, mixed int-optimizable gauges/counters and true floats."""
-    t_chunks = chunks or 1
-    tw = t_chunks * w
+def make_example_raw(n: int, tw: int, rng: np.random.Generator,
+                     start=1_600_000_000):
+    """Synthetic raw shard data shaped like production metrics: regular 10s
+    timestamps, mixed int-optimizable gauges/counters and true floats.
+    Returns (timestamps int64 [n, tw], values f64 [n, tw], npoints [n])."""
     # Timestamps: scrape-style regular 10s interval; ~5% of series see
     # per-point jitter (mirrors the production workload behind the
     # reference's 1.45 bytes/datapoint figure, where delta-of-delta is
@@ -202,22 +202,37 @@ def make_example_batch(n: int, w: int, rng: np.random.Generator, *, chunks: int 
     gauges = base + np.cumsum(moves, axis=1).astype(np.float64)
     floats = base + np.cumsum(moves, axis=1) * 0.1 + rng.standard_normal((n, tw)) * 1e-3
     values = np.where(kind <= 1, counters, np.where(kind <= 3, gauges, floats))
+    return ts, values, np.full(n, tw, np.int32)
+
+
+def make_batch_from_raw(ts2: np.ndarray, v2: np.ndarray,
+                        npoints: np.ndarray) -> IngestBatch:
+    """Host prep: raw (timestamps, values) -> device-ready IngestBatch."""
+    inp = tsz.prepare_encode_inputs(ts2, v2, npoints)
+    return IngestBatch(
+        dt=inp["dt"],
+        t0_hi=inp["t0"][0],
+        t0_lo=inp["t0"][1],
+        vhi=inp["vhi"],
+        vlo=inp["vlo"],
+        int_mode=inp["int_mode"],
+        k=inp["k"],
+        npoints=inp["npoints"],
+        ts_regular=inp["ts_regular"],
+        delta0=inp["delta0"],
+        values=v2.astype(np.float32),
+    )
+
+
+def make_example_batch(n: int, w: int, rng: np.random.Generator, *, chunks: int | None = None, start=1_600_000_000):
+    """Synthetic shard batch: make_example_raw + host prep, optionally split
+    into `chunks` leading time chunks for the sharded [T, N, W] layout."""
+    t_chunks = chunks or 1
+    ts, values, _ = make_example_raw(n, t_chunks * w, rng, start=start)
 
     def prep(ts2, v2):
-        inp = tsz.prepare_encode_inputs(ts2, v2, np.full(ts2.shape[0], ts2.shape[1], np.int32))
-        return IngestBatch(
-            dt=inp["dt"],
-            t0_hi=inp["t0"][0],
-            t0_lo=inp["t0"][1],
-            vhi=inp["vhi"],
-            vlo=inp["vlo"],
-            int_mode=inp["int_mode"],
-            k=inp["k"],
-            npoints=inp["npoints"],
-            ts_regular=inp["ts_regular"],
-            delta0=inp["delta0"],
-            values=v2.astype(np.float32),
-        )
+        return make_batch_from_raw(
+            ts2, v2, np.full(ts2.shape[0], ts2.shape[1], np.int32))
 
     if chunks is None:
         return prep(ts, values)

@@ -19,7 +19,7 @@ import numpy as np
 
 from ..ops import series_agg, temporal
 from . import promql
-from .block import Block, BlockMeta, consolidate
+from .block import Block, BlockMeta, consolidate_series
 from .model import Matcher, MatchType, METRIC_NAME, Tags
 from .promql import (
     Aggregation,
@@ -137,14 +137,8 @@ class Engine:
         meta = params.meta()
         series = self._fetch(sel, params.start_ns - self.lookback_ns - off,
                              params.end_ns - off + 1)
-        tags_list, rows = [], []
         shifted = BlockMeta(meta.start_ns - off, meta.step_ns, meta.steps)
-        for sid, entry in sorted(series.items()):
-            tags_list.append(Tags.of(dict(entry["tags"])))
-            rows.append(consolidate(
-                np.asarray(entry["t"], np.int64), np.asarray(entry["v"]),
-                shifted, self.lookback_ns))
-        values = np.stack(rows) if rows else np.zeros((0, meta.steps))
+        tags_list, values = consolidate_series(series, shifted, self.lookback_ns)
         return Block(meta, tags_list, values)
 
     def _eval_range_selector(self, sel: VectorSelector, params: QueryParams
@@ -162,15 +156,9 @@ class Engine:
         ext_steps = (W - 1) + (meta.steps - 1) * stride + 1
         ext_meta = BlockMeta(ext_start, wgrid, ext_steps)
         series = self._fetch(sel, ext_start - wgrid, meta.end_ns - off + 1)
-        tags_list, rows = [], []
-        for sid, entry in sorted(series.items()):
-            tags_list.append(Tags.of(dict(entry["tags"])))
-            # Range selectors see raw samples (no lookback): a cell holds
-            # the latest sample within its grid cell only.
-            rows.append(consolidate(
-                np.asarray(entry["t"], np.int64), np.asarray(entry["v"]),
-                ext_meta, wgrid))
-        values = np.stack(rows) if rows else np.zeros((0, ext_steps))
+        # Range selectors see raw samples (no lookback): a cell holds the
+        # latest sample within its grid cell only.
+        tags_list, values = consolidate_series(series, ext_meta, wgrid)
         return Block(ext_meta, tags_list, values), W, stride
 
     # -- functions ---------------------------------------------------------

@@ -107,3 +107,27 @@ def test_rollup_quantiles_shape(rng):
     v = rng.standard_normal((4, 24)).astype(np.float32)
     out = agg.rollup_quantiles(v, np.ones_like(v, bool), 6, (0.5, 0.99))
     assert np.asarray(out).shape == (4, 4, 2)
+
+
+def test_window_stats_preserves_negative_zero_first_last():
+    """The one-hot first/last select sums raw bit patterns, so the sign of
+    a selected -0.0 survives (a float sum would yield +0.0)."""
+    v = np.array([[-0.0, 1.0, -0.0]], np.float32)
+    s = agg.window_stats(v, np.ones_like(v, bool))
+    assert np.signbit(np.asarray(s["first"]))[0]
+    assert np.signbit(np.asarray(s["last"]))[0]
+
+
+def test_quantiles_nan_samples_are_missing():
+    """NaN samples (stale markers) carry no rank info: both the generic
+    sort path and the small-factor sorting-network path must exclude them
+    instead of propagating NaN into the quantile."""
+    v = np.array([[1.0, np.nan, 2.0, 3.0, 4.0, 5.0]], np.float32)
+    mask = np.ones_like(v, bool)
+    got = float(np.asarray(agg.quantiles(v, mask, (0.5,)))[0, 0])
+    assert got == 3.0  # rank ceil(0.5*5)=3 of [1,2,3,4,5]
+    net = np.asarray(agg.rollup_quantiles(v, mask, 6, (0.5, 1.0)))[0, 0]
+    assert net[0] == 3.0 and net[1] == 5.0
+    # all-NaN window behaves like an empty one
+    allnan = np.full((1, 6), np.nan, np.float32)
+    assert np.all(np.asarray(agg.rollup_quantiles(allnan, np.ones_like(allnan, bool), 6, (0.5,))) == 0.0)

@@ -73,17 +73,26 @@ class TestScalarOracle:
 
 
 class TestBatchedVsOracle:
+    @pytest.mark.parametrize("pack", ["scatter", "tree"])
     @pytest.mark.parametrize("w", [2, 17, 120])
-    def test_encode_bit_exact(self, rng, w):
+    def test_encode_bit_exact(self, rng, w, pack):
+        """Both packers (CPU scatter path and TPU merge-tree path) must be
+        bit-exact vs the oracle — conftest pins tests to CPU, so the tree
+        path is exercised explicitly here."""
         n = 24
         ts, vals = make_workload(rng, n, w)
         npoints = np.full(n, w, dtype=np.int32)
-        words, nbits = tsz.encode(ts, vals, npoints)
+        inp = tsz.prepare_encode_inputs(ts, vals, npoints)
+        words, nbits = tsz.encode_batch(
+            inp["dt"], inp["t0"], inp["vhi"], inp["vlo"], inp["int_mode"],
+            inp["k"], inp["npoints"], inp["ts_regular"], inp["delta0"],
+            max_words=tsz.max_words_for(w), pack=pack)
         words, nbits = np.asarray(words), np.asarray(nbits)
         for i, blk in enumerate(ref_encode_all(ts, vals, npoints)):
             assert nbits[i] == blk.nbits, f"series {i}: nbits {nbits[i]} != {blk.nbits}"
             nw = (blk.nbits + 31) // 32
             assert np.array_equal(words[i, :nw], blk.words), f"series {i} words differ"
+            assert not words[i, nw:].any(), f"series {i} tail not zero"
 
     def test_decode_roundtrip(self, rng):
         n, w = 24, 90
@@ -162,20 +171,26 @@ class TestBatchedVsOracle:
         assert_values_equal(vals[0], v3)
 
     def _parity(self, ts, vals):
-        """Batched encode must be bit-exact vs oracle and roundtrip."""
+        """Batched encode (both packers) must be bit-exact vs oracle and
+        roundtrip."""
         ts = np.asarray(ts, np.int64)
         vals = np.asarray(vals, np.float64)
         n, w = ts.shape
         npoints = np.full(n, w, dtype=np.int32)
-        words, nbits = tsz.encode(ts, vals, npoints)
-        words, nbits = np.asarray(words), np.asarray(nbits)
-        for i, blk in enumerate(ref_encode_all(ts, vals, npoints)):
-            assert nbits[i] == blk.nbits, f"series {i} nbits"
-            nw = (blk.nbits + 31) // 32
-            assert np.array_equal(words[i, :nw], blk.words), f"series {i}"
-        t2, v2 = tsz.decode(words, npoints, w)
-        assert np.array_equal(ts, t2)
-        assert_values_equal(vals, v2)
+        inp = tsz.prepare_encode_inputs(ts, vals, npoints)
+        for pack in ("scatter", "tree"):
+            words, nbits = tsz.encode_batch(
+                inp["dt"], inp["t0"], inp["vhi"], inp["vlo"], inp["int_mode"],
+                inp["k"], inp["npoints"], inp["ts_regular"], inp["delta0"],
+                max_words=tsz.max_words_for(w), pack=pack)
+            words, nbits = np.asarray(words), np.asarray(nbits)
+            for i, blk in enumerate(ref_encode_all(ts, vals, npoints)):
+                assert nbits[i] == blk.nbits, f"series {i} nbits ({pack})"
+                nw = (blk.nbits + 31) // 32
+                assert np.array_equal(words[i, :nw], blk.words), f"series {i} ({pack})"
+            t2, v2 = tsz.decode(words, npoints, w)
+            assert np.array_equal(ts, t2)
+            assert_values_equal(vals, v2)
 
     def test_wide_t0_64bit_header(self):
         """t0 whose zigzag needs >32 bits selects the wide t0c path."""
