@@ -49,16 +49,49 @@ class AggregatorShard:
 
 class ForwardedWriter:
     """Routes rollup-pipeline outputs to the next aggregation stage
-    (forwarded_writer.go). In-process it feeds straight back into an
-    Aggregator (the reference sends over the network to the instance owning
-    the rollup ID's shard — the routing hash is identical)."""
+    (forwarded_writer.go): the forwarded ID hashes to a shard, and the
+    partial aggregate is delivered to every instance owning that shard in
+    the aggregator placement — over the wire when the owner is another
+    instance, directly when it is this one. Without routing configuration
+    (the embedded single-instance downsampler) everything loops back into
+    the local aggregator, which owns all shards."""
 
     def __init__(self, target: "Aggregator"):
         self._target = target
+        self._placement = None      # Callable[[], Placement] | None
+        self._transports = {}       # instance_id -> send_forwarded fn
+        self._local_id = None
+        self.dropped = 0
+
+    def set_routing(self, placement_getter, transports, local_instance_id):
+        """transports: instance_id -> fn(metric_type, id, t, value, meta)
+        (e.g. TCPTransport.send_forwarded of the peer's rawtcp server)."""
+        self._placement = placement_getter
+        self._transports = dict(transports)
+        self._local_id = local_instance_id
 
     def __call__(self, new_id: bytes, t_nanos: int, value: float,
                  meta: ForwardMetadata, source_id: bytes):
-        self._target.add_forwarded(MetricType.GAUGE, new_id, t_nanos, value, meta)
+        if self._placement is None:
+            self._target.add_forwarded(
+                MetricType.GAUGE, new_id, t_nanos, value, meta)
+            return
+        from ..cluster.placement import ShardState
+
+        shard = self._target.shard_for(new_id)
+        delivered = False
+        for inst in self._placement().replicas_for(
+                shard, states=(ShardState.INITIALIZING, ShardState.AVAILABLE)):
+            if inst.id == self._local_id:
+                delivered |= self._target.add_forwarded(
+                    MetricType.GAUGE, new_id, t_nanos, value, meta)
+                continue
+            send = self._transports.get(inst.id)
+            if send is not None and send(
+                    MetricType.GAUGE, new_id, t_nanos, value, meta):
+                delivered = True
+        if not delivered:
+            self.dropped += 1
 
 
 class Aggregator:
@@ -98,6 +131,15 @@ class Aggregator:
             if sid in self._shards:
                 self._shards[sid].cutoff_nanos = now
         self._owned = new
+
+    def set_forward_routing(self, placement_getter, transports,
+                            local_instance_id):
+        """Enable cross-instance forwarded pipelines: rollup outputs are
+        routed to the instances owning the forwarded ID's shard
+        (forwarded_writer.go; proven end-to-end by the reference's
+        multi_server_forwarding_pipeline_test.go)."""
+        self._forward.set_routing(placement_getter, transports,
+                                  local_instance_id)
 
     def owned_shards(self) -> List[int]:
         return sorted(self._owned)

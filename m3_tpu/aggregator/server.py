@@ -9,6 +9,11 @@ Wire frames:
    "metadatas": [...]}
   {"t": "timed", "mtype": i64, "id": bytes, "time": i64, "value": f64,
    "policy": str, "agg_id": i64}
+  {"t": "forwarded", "mtype": i64, "id": bytes, "time": i64, "value": f64,
+   "agg_id": i64, "policy": str, "pipeline": [...], "source_id": bytes,
+   "num_times": i64}   (partial aggregates between pipeline stages,
+   reference: src/aggregator/server/rawtcp handling of forwarded metric
+   unions + forwarded_writer.go)
 A batch frame {"t": "batch", "entries": [...]} carries many at once.
 """
 
@@ -18,7 +23,8 @@ import socketserver
 import threading
 from typing import List, Optional, Sequence
 
-from ..metrics.metadata import Metadata, PipelineMetadata, StagedMetadata
+from ..metrics.metadata import (ForwardMetadata, Metadata, PipelineMetadata,
+                                StagedMetadata)
 from ..metrics.matcher import pipeline_from_json, pipeline_to_json
 from ..metrics.metric import MetricType, MetricUnion
 from ..metrics.policy import StoragePolicy
@@ -74,6 +80,29 @@ def union_to_wire(mu: MetricUnion, metadatas: Sequence[StagedMetadata]) -> dict:
             "value": value, "metadatas": metadatas_to_wire(metadatas)}
 
 
+def forwarded_to_wire(metric_type: MetricType, metric_id: bytes,
+                      t_nanos: int, value: float, meta: ForwardMetadata) -> dict:
+    return {
+        "t": "forwarded", "mtype": int(metric_type), "id": metric_id,
+        "time": t_nanos, "value": float(value),
+        "agg_id": meta.aggregation_id, "policy": str(meta.storage_policy),
+        "pipeline": pipeline_to_json(meta.pipeline),
+        "source_id": meta.source_id, "num_times": meta.num_forwarded_times,
+    }
+
+
+def forwarded_from_wire(frame: dict):
+    meta = ForwardMetadata(
+        aggregation_id=frame["agg_id"],
+        storage_policy=StoragePolicy.parse(frame["policy"]),
+        pipeline=pipeline_from_json(frame["pipeline"]),
+        source_id=frame["source_id"],
+        num_forwarded_times=frame["num_times"],
+    )
+    return (MetricType(frame["mtype"]), frame["id"], frame["time"],
+            frame["value"], meta)
+
+
 def union_from_wire(frame: dict):
     mt = MetricType(frame["mtype"])
     mid = frame["id"]
@@ -126,6 +155,9 @@ class RawTCPServer:
                 self.aggregator.add_timed(
                     MetricType(e["mtype"]), e["id"], e["time"], e["value"],
                     StoragePolicy.parse(e["policy"]), e.get("agg_id", 0))
+            elif e["t"] == "forwarded":
+                mt, mid, t_nanos, value, meta = forwarded_from_wire(e)
+                self.aggregator.add_forwarded(mt, mid, t_nanos, value, meta)
         except Exception:  # noqa: BLE001 - bad frame must not kill the conn
             self.errors += 1
 
@@ -164,6 +196,20 @@ class TCPTransport:
             batch, self._batch = self._batch, []
         return self._send_batch(batch)
 
+    def send_forwarded(self, metric_type: MetricType, metric_id: bytes,
+                       t_nanos: int, value: float,
+                       meta: ForwardMetadata) -> bool:
+        """Deliver a partial aggregate to the next pipeline stage's owner.
+
+        Sent immediately (not batched): forwards happen at flush boundaries,
+        and the downstream stage's flush deadline is already ticking
+        (forwarded_writer.go Flush)."""
+        with self._lock:
+            batch, self._batch = self._batch, []
+        batch.append(forwarded_to_wire(metric_type, metric_id, t_nanos,
+                                       value, meta))
+        return self._send_batch(batch)
+
     def flush(self) -> bool:
         with self._lock:
             batch, self._batch = self._batch, []
@@ -196,6 +242,11 @@ class TCPTransport:
                 sock.close()
             except OSError:
                 pass
+
+    def close(self):
+        with self._lock:
+            self._batch = []
+        self._drop_conn()
 
     def close(self):
         self.flush()

@@ -105,6 +105,16 @@ class MemStore:
                 w._notify()
         return w
 
+    def unwatch(self, key: str, w: Watch):
+        """Deregister a watch (long-lived stores serving churning watchers —
+        e.g. the KV service's per-connection streams — must not leak them)."""
+        with self._lock:
+            ws = self._watches.get(key)
+            if ws is not None and w in ws:
+                ws.remove(w)
+                if not ws:
+                    del self._watches[key]
+
     def on_change(self, key: str, fn: Callable[[str, Value], None]):
         """Callback-style watch; fires immediately if the key exists."""
         with self._lock:

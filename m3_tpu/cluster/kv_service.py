@@ -1,0 +1,282 @@
+"""Networked versioned-KV service with watch push: the cluster metadata
+plane as a process (reference: src/cluster/kv/etcd/store.go — etcd v3 backs
+kv/placement/election/heartbeat in production;
+src/cluster/etcd/watchmanager/watch_manager.go for the watch stream).
+
+One KVServer process (backed by a MemStore, or FileStore for durability)
+serves every dbnode/coordinator/aggregator in the cluster; each connects a
+RemoteStore speaking the framed binary wire (m3_tpu.rpc.wire). RemoteStore
+implements the exact MemStore surface (get/set/set_if_not_exists/
+check_and_set/delete/keys/watch/on_change), so placement, namespaces,
+elections, flush times, runtime options and rule matchers work unchanged
+across processes.
+
+Protocol: request/response dicts on a pooled connection —
+  {"op": "get"|"set"|"setnx"|"cas"|"delete"|"keys", ...} -> {"ok", ...}
+— plus a dedicated streaming connection per watched key:
+  {"op": "watch", "key", "from_version"} -> stream of
+  {"key", "data", "version"} frames, pushed on every change (and once
+  immediately if the current version is newer than from_version; deletes
+  push {"version": 0, "data": None}).
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import threading
+from typing import Callable, Dict, List, Optional
+
+from ..rpc import wire
+from . import kv as cluster_kv
+
+
+class KVServer:
+    """Serves a MemStore/FileStore over the framed wire."""
+
+    def __init__(self, store: Optional[cluster_kv.MemStore] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.store = store if store is not None else cluster_kv.MemStore()
+        outer = self
+
+        class _Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        req = wire.read_frame(self.request)
+                        if req.get("op") == "watch":
+                            outer._serve_watch(self.request, req)
+                            return  # connection is now a push stream
+                        wire.write_frame(self.request, outer._handle(req))
+                except (ConnectionError, OSError, EOFError):
+                    pass
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = _Server((host, port), _Handler)
+
+    def _handle(self, req: dict) -> dict:
+        op = req.get("op")
+        key = req.get("key", "")
+        store = self.store
+        try:
+            if op == "get":
+                v = store.get(key)
+                return {"ok": True, "data": v.data if v else None,
+                        "version": v.version if v else 0}
+            if op == "set":
+                return {"ok": True, "version": store.set(key, req["data"])}
+            if op == "setnx":
+                return {"ok": True,
+                        "version": store.set_if_not_exists(key, req["data"])}
+            if op == "cas":
+                return {"ok": True, "version": store.check_and_set(
+                    key, req["expect"], req["data"])}
+            if op == "delete":
+                v = store.delete(key)
+                return {"ok": True, "existed": v is not None,
+                        "data": v.data if v else None,
+                        "version": v.version if v else 0}
+            if op == "keys":
+                return {"ok": True, "keys": store.keys(req.get("prefix", ""))}
+            return {"ok": False, "err": f"unknown op {op!r}", "kind": "proto"}
+        except KeyError as e:
+            return {"ok": False, "err": str(e), "kind": "exists"}
+        except ValueError as e:
+            return {"ok": False, "err": str(e), "kind": "cas"}
+
+    def _serve_watch(self, sock, req: dict):
+        """Push every change of one key until the client disconnects."""
+        key = req["key"]
+        last_sent = int(req.get("from_version", 0))
+        w = self.store.watch(key)
+        try:
+            while True:
+                v = self.store.get(key)
+                version = v.version if v else 0
+                if version != last_sent and (v is not None or last_sent != 0):
+                    try:
+                        wire.write_frame(sock, {
+                            "key": key, "data": v.data if v else None,
+                            "version": version})
+                    except (ConnectionError, OSError):
+                        return
+                    last_sent = version
+                if not w.wait(timeout=30.0):
+                    # Idle heartbeat keeps half-open connections detectable.
+                    try:
+                        wire.write_frame(sock, {"key": key, "heartbeat": True})
+                    except (ConnectionError, OSError):
+                        return
+        finally:
+            self.store.unwatch(key, w)
+
+    @property
+    def endpoint(self) -> str:
+        h, p = self._server.server_address
+        return f"{h}:{p}"
+
+    def start(self) -> "KVServer":
+        threading.Thread(target=self._server.serve_forever, daemon=True).start()
+        return self
+
+    def close(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class RemoteStore:
+    """Client to a KVServer; drop-in for MemStore across processes."""
+
+    def __init__(self, endpoint: str, timeout: float = 10.0):
+        self._endpoint = endpoint
+        self._timeout = timeout
+        self._lock = threading.Lock()     # guards the request connection
+        self._sock: Optional[socket.socket] = None
+        self._watch_lock = threading.Lock()
+        self._watch_threads: Dict[str, threading.Thread] = {}
+        self._watches: Dict[str, List[cluster_kv.Watch]] = {}
+        self._callbacks: Dict[str, List[Callable]] = {}
+        self._closed = False
+
+    # -- request/response --------------------------------------------------
+
+    def _connect(self) -> socket.socket:
+        host, _, port = self._endpoint.rpartition(":")
+        s = socket.create_connection((host, int(port)), timeout=self._timeout)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return s
+
+    def _request(self, req: dict) -> dict:
+        with self._lock:
+            for attempt in range(2):  # one reconnect attempt
+                try:
+                    if self._sock is None:
+                        self._sock = self._connect()
+                    wire.write_frame(self._sock, req)
+                    resp = wire.read_frame(self._sock)
+                    break
+                except (ConnectionError, OSError, EOFError):
+                    if self._sock is not None:
+                        try:
+                            self._sock.close()
+                        except OSError:
+                            pass
+                        self._sock = None
+                    if attempt == 1:
+                        raise
+        if resp.get("ok"):
+            return resp
+        if resp.get("kind") == "exists":
+            raise KeyError(resp.get("err", "exists"))
+        if resp.get("kind") == "cas":
+            raise ValueError(resp.get("err", "version mismatch"))
+        raise RuntimeError(resp.get("err", "kv protocol error"))
+
+    # -- MemStore surface --------------------------------------------------
+
+    def get(self, key: str) -> Optional[cluster_kv.Value]:
+        r = self._request({"op": "get", "key": key})
+        if r["version"] == 0 and r["data"] is None:
+            return None
+        return cluster_kv.Value(r["data"], r["version"])
+
+    def set(self, key: str, data: bytes) -> int:
+        return self._request({"op": "set", "key": key, "data": data})["version"]
+
+    def set_if_not_exists(self, key: str, data: bytes) -> int:
+        return self._request({"op": "setnx", "key": key, "data": data})["version"]
+
+    def check_and_set(self, key: str, expect_version: int, data: bytes) -> int:
+        return self._request({"op": "cas", "key": key,
+                              "expect": expect_version, "data": data})["version"]
+
+    def delete(self, key: str) -> Optional[cluster_kv.Value]:
+        r = self._request({"op": "delete", "key": key})
+        if not r["existed"]:
+            return None
+        return cluster_kv.Value(r["data"], r["version"])
+
+    def keys(self, prefix: str = "") -> List[str]:
+        return self._request({"op": "keys", "prefix": prefix})["keys"]
+
+    # -- watches -----------------------------------------------------------
+
+    def watch(self, key: str) -> cluster_kv.Watch:
+        # kv.Watch only calls store.get(), so it works against this store.
+        w = cluster_kv.Watch(self, key)
+        with self._watch_lock:
+            self._watches.setdefault(key, []).append(w)
+            self._ensure_watch_thread(key)
+        if self.get(key) is not None:
+            w._notify()
+        return w
+
+    def on_change(self, key: str, fn: Callable[[str, cluster_kv.Value], None]):
+        with self._watch_lock:
+            self._callbacks.setdefault(key, []).append(fn)
+            self._ensure_watch_thread(key)
+        cur = self.get(key)
+        if cur is not None:
+            fn(key, cur)
+
+    def _ensure_watch_thread(self, key: str):
+        if key in self._watch_threads:
+            return
+        t = threading.Thread(target=self._watch_loop, args=(key,), daemon=True)
+        self._watch_threads[key] = t
+        t.start()
+
+    def _watch_loop(self, key: str):
+        """Dedicated push-stream connection; reconnects with the last seen
+        version so missed intermediate versions collapse into one event
+        (same coalescing etcd watches exhibit under reconnect)."""
+        last = 0
+        while not self._closed:
+            try:
+                s = self._connect()
+                # Outlive the server's 30s idle heartbeat: a silent stream
+                # for >2 beats means the connection is dead.
+                s.settimeout(65.0)
+                wire.write_frame(s, {"op": "watch", "key": key,
+                                     "from_version": last})
+                while not self._closed:
+                    ev = wire.read_frame(s)
+                    if ev.get("heartbeat"):
+                        continue
+                    last = ev["version"]
+                    value = (cluster_kv.Value(ev["data"], last)
+                             if ev["data"] is not None else None)
+                    with self._watch_lock:
+                        watches = list(self._watches.get(key, []))
+                        callbacks = list(self._callbacks.get(key, []))
+                    for w in watches:
+                        w._notify()
+                    if value is not None:
+                        for fn in callbacks:
+                            # A raising callback (even a network error from
+                            # work it does, like a placement re-read) must
+                            # neither kill this thread — ending delivery for
+                            # every watcher of the key — nor roll the stream
+                            # back: `last` already advanced, and the server
+                            # would never re-push this version.
+                            try:
+                                fn(key, value)
+                            except Exception:  # noqa: BLE001
+                                pass
+            except (ConnectionError, OSError, EOFError):
+                if self._closed:
+                    return
+                threading.Event().wait(0.2)  # backoff, then reconnect
+
+    def close(self):
+        self._closed = True
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None

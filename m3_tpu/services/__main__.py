@@ -13,7 +13,7 @@ def main(argv=None):
     parser = argparse.ArgumentParser(prog="m3_tpu.services")
     parser.add_argument("service",
                         choices=["dbnode", "coordinator", "aggregator",
-                                 "collector"])
+                                 "collector", "kv"])
     parser.add_argument("-f", "--config", required=False, default=None,
                         help="yaml config file (defaults apply if omitted)")
     args = parser.parse_args(argv)
@@ -33,13 +33,22 @@ def main(argv=None):
             print(f"embedded coordinator on {handle.coordinator.endpoint}",
                   flush=True)
     elif args.service == "aggregator":
-        handle = runmod.run_aggregator(cfg)
+        handle = runmod.run_aggregator(
+            cfg,
+            on_placement=lambda shards: print(
+                f"placement update: owned={shards}", flush=True))
         print(f"m3_tpu aggregator listening on {handle.endpoint}", flush=True)
+    elif args.service == "kv":
+        handle = runmod.run_kv(cfg)
+        print(f"m3_tpu kv listening on {handle.endpoint}", flush=True)
     elif args.service == "coordinator":
-        print("standalone coordinator requires a dbnode session; "
-              "use dbnode with a coordinator section for the single-binary "
-              "quickstart", file=sys.stderr)
-        return 2
+        if not cfg.kv_endpoint:
+            print("standalone coordinator requires kv_endpoint (or use "
+                  "dbnode with a coordinator section for the single-binary "
+                  "quickstart)", file=sys.stderr)
+            return 2
+        handle = runmod.run_coordinator_standalone(cfg)
+        print(f"m3_tpu coordinator listening on {handle.endpoint}", flush=True)
     else:
         print("collector runs embedded; see m3_tpu.services.run.run_collector",
               file=sys.stderr)

@@ -48,6 +48,7 @@ class DBNodeConfig:
         default_factory=lambda: [NamespaceConfig()])
     commitlog_enabled: bool = True
     kv_path: str = ""          # FileStore path; empty = in-memory
+    kv_endpoint: str = ""      # networked KV service; overrides kv_path
     coordinator: Optional["CoordinatorConfig"] = None  # embedded mode
 
 
@@ -59,6 +60,8 @@ class CoordinatorConfig:
     carbon_listen_address: str = ""    # empty = disabled
     remotes: List[str] = dataclasses.field(default_factory=list)
     lookback: str = "5m"
+    kv_endpoint: str = ""              # standalone mode: cluster KV service
+    placement_key: str = "_placement"  # dbnode placement watched for routing
 
 
 @dataclasses.dataclass
@@ -70,6 +73,8 @@ class AggregatorConfig:
     election_id: str = "agg-election"
     flush_interval: str = "1s"
     kv_path: str = ""
+    kv_endpoint: str = ""
+    placement_key: str = ""    # empty = static: own all shards
     topic: str = "aggregated_metrics"
 
 
@@ -78,6 +83,15 @@ class CollectorConfig:
     num_shards: int = 64
     rules_namespace: str = "default"
     kv_path: str = ""
+    kv_endpoint: str = ""
+
+
+@dataclasses.dataclass
+class KVConfig:
+    """Standalone cluster-metadata KV service (the etcd-analog process)."""
+
+    listen_address: str = "127.0.0.1:0"
+    kv_path: str = ""          # FileStore durability; empty = in-memory
 
 
 _SERVICES = {
@@ -85,6 +99,7 @@ _SERVICES = {
     "coordinator": CoordinatorConfig,
     "aggregator": AggregatorConfig,
     "collector": CollectorConfig,
+    "kv": KVConfig,
 }
 
 

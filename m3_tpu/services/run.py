@@ -15,8 +15,10 @@ import time
 from typing import Optional
 
 from ..aggregator import Aggregator, ElectionManager, FlushTimesManager, ProducerHandler
-from ..aggregator.server import RawTCPServer
+from ..aggregator.server import RawTCPServer, TCPTransport
 from ..cluster import kv as cluster_kv
+from ..cluster import kv_service
+from ..cluster.placement import PlacementService
 from ..cluster.services import LeaderService
 from ..index.namespace_index import NamespaceIndex
 from ..parallel.sharding import ShardSet
@@ -34,10 +36,38 @@ from .config import (
 )
 
 
-def _kv_store(path: str) -> cluster_kv.MemStore:
+def _kv_store(path: str, endpoint: str = "") -> cluster_kv.MemStore:
+    if endpoint:
+        return kv_service.RemoteStore(endpoint)
     if path:
         return cluster_kv.FileStore(path)
     return cluster_kv.MemStore()
+
+
+@dataclasses.dataclass
+class KVHandle:
+    server: kv_service.KVServer
+
+    @property
+    def endpoint(self) -> str:
+        return self.server.endpoint
+
+    @property
+    def store(self):
+        return self.server.store
+
+    def close(self):
+        self.server.close()
+
+
+def run_kv(cfg) -> KVHandle:
+    """The cluster-metadata KV service process (etcd-analog): one per
+    cluster, serving placements/namespaces/elections/flush-times to every
+    other service over the framed wire with watch push."""
+    host, port = _host_port(cfg.listen_address)
+    store = cluster_kv.FileStore(cfg.kv_path) if cfg.kv_path else None
+    server = kv_service.KVServer(store, host=host, port=port).start()
+    return KVHandle(server)
 
 
 def _host_port(addr: str):
@@ -99,7 +129,7 @@ def run_dbnode(cfg: DBNodeConfig, clock=None) -> DBNodeHandle:
         hhost, hport = _host_port(cfg.http_listen_address)
         httpjson = HTTPJSONServer(service, host=hhost, port=hport).start()
     persist = PersistManager(os.path.join(cfg.data_dir, "data"))
-    kv = _kv_store(cfg.kv_path)
+    kv = _kv_store(cfg.kv_path, cfg.kv_endpoint)
     coordinator = None
     if cfg.coordinator is not None:
         from ..coordinator import run_embedded
@@ -129,9 +159,14 @@ class AggregatorHandle:
 
 
 def run_aggregator(cfg: AggregatorConfig, flush_handler=None,
-                   clock=None) -> AggregatorHandle:
-    """m3aggregator assembly: rawtcp server + election-managed flush loop."""
-    kv = _kv_store(cfg.kv_path)
+                   clock=None, on_placement=None) -> AggregatorHandle:
+    """m3aggregator assembly: rawtcp server + election-managed flush loop.
+
+    With a placement_key configured, the instance watches the aggregator
+    placement in KV (aggregator.go:307 placement watch): shard ownership
+    follows placement changes without restart, and forwarded-pipeline
+    routing targets the peers named by the placement's endpoints."""
+    kv = _kv_store(cfg.kv_path, cfg.kv_endpoint)
     clock = clock or time.time_ns
     leader = LeaderService(kv, cfg.election_id, cfg.instance_id, clock=clock)
     election = ElectionManager(leader)
@@ -141,6 +176,44 @@ def run_aggregator(cfg: AggregatorConfig, flush_handler=None,
                      flush_times=flush_times)
     host, port = _host_port(cfg.listen_address)
     server = RawTCPServer(agg, host=host, port=port).start()
+
+    if cfg.placement_key:
+        psvc = PlacementService(kv, cfg.placement_key)
+        transports = {}
+        latest = {"p": None}  # watch-updated cache; forwards must not hit KV
+
+        def _on_placement(_key, value):
+            # Parse the pushed value itself — a re-fetch through KV could
+            # fail transiently and lose the (coalesced) watch event.
+            import json as _json
+
+            from ..cluster.placement import Placement
+
+            p = Placement.from_json(_json.loads(value.data.decode()),
+                                    value.version)
+            latest["p"] = p
+            inst = p.instances.get(cfg.instance_id)
+            shards = inst.shard_ids() if inst else []
+            agg.assign_shards(shards)
+            peers = {}
+            for iid, i in p.instances.items():
+                if iid == cfg.instance_id:
+                    continue
+                tr = transports.get(iid)
+                if tr is not None and tr._endpoint != i.endpoint:
+                    tr.close()  # endpoint moved: drop the stale socket
+                    tr = None
+                if tr is None:
+                    tr = transports[iid] = TCPTransport(i.endpoint)
+                peers[iid] = tr.send_forwarded
+            for iid in set(transports) - set(p.instances):
+                transports.pop(iid).close()  # instance left the placement
+            agg.set_forward_routing(lambda: latest["p"], peers, cfg.instance_id)
+            if on_placement is not None:
+                on_placement(shards)
+
+        kv.on_change(cfg.placement_key, _on_placement)
+
     handle = AggregatorHandle(agg, server, None, kv)
     interval_s = parse_duration_ns(cfg.flush_interval) / 1e9
 
@@ -187,6 +260,26 @@ def run_coordinator(cfg: CoordinatorConfig, session=None, db=None,
     return coord
 
 
+def run_coordinator_standalone(cfg: CoordinatorConfig, clock=None):
+    """Standalone coordinator process: discovers the dbnode cluster through
+    the networked KV service (placement-watched topology) and serves the
+    query/write HTTP API over a replicating client session — the reference's
+    m3query/m3coordinator deployment shape (src/query/server/server.go:115
+    with an etcd cluster client)."""
+    from ..client.session import Session, SessionOptions
+    from ..cluster.topology import DynamicTopology
+
+    if not cfg.kv_endpoint:
+        raise ValueError("standalone coordinator requires kv_endpoint")
+    kv = kv_service.RemoteStore(cfg.kv_endpoint)
+    topo = DynamicTopology(PlacementService(kv, cfg.placement_key))
+    if topo.get() is None:
+        raise RuntimeError(
+            f"no placement at {cfg.placement_key!r} in KV {cfg.kv_endpoint}")
+    session = Session(topo, SessionOptions())
+    return run_coordinator(cfg, session=session, kv_store=kv, clock=clock)
+
+
 def run_collector(cfg: CollectorConfig, placement_getter, transports,
                   clock=None):
     """m3collector: matcher + shard-aware aggregator client + reporter."""
@@ -194,7 +287,7 @@ def run_collector(cfg: CollectorConfig, placement_getter, transports,
     from ..collector import Reporter
     from ..metrics.matcher import Matcher, RuleSetStore
 
-    kv = _kv_store(cfg.kv_path)
+    kv = _kv_store(cfg.kv_path, cfg.kv_endpoint)
     matcher = Matcher(RuleSetStore(kv), cfg.rules_namespace.encode(),
                       clock=clock)
     client = AggregatorClient(cfg.num_shards, placement_getter, transports)

@@ -1,44 +1,93 @@
 #!/usr/bin/env bash
-# End-to-end smoke over the REAL service process + HTTP surface (reference:
-# scripts/docker-integration-tests/simple/test.sh — build, create namespace
-# via the coordinator API, write, read back through HTTP).
+# Multi-process end-to-end smoke (reference: scripts/docker-integration-tests/
+# simple/test.sh, but over real cooperating processes): 1 KV metadata service
+# + 2 dbnodes + 1 standalone coordinator + 2 aggregators sharing cluster
+# state through the KV process. Verifies: scatter-gather write/query across
+# both dbnodes via the coordinator HTTP API, and an aggregator placement
+# change observed via KV watch reassigning shards without restart.
 set -euo pipefail
 
 cd "$(dirname "$0")/.."
 WORKDIR=$(mktemp -d)
-trap 'kill $PID 2>/dev/null || true; rm -rf "$WORKDIR"' EXIT
+PIDS=()
+trap 'kill "${PIDS[@]}" 2>/dev/null || true; rm -rf "$WORKDIR"' EXIT
 
-cat > "$WORKDIR/config.yml" <<EOF
+export M3_TPU_JAX_PLATFORM=${M3_TPU_JAX_PLATFORM:-cpu}
+export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
+
+await_log() { # file pattern
+  for i in $(seq 1 120); do
+    grep -q "$2" "$1" 2>/dev/null && return 0
+    sleep 0.5
+  done
+  echo "timeout waiting for '$2' in $1:"; cat "$1"; return 1
+}
+
+# --- 1. KV metadata service ------------------------------------------------
+cat > "$WORKDIR/kv.yml" <<EOF
 listen_address: 127.0.0.1:0
-data_dir: $WORKDIR/data
+EOF
+python -m m3_tpu.services kv -f "$WORKDIR/kv.yml" > "$WORKDIR/kv.log" 2>&1 &
+PIDS+=($!)
+await_log "$WORKDIR/kv.log" "m3_tpu kv listening on"
+KV=$(grep "m3_tpu kv listening on" "$WORKDIR/kv.log" | awk '{print $NF}')
+echo "kv: $KV"
+
+# --- 2. two dbnodes --------------------------------------------------------
+DB1_PORT=$(python -c "import socket; s=socket.socket(); s.bind(('127.0.0.1',0)); print(s.getsockname()[1])")
+DB2_PORT=$(python -c "import socket; s=socket.socket(); s.bind(('127.0.0.1',0)); print(s.getsockname()[1])")
+for i in 1 2; do
+  PORT_VAR="DB${i}_PORT"
+  cat > "$WORKDIR/dbnode$i.yml" <<EOF
+host_id: dbnode-$i
+listen_address: 127.0.0.1:${!PORT_VAR}
+data_dir: $WORKDIR/data$i
 num_shards: 16
+kv_endpoint: $KV
 namespaces:
   - name: default
     retention: 2h
-coordinator:
-  namespace: default
+EOF
+  python -m m3_tpu.services dbnode -f "$WORKDIR/dbnode$i.yml" > "$WORKDIR/dbnode$i.log" 2>&1 &
+  PIDS+=($!)
+done
+await_log "$WORKDIR/dbnode1.log" "m3_tpu dbnode listening on"
+await_log "$WORKDIR/dbnode2.log" "m3_tpu dbnode listening on"
+echo "dbnodes: 127.0.0.1:$DB1_PORT 127.0.0.1:$DB2_PORT"
+
+# --- 3. dbnode placement in KV --------------------------------------------
+python - "$KV" "127.0.0.1:$DB1_PORT" "127.0.0.1:$DB2_PORT" <<'EOF'
+import sys
+from m3_tpu.cluster.kv_service import RemoteStore
+from m3_tpu.cluster.placement import Instance, PlacementService
+kv, db1, db2 = sys.argv[1:4]
+st = RemoteStore(kv)
+PlacementService(st, "_placement").init(
+    [Instance("dbnode-1", db1), Instance("dbnode-2", db2)],
+    num_shards=16, replica_factor=1)
+print("dbnode placement initialized")
 EOF
 
-M3_TPU_JAX_PLATFORM=${M3_TPU_JAX_PLATFORM:-cpu} python -m m3_tpu.services dbnode -f "$WORKDIR/config.yml" > "$WORKDIR/out.log" 2>&1 &
-PID=$!
-
-for i in $(seq 1 60); do
-  grep -q "embedded coordinator on" "$WORKDIR/out.log" 2>/dev/null && break
-  kill -0 $PID || { echo "service died:"; cat "$WORKDIR/out.log"; exit 1; }
-  sleep 0.5
-done
-COORD=$(grep "embedded coordinator on" "$WORKDIR/out.log" | awk '{print $NF}')
+# --- 4. standalone coordinator --------------------------------------------
+cat > "$WORKDIR/coord.yml" <<EOF
+namespace: default
+kv_endpoint: $KV
+EOF
+python -m m3_tpu.services coordinator -f "$WORKDIR/coord.yml" > "$WORKDIR/coord.log" 2>&1 &
+PIDS+=($!)
+await_log "$WORKDIR/coord.log" "m3_tpu coordinator listening on"
+COORD=$(grep "m3_tpu coordinator listening on" "$WORKDIR/coord.log" | awk '{print $NF}')
 echo "coordinator: $COORD"
 
 curl -fsS "$COORD/health" > /dev/null
 
-curl -fsS -X POST "$COORD/api/v1/database/create" \
-  -d '{"type":"local","namespaceName":"smoke"}' > /dev/null
-
+# --- 5. scatter-gather writes + PromQL reads across both dbnodes ----------
 NOW=$(python -c "import time; print(int(time.time()))")
-for i in 0 1 2 3 4; do
-  curl -fsS -X POST "$COORD/api/v1/json/write" \
-    -d "{\"tags\":{\"__name__\":\"smoke_metric\",\"host\":\"a\"},\"timestamp\":$((NOW - 40 + i * 10)),\"value\":$((10 + i))}" > /dev/null
+for h in a b c d e f; do  # several hosts so shards land on both dbnodes
+  for i in 0 1 2 3 4; do
+    curl -fsS -X POST "$COORD/api/v1/json/write" \
+      -d "{\"tags\":{\"__name__\":\"smoke_metric\",\"host\":\"$h\"},\"timestamp\":$((NOW - 40 + i * 10)),\"value\":$((10 + i))}" > /dev/null
+  done
 done
 
 RESULT=$(curl -fsS "$COORD/api/v1/query_range?query=smoke_metric&start=$((NOW-60))&end=$NOW&step=10")
@@ -47,13 +96,13 @@ import json, sys
 out = json.load(sys.stdin)
 assert out['status'] == 'success', out
 series = out['data']['result']
-assert len(series) == 1, series
-vals = [float(v) for _, v in series[0]['values']]
-assert vals[-1] == 14.0, vals
-print('query_range round trip OK:', vals)
+assert len(series) == 6, [s['metric'] for s in series]
+for s in series:
+    vals = [float(v) for _, v in s['values']]
+    assert vals[-1] == 14.0, (s['metric'], vals)
+print('scatter-gather query_range across 2 dbnodes OK (6 series)')
 "
 
-# Graphite path: carbon-style write via json + render.
 RESULT2=$(curl -fsS "$COORD/api/v1/query_range?query=sum(rate(smoke_metric%5B30s%5D))&start=$((NOW-30))&end=$NOW&step=10")
 echo "$RESULT2" | python -c "
 import json, sys
@@ -61,5 +110,50 @@ out = json.load(sys.stdin)
 assert out['status'] == 'success', out
 print('promql function over HTTP OK')
 "
+
+# --- 6. aggregators with placement watch ----------------------------------
+for a in a b; do
+  cat > "$WORKDIR/agg$a.yml" <<EOF
+instance_id: agg-$a
+listen_address: 127.0.0.1:0
+num_shards: 8
+kv_endpoint: $KV
+placement_key: _placement/agg
+election_id: agg-election-$a
+flush_interval: 5s
+EOF
+  python -m m3_tpu.services aggregator -f "$WORKDIR/agg$a.yml" > "$WORKDIR/agg$a.log" 2>&1 &
+  PIDS+=($!)
+done
+await_log "$WORKDIR/agga.log" "m3_tpu aggregator listening on"
+await_log "$WORKDIR/aggb.log" "m3_tpu aggregator listening on"
+AGG_A=$(grep "m3_tpu aggregator listening on" "$WORKDIR/agga.log" | awk '{print $NF}')
+AGG_B=$(grep "m3_tpu aggregator listening on" "$WORKDIR/aggb.log" | awk '{print $NF}')
+
+# Initial aggregator placement: agg-a owns everything.
+python - "$KV" "$AGG_A" <<'EOF'
+import sys
+from m3_tpu.cluster.kv_service import RemoteStore
+from m3_tpu.cluster.placement import Instance, PlacementService
+kv, agg_a = sys.argv[1:3]
+PlacementService(RemoteStore(kv), "_placement/agg").init(
+    [Instance("agg-a", agg_a)], num_shards=8, replica_factor=1)
+print("aggregator placement initialized (agg-a only)")
+EOF
+await_log "$WORKDIR/agga.log" "placement update: owned=\[0, 1, 2, 3, 4, 5, 6, 7\]"
+echo "agg-a owns all 8 shards"
+
+# Placement change: add agg-b; both instances observe via KV watch push.
+python - "$KV" "$AGG_B" <<'EOF'
+import sys
+from m3_tpu.cluster.kv_service import RemoteStore
+from m3_tpu.cluster.placement import Instance, PlacementService
+kv, agg_b = sys.argv[1:3]
+PlacementService(RemoteStore(kv), "_placement/agg").add_instance(
+    Instance("agg-b", agg_b))
+print("aggregator placement changed (added agg-b)")
+EOF
+await_log "$WORKDIR/aggb.log" "placement update: owned=\[[0-7]"
+echo "agg-b picked up shards from the placement change via watch (no restart)"
 
 echo "SMOKE PASS"

@@ -1,0 +1,161 @@
+"""Networked KV service: cross-process metadata plane (reference:
+src/cluster/kv/etcd/store.go semantics — versioned CAS KV with watch
+streams; src/cluster/etcd/watchmanager/watch_manager.go). RemoteStore must
+be a drop-in for MemStore so placements/elections/flush-times work
+identically across processes."""
+
+import time
+
+import pytest
+
+from m3_tpu.cluster import kv as cluster_kv
+from m3_tpu.cluster.kv_service import KVServer, RemoteStore
+from m3_tpu.cluster.placement import Instance, PlacementService
+from m3_tpu.services import config as svc_config
+from m3_tpu.services import run as svc_run
+
+
+def _await(cond, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return cond()
+
+
+@pytest.fixture
+def server():
+    srv = KVServer().start()
+    yield srv
+    srv.close()
+
+
+class TestRemoteStoreParity:
+    def test_get_set_versioning(self, server):
+        st = RemoteStore(server.endpoint)
+        assert st.get("k") is None
+        assert st.set("k", b"v1") == 1
+        assert st.set("k", b"v2") == 2
+        v = st.get("k")
+        assert v.data == b"v2" and v.version == 2
+
+    def test_setnx_and_cas(self, server):
+        st = RemoteStore(server.endpoint)
+        assert st.set_if_not_exists("k", b"a") == 1
+        with pytest.raises(KeyError):
+            st.set_if_not_exists("k", b"b")
+        assert st.check_and_set("k", 1, b"c") == 2
+        with pytest.raises(ValueError):
+            st.check_and_set("k", 1, b"d")  # stale version
+        with pytest.raises(ValueError):
+            st.check_and_set("new", 5, b"x")  # 0 means not-exists
+
+    def test_delete_and_keys(self, server):
+        st = RemoteStore(server.endpoint)
+        st.set("a/1", b"x")
+        st.set("a/2", b"y")
+        st.set("b/1", b"z")
+        assert st.keys("a/") == ["a/1", "a/2"]
+        assert st.delete("a/1") is not None
+        assert st.delete("a/1") is None
+        assert st.keys("a/") == ["a/2"]
+
+    def test_reconnect_after_server_side_close(self, server):
+        st = RemoteStore(server.endpoint)
+        st.set("k", b"v")
+        # Kill the pooled connection server-side; next request reconnects.
+        st._sock.close()
+        assert st.get("k").data == b"v"
+
+
+class TestWatchPush:
+    def test_watch_fires_across_clients(self, server):
+        writer = RemoteStore(server.endpoint)
+        reader = RemoteStore(server.endpoint)
+        w = reader.watch("key")
+        writer.set("key", b"v1")
+        assert w.wait(timeout=5.0)
+        assert w.get().data == b"v1"
+        writer.set("key", b"v2")
+        assert w.wait(timeout=5.0)
+        assert w.get().version == 2
+
+    def test_on_change_pushes_values(self, server):
+        writer = RemoteStore(server.endpoint)
+        reader = RemoteStore(server.endpoint)
+        seen = []
+        reader.on_change("cfg", lambda key, v: seen.append((v.version, v.data)))
+        writer.set("cfg", b"one")
+        assert _await(lambda: (1, b"one") in seen)
+        writer.set("cfg", b"two")
+        assert _await(lambda: (2, b"two") in seen)
+
+    def test_watch_delivers_current_value_immediately(self, server):
+        writer = RemoteStore(server.endpoint)
+        writer.set("pre", b"existing")
+        reader = RemoteStore(server.endpoint)
+        seen = []
+        reader.on_change("pre", lambda key, v: seen.append(v.data))
+        assert _await(lambda: b"existing" in seen)
+
+
+class TestServicesOverNetworkedKV:
+    def test_election_and_flush_times_across_processes(self, server):
+        """LeaderService + FlushTimesManager work unchanged on RemoteStore
+        (the point of interface parity: one KV process serves the cluster)."""
+        from m3_tpu.aggregator import FlushTimesManager
+        from m3_tpu.cluster.services import LeaderService
+
+        st_a = RemoteStore(server.endpoint)
+        st_b = RemoteStore(server.endpoint)
+        clock = lambda: time.time_ns()
+        la = LeaderService(st_a, "e1", "inst-a", clock=clock)
+        lb = LeaderService(st_b, "e1", "inst-b", clock=clock)
+        from m3_tpu.cluster.services import CampaignState
+
+        assert la.campaign() == CampaignState.LEADER
+        assert lb.campaign() == CampaignState.FOLLOWER
+        assert lb.leader() == "inst-a"
+        fa = FlushTimesManager(st_a, "ss")
+        fb = FlushTimesManager(st_b, "ss")
+        fa.store(0, {10_000_000_000: 123})
+        assert _await(lambda: fb.get(0).get(10_000_000_000) == 123)
+
+    def test_aggregator_placement_watch_assigns_shards(self, server):
+        """Placement written to the KV service propagates to running
+        aggregator instances via watch: shard ownership changes without
+        restart (aggregator.go:307)."""
+        admin = RemoteStore(server.endpoint)
+        psvc = PlacementService(admin, "_placement/agg")
+        psvc.init([Instance("agg-a", "a:1"), Instance("agg-b", "b:1")],
+                  num_shards=8, replica_factor=1)
+        handles = {}
+        assigns = {"agg-a": [], "agg-b": []}
+        try:
+            for iid in ("agg-a", "agg-b"):
+                cfg = svc_config.load_dict({
+                    "instance_id": iid, "num_shards": 8,
+                    "kv_endpoint": server.endpoint,
+                    "placement_key": "_placement/agg",
+                    "election_id": f"e-{iid}",
+                    "flush_interval": "10s",
+                }, "aggregator")
+                handles[iid] = svc_run.run_aggregator(
+                    cfg, on_placement=assigns[iid].append)
+            assert _await(lambda: assigns["agg-a"] and assigns["agg-b"])
+            a_owned = set(handles["agg-a"].aggregator.owned_shards())
+            b_owned = set(handles["agg-b"].aggregator.owned_shards())
+            assert a_owned | b_owned == set(range(8))
+            assert a_owned.isdisjoint(b_owned)
+            # Placement change: drop agg-b; its shards move to agg-a, both
+            # instances observe it via watch push.
+            psvc.remove_instance("agg-b")
+            assert _await(
+                lambda: set(handles["agg-a"].aggregator.owned_shards())
+                == set(range(8)))
+            assert _await(
+                lambda: handles["agg-b"].aggregator.owned_shards() == [])
+        finally:
+            for h in handles.values():
+                h.close()

@@ -104,6 +104,72 @@ class TestAggregatorNetworkPath:
         finally:
             srv.close()
 
+    def test_multi_server_forwarding_pipeline(self):
+        """Rollup pipeline crossing two real aggregator instances over TCP
+        (mirrors the reference's multi_server_forwarding_pipeline_test.go):
+        stage 1 aggregates source gauges on instance A, the forwarded writer
+        routes the partials to instance B (owner of the rollup ID's shard)
+        over the rawtcp wire, and the rolled-up metric lands exactly once,
+        with the correct value, on B."""
+        from m3_tpu.cluster.placement import Instance, initial_placement
+        from m3_tpu.metrics import aggregation as magg
+        from m3_tpu.metrics.pipeline import Op, Pipeline
+        from m3_tpu.utils.hashing import murmur3_32
+
+        num_shards = 4
+        placement = initial_placement(
+            [Instance("agg-a", "a:1"), Instance("agg-b", "b:1")],
+            num_shards, replica_factor=1)
+        owned = {iid: set(placement.instances[iid].shard_ids())
+                 for iid in ("agg-a", "agg-b")}
+        clock = SettableClock(100 * S)
+        caps = {iid: CaptureHandler() for iid in owned}
+        aggs = {iid: Aggregator(num_shards=num_shards, clock=clock,
+                                flush_handler=caps[iid]) for iid in owned}
+        for iid, agg in aggs.items():
+            agg.assign_shards(sorted(owned[iid]))
+        srvs = {iid: RawTCPServer(agg).start() for iid, agg in aggs.items()}
+        try:
+            transports = {iid: TCPTransport(srv.endpoint)
+                          for iid, srv in srvs.items()}
+            for iid, agg in aggs.items():
+                agg.set_forward_routing(
+                    lambda: placement,
+                    {peer: transports[peer].send_forwarded
+                     for peer in owned if peer != iid},
+                    iid)
+
+            def owner(mid: bytes) -> str:
+                shard = murmur3_32(mid) % num_shards
+                return next(i for i, s in owned.items() if shard in s)
+
+            # A rollup ID owned by B, and two source IDs owned by A.
+            rollup_id = next(b"cross+n=%d" % i for i in range(64)
+                             if owner(b"cross+n=%d" % i) == "agg-b")
+            sources = [m for m in (b"lat+svc=%d" % i for i in range(64))
+                       if owner(m) == "agg-a"][:2]
+            pipe = Pipeline((Op.roll(rollup_id, (b"region",),
+                                     magg.AggID.compress([magg.AggType.SUM])),))
+            md = (StagedMetadata(0, False, Metadata((PipelineMetadata(
+                magg.AggID.compress([magg.AggType.LAST]), (TEN_S,), pipe),))),)
+            for mid, v in zip(sources, (10.0, 20.0)):
+                assert aggs["agg-a"].add_untimed(MetricUnion.gauge(mid, v), md)
+            assert aggs["agg-b"].num_entries() == 0
+            clock.advance(10 * S)
+            aggs["agg-a"].flush()   # stage 1 -> forwards over the wire to B
+            assert _await(lambda: aggs["agg-b"].num_entries() == 1)
+            clock.advance(10 * S)
+            for agg in aggs.values():
+                agg.flush()         # stage 2 on B consumes the partials
+            out = caps["agg-b"].by_id(rollup_id + b".sum")
+            assert len(out) == 1 and out[0].value == 30.0
+            # ... and nowhere else: the rollup landed exactly once.
+            assert not caps["agg-a"].by_id(rollup_id + b".sum")
+            assert aggs["agg-a"]._forward.dropped == 0
+        finally:
+            for srv in srvs.values():
+                srv.close()
+
     def test_aggregator_service_flush_loop(self):
         cap = CaptureHandler()
         cfg = svc_config.load_dict(
