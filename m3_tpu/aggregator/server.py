@@ -244,10 +244,10 @@ class TCPTransport:
                 pass
 
     def close(self):
+        """Drop the connection and discard buffered entries — callers are
+        placement updates retiring a stale peer, where flushing would send
+        metrics to an instance that no longer owns them. Flush explicitly
+        first for a graceful shutdown."""
         with self._lock:
             self._batch = []
-        self._drop_conn()
-
-    def close(self):
-        self.flush()
         self._drop_conn()

@@ -139,6 +139,7 @@ class RemoteStore:
         self._watch_threads: Dict[str, threading.Thread] = {}
         self._watches: Dict[str, List[cluster_kv.Watch]] = {}
         self._callbacks: Dict[str, List[Callable]] = {}
+        self._last_seen: Dict[str, cluster_kv.Value] = {}
         self._closed = False
 
     # -- request/response --------------------------------------------------
@@ -150,10 +151,12 @@ class RemoteStore:
         return s
 
     def _request(self, req: dict) -> dict:
+        read_only = req.get("op") in ("get", "keys")
         with self._lock:
             for attempt in range(2):  # one reconnect attempt
+                fresh = self._sock is None
                 try:
-                    if self._sock is None:
+                    if fresh:
                         self._sock = self._connect()
                     wire.write_frame(self._sock, req)
                     resp = wire.read_frame(self._sock)
@@ -165,7 +168,14 @@ class RemoteStore:
                         except OSError:
                             pass
                         self._sock = None
-                    if attempt == 1:
+                    # Reads retry freely. A mutation is retried only when it
+                    # failed on a stale pooled socket (dead since last use,
+                    # bytes never processed); on a fresh connection the
+                    # server may already have applied it, and a blind
+                    # re-send would double-apply a set or fail a CAS that in
+                    # fact won — surface the error, the caller decides
+                    # (at-most-once, as with etcd client errors).
+                    if attempt == 1 or (not read_only and fresh):
                         raise
         if resp.get("ok"):
             return resp
@@ -215,12 +225,19 @@ class RemoteStore:
         return w
 
     def on_change(self, key: str, fn: Callable[[str, cluster_kv.Value], None]):
+        """Callback watch; like MemStore, fires once with the current value
+        if the key exists. The initial fire is coalesced with the watch
+        stream: a brand-new stream pushes the current value itself, so the
+        local fire only happens when the stream already delivered one
+        (otherwise a registration racing the initial push would invoke the
+        callback twice, concurrently, with the same value)."""
         with self._watch_lock:
             self._callbacks.setdefault(key, []).append(fn)
+            started = key not in self._watch_threads
             self._ensure_watch_thread(key)
-        cur = self.get(key)
-        if cur is not None:
-            fn(key, cur)
+            cached = None if started else self._last_seen.get(key)
+        if cached is not None:
+            fn(key, cached)
 
     def _ensure_watch_thread(self, key: str):
         if key in self._watch_threads:
@@ -250,6 +267,11 @@ class RemoteStore:
                     value = (cluster_kv.Value(ev["data"], last)
                              if ev["data"] is not None else None)
                     with self._watch_lock:
+                        # Cache + snapshot under one lock hold so on_change's
+                        # registered-then-cached check can't interleave into
+                        # a double initial fire.
+                        if value is not None:
+                            self._last_seen[key] = value
                         watches = list(self._watches.get(key, []))
                         callbacks = list(self._callbacks.get(key, []))
                     for w in watches:

@@ -49,7 +49,8 @@ class HTTPApi:
             ("GET", r"/api/v1/series", self.series),
             ("GET", r"/api/v1/search", self.series),
             ("POST", r"/api/v1/json/write", self.json_write),
-            ("POST", r"/api/v1/prom/remote/write", self.json_write),
+            ("POST", r"/api/v1/prom/remote/write", self.prom_remote_write),
+            ("POST", r"/api/v1/prom/remote/read", self.prom_remote_read),
             ("GET", r"/api/v1/graphite/render", self.graphite_render),
             ("POST", r"/api/v1/graphite/render", self.graphite_render),
             ("GET", r"/api/v1/graphite/find", self.graphite_find),
@@ -161,6 +162,53 @@ class HTTPApi:
                 wrote += 1
         return {"status": "success", "wrote": wrote}
 
+    def prom_remote_write(self, req):
+        """api/v1/handler/prometheus/remote/write.go:46 — snappy-compressed
+        protobuf prompb.WriteRequest, the wire format a real Prometheus
+        remote_write sends. Sample timestamps are milliseconds."""
+        from . import promremote
+
+        if self.writer is None:
+            raise HTTPError(501, "no write backend configured")
+        try:
+            raw = promremote.snappy_decompress(req.body)
+            series = promremote.decode_write_request(raw)
+        except (promremote.SnappyError, promremote.ProtoError) as e:
+            raise HTTPError(400, f"bad remote write body: {e}")
+        wrote = 0
+        for tags, samples in series:
+            for t_ms, value in samples:
+                self.writer.write(tags, t_ms * 1_000_000, value)
+                wrote += 1
+        return {"status": "success", "wrote": wrote}
+
+    def prom_remote_read(self, req):
+        """remote/read.go — snappy+proto prompb.ReadRequest in,
+        prompb.ReadResponse out (raw bytes, snappy-compressed)."""
+        from . import promremote
+
+        try:
+            raw = promremote.snappy_decompress(req.body)
+            queries = promremote.decode_read_request(raw)
+        except (promremote.SnappyError, promremote.ProtoError) as e:
+            raise HTTPError(400, f"bad remote read body: {e}")
+        results = []
+        for q in queries:
+            series = self.engine.storage.fetch_raw(
+                q["matchers"], q["start_ms"] * 1_000_000,
+                q["end_ms"] * 1_000_000 + 1)
+            out = []
+            for sid in sorted(series):
+                entry = series[sid]
+                samples = [(int(t) // 1_000_000, float(v))
+                           for t, v in zip(entry["t"], entry["v"])]
+                out.append((dict(entry["tags"]), samples))
+            results.append(out)
+        body = promremote.snappy_compress(
+            promremote.encode_read_response(results))
+        return RawResponse("application/x-protobuf", body,
+                           headers={"Content-Encoding": "snappy"})
+
     def graphite_render(self, req) -> list:
         """api/v1/handler/graphite/render.go: graphite-web compatible
         /render — list of {target, datapoints: [[v, t], ...]}."""
@@ -242,10 +290,17 @@ class HTTPApi:
                             out, code = {"status": "error", "error": e.msg}, e.code
                         except Exception as e:  # noqa: BLE001
                             out, code = {"status": "error", "error": str(e)}, 400
-                        data = json.dumps(out).encode()
+                        if isinstance(out, RawResponse):
+                            ctype, data = out.content_type, out.data
+                            extra = out.headers
+                        else:
+                            ctype, data = "application/json", json.dumps(out).encode()
+                            extra = {}
                         self.send_response(code)
-                        self.send_header("Content-Type", "application/json")
+                        self.send_header("Content-Type", ctype)
                         self.send_header("Content-Length", str(len(data)))
+                        for k, v in extra.items():
+                            self.send_header(k, v)
                         self.end_headers()
                         self.wfile.write(data)
                         return
@@ -267,6 +322,16 @@ class HTTPApi:
         if self._server is not None:
             self._server.shutdown()
             self._server.server_close()
+
+
+class RawResponse:
+    """Non-JSON handler result: raw bytes with an explicit content type
+    (the remote-read protobuf response path)."""
+
+    def __init__(self, content_type: str, data: bytes, headers=None):
+        self.content_type = content_type
+        self.data = data
+        self.headers = headers or {}
 
 
 class Request:

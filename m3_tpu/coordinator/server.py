@@ -41,7 +41,8 @@ class Coordinator:
 
 def _build(storage, aggregated_storages: Dict[StoragePolicy, object],
            kv_store: Optional[cluster_kv.MemStore],
-           rules_namespace: bytes, clock, create_namespace) -> Coordinator:
+           rules_namespace: bytes, clock, create_namespace,
+           listen=("127.0.0.1", 0)) -> Coordinator:
     downsampler = None
     if kv_store is not None:
         matcher = Matcher(RuleSetStore(kv_store), rules_namespace, clock=clock)
@@ -55,7 +56,7 @@ def _build(storage, aggregated_storages: Dict[StoragePolicy, object],
     engine = Engine(storage)
     admin = AdminAPI(kv_store if kv_store is not None else cluster_kv.MemStore(),
                      create_namespace=create_namespace)
-    api = HTTPApi(engine, writer, admin=admin).serve()
+    api = HTTPApi(engine, writer, admin=admin).serve(*listen)
     return Coordinator(engine, writer, api, downsampler, admin)
 
 
@@ -63,7 +64,7 @@ def run_embedded(db, namespace: bytes = b"default",
                  kv_store: Optional[cluster_kv.MemStore] = None,
                  rules_namespace: bytes = b"default",
                  aggregated_namespaces: Optional[Dict[StoragePolicy, bytes]] = None,
-                 clock=None) -> Coordinator:
+                 clock=None, listen=("127.0.0.1", 0)) -> Coordinator:
     storage = LocalStorage(db, namespace)
     agg = {
         policy: LocalStorage(db, ns)
@@ -80,17 +81,18 @@ def run_embedded(db, namespace: bytes = b"default",
                 index=NamespaceIndex(clock=db.clock))
 
     return _build(storage, agg, kv_store, rules_namespace, clock,
-                  create_namespace)
+                  create_namespace, listen)
 
 
 def run_clustered(session, namespace: bytes = b"default",
                   kv_store: Optional[cluster_kv.MemStore] = None,
                   rules_namespace: bytes = b"default",
                   aggregated_namespaces: Optional[Dict[StoragePolicy, bytes]] = None,
-                  clock=None) -> Coordinator:
+                  clock=None, listen=("127.0.0.1", 0)) -> Coordinator:
     storage = SessionStorage(session, namespace)
     agg = {
         policy: SessionStorage(session, ns)
         for policy, ns in (aggregated_namespaces or {}).items()
     }
-    return _build(storage, agg, kv_store, rules_namespace, clock, None)
+    return _build(storage, agg, kv_store, rules_namespace, clock, None,
+                  listen)

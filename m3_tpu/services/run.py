@@ -137,7 +137,7 @@ def run_dbnode(cfg: DBNodeConfig, clock=None) -> DBNodeHandle:
         coordinator = run_embedded(
             db, namespace=cfg.coordinator.namespace.encode(), kv_store=kv,
             rules_namespace=cfg.coordinator.rules_namespace.encode(),
-            clock=db.clock)
+            clock=db.clock, listen=_host_port(cfg.coordinator.listen_address))
     return DBNodeHandle(db, server, persist, coordinator, kv, lock, httpjson)
 
 
@@ -178,7 +178,6 @@ def run_aggregator(cfg: AggregatorConfig, flush_handler=None,
     server = RawTCPServer(agg, host=host, port=port).start()
 
     if cfg.placement_key:
-        psvc = PlacementService(kv, cfg.placement_key)
         transports = {}
         latest = {"p": None}  # watch-updated cache; forwards must not hit KV
 
@@ -240,16 +239,17 @@ def run_coordinator(cfg: CoordinatorConfig, session=None, db=None,
 
     if (session is None) == (db is None):
         raise ValueError("exactly one of session/db required")
+    listen = _host_port(cfg.listen_address)
     if db is not None:
         coord = run_embedded(db, namespace=cfg.namespace.encode(),
                              kv_store=kv_store,
                              rules_namespace=cfg.rules_namespace.encode(),
-                             clock=clock)
+                             clock=clock, listen=listen)
     else:
         coord = run_clustered(session, namespace=cfg.namespace.encode(),
                               kv_store=kv_store,
                               rules_namespace=cfg.rules_namespace.encode(),
-                              clock=clock)
+                              clock=clock, listen=listen)
     if cfg.remotes:
         stores = [coord.engine.storage] + [RemoteStorage(r) for r in cfg.remotes]
         coord.engine.storage = FanoutStorage(stores)
