@@ -154,9 +154,8 @@ class RemoteStore:
         read_only = req.get("op") in ("get", "keys")
         with self._lock:
             for attempt in range(2):  # one reconnect attempt
-                fresh = self._sock is None
                 try:
-                    if fresh:
+                    if self._sock is None:
                         self._sock = self._connect()
                     wire.write_frame(self._sock, req)
                     resp = wire.read_frame(self._sock)
@@ -168,14 +167,15 @@ class RemoteStore:
                         except OSError:
                             pass
                         self._sock = None
-                    # Reads retry freely. A mutation is retried only when it
-                    # failed on a stale pooled socket (dead since last use,
-                    # bytes never processed); on a fresh connection the
-                    # server may already have applied it, and a blind
-                    # re-send would double-apply a set or fail a CAS that in
-                    # fact won — surface the error, the caller decides
-                    # (at-most-once, as with etcd client errors).
-                    if attempt == 1 or (not read_only and fresh):
+                    # Only reads retry. A failed mutation is never re-sent:
+                    # whether the failure hit a stale pooled socket or ate
+                    # the reply mid-request is indistinguishable without
+                    # request IDs, and in the latter case the server already
+                    # applied it — a blind re-send double-applies a set or
+                    # fails a CAS that in fact won. Surface the error; the
+                    # caller re-reads state to recover (at-most-once, as
+                    # with etcd client errors).
+                    if attempt == 1 or not read_only:
                         raise
         if resp.get("ok"):
             return resp
@@ -269,9 +269,12 @@ class RemoteStore:
                     with self._watch_lock:
                         # Cache + snapshot under one lock hold so on_change's
                         # registered-then-cached check can't interleave into
-                        # a double initial fire.
+                        # a double initial fire. Deletes clear the cache: a
+                        # later registration must not see a dead value.
                         if value is not None:
                             self._last_seen[key] = value
+                        else:
+                            self._last_seen.pop(key, None)
                         watches = list(self._watches.get(key, []))
                         callbacks = list(self._callbacks.get(key, []))
                     for w in watches:

@@ -97,11 +97,15 @@ def snappy_decompress(buf: bytes) -> bytes:
             pos += 4
         if offset == 0 or offset > len(out):
             raise SnappyError("copy offset out of range")
-        # Copies may overlap forward (offset < length): byte-at-a-time
-        # semantics, the run-length trick snappy uses for RLE.
         start = len(out) - offset
-        for i in range(ln):
-            out.append(out[start + i])
+        if offset >= ln:
+            # Non-overlapping (the common label-dedup case): bulk slice.
+            out += out[start:start + ln]
+        else:
+            # Overlapping forward copy (offset < length): byte-at-a-time
+            # semantics, the run-length trick snappy uses for RLE.
+            for i in range(ln):
+                out.append(out[start + i])
     if len(out) != n:
         raise SnappyError(f"length mismatch: header {n}, decoded {len(out)}")
     return bytes(out)
@@ -123,18 +127,15 @@ def snappy_compress(data: bytes) -> bytes:
     pos = 0
     while pos < len(data):
         chunk = data[pos:pos + 65536]
-        ln = len(chunk) - 1
+        ln = len(chunk) - 1  # <= 65535 by the chunk cap
         if ln < 60:
             out.append(ln << 2)
         elif ln < (1 << 8):
             out.append(60 << 2)
             out += ln.to_bytes(1, "little")
-        elif ln < (1 << 16):
+        else:
             out.append(61 << 2)
             out += ln.to_bytes(2, "little")
-        else:
-            out.append(62 << 2)
-            out += ln.to_bytes(3, "little")
         out += chunk
         pos += len(chunk)
     return bytes(out)

@@ -11,6 +11,7 @@ and expires blocks beyond retention."""
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
@@ -66,6 +67,13 @@ class NamespaceIndex:
         self.clock = clock
         self.blocks: Dict[int, IndexBlock] = {}
         self._known: Set[bytes] = set()
+        # Inserts arrive concurrently from every shard's write path and
+        # race queries and the mediator's tick/seal (the per-shard locks do
+        # not serialize cross-shard index access — reference: index.go
+        # nsIndex RWMutex). One reentrant lock guards blocks, _known, and
+        # every mutable-segment access; sealed ImmutableSegments are
+        # read-only and safe outside it once obtained.
+        self._lock = threading.RLock()
 
     def _block_for(self, t_ns: int) -> IndexBlock:
         bs = xtime.truncate(t_ns, self.block_size_ns)
@@ -76,50 +84,77 @@ class NamespaceIndex:
 
     def insert(self, series_id: bytes, tags: dict, t_ns: Optional[int] = None):
         """nsIndex.WriteBatch analog (per new series)."""
-        if series_id in self._known:
-            return
-        self._known.add(series_id)
-        if t_ns is None:
-            t_ns = self.clock() if self.clock else 0
-        self._block_for(t_ns).mutable.insert(tags_to_doc(series_id, tags))
+        with self._lock:
+            if series_id in self._known:
+                return
+            self._known.add(series_id)
+            if t_ns is None:
+                t_ns = self.clock() if self.clock else 0
+            self._block_for(t_ns).mutable.insert(tags_to_doc(series_id, tags))
 
     def insert_batch(self, items: List[Tuple[bytes, dict]], t_ns: int):
-        blk = self._block_for(t_ns)
-        for sid, tags in items:
-            if sid not in self._known:
-                self._known.add(sid)
-                blk.mutable.insert(tags_to_doc(sid, tags))
+        with self._lock:
+            blk = self._block_for(t_ns)
+            for sid, tags in items:
+                if sid not in self._known:
+                    self._known.add(sid)
+                    blk.mutable.insert(tags_to_doc(sid, tags))
+
+    def _split_segments(self, start_ns, end_ns, run_mutable):
+        """Under the lock: collect overlapping blocks' immutable segments
+        (read-only once sealed, safe to scan lock-free) and run
+        `run_mutable` on each live mutable segment while still inside the
+        lock. Keeps arbitrary query work off the write path's critical
+        section — the nsIndex RWMutex trade, without serializing ingest
+        behind every regexp scan."""
+        imm = []
+        with self._lock:
+            for bs, blk in list(self.blocks.items()):
+                if bs + self.block_size_ns <= start_ns or bs >= end_ns:
+                    continue
+                imm.extend(blk.immutable)
+                if len(blk.mutable):
+                    run_mutable(blk.mutable)
+        return imm
 
     def query(self, q: Query, start_ns: int = 0, end_ns: int = 2**63 - 1) -> List[bytes]:
         """nsIndex.Query: union across blocks overlapping [start, end)."""
         out: Set[bytes] = set()
-        for bs, blk in self.blocks.items():
-            if bs + self.block_size_ns <= start_ns or bs >= end_ns:
-                continue
-            out |= blk.query(q)
+
+        def scan(seg):
+            for pos in execute(seg, q):
+                out.add(seg.doc(int(pos)).id)
+
+        imm = self._split_segments(start_ns, end_ns, scan)
+        for seg in imm:
+            scan(seg)
         return sorted(out)
 
     def aggregate_terms(self, field: bytes, start_ns: int = 0, end_ns: int = 2**63 - 1) -> List[bytes]:
         """Distinct values for a tag (complete-tags / tag-values API)."""
         vals: Set[bytes] = set()
-        for bs, blk in self.blocks.items():
-            if bs + self.block_size_ns <= start_ns or bs >= end_ns:
-                continue
-            for seg in blk.segments():
-                vals.update(seg.terms(field))
+        imm = self._split_segments(start_ns, end_ns,
+                                   lambda seg: vals.update(seg.terms(field)))
+        for seg in imm:
+            vals.update(seg.terms(field))
         return sorted(vals)
 
     def fields(self, start_ns: int = 0, end_ns: int = 2**63 - 1) -> List[bytes]:
         names: Set[bytes] = set()
-        for bs, blk in self.blocks.items():
-            if bs + self.block_size_ns <= start_ns or bs >= end_ns:
-                continue
-            for seg in blk.segments():
-                names.update(seg.fields())
+        imm = self._split_segments(start_ns, end_ns,
+                                   lambda seg: names.update(seg.fields()))
+        for seg in imm:
+            names.update(seg.fields())
         return sorted(names)
 
     def tick(self, now_ns: int, retention_ns: int):
-        """Seal past blocks; expire blocks beyond retention."""
+        """Seal past blocks; expire blocks beyond retention. Runs under the
+        index lock: seal() swaps the mutable segment out, and an insert
+        landing between snapshot and swap would silently vanish."""
+        with self._lock:
+            return self._tick_locked(now_ns, retention_ns)
+
+    def _tick_locked(self, now_ns: int, retention_ns: int):
         for bs, blk in list(self.blocks.items()):
             if not blk.sealed and bs + self.block_size_ns <= now_ns:
                 blk.seal()

@@ -19,6 +19,7 @@ from __future__ import annotations
 import enum
 import os
 import struct
+import threading
 import time
 import zlib
 from typing import Callable, Dict, Iterator, List, Optional, Tuple
@@ -49,6 +50,10 @@ class CommitLog:
         self._buf = bytearray()
         self._series_refs: Dict[Tuple[bytes, bytes], int] = {}
         self._last_flush = self.clock()
+        # One appender file shared by every shard's write path: the commit
+        # log serializes internally (commit_log.go's single writer loop)
+        # now that the node no longer holds a global write mutex.
+        self._lock = threading.RLock()
         self._open_new_file()
 
     # ----------------------------------------------------------------- files
@@ -65,10 +70,11 @@ class CommitLog:
 
     def rotate(self) -> int:
         """Start a new commit log file (rotation on flush/time window)."""
-        old = self._file_num
-        self._file_num += 1
-        self._open_new_file()
-        return old
+        with self._lock:
+            old = self._file_num
+            self._file_num += 1
+            self._open_new_file()
+            return old
 
     def active_file(self) -> str:
         return self._path(self._file_num)
@@ -100,15 +106,21 @@ class CommitLog:
         return ref
 
     def write(self, namespace: bytes, series_id: bytes, t_ns: int, value: float):
-        ref = self._ref(namespace, series_id)
-        self._buf += _DATA_ENTRY.pack(1, ref, t_ns, value)
-        self._maybe_flush()
+        with self._lock:
+            if self._f is None:
+                raise ValueError("commit log is closed")
+            ref = self._ref(namespace, series_id)
+            self._buf += _DATA_ENTRY.pack(1, ref, t_ns, value)
+            self._maybe_flush()
 
     def write_batch(self, namespace: bytes, ids, ts, vals):
-        for sid, t, v in zip(ids, ts, vals):
-            ref = self._ref(namespace, sid)
-            self._buf += _DATA_ENTRY.pack(1, ref, int(t), float(v))
-        self._maybe_flush()
+        with self._lock:
+            if self._f is None:
+                raise ValueError("commit log is closed")
+            for sid, t, v in zip(ids, ts, vals):
+                ref = self._ref(namespace, sid)
+                self._buf += _DATA_ENTRY.pack(1, ref, int(t), float(v))
+            self._maybe_flush()
 
     def _maybe_flush(self):
         if self.strategy == Strategy.WRITE_WAIT:
@@ -118,21 +130,23 @@ class CommitLog:
 
     def flush(self):
         """Write buffered entries as one checksummed chunk (writer.go)."""
-        if not self._buf:
-            return
-        payload = bytes(self._buf)
-        self._buf.clear()
-        self._f.write(_CHUNK_HEADER.pack(len(payload), zlib.adler32(payload)))
-        self._f.write(payload)
-        self._f.flush()
-        os.fsync(self._f.fileno())
-        self._last_flush = self.clock()
+        with self._lock:
+            if not self._buf or self._f is None:
+                return
+            payload = bytes(self._buf)
+            self._buf.clear()
+            self._f.write(_CHUNK_HEADER.pack(len(payload), zlib.adler32(payload)))
+            self._f.write(payload)
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            self._last_flush = self.clock()
 
     def close(self):
-        if self._f is not None:
-            self.flush()
-            self._f.close()
-            self._f = None
+        with self._lock:
+            if self._f is not None:
+                self.flush()
+                self._f.close()
+                self._f = None
 
 
 def replay(directory: str) -> Iterator[Tuple[bytes, bytes, int, float]]:

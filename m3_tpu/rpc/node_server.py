@@ -35,7 +35,6 @@ class NodeService:
     def __init__(self, db: Database):
         self.db = db
         self.start_ns = time.time_ns()
-        self._write_lock = threading.Lock()
 
     # --------------------------------------------------------------- dispatch
 
@@ -58,14 +57,17 @@ class NodeService:
 
     def rpc_write(self, ns: bytes, id: bytes, t_ns: int, value: float,
                   tags: Optional[dict] = None):
-        with self._write_lock:
-            self.db.write(ns, id, t_ns, value, tags)
+        """Concurrency is per shard, not global: the storage layer holds a
+        per-shard write lock (storage/shard.py write_lock, the reference's
+        shard.go:769 per-shard RWMutex), the reverse index and commit log
+        serialize internally, so writes to different shards proceed in
+        parallel across server threads."""
+        self.db.write(ns, id, t_ns, value, tags)
         return True
 
     def rpc_write_batch(self, ns: bytes, ids: list, ts: np.ndarray, vals: np.ndarray,
                         tags: Optional[list] = None):
-        with self._write_lock:
-            self.db.write_batch(ns, ids, ts, vals, tags)
+        self.db.write_batch(ns, ids, ts, vals, tags)
         return len(ids)
 
     # ------------------------------------------------------------------ reads
@@ -77,8 +79,11 @@ class NodeService:
     def _series_segments(self, shard, idx: int, start_ns: int, end_ns: int) -> dict:
         """Encoded sealed-block rows + raw buffer columns for one series."""
         segs = []
-        for bs in sorted(shard.blocks):
-            blk = shard.blocks[bs]
+        with shard.write_lock:  # snapshot racing tick's expiry/seal
+            blocks = dict(shard.blocks)
+            bt, bv = shard.buffer.read(idx, start_ns, end_ns)
+        for bs in sorted(blocks):
+            blk = blocks[bs]
             if bs + shard.opts.block_size_ns <= start_ns or bs >= end_ns:
                 continue
             row = blk.row_of(idx)
@@ -92,7 +97,6 @@ class NodeService:
                 "window": int(blk.window),
                 "time_unit": int(blk.time_unit),
             })
-        bt, bv = shard.buffer.read(idx, start_ns, end_ns)
         return {"segments": segs, "buf_t": bt, "buf_v": bv}
 
     def rpc_fetch_tagged(self, ns: bytes, query: dict, start_ns: int, end_ns: int,
@@ -141,12 +145,14 @@ class NodeService:
         all_ids = sh.registry.all_ids()
         out = []
         i = page_token
+        with sh.write_lock:  # snapshot racing tick's expiry/seal
+            shard_blocks = dict(sh.blocks)
         while i < len(all_ids) and len(out) < limit:
             sid = all_ids[i]
             idx = sh.registry.get(sid)
             blocks = []
-            for bs in sorted(sh.blocks):
-                blk = sh.blocks[bs]
+            for bs in sorted(shard_blocks):
+                blk = shard_blocks[bs]
                 if bs + sh.opts.block_size_ns <= start_ns or bs >= end_ns:
                     continue
                 row = blk.row_of(idx)
@@ -169,6 +175,9 @@ class NodeService:
         nsobj = self.db.namespace(ns)
         sh = nsobj.shards.get(shard)
         out = []
+        if sh is not None:
+            with sh.write_lock:  # snapshot racing tick's expiry/seal
+                shard_blocks = dict(sh.blocks)
         for req in requests:
             sid = req["id"]
             entry = {"id": sid, "blocks": []}
@@ -176,7 +185,7 @@ class NodeService:
                 idx = sh.registry.get(sid)
                 if idx is not None:
                     for bs in req["block_starts"]:
-                        blk = sh.blocks.get(bs)
+                        blk = shard_blocks.get(bs)
                         if blk is None:
                             continue
                         row = blk.row_of(idx)

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import threading
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -53,6 +54,12 @@ class Shard:
         self.shard_id = shard_id
         self.opts = opts
         self.state = state
+        # Per-shard write/seal lock (shard.go:769 per-shard RWMutex): writes
+        # to different shards never contend; a write only serializes with
+        # writes to the same shard and with that shard's tick/seal. Reads
+        # take the lock only to snapshot mutable dicts + buffer columns;
+        # decode work runs on immutable sealed blocks outside it.
+        self.write_lock = threading.RLock()
         self.registry = SeriesRegistry()
         self.buffer = ShardBuffer(opts.block_size_ns, opts.buffer_past_ns, opts.buffer_future_ns)
         self.blocks: Dict[int, SealedBlock] = {}
@@ -78,10 +85,11 @@ class Shard:
                 f"datapoint at {t_ns} outside acceptance window at {now_ns} "
                 f"(past {self.opts.buffer_past_ns}, future {self.opts.buffer_future_ns})"
             )
-        idx, is_new = self.registry.get_or_create(series_id, tags)
-        if is_new and self.on_new_series is not None:
-            self.on_new_series(series_id, tags, idx)
-        self.buffer.write(idx, t_ns, value)
+        with self.write_lock:
+            idx, is_new = self.registry.get_or_create(series_id, tags)
+            if is_new and self.on_new_series is not None:
+                self.on_new_series(series_id, tags, idx)
+            self.buffer.write(idx, t_ns, value)
         return is_new
 
     def write_batch(self, ids: Sequence[bytes], ts: np.ndarray, vals: np.ndarray,
@@ -93,18 +101,23 @@ class Shard:
             bad = int((~ok).sum())
             raise ValueError(f"{bad} datapoints outside acceptance window")
         sidx = np.empty(len(ids), np.int32)
-        for i, sid in enumerate(ids):
-            idx, is_new = self.registry.get_or_create(sid, tags[i] if tags else None)
-            sidx[i] = idx
-            if is_new and self.on_new_series is not None:
-                self.on_new_series(sid, tags[i] if tags else None, idx)
-        self.buffer.write_batch(sidx, ts, vals)
+        with self.write_lock:
+            for i, sid in enumerate(ids):
+                idx, is_new = self.registry.get_or_create(sid, tags[i] if tags else None)
+                sidx[i] = idx
+                if is_new and self.on_new_series is not None:
+                    self.on_new_series(sid, tags[i] if tags else None, idx)
+            self.buffer.write_batch(sidx, ts, vals)
 
     # ------------------------------------------------------------------- tick
 
     def tick(self, now_ns: int) -> dict:
         """Seal no-longer-writable buckets into device-encoded blocks and
         expire blocks past retention (shard.go:573 tick + cleanup)."""
+        with self.write_lock:
+            return self._tick_locked(now_ns)
+
+    def _tick_locked(self, now_ns: int) -> dict:
         sealed, expired = 0, 0
         for bs in self.buffer.sealable(now_ns):
             dense = self.buffer.drain(bs)
@@ -156,25 +169,33 @@ class Shard:
             parts_t.append(t[keep])
             parts_v.append(v[keep])
 
+        # Snapshot mutable state under the shard lock (tick deletes expired
+        # blocks and creates buffer buckets concurrently); SealedBlocks are
+        # immutable once referenced, and the buffer read happens inside the
+        # lock, so the decode/clip work below runs lock-free.
+        with self.write_lock:
+            blocks = dict(self.blocks)
+            if idx is not None:
+                bt, bv = self.buffer.read(idx, start_ns, end_ns)
+            else:
+                bt = bv = None
         if idx is not None:
-            for bs in sorted(self.blocks):
+            for bs in sorted(blocks):
                 if overlaps(bs):
-                    clip_append(self.blocks[bs].read(idx))
+                    clip_append(blocks[bs].read(idx))
         if self._retriever is not None:
             on_disk = self._retriever.block_starts(self._retriever_ns, self.shard_id)
             for bs in sorted(on_disk):
-                if bs in self.blocks or not overlaps(bs):
+                if bs in blocks or not overlaps(bs):
                     continue
                 if (self._retention_cutoff is not None
                         and bs + self.opts.block_size_ns <= self._retention_cutoff):
                     continue  # past retention; cleanup just hasn't run yet
                 clip_append(self._retriever.retrieve(
                     self._retriever_ns, self.shard_id, bs, series_id))
-        if idx is not None:
-            bt, bv = self.buffer.read(idx, start_ns, end_ns)
-            if len(bt):
-                parts_t.append(bt)
-                parts_v.append(bv)
+        if bt is not None and len(bt):
+            parts_t.append(bt)
+            parts_v.append(bv)
         if not parts_t:
             return np.zeros(0, np.int64), np.zeros(0, np.float64)
         t = np.concatenate(parts_t)
@@ -186,13 +207,15 @@ class Shard:
 
     def flushable(self, now_ns: int) -> List[int]:
         """Sealed blocks not yet durably flushed."""
-        return sorted(
-            bs for bs, st in self.flush_states.items()
-            if st in (FlushState.NOT_STARTED, FlushState.FAILED) and bs in self.blocks
-        )
+        with self.write_lock:
+            return sorted(
+                bs for bs, st in self.flush_states.items()
+                if st in (FlushState.NOT_STARTED, FlushState.FAILED) and bs in self.blocks
+            )
 
     def mark_flushed(self, block_start: int, ok: bool = True):
-        self.flush_states[block_start] = FlushState.SUCCESS if ok else FlushState.FAILED
+        with self.write_lock:
+            self.flush_states[block_start] = FlushState.SUCCESS if ok else FlushState.FAILED
 
     def evict_flushed(self) -> int:
         """Drop in-memory blocks whose fileset is durable; subsequent reads
@@ -207,10 +230,11 @@ class Shard:
             return 0
         on_disk = self._retriever.block_starts(self._retriever_ns, self.shard_id)
         evicted = 0
-        for bs in [b for b, st in self.flush_states.items()
-                   if st == FlushState.SUCCESS and b in self.blocks and b in on_disk]:
-            del self.blocks[bs]
-            evicted += 1
+        with self.write_lock:
+            for bs in [b for b, st in self.flush_states.items()
+                       if st == FlushState.SUCCESS and b in self.blocks and b in on_disk]:
+                del self.blocks[bs]
+                evicted += 1
         return evicted
 
     def load_block(self, blk: SealedBlock, remap: Optional[np.ndarray] = None):
@@ -225,8 +249,9 @@ class Shard:
             blk.words = blk.words[order]
             blk.nbits = blk.nbits[order]
             blk.npoints = blk.npoints[order]
-        self.blocks[blk.block_start] = blk
-        self.flush_states.setdefault(blk.block_start, FlushState.SUCCESS)
+        with self.write_lock:
+            self.blocks[blk.block_start] = blk
+            self.flush_states.setdefault(blk.block_start, FlushState.SUCCESS)
 
     def num_series(self) -> int:
         return len(self.registry)
