@@ -29,6 +29,17 @@ class IndexBlock:
         self.mutable = MutableSegment()
         self.immutable: List[ImmutableSegment] = []
         self.sealed = False
+        # Generation-cached frozen view of the mutable segment: queries scan
+        # immutable snapshots outside the index lock, so a slow regexp never
+        # stalls the write path (which inserts under that lock). The freeze
+        # cost is paid once per write burst, not per query.
+        self._gen = 0
+        self._snap: Optional[ImmutableSegment] = None
+        self._snap_gen = -1
+
+    def insert(self, doc):
+        self.mutable.insert(doc)
+        self._gen += 1
 
     def segments(self):
         segs = list(self.immutable)
@@ -36,12 +47,23 @@ class IndexBlock:
             segs.append(self.mutable)
         return segs
 
+    def frozen_segments(self) -> List[ImmutableSegment]:
+        """Immutable-only view covering every indexed doc; call under the
+        index lock, scan the result outside it."""
+        if len(self.mutable):
+            if self._snap_gen != self._gen:
+                self._snap = ImmutableSegment.from_mutable(self.mutable)
+                self._snap_gen = self._gen
+            return list(self.immutable) + [self._snap]
+        return list(self.immutable)
+
     def seal(self):
         """Mutable -> immutable compaction; merge accumulated immutables
         (index/compaction/compactor.go plan: fewest, largest segments)."""
         if len(self.mutable):
             self.immutable.append(ImmutableSegment.from_mutable(self.mutable))
             self.mutable = MutableSegment()
+            self._snap, self._snap_gen = None, -1
         if len(self.immutable) > 1:
             self.immutable = [ImmutableSegment.merge(self.immutable)]
         self.sealed = True
@@ -90,7 +112,7 @@ class NamespaceIndex:
             self._known.add(series_id)
             if t_ns is None:
                 t_ns = self.clock() if self.clock else 0
-            self._block_for(t_ns).mutable.insert(tags_to_doc(series_id, tags))
+            self._block_for(t_ns).insert(tags_to_doc(series_id, tags))
 
     def insert_batch(self, items: List[Tuple[bytes, dict]], t_ns: int):
         with self._lock:
@@ -98,52 +120,40 @@ class NamespaceIndex:
             for sid, tags in items:
                 if sid not in self._known:
                     self._known.add(sid)
-                    blk.mutable.insert(tags_to_doc(sid, tags))
+                    blk.insert(tags_to_doc(sid, tags))
 
-    def _split_segments(self, start_ns, end_ns, run_mutable):
-        """Under the lock: collect overlapping blocks' immutable segments
-        (read-only once sealed, safe to scan lock-free) and run
-        `run_mutable` on each live mutable segment while still inside the
-        lock. Keeps arbitrary query work off the write path's critical
-        section — the nsIndex RWMutex trade, without serializing ingest
-        behind every regexp scan."""
-        imm = []
+    def _snapshot_segments(self, start_ns, end_ns) -> List[ImmutableSegment]:
+        """Under the lock: frozen immutable views of every overlapping
+        block (generation-cached, so the freeze is amortized over write
+        bursts). All scanning happens on the returned read-only segments
+        outside the lock — a slow regexp query never blocks ingest, which
+        inserts under this same lock from every shard's write path."""
+        segs: List[ImmutableSegment] = []
         with self._lock:
             for bs, blk in list(self.blocks.items()):
                 if bs + self.block_size_ns <= start_ns or bs >= end_ns:
                     continue
-                imm.extend(blk.immutable)
-                if len(blk.mutable):
-                    run_mutable(blk.mutable)
-        return imm
+                segs.extend(blk.frozen_segments())
+        return segs
 
     def query(self, q: Query, start_ns: int = 0, end_ns: int = 2**63 - 1) -> List[bytes]:
         """nsIndex.Query: union across blocks overlapping [start, end)."""
         out: Set[bytes] = set()
-
-        def scan(seg):
+        for seg in self._snapshot_segments(start_ns, end_ns):
             for pos in execute(seg, q):
                 out.add(seg.doc(int(pos)).id)
-
-        imm = self._split_segments(start_ns, end_ns, scan)
-        for seg in imm:
-            scan(seg)
         return sorted(out)
 
     def aggregate_terms(self, field: bytes, start_ns: int = 0, end_ns: int = 2**63 - 1) -> List[bytes]:
         """Distinct values for a tag (complete-tags / tag-values API)."""
         vals: Set[bytes] = set()
-        imm = self._split_segments(start_ns, end_ns,
-                                   lambda seg: vals.update(seg.terms(field)))
-        for seg in imm:
+        for seg in self._snapshot_segments(start_ns, end_ns):
             vals.update(seg.terms(field))
         return sorted(vals)
 
     def fields(self, start_ns: int = 0, end_ns: int = 2**63 - 1) -> List[bytes]:
         names: Set[bytes] = set()
-        imm = self._split_segments(start_ns, end_ns,
-                                   lambda seg: names.update(seg.fields()))
-        for seg in imm:
+        for seg in self._snapshot_segments(start_ns, end_ns):
             names.update(seg.fields())
         return sorted(names)
 

@@ -244,10 +244,11 @@ class PeersBootstrapper(Bootstrapper):
                     vs = np.concatenate([v for _t, v in decoded])
                     order = np.lexsort((ts, sidx))
                     series, td, vd, counts = to_dense(sidx[order], ts[order], vs[order])
-                    shard.blocks[bs] = encode_block(bs, series, td, vd, counts)
                     from .shard import FlushState
 
-                    shard.flush_states.setdefault(bs, FlushState.SUCCESS)
+                    with shard.write_lock:
+                        shard.blocks[bs] = encode_block(bs, series, td, vd, counts)
+                        shard.flush_states.setdefault(bs, FlushState.SUCCESS)
             for s, e in ranges:
                 claimed.add(shard_id, s, e)
         return claimed

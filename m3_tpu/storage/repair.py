@@ -99,7 +99,16 @@ class ShardRepairer:
 
     def _rebuild_block(self, ns, shard, bs: int, peer_rows: Dict[bytes, dict],
                        tags_by_sid: Dict[bytes, dict]):
-        """Decode local block + peer rows, union points, re-encode the tile."""
+        """Decode local block + peer rows, union points, re-encode the tile.
+
+        Runs under the shard's write lock: registry.get_or_create and the
+        blocks/flush_states dicts share the per-shard synchronization
+        contract with the write path (no more global node mutex)."""
+        with shard.write_lock:
+            return self._rebuild_block_locked(ns, shard, bs, peer_rows,
+                                              tags_by_sid)
+
+    def _rebuild_block_locked(self, ns, shard, bs, peer_rows, tags_by_sid):
         points: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
         blk = shard.blocks.get(bs)
         if blk is not None:
