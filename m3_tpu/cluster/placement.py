@@ -236,13 +236,17 @@ def remove_instance(p: Placement, instance_id: str) -> Placement:
     for a in leaving.shards.values():
         if a.state == ShardState.LEAVING:
             continue
+        # A shard the removed instance was still *receiving* keeps its
+        # original donor as the source — re-sourcing it to the (now gone)
+        # removed id would orphan the donor's LEAVING copy forever.
+        source = a.source_id if a.state == ShardState.INITIALIZING else instance_id
         placed = False
         buffer = []
         while heap and not placed:
             cnt, iid = heapq.heappop(heap)
             if a.shard not in insts[iid].shards:
                 insts[iid].shards[a.shard] = ShardAssignment(
-                    a.shard, ShardState.INITIALIZING, instance_id
+                    a.shard, ShardState.INITIALIZING, source
                 )
                 heapq.heappush(heap, (cnt + 1, iid))
                 placed = True
@@ -388,25 +392,64 @@ def mirrored_add_shard_set(p: Placement, new_members: Sequence[Instance]) -> Pla
 
 
 def mirrored_remove_shard_set(p: Placement, shard_set_id: str) -> Placement:
-    """algo/mirrored.go RemoveInstances: a whole set leaves; its shards
-    redistribute across the remaining sets."""
+    """algo/mirrored.go RemoveInstances: a whole set leaves. The leaving
+    set STAYS in the placement with its shards LEAVING until the receiving
+    sets cut over (mirrored_mark_available drops emptied sets) — dropping
+    it immediately would leave its shards with zero available replicas
+    while the receivers are still initializing."""
     pv, groups = _to_virtual(p)
     if shard_set_id not in groups:
         raise KeyError(shard_set_id)
-    pv2 = remove_instance(pv, shard_set_id)
-    groups2 = {ssid: m for ssid, m in groups.items() if ssid != shard_set_id}
-    return _expand_groups(pv2, groups2, src_groups=groups)
+    insts = {iid: dataclasses.replace(i, shards=dict(i.shards))
+             for iid, i in pv.instances.items()}
+    leaving = insts[shard_set_id]
+    heap = [(len(i.shards), iid) for iid, i in insts.items()
+            if iid != shard_set_id]
+    heapq.heapify(heap)
+    for a in list(leaving.shards.values()):
+        if a.state == ShardState.LEAVING:
+            continue
+        source = (a.source_id if a.state == ShardState.INITIALIZING
+                  else shard_set_id)
+        placed = False
+        buffer = []
+        while heap and not placed:
+            cnt, iid = heapq.heappop(heap)
+            if a.shard not in insts[iid].shards:
+                insts[iid].shards[a.shard] = ShardAssignment(
+                    a.shard, ShardState.INITIALIZING, source)
+                heapq.heappush(heap, (cnt + 1, iid))
+                placed = True
+            else:
+                buffer.append((cnt, iid))
+        for item in buffer:
+            heapq.heappush(heap, item)
+        if not placed:
+            raise ValueError(
+                f"cannot place shard {a.shard}: all shard sets own it")
+        leaving.shards[a.shard] = ShardAssignment(a.shard, ShardState.LEAVING)
+    pv2 = Placement(insts, pv.num_shards, 1, pv.version)
+    return _expand_groups(pv2, groups, src_groups=groups)
 
 
 def mirrored_mark_available(p: Placement, shard_set_id: str) -> Placement:
     """Cut over every Initializing shard of one set (all members at once —
-    mirrored sets move in lockstep)."""
+    mirrored sets move in lockstep). Shard sets fully emptied by the
+    cutover (a removed set whose last LEAVING copies just dropped) leave
+    the placement."""
     out = p
     members = p.shard_sets()[shard_set_id]
     for m in members:
         for s, a in list(m.shards.items()):
             if a.state == ShardState.INITIALIZING:
                 out = mark_shard_available(out, m.id, s)
+    emptied = [ssid for ssid, mem in out.shard_sets().items()
+               if all(not m.shards for m in mem)]
+    if emptied:
+        insts = {iid: inst for iid, inst in out.instances.items()
+                 if inst.shard_set_id not in emptied}
+        out = Placement(insts, out.num_shards, out.replica_factor,
+                        out.version, out.is_mirrored)
     return out
 
 

@@ -47,15 +47,25 @@ class IndexBlock:
             segs.append(self.mutable)
         return segs
 
-    def frozen_segments(self) -> List[ImmutableSegment]:
-        """Immutable-only view covering every indexed doc; call under the
-        index lock, scan the result outside it."""
-        if len(self.mutable):
-            if self._snap_gen != self._gen:
-                self._snap = ImmutableSegment.from_mutable(self.mutable)
-                self._snap_gen = self._gen
-            return list(self.immutable) + [self._snap]
-        return list(self.immutable)
+    def snapshot_parts(self):
+        """Under the index lock: cached frozen view when current, else a
+        cheap shallow copy of the mutable docs (Documents are immutable) so
+        the O(fields x terms) freeze itself can run OUTSIDE the lock —
+        under steady interleaved ingest the cache would never hit, and
+        rebuilding inside the lock would stall every shard's write path.
+        Returns (immutables, cached_snap_or_None, docs_or_None, gen)."""
+        if not len(self.mutable):
+            return list(self.immutable), None, None, self._gen
+        if self._snap_gen == self._gen:
+            return list(self.immutable), self._snap, None, self._gen
+        return list(self.immutable), None, list(self.mutable._docs), self._gen
+
+    def store_snapshot(self, snap: ImmutableSegment, gen: int):
+        """Under the index lock: publish a freeze built outside it (kept
+        only if no newer snapshot landed first)."""
+        if gen > self._snap_gen:
+            self._snap = snap
+            self._snap_gen = gen
 
     def seal(self):
         """Mutable -> immutable compaction; merge accumulated immutables
@@ -123,17 +133,31 @@ class NamespaceIndex:
                     blk.insert(tags_to_doc(sid, tags))
 
     def _snapshot_segments(self, start_ns, end_ns) -> List[ImmutableSegment]:
-        """Under the lock: frozen immutable views of every overlapping
-        block (generation-cached, so the freeze is amortized over write
-        bursts). All scanning happens on the returned read-only segments
-        outside the lock — a slow regexp query never blocks ingest, which
-        inserts under this same lock from every shard's write path."""
+        """Frozen immutable views of every overlapping block. The lock is
+        held only for dict snapshots and doc-list copies; the actual
+        freezes (and all scanning) run outside it, so neither a slow query
+        nor the freeze itself ever blocks ingest. Freezes are
+        generation-cached and published back, amortizing over read-heavy
+        periods."""
         segs: List[ImmutableSegment] = []
+        pending = []  # (block, docs, gen)
         with self._lock:
             for bs, blk in list(self.blocks.items()):
                 if bs + self.block_size_ns <= start_ns or bs >= end_ns:
                     continue
-                segs.extend(blk.frozen_segments())
+                imm, snap, docs, gen = blk.snapshot_parts()
+                segs.extend(imm)
+                if snap is not None:
+                    segs.append(snap)
+                elif docs is not None:
+                    pending.append((blk, docs, gen))
+        for blk, docs, gen in pending:
+            tmp = MutableSegment()
+            tmp.insert_batch(docs)
+            snap = ImmutableSegment.from_mutable(tmp)
+            segs.append(snap)
+            with self._lock:
+                blk.store_snapshot(snap, gen)
         return segs
 
     def query(self, q: Query, start_ns: int = 0, end_ns: int = 2**63 - 1) -> List[bytes]:

@@ -238,6 +238,10 @@ def _combine(eng, args, params, reducer, name):
     meta = blocks[0].meta if blocks else params.meta()
     with np.errstate(invalid="ignore"):
         row = reducer(vals)
+    # Columns where every input is missing stay missing (graphite's safe*
+    # combiners return None there; np.nansum/nanprod would fabricate 0/1).
+    if vals.shape[0]:
+        row = np.where(np.isfinite(vals).any(axis=0), row, np.nan)
     tags = Tags.of({b"__alias__": name.encode()})
     return Block(meta, [tags], row[None, :])
 
@@ -403,29 +407,40 @@ def _grep(eng, args, params):
 
 @_register("highestCurrent")
 def _highest_current(eng, args, params):
-    block = eng._eval(args[0], params)
-    n = int(args[1].value) if len(args) > 1 else 1
-    last = np.where(np.isfinite(block.values), block.values, -np.inf)
-    cur = np.full(block.n_series, -np.inf)
-    for i in range(block.n_series):
-        finite = np.flatnonzero(np.isfinite(block.values[i]))
-        if finite.size:
-            cur[i] = block.values[i][finite[-1]]
-    order = np.argsort(-cur, kind="stable")[:n]
-    return block.with_values(block.values[order],
-                             [block.series_tags[i] for i in order])
+    return _top_by(eng, args, params, "current", highest=True)
 
 
 @_register("averageAbove")
 def _average_above(eng, args, params):
-    block = eng._eval(args[0], params)
-    thresh = args[1].value
-    with np.errstate(invalid="ignore"):
-        mean = np.nanmean(np.where(np.isfinite(block.values), block.values,
-                                   np.nan), axis=1)
-    keep = np.flatnonzero(mean > thresh)
-    return block.with_values(block.values[keep],
-                             [block.series_tags[i] for i in keep])
+    return _filter_by(eng, args, params, "average", lambda s, t: s > t)
+
+
+_GROUP_REDUCERS = {
+    "sum": np.nansum, "avg": np.nanmean, "average": np.nanmean,
+    "max": np.nanmax, "min": np.nanmin,
+    "median": lambda v, axis: np.nanmedian(v, axis=axis),
+}
+
+
+def _grouped_reduce(block: Block, key_fn, agg: str) -> Block:
+    """Group series by key_fn(series name parts) and reduce each group;
+    shared by groupByNode/groupByNodes/*SeriesWithWildcards."""
+    reducer = _GROUP_REDUCERS.get(agg)
+    if reducer is None:
+        raise GraphiteParseError(f"unknown aggregator {agg!r}")
+    groups: Dict[bytes, List[int]] = {}
+    for i, t in enumerate(block.series_tags):
+        groups.setdefault(key_fn(series_name(t).split(b".")), []).append(i)
+    tags_out, rows = [], []
+    for key, idxs in sorted(groups.items()):
+        sub = block.values[idxs]
+        with np.errstate(invalid="ignore"):
+            row = reducer(sub, axis=0)
+        row = np.where(np.isfinite(sub).any(axis=0), row, np.nan)
+        rows.append(row)
+        tags_out.append(Tags.of({b"__alias__": key}))
+    vals = np.stack(rows) if rows else np.zeros((0, block.meta.steps))
+    return Block(block.meta, tags_out, vals)
 
 
 @_register("groupByNode")
@@ -433,21 +448,9 @@ def _group_by_node(eng, args, params):
     block = eng._eval(args[0], params)
     node = int(args[1].value)
     agg = args[2].value if len(args) > 2 else "sum"
-    reducers = {"sum": np.nansum, "avg": np.nanmean, "average": np.nanmean,
-                "max": np.nanmax, "min": np.nanmin}
-    reducer = reducers[agg]
-    groups: Dict[bytes, List[int]] = {}
-    for i, t in enumerate(block.series_tags):
-        parts = tags_to_path(t.as_dict()).split(b".")
-        key = parts[node] if -len(parts) <= node < len(parts) else b""
-        groups.setdefault(key, []).append(i)
-    tags_out, rows = [], []
-    for key, idxs in sorted(groups.items()):
-        with np.errstate(invalid="ignore"):
-            rows.append(reducer(block.values[idxs], axis=0))
-        tags_out.append(Tags.of({b"__alias__": key}))
-    vals = np.stack(rows) if rows else np.zeros((0, block.meta.steps))
-    return Block(block.meta, tags_out, vals)
+    key = lambda parts: (parts[node]
+                         if -len(parts) <= node < len(parts) else b"")
+    return _grouped_reduce(block, key, agg)
 
 
 @_register("summarize")
@@ -468,3 +471,592 @@ def _summarize(eng, args, params):
         out = reducers[agg](v, axis=2)
     meta = BlockMeta(block.meta.start_ns, bucket_ns, steps)
     return Block(meta, block.series_tags, out)
+
+
+# ------------------------------------------------------- function appendix
+# Broader builtin coverage (reference:
+# src/query/graphite/native/builtin_functions.go). Helpers keep the whole
+# block batched: every transform is a vectorized [n_series, steps] op.
+
+
+def _pick_rows(block: Block, keep) -> Block:
+    keep = list(keep)
+    vals = block.values[keep] if len(keep) else np.zeros((0, block.meta.steps))
+    return block.with_values(vals, [block.series_tags[i] for i in keep])
+
+
+def _series_stat(block: Block, stat: str) -> np.ndarray:
+    """Per-series scalar used by filters/sorts; NaN-aware."""
+    v = block.values
+    with np.errstate(invalid="ignore", divide="ignore"):
+        if stat == "average":
+            return np.nanmean(v, axis=1) if v.size else np.zeros(0)
+        if stat == "total":
+            return np.nansum(v, axis=1) if v.size else np.zeros(0)
+        if stat == "max":
+            return np.nanmax(v, axis=1) if v.size else np.zeros(0)
+        if stat == "min":
+            return np.nanmin(v, axis=1) if v.size else np.zeros(0)
+        if stat == "current":
+            cur = np.full(v.shape[0], np.nan)
+            for i in range(v.shape[0]):
+                finite = np.flatnonzero(np.isfinite(v[i]))
+                if finite.size:
+                    cur[i] = v[i][finite[-1]]
+            return cur
+    raise GraphiteParseError(f"unknown series stat {stat!r}")
+
+
+def _filter_by(eng, args, params, stat, op, default_thresh=None):
+    block = eng._eval(args[0], params)
+    thresh = args[1].value if len(args) > 1 else default_thresh
+    s = _series_stat(block, stat)
+    with np.errstate(invalid="ignore"):
+        keep = np.flatnonzero(op(s, thresh))
+    return _pick_rows(block, keep)
+
+
+def _top_by(eng, args, params, stat, highest: bool):
+    block = eng._eval(args[0], params)
+    n = int(args[1].value) if len(args) > 1 else 1
+    s = _series_stat(block, stat)
+    s = np.where(np.isfinite(s), s, -np.inf if highest else np.inf)
+    order = np.argsort(-s if highest else s, kind="stable")[:n]
+    return _pick_rows(block, order)
+
+
+@_register("aliasSub")
+def _alias_sub(eng, args, params):
+    block = eng._eval(args[0], params)
+    pat = re.compile(args[1].value.encode())
+    repl = args[2].value.encode()
+    tags = [t.with_tag(b"__alias__", pat.sub(repl, series_name(t)))
+            for t in block.series_tags]
+    return block.with_values(block.values, tags)
+
+
+@_register("aliasByMetric")
+def _alias_by_metric(eng, args, params):
+    block = eng._eval(args[0], params)
+    tags = [t.with_tag(b"__alias__",
+                       series_name(t).split(b".")[-1].split(b",")[0])
+            for t in block.series_tags]
+    return block.with_values(block.values, tags)
+
+
+@_register("substr")
+def _substr(eng, args, params):
+    block = eng._eval(args[0], params)
+    start = int(args[1].value) if len(args) > 1 else 0
+    stop = int(args[2].value) if len(args) > 2 else 0
+    tags = []
+    for t in block.series_tags:
+        parts = series_name(t).split(b".")
+        picked = parts[start: stop if stop else len(parts)]
+        tags.append(t.with_tag(b"__alias__", b".".join(picked)))
+    return block.with_values(block.values, tags)
+
+
+@_register("scaleToSeconds")
+def _scale_to_seconds(eng, args, params):
+    block = eng._eval(args[0], params)
+    seconds = args[1].value
+    return block.with_values(block.values * (seconds / (params.step_ns / S)))
+
+
+@_register("invert")
+def _invert(eng, args, params):
+    block = eng._eval(args[0], params)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        v = np.where(block.values != 0, 1.0 / block.values, np.nan)
+    return block.with_values(v)
+
+
+@_register("logarithm", "log")
+def _logarithm(eng, args, params):
+    block = eng._eval(args[0], params)
+    base = args[1].value if len(args) > 1 else 10
+    with np.errstate(divide="ignore", invalid="ignore"):
+        v = np.where(block.values > 0,
+                     np.log(block.values) / np.log(base), np.nan)
+    return block.with_values(v)
+
+
+@_register("pow")
+def _pow(eng, args, params):
+    block = eng._eval(args[0], params)
+    with np.errstate(invalid="ignore"):
+        return block.with_values(np.power(block.values, args[1].value))
+
+
+@_register("squareRoot")
+def _square_root(eng, args, params):
+    block = eng._eval(args[0], params)
+    with np.errstate(invalid="ignore"):
+        v = np.where(block.values >= 0, np.sqrt(block.values), np.nan)
+    return block.with_values(v)
+
+
+@_register("timeShift")
+def _time_shift(eng, args, params):
+    """Render data from `shift` ago at the requested timestamps
+    (builtin_functions.go timeShift: positive shifts look back)."""
+    from .promql import parse_duration_ns
+
+    spec = str(args[1].value)
+    sign = -1
+    if spec.startswith("+"):
+        sign, spec = 1, spec[1:]
+    elif spec.startswith("-"):
+        spec = spec[1:]
+    delta = sign * parse_duration_ns(spec)
+    shifted = QueryParams(params.start_ns + delta, params.end_ns + delta,
+                          params.step_ns)
+    block = eng._eval(args[0], shifted)
+    return Block(params.meta(), block.series_tags, block.values)
+
+
+@_register("timeSlice")
+def _time_slice(eng, args, params):
+    block = eng._eval(args[0], params)
+    t0 = _parse_graphite_time(args[1].value, params.start_ns)
+    t1 = (_parse_graphite_time(args[2].value, params.end_ns)
+          if len(args) > 2 else params.end_ns)
+    times = block.meta.times()
+    keep = ((times >= t0) & (times < t1))[None, :]
+    return block.with_values(np.where(keep, block.values, np.nan))
+
+
+def _parse_graphite_time(spec, default_ns):
+    from .promql import parse_duration_ns
+
+    if isinstance(spec, (int, float)):
+        return int(spec * S)
+    s = str(spec)
+    if s in ("now", ""):
+        return default_ns
+    if s.startswith("-"):
+        return default_ns - parse_duration_ns(s[1:])
+    return int(float(s) * S)
+
+
+@_register("transformNull")
+def _transform_null(eng, args, params):
+    block = eng._eval(args[0], params)
+    default = args[1].value if len(args) > 1 else 0.0
+    return block.with_values(
+        np.where(np.isfinite(block.values), block.values, default))
+
+
+@_register("isNonNull")
+def _is_non_null(eng, args, params):
+    block = eng._eval(args[0], params)
+    return block.with_values(np.isfinite(block.values).astype(np.float64))
+
+
+@_register("removeAboveValue")
+def _remove_above_value(eng, args, params):
+    block = eng._eval(args[0], params)
+    with np.errstate(invalid="ignore"):
+        v = np.where(block.values > args[1].value, np.nan, block.values)
+    return block.with_values(v)
+
+
+@_register("removeBelowValue")
+def _remove_below_value(eng, args, params):
+    block = eng._eval(args[0], params)
+    with np.errstate(invalid="ignore"):
+        v = np.where(block.values < args[1].value, np.nan, block.values)
+    return block.with_values(v)
+
+
+def _row_percentile(v: np.ndarray, n: float) -> np.ndarray:
+    out = np.full(v.shape[0], np.nan)
+    for i in range(v.shape[0]):
+        finite = v[i][np.isfinite(v[i])]
+        if finite.size:
+            out[i] = np.percentile(finite, n)
+    return out
+
+
+@_register("removeAbovePercentile")
+def _remove_above_percentile(eng, args, params):
+    block = eng._eval(args[0], params)
+    p = _row_percentile(block.values, args[1].value)
+    with np.errstate(invalid="ignore"):
+        v = np.where(block.values > p[:, None], np.nan, block.values)
+    return block.with_values(v)
+
+
+@_register("removeBelowPercentile")
+def _remove_below_percentile(eng, args, params):
+    block = eng._eval(args[0], params)
+    p = _row_percentile(block.values, args[1].value)
+    with np.errstate(invalid="ignore"):
+        v = np.where(block.values < p[:, None], np.nan, block.values)
+    return block.with_values(v)
+
+
+@_register("integral")
+def _integral(eng, args, params):
+    block = eng._eval(args[0], params)
+    v = np.where(np.isfinite(block.values), block.values, 0.0)
+    out = np.cumsum(v, axis=1)
+    out[~np.isfinite(block.values)] = np.nan
+    return block.with_values(out)
+
+
+@_register("offsetToZero")
+def _offset_to_zero(eng, args, params):
+    block = eng._eval(args[0], params)
+    with np.errstate(invalid="ignore"):
+        mn = np.nanmin(block.values, axis=1, keepdims=True) \
+            if block.values.size else np.zeros((0, 1))
+    return block.with_values(block.values - mn)
+
+
+@_register("changed")
+def _changed(eng, args, params):
+    """1 where the value differs from the previous REAL value; gaps emit
+    0 and do not count as changes (graphite-web changed())."""
+    block = eng._eval(args[0], params)
+    v = block.values
+    out = np.zeros_like(v)
+    idx = np.arange(v.shape[1])
+    for i in range(v.shape[0]):
+        finite = np.isfinite(v[i])
+        run = np.maximum.accumulate(np.where(finite, idx, -1))
+        prev_run = np.concatenate([[-1], run[:-1]])
+        cmp_ok = finite & (prev_run >= 0)
+        prev_vals = v[i][np.maximum(prev_run, 0)]
+        out[i] = np.where(cmp_ok & (v[i] != prev_vals), 1.0, 0.0)
+    return block.with_values(out)
+
+
+@_register("delay")
+def _delay(eng, args, params):
+    block = eng._eval(args[0], params)
+    steps = int(args[1].value)
+    v = np.full_like(block.values, np.nan)
+    if steps >= 0:
+        if steps < v.shape[1]:
+            v[:, steps:] = block.values[:, : v.shape[1] - steps]
+    else:
+        if -steps < v.shape[1]:
+            v[:, :steps] = block.values[:, -steps:]
+    return block.with_values(v)
+
+
+@_register("minimumAbove")
+def _minimum_above(eng, args, params):
+    return _filter_by(eng, args, params, "min", lambda s, t: s > t)
+
+
+@_register("minimumBelow")
+def _minimum_below(eng, args, params):
+    return _filter_by(eng, args, params, "min", lambda s, t: s <= t)
+
+
+@_register("maximumAbove")
+def _maximum_above(eng, args, params):
+    return _filter_by(eng, args, params, "max", lambda s, t: s > t)
+
+
+@_register("maximumBelow")
+def _maximum_below(eng, args, params):
+    return _filter_by(eng, args, params, "max", lambda s, t: s <= t)
+
+
+@_register("currentAbove")
+def _current_above(eng, args, params):
+    return _filter_by(eng, args, params, "current", lambda s, t: s > t)
+
+
+@_register("currentBelow")
+def _current_below(eng, args, params):
+    return _filter_by(eng, args, params, "current", lambda s, t: s <= t)
+
+
+@_register("averageBelow")
+def _average_below(eng, args, params):
+    return _filter_by(eng, args, params, "average", lambda s, t: s <= t)
+
+
+@_register("highestAverage")
+def _highest_average(eng, args, params):
+    return _top_by(eng, args, params, "average", highest=True)
+
+
+@_register("lowestAverage")
+def _lowest_average(eng, args, params):
+    return _top_by(eng, args, params, "average", highest=False)
+
+
+@_register("highestMax")
+def _highest_max(eng, args, params):
+    return _top_by(eng, args, params, "max", highest=True)
+
+
+@_register("lowestCurrent")
+def _lowest_current(eng, args, params):
+    return _top_by(eng, args, params, "current", highest=False)
+
+
+@_register("sortByTotal")
+def _sort_by_total(eng, args, params):
+    block = eng._eval(args[0], params)
+    s = _series_stat(block, "total")
+    return _pick_rows(block, np.argsort(-np.nan_to_num(s), kind="stable"))
+
+
+@_register("sortByMaxima")
+def _sort_by_maxima(eng, args, params):
+    block = eng._eval(args[0], params)
+    s = _series_stat(block, "max")
+    return _pick_rows(block, np.argsort(-np.nan_to_num(s, nan=-np.inf),
+                                        kind="stable"))
+
+
+@_register("sortByMinima")
+def _sort_by_minima(eng, args, params):
+    block = eng._eval(args[0], params)
+    s = _series_stat(block, "min")
+    return _pick_rows(block, np.argsort(np.nan_to_num(s, nan=np.inf),
+                                        kind="stable"))
+
+
+@_register("nPercentile")
+def _n_percentile(eng, args, params):
+    """Per-series flat line at that series' n-th percentile."""
+    block = eng._eval(args[0], params)
+    p = _row_percentile(block.values, args[1].value)
+    return block.with_values(np.broadcast_to(
+        p[:, None], block.values.shape).copy())
+
+
+@_register("percentileOfSeries")
+def _percentile_of_series(eng, args, params):
+    block = eng._eval(args[0], params)
+    n = args[1].value
+    out = np.full(block.meta.steps, np.nan)
+    v = block.values
+    for j in range(v.shape[1]):
+        finite = v[:, j][np.isfinite(v[:, j])]
+        if finite.size:
+            out[j] = np.percentile(finite, n)
+    tags = Tags.of({b"__alias__": b"percentileOfSeries"})
+    return Block(block.meta, [tags], out[None, :])
+
+
+def _moving(eng, args, params, kind):
+    w = args[1].value
+    if isinstance(w, str):
+        from .promql import parse_duration_ns
+
+        W = max(1, parse_duration_ns(w) // params.step_ns)
+    else:
+        W = max(1, int(w))
+    ext = QueryParams(params.start_ns - (W - 1) * params.step_ns,
+                      params.end_ns, params.step_ns)
+    block = eng._eval(args[0], ext)
+    if kind == "median":
+        out = temporal.quantile_over_time(block.values, W, 0.5)
+    else:
+        out = temporal.over_time(block.values, W, kind)
+    return Block(params.meta(), block.series_tags, out)
+
+
+@_register("movingMax")
+def _moving_max(eng, args, params):
+    return _moving(eng, args, params, "max")
+
+
+@_register("movingMin")
+def _moving_min(eng, args, params):
+    return _moving(eng, args, params, "min")
+
+
+@_register("movingSum")
+def _moving_sum(eng, args, params):
+    return _moving(eng, args, params, "sum")
+
+
+@_register("movingMedian")
+def _moving_median(eng, args, params):
+    return _moving(eng, args, params, "median")
+
+
+@_register("stdev", "stddev")
+def _stdev(eng, args, params):
+    return _moving(eng, args, params, "stddev")
+
+
+@_register("diffSeries")
+def _diff_series(eng, args, params):
+    blocks = [eng._eval(a, params) for a in args]
+    vals = np.concatenate([b.values for b in blocks])
+    if not vals.shape[0]:
+        return Block(params.meta(), [], np.zeros((0, params.steps)))
+    rest = np.where(np.isfinite(vals[1:]), vals[1:], 0.0)
+    out = vals[0] - rest.sum(axis=0)
+    return Block(blocks[0].meta, [Tags.of({b"__alias__": b"diffSeries"})],
+                 out[None, :])
+
+
+@_register("multiplySeries")
+def _multiply_series(eng, args, params):
+    return _combine(eng, args, params,
+                    lambda v: np.nanprod(v, axis=0), "multiplySeries")
+
+
+@_register("rangeOfSeries")
+def _range_of_series(eng, args, params):
+    return _combine(
+        eng, args, params,
+        lambda v: np.nanmax(v, axis=0) - np.nanmin(v, axis=0),
+        "rangeOfSeries")
+
+
+@_register("stddevSeries")
+def _stddev_series(eng, args, params):
+    return _combine(eng, args, params,
+                    lambda v: np.nanstd(v, axis=0), "stddevSeries")
+
+
+@_register("countSeries")
+def _count_series(eng, args, params):
+    """Constant line of the number of series (builtin_functions.go
+    countSeries draws len(seriesList), not a per-step finite count)."""
+    blocks = [eng._eval(a, params) for a in args]
+    n = sum(b.n_series for b in blocks)
+    meta = blocks[0].meta if blocks else params.meta()
+    return Block(meta, [Tags.of({b"__alias__": b"countSeries"})],
+                 np.full((1, meta.steps), float(n)))
+
+
+@_register("divideSeries")
+def _divide_series(eng, args, params):
+    dividend = eng._eval(args[0], params)
+    divisor = eng._eval(args[1], params)
+    if divisor.n_series != 1:
+        raise GraphiteParseError(
+            f"divideSeries divisor must be one series, got {divisor.n_series}")
+    with np.errstate(divide="ignore", invalid="ignore"):
+        d = divisor.values[0]
+        v = np.where(d != 0, dividend.values / d, np.nan)
+    tags = [t.with_tag(b"__alias__",
+                       b"divideSeries(%s,%s)" % (series_name(t),
+                                                 series_name(divisor.series_tags[0])))
+            for t in dividend.series_tags]
+    return dividend.with_values(v, tags)
+
+
+@_register("asPercent")
+def _as_percent(eng, args, params):
+    block = eng._eval(args[0], params)
+    if len(args) > 1 and not isinstance(args[1], Literal):
+        total = eng._eval(args[1], params).values
+        total = np.nansum(total, axis=0)
+    elif len(args) > 1:
+        total = np.full(block.meta.steps, float(args[1].value))
+    else:
+        with np.errstate(invalid="ignore"):
+            total = np.nansum(block.values, axis=0)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        v = np.where(total != 0, block.values / total * 100.0, np.nan)
+    return block.with_values(v)
+
+
+def _with_wildcards(eng, args, params, agg):
+    block = eng._eval(args[0], params)
+    positions = {int(a.value) for a in args[1:]}
+    key = lambda parts: b".".join(
+        p for j, p in enumerate(parts) if j not in positions)
+    return _grouped_reduce(block, key, agg)
+
+
+@_register("sumSeriesWithWildcards")
+def _sum_series_with_wildcards(eng, args, params):
+    return _with_wildcards(eng, args, params, "sum")
+
+
+@_register("averageSeriesWithWildcards")
+def _average_series_with_wildcards(eng, args, params):
+    return _with_wildcards(eng, args, params, "average")
+
+
+@_register("group")
+def _group(eng, args, params):
+    blocks = [eng._eval(a, params) for a in args]
+    vals = np.concatenate([b.values for b in blocks]) if blocks else \
+        np.zeros((0, params.steps))
+    tags = [t for b in blocks for t in b.series_tags]
+    meta = blocks[0].meta if blocks else params.meta()
+    return Block(meta, tags, vals)
+
+
+@_register("groupByNodes")
+def _group_by_nodes(eng, args, params):
+    block = eng._eval(args[0], params)
+    agg = args[1].value
+    nodes = [int(a.value) for a in args[2:]]
+    key = lambda parts: b".".join(parts[n] for n in nodes
+                                  if -len(parts) <= n < len(parts))
+    return _grouped_reduce(block, key, agg)
+
+
+@_register("constantLine")
+def _constant_line(eng, args, params):
+    value = float(args[0].value)
+    meta = params.meta()
+    tags = Tags.of({b"__alias__": str(value).encode()})
+    return Block(meta, [tags], np.full((1, meta.steps), value))
+
+
+@_register("threshold")
+def _threshold(eng, args, params):
+    value = float(args[0].value)
+    label = str(args[1].value) if len(args) > 1 else str(value)
+    meta = params.meta()
+    return Block(meta, [Tags.of({b"__alias__": label.encode()})],
+                 np.full((1, meta.steps), value))
+
+
+@_register("stacked")
+def _stacked(eng, args, params):
+    """Cumulative stacking: series i becomes sum of series 0..i; a series'
+    own gaps stay gaps."""
+    block = eng._eval(args[0], params)
+    v = np.where(np.isfinite(block.values), block.values, 0.0)
+    out = np.cumsum(v, axis=0)
+    out[~np.isfinite(block.values)] = np.nan
+    tags = [t.with_tag(b"__alias__", b"stacked(" + series_name(t) + b")")
+            for t in block.series_tags]
+    return block.with_values(out, tags)
+
+
+@_register("consolidateBy")
+def _consolidate_by(eng, args, params):
+    """Annotation only: block consolidation already happens at fetch grid
+    resolution; the chosen function is recorded in the alias (render-layer
+    consolidation concern, builtin_functions.go consolidateBy)."""
+    block = eng._eval(args[0], params)
+    return block
+
+
+@_register("averageOutsidePercentile")
+def _average_outside_percentile(eng, args, params):
+    block = eng._eval(args[0], params)
+    n = args[1].value
+    n = max(n, 100 - n)
+    means = _series_stat(block, "average")
+    finite = means[np.isfinite(means)]
+    if not finite.size:
+        return block
+    hi = np.percentile(finite, n)
+    lo = np.percentile(finite, 100 - n)
+    # graphite-web keeps anything NOT strictly inside (lo, hi), so the
+    # boundary series (including n=100/n=0) survive.
+    with np.errstate(invalid="ignore"):
+        keep = np.flatnonzero(~((means > lo) & (means < hi)))
+    return _pick_rows(block, keep)

@@ -162,3 +162,145 @@ class TestCarbonServerEndToEnd:
             assert vals == [0.0, 1.0, 2.0, 3.0, 4.0, 5.0]
         finally:
             srv.close()
+
+
+class TestExtendedBuiltins:
+    """Appendix builtins (builtin_functions.go coverage expansion)."""
+
+    @pytest.fixture
+    def env(self, genv):
+        c, db, now = genv
+        ingest_paths(c, now, [(b"apps.api.req", 10.0),
+                              (b"apps.api.err", 1.0),
+                              (b"apps.db.req", 100.0)])
+        return GraphiteEngine(c.engine.storage), T0 + 30 * S, T0 + 110 * S
+
+    def render(self, env, target):
+        eng, start, end = env
+        return eng.render(target, start, end, 10 * S)
+
+    def test_alias_sub_and_by_metric(self, env):
+        blk = self.render(env, 'aliasSub(apps.api.req, "apps\\.", "svc.")')
+        assert series_name(blk.series_tags[0]) == b"svc.api.req"
+        blk = self.render(env, "aliasByMetric(apps.*.req)")
+        assert {series_name(t) for t in blk.series_tags} == {b"req"}
+
+    def test_substr(self, env):
+        blk = self.render(env, "substr(apps.api.req, 1, 2)")
+        assert series_name(blk.series_tags[0]) == b"api"
+
+    def test_math_transforms(self, env):
+        v0 = self.render(env, "apps.api.req").values
+        assert np.allclose(self.render(env, "scaleToSeconds(apps.api.req, 20)").values,
+                           v0 * 2, equal_nan=True)
+        assert np.allclose(self.render(env, "invert(apps.api.req)").values,
+                           1.0 / v0, equal_nan=True)
+        assert np.allclose(self.render(env, "pow(apps.api.req, 2)").values,
+                           v0 ** 2, equal_nan=True)
+        assert np.allclose(self.render(env, "squareRoot(apps.api.req)").values,
+                           np.sqrt(v0), equal_nan=True)
+        assert np.allclose(self.render(env, "logarithm(apps.api.req)").values,
+                           np.log10(v0), equal_nan=True)
+
+    def test_time_shift(self, env):
+        eng, start, end = env
+        shifted = eng.render('timeShift(apps.api.req, "30s")', start, end, 10 * S)
+        plain = eng.render("apps.api.req", start - 30 * S, end - 30 * S, 10 * S)
+        np.testing.assert_allclose(shifted.values, plain.values)
+        assert shifted.meta.start_ns == start
+
+    def test_transform_null_and_is_non_null(self, env):
+        eng, start, end = env
+        blk = eng.render("transformNull(apps.api.req, -1)", start, end + 60 * S, 10 * S)
+        assert (blk.values[0] == -1).any()  # beyond ingested range -> filled
+        nn = eng.render("isNonNull(apps.api.req)", start, end + 60 * S, 10 * S)
+        assert set(np.unique(nn.values)) <= {0.0, 1.0}
+
+    def test_remove_value_bounds(self, env):
+        v0 = self.render(env, "apps.api.req").values
+        hi = self.render(env, "removeAboveValue(apps.api.req, 15)").values
+        assert np.isnan(hi[v0 > 15]).all()
+        lo = self.render(env, "removeBelowValue(apps.api.req, 15)").values
+        assert np.isnan(lo[v0 < 15]).all()
+
+    def test_integral_and_offset_to_zero(self, env):
+        v0 = self.render(env, "apps.api.req").values
+        integ = self.render(env, "integral(apps.api.req)").values
+        np.testing.assert_allclose(integ[0, -1], np.nansum(v0))
+        z = self.render(env, "offsetToZero(apps.api.req)").values
+        assert np.nanmin(z) == 0.0
+
+    def test_filters_and_tops(self, env):
+        blk = self.render(env, "maximumAbove(apps.*.req, 50)")
+        assert blk.n_series == 1
+        assert series_name(blk.series_tags[0]) == b"apps.db.req"
+        blk = self.render(env, "currentBelow(apps.*.req, 50)")
+        assert blk.n_series == 1
+        blk = self.render(env, "highestAverage(apps.*.req, 1)")
+        assert series_name(blk.series_tags[0]) == b"apps.db.req"
+        blk = self.render(env, "lowestCurrent(apps.*.req, 1)")
+        assert series_name(blk.series_tags[0]) == b"apps.api.req"
+
+    def test_sorts(self, env):
+        blk = self.render(env, "sortByTotal(apps.*.req)")
+        assert series_name(blk.series_tags[0]) == b"apps.db.req"
+        blk = self.render(env, "sortByMinima(apps.*.req)")
+        assert series_name(blk.series_tags[0]) == b"apps.api.req"
+
+    def test_percentiles(self, env):
+        v0 = self.render(env, "apps.api.req").values
+        npct = self.render(env, "nPercentile(apps.api.req, 50)").values
+        assert np.allclose(npct[0], np.percentile(v0[0][np.isfinite(v0[0])], 50))
+        pos = self.render(env, "percentileOfSeries(apps.*.req, 100)").values
+        hi = self.render(env, "apps.db.req").values
+        np.testing.assert_allclose(pos, hi, equal_nan=True)
+
+    def test_moving_family(self, env):
+        ms = self.render(env, "movingSum(apps.api.req, 3)").values
+        v0 = self.render(env, "apps.api.req").values
+        assert ms.shape == v0.shape
+        mm = self.render(env, "movingMedian(apps.api.req, 3)").values
+        assert np.isfinite(mm).any()
+
+    def test_series_combinators(self, env):
+        req = self.render(env, "apps.api.req").values
+        err = self.render(env, "apps.api.err").values
+        diff = self.render(env, "diffSeries(apps.api.req, apps.api.err)").values
+        np.testing.assert_allclose(diff[0], req[0] - err[0], equal_nan=True)
+        div = self.render(env, "divideSeries(apps.api.err, apps.api.req)").values
+        np.testing.assert_allclose(div[0], err[0] / req[0], equal_nan=True)
+        rng = self.render(env, "rangeOfSeries(apps.*.req)").values
+        assert (rng >= 0).all()
+        cnt = self.render(env, "countSeries(apps.*.req)").values
+        assert (cnt == 2.0).all()
+
+    def test_as_percent(self, env):
+        pct = self.render(env, "asPercent(apps.*.req)").values
+        np.testing.assert_allclose(pct.sum(axis=0), 100.0)
+
+    def test_wildcards_grouping(self, env):
+        blk = self.render(env, "sumSeriesWithWildcards(apps.*.req, 1)")
+        assert blk.n_series == 1
+        assert series_name(blk.series_tags[0]) == b"apps.req"
+        blk = self.render(env, 'groupByNodes(apps.*.*, "sum", 1)')
+        names = {series_name(t) for t in blk.series_tags}
+        assert names == {b"api", b"db"}
+
+    def test_group_constant_threshold_stacked(self, env):
+        blk = self.render(env, "group(apps.api.req, apps.db.req)")
+        assert blk.n_series == 2
+        cl = self.render(env, "constantLine(5)")
+        assert (cl.values == 5.0).all()
+        th = self.render(env, 'threshold(9, "nine")')
+        assert series_name(th.series_tags[0]) == b"nine"
+        st = self.render(env, "stacked(sortByName(apps.*.req))")
+        v_api = self.render(env, "apps.api.req").values[0]
+        v_db = self.render(env, "apps.db.req").values[0]
+        np.testing.assert_allclose(st.values[1], v_api + v_db, equal_nan=True)
+
+    def test_delay_and_changed(self, env):
+        d = self.render(env, "delay(apps.api.req, 2)").values
+        v0 = self.render(env, "apps.api.req").values
+        np.testing.assert_allclose(d[0, 2:], v0[0, :-2], equal_nan=True)
+        ch = self.render(env, "changed(apps.api.req)").values
+        assert (ch[0, 1:][np.isfinite(v0[0, 1:])] == 1.0).all()

@@ -73,9 +73,19 @@ class TestMirrored:
         p3 = mirrored_mark_available(p2, "ss3")
         p3.validate_mirrored()
         p4 = mirrored_remove_shard_set(p3, "ss1")
-        assert "ss1-0" not in p4.instances
+        # The leaving set stays (LEAVING) until receivers cut over — its
+        # shards never drop to zero available replicas mid-move.
+        assert "ss1-0" in p4.instances
+        assert all(a.state == ShardState.LEAVING
+                   for a in p4.instances["ss1-0"].shards.values())
+        for s in range(8):
+            avail = p4.replicas_for(s, states=(ShardState.AVAILABLE,
+                                               ShardState.LEAVING))
+            assert len(avail) >= 2, s
         for ssid in ("ss2", "ss3"):
             p4 = mirrored_mark_available(p4, ssid)
+        # Fully handed off: the emptied set leaves the placement.
+        assert "ss1-0" not in p4.instances
         p4.validate_mirrored()
         assert sum(len(m[0].shards) for m in p4.shard_sets().values()) == 8
 
