@@ -64,21 +64,20 @@ def run_embedded(db, namespace: bytes = b"default",
                  kv_store: Optional[cluster_kv.MemStore] = None,
                  rules_namespace: bytes = b"default",
                  aggregated_namespaces: Optional[Dict[StoragePolicy, bytes]] = None,
-                 clock=None, listen=("127.0.0.1", 0)) -> Coordinator:
+                 clock=None, listen=("127.0.0.1", 0),
+                 create_namespace=None) -> Coordinator:
     storage = LocalStorage(db, namespace)
     agg = {
         policy: LocalStorage(db, ns)
         for policy, ns in (aggregated_namespaces or {}).items()
     }
 
-    def create_namespace(name: bytes, retention_ns: int):
-        from ..index.namespace_index import NamespaceIndex
-        from ..storage.namespace import NamespaceOptions
+    if create_namespace is None:
+        def create_namespace(name: bytes, retention_ns: int):
+            from ..storage.namespace import NamespaceOptions
 
-        if name not in db.namespaces:
-            db.create_namespace(
-                name, NamespaceOptions(retention_ns=retention_ns),
-                index=NamespaceIndex(clock=db.clock))
+            db.ensure_namespace(
+                name, NamespaceOptions(retention_ns=retention_ns))
 
     return _build(storage, agg, kv_store, rules_namespace, clock,
                   create_namespace, listen)

@@ -215,7 +215,7 @@ class NodeService:
 
     def rpc_namespaces(self):
         out = []
-        for name, nsobj in self.db.namespaces.items():
+        for name, nsobj in list(self.db.namespaces.items()):
             out.append({
                 "name": name,
                 "retention_ns": nsobj.opts.retention_ns,

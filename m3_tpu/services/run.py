@@ -84,17 +84,22 @@ class DBNodeHandle:
     kv: Optional[cluster_kv.MemStore] = None
     lock: Optional[object] = None
     httpjson: Optional[object] = None
+    ns_watch: Optional[object] = None
 
     @property
     def endpoint(self) -> str:
         return self.server.endpoint
 
     def close(self):
+        if self.ns_watch is not None:
+            self.ns_watch.stop()
         if self.coordinator is not None:
             self.coordinator.close()
         if self.httpjson is not None:
             self.httpjson.close()
         self.server.close()
+        if self.kv is not None and hasattr(self.kv, "close"):
+            self.kv.close()  # RemoteStore: stops watch threads + socket
         if self.lock is not None:
             self.lock.release()
 
@@ -111,13 +116,11 @@ def run_dbnode(cfg: DBNodeConfig, clock=None) -> DBNodeHandle:
         commitlog = CommitLog(os.path.join(cfg.data_dir, "commitlog"))
     db = Database(ShardSet(cfg.num_shards), commitlog=commitlog, clock=clock)
     for ns_cfg in cfg.namespaces:
-        index = NamespaceIndex(clock=db.clock) if ns_cfg.index_enabled else None
-        db.create_namespace(
+        db.ensure_namespace(
             ns_cfg.name.encode(),
             NamespaceOptions(retention_ns=ns_cfg.retention_ns,
                              block_size_ns=ns_cfg.block_size_ns,
-                             index_enabled=ns_cfg.index_enabled),
-            index=index)
+                             index_enabled=ns_cfg.index_enabled))
     db.mark_bootstrapped()
     host, port = _host_port(cfg.listen_address)
     service = NodeService(db)
@@ -130,6 +133,11 @@ def run_dbnode(cfg: DBNodeConfig, clock=None) -> DBNodeHandle:
         httpjson = HTTPJSONServer(service, host=hhost, port=hport).start()
     persist = PersistManager(os.path.join(cfg.data_dir, "data"))
     kv = _kv_store(cfg.kv_path, cfg.kv_endpoint)
+    # KV-watched namespace registry: namespaces added to KV (by admins or
+    # peers) bootstrap and serve without restart (namespace_watch.go).
+    from ..storage.namespace_watch import NamespaceWatch
+
+    ns_watch = NamespaceWatch(db, kv).start()
     coordinator = None
     if cfg.coordinator is not None:
         from ..coordinator import run_embedded
@@ -137,8 +145,11 @@ def run_dbnode(cfg: DBNodeConfig, clock=None) -> DBNodeHandle:
         coordinator = run_embedded(
             db, namespace=cfg.coordinator.namespace.encode(), kv_store=kv,
             rules_namespace=cfg.coordinator.rules_namespace.encode(),
-            clock=db.clock, listen=_host_port(cfg.coordinator.listen_address))
-    return DBNodeHandle(db, server, persist, coordinator, kv, lock, httpjson)
+            clock=db.clock, listen=_host_port(cfg.coordinator.listen_address),
+            create_namespace=lambda name, retention_ns:
+                ns_watch.add(name, retention_ns))
+    return DBNodeHandle(db, server, persist, coordinator, kv, lock, httpjson,
+                        ns_watch)
 
 
 @dataclasses.dataclass

@@ -8,6 +8,7 @@ timing; services wrap it in a mediator thread."""
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
@@ -26,25 +27,57 @@ class Database:
         self.clock = clock or (lambda: time.time_ns())
         self.retriever = retriever
         self.namespaces: Dict[bytes, Namespace] = {}
+        # Guards namespace map mutation (dynamic registry updates arrive on
+        # watch threads); iterating code snapshots values() under the GIL.
+        self._ns_lock = threading.Lock()
         self._bootstrapped = False
 
     # ------------------------------------------------------------- namespaces
 
     def create_namespace(self, name: bytes, opts: NamespaceOptions = NamespaceOptions(),
                          index=None) -> Namespace:
-        if name in self.namespaces:
-            raise ValueError(f"namespace {name!r} already exists")
-        ns = Namespace(name, opts, self.shard_set.all_shard_ids(), index=index,
-                       retriever=self.retriever)
-        self.namespaces[name] = ns
-        return ns
+        with self._ns_lock:
+            if name in self.namespaces:
+                raise ValueError(f"namespace {name!r} already exists")
+            ns = Namespace(name, opts, self.shard_set.all_shard_ids(), index=index,
+                           retriever=self.retriever)
+            self.namespaces[name] = ns
+            return ns
+
+    def ensure_namespace(self, name: bytes,
+                         opts: Optional[NamespaceOptions] = None,
+                         index_enabled: Optional[bool] = None) -> Namespace:
+        """Create-if-absent with the standard index wiring — the single
+        namespace-creation path shared by config startup, the coordinator
+        admin API, and the KV registry watch."""
+        existing = self.namespaces.get(name)
+        if existing is not None:
+            return existing
+        opts = opts or NamespaceOptions()
+        enabled = opts.index_enabled if index_enabled is None else index_enabled
+        index = None
+        if enabled:
+            from ..index.namespace_index import NamespaceIndex
+
+            index = NamespaceIndex(clock=self.clock)
+        try:
+            return self.create_namespace(name, opts, index=index)
+        except ValueError:
+            return self.namespaces[name]  # lost a creation race: reuse
 
     def set_retriever(self, retriever):
         """Attach a disk retriever (serving-path cold reads) to every
         namespace, current and future."""
         self.retriever = retriever
-        for ns in self.namespaces.values():
+        for ns in list(self.namespaces.values()):
             ns.set_retriever(retriever)
+
+    def drop_namespace(self, name: bytes):
+        """Remove a namespace (namespace_watch.go applying a registry
+        removal): in-flight reads of the dropped object finish against its
+        now-orphaned state; new operations get KeyError."""
+        with self._ns_lock:
+            self.namespaces.pop(name, None)
 
     def namespace(self, name: bytes) -> Namespace:
         ns = self.namespaces.get(name)
@@ -101,7 +134,7 @@ class Database:
     def tick(self, now_ns: Optional[int] = None) -> dict:
         now = now_ns if now_ns is not None else self.clock()
         totals = {"sealed": 0, "expired": 0}
-        for ns in self.namespaces.values():
+        for ns in list(self.namespaces.values()):
             r = ns.tick(now)
             for k in totals:
                 totals[k] += r[k]
@@ -112,7 +145,7 @@ class Database:
         (storage/flush.go); returns number of filesets written."""
         now = now_ns if now_ns is not None else self.clock()
         flushed = 0
-        for ns in self.namespaces.values():
+        for ns in list(self.namespaces.values()):
             for shard in ns.shards.values():
                 wrote = False
                 for bs in shard.flushable(now):
@@ -141,7 +174,7 @@ class Database:
         if self.retriever is None:
             return 0
         evicted = 0
-        for ns in self.namespaces.values():
+        for ns in list(self.namespaces.values()):
             for shard in ns.shards.values():
                 evicted += shard.evict_flushed()
         return evicted

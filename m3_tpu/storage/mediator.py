@@ -57,7 +57,7 @@ class Mediator:
         self._snapshot_version += 1
         version = self._snapshot_version
         count = 0
-        for ns in self.db.namespaces.values():
+        for ns in list(self.db.namespaces.values()):
             if not ns.opts.snapshot_enabled:
                 continue
             for shard in ns.shards.values():
@@ -76,7 +76,7 @@ class Mediator:
         """cleanup.go: remove filesets past retention, superseded snapshots,
         and snapshots for blocks already flushed."""
         removed = 0
-        for ns in self.db.namespaces.values():
+        for ns in list(self.db.namespaces.values()):
             cutoff = now_ns - ns.opts.retention_ns
             for shard_id in ns.shards:
                 shard_removed = 0

@@ -1,0 +1,152 @@
+"""KV-watched dynamic namespace registry (reference:
+src/dbnode/storage/namespace_watch.go dbNamespaceWatch — the database
+watches the namespace registry in the cluster KV and applies updates live;
+src/dbnode/namespace/kvadmin for the admin side).
+
+The registry key holds {"namespaces": {name: {retention_ns, block_size_ns,
+index_enabled}}}. On watch delivery the database diffs its live namespaces
+against the registry: new entries are created (with a reverse index when
+enabled) and start serving immediately — no restart — and entries removed
+from the registry are dropped. On start the watch seeds an absent registry
+from the database's config-defined namespaces, making KV authoritative
+from then on."""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from ..cluster import kv as cluster_kv
+from .namespace import NamespaceOptions
+
+REGISTRY_KEY = "_namespaces"
+
+
+def _ns_entry(opts) -> dict:
+    return {
+        "retention_ns": opts.retention_ns,
+        "block_size_ns": opts.block_size_ns,
+        "index_enabled": opts.index_enabled,
+    }
+
+
+class NamespaceWatch:
+    """Binds a Database to the KV namespace registry."""
+
+    def __init__(self, db, store, key: str = REGISTRY_KEY):
+        self.db = db
+        self.store = store
+        self.key = key
+        self._started = False
+        self._stopped = False
+        self.updates_applied = 0
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> "NamespaceWatch":
+        """Seed an absent registry from the live namespaces, then watch."""
+        if self._started:
+            return self
+        self._started = True
+        cur = self.store.get(self.key)
+        if cur is None:
+            try:
+                self._publish({
+                    ns.name.decode(): _ns_entry(ns.opts)
+                    for ns in list(self.db.namespaces.values())
+                }, expect_version=0)
+            except ValueError:
+                pass  # another node seeded first: adopt its registry
+        self.store.on_change(self.key, self._on_update)
+        return self
+
+    def stop(self):
+        """Detach from the registry: later watch deliveries no-op, so a
+        closed node's database is never mutated by registry churn."""
+        self._stopped = True
+
+    # ---------------------------------------------------------------- admin
+
+    def add(self, name: bytes, retention_ns: int,
+            block_size_ns: Optional[int] = None,
+            index_enabled: bool = True):
+        """Publish to the registry FIRST, then create locally so the
+        caller can use the namespace immediately (namespace/kvadmin Add).
+        Publish-before-create closes the race where a concurrent registry
+        update delivered between a local create and its publish would see
+        the namespace as unregistered and drop it, losing buffered writes.
+        An existing namespace with different options is a conflict, not a
+        silent divergence between this node and its peers."""
+        entry = {
+            "retention_ns": retention_ns,
+            "block_size_ns": block_size_ns or NamespaceOptions().block_size_ns,
+            "index_enabled": index_enabled,
+        }
+        existing = self.db.namespaces.get(name)
+        if existing is not None and _ns_entry(existing.opts) != entry:
+            raise ValueError(
+                f"namespace {name!r} already exists with different options")
+        for _ in range(8):  # CAS loop against concurrent admins
+            cur = self.store.get(self.key)
+            reg = json.loads(cur.data) if cur else {}
+            prev = reg.get(name.decode())
+            if prev is not None and prev != entry:
+                raise ValueError(
+                    f"namespace {name!r} registered with different options")
+            if prev == entry:
+                break
+            reg[name.decode()] = entry
+            try:
+                self._publish(reg, cur.version if cur else 0)
+                break
+            except ValueError:
+                continue
+        else:
+            raise RuntimeError("namespace registry CAS contention")
+        self._create_local(name, retention_ns, entry["block_size_ns"],
+                           index_enabled)
+
+    def remove(self, name: bytes):
+        for _ in range(8):
+            cur = self.store.get(self.key)
+            reg = json.loads(cur.data) if cur else {}
+            if name.decode() not in reg:
+                return
+            del reg[name.decode()]
+            try:
+                self._publish(reg, cur.version if cur else 0)
+                return
+            except ValueError:
+                continue
+        raise RuntimeError("namespace registry CAS contention")
+
+    def _publish(self, reg: dict, expect_version: int):
+        self.store.check_and_set(self.key, expect_version,
+                                 json.dumps(reg).encode())
+
+    # ---------------------------------------------------------------- watch
+
+    def _on_update(self, _key: str, value: cluster_kv.Value):
+        if self._stopped:
+            return
+        try:
+            reg = json.loads(value.data)
+        except (ValueError, TypeError):
+            return
+        want = {name.encode(): entry for name, entry in reg.items()}
+        for name, entry in want.items():
+            if name not in self.db.namespaces:
+                self._create_local(
+                    name, int(entry["retention_ns"]),
+                    int(entry.get("block_size_ns") or 0) or None,
+                    bool(entry.get("index_enabled", True)))
+        for name in [n for n in self.db.namespaces if n not in want]:
+            self.db.drop_namespace(name)
+        self.updates_applied += 1
+
+    def _create_local(self, name: bytes, retention_ns: int,
+                      block_size_ns: Optional[int], index_enabled: bool):
+        kwargs = {"retention_ns": retention_ns, "index_enabled": index_enabled}
+        if block_size_ns:
+            kwargs["block_size_ns"] = block_size_ns
+        self.db.ensure_namespace(name, NamespaceOptions(**kwargs))

@@ -1,0 +1,108 @@
+"""Dynamic namespace registry (reference:
+src/dbnode/storage/namespace_watch.go): a namespace added to the KV
+registry is created on watching databases and serves without restart;
+removals drop it; the watch seeds an absent registry from config-defined
+namespaces so KV becomes authoritative."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from m3_tpu.cluster import kv as cluster_kv
+from m3_tpu.cluster.kv_service import KVServer, RemoteStore
+from m3_tpu.index.namespace_index import NamespaceIndex
+from m3_tpu.parallel.sharding import ShardSet
+from m3_tpu.storage.database import Database
+from m3_tpu.storage.namespace import NamespaceOptions
+from m3_tpu.storage.namespace_watch import REGISTRY_KEY, NamespaceWatch
+
+S = 1_000_000_000
+T0 = 1_700_000_000 * S
+HOUR = 3600 * S
+
+
+def _await(cond, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return cond()
+
+
+def make_db():
+    db = Database(ShardSet(4), clock=lambda: T0)
+    db.create_namespace(b"default", NamespaceOptions(),
+                        index=NamespaceIndex(clock=lambda: T0))
+    return db
+
+
+class TestLocalRegistry:
+    def test_seed_then_add_and_remove(self):
+        db = make_db()
+        store = cluster_kv.MemStore()
+        watch = NamespaceWatch(db, store).start()
+        # Seeded from the live namespace set.
+        reg = json.loads(store.get(REGISTRY_KEY).data)
+        assert set(reg) == {"default"}
+        # Registry write from "elsewhere" creates the namespace live.
+        reg["metrics_1m"] = {"retention_ns": 40 * 24 * HOUR,
+                             "block_size_ns": 4 * HOUR, "index_enabled": True}
+        store.set(REGISTRY_KEY, json.dumps(reg).encode())
+        assert b"metrics_1m" in db.namespaces
+        ns = db.namespace(b"metrics_1m")
+        assert ns.opts.retention_ns == 40 * 24 * HOUR
+        assert ns.opts.block_size_ns == 4 * HOUR
+        assert ns.index is not None
+        # ... and serves immediately.
+        db.write(b"metrics_1m", b"series", T0, 1.5, tags={b"a": b"b"})
+        t, v = db.read(b"metrics_1m", b"series", 0, 2**62)
+        assert v.tolist() == [1.5]
+        # Removal drops it.
+        del reg["metrics_1m"]
+        store.set(REGISTRY_KEY, json.dumps(reg).encode())
+        assert b"metrics_1m" not in db.namespaces
+        assert b"default" in db.namespaces
+
+    def test_add_helper_creates_and_publishes(self):
+        db = make_db()
+        store = cluster_kv.MemStore()
+        watch = NamespaceWatch(db, store).start()
+        watch.add(b"agg_10s", retention_ns=2 * 24 * HOUR, index_enabled=False)
+        assert b"agg_10s" in db.namespaces
+        assert db.namespace(b"agg_10s").index is None
+        reg = json.loads(store.get(REGISTRY_KEY).data)
+        assert reg["agg_10s"]["index_enabled"] is False
+        watch.remove(b"agg_10s")
+        assert b"agg_10s" not in db.namespaces
+
+    def test_no_index_when_disabled(self):
+        db = make_db()
+        store = cluster_kv.MemStore()
+        NamespaceWatch(db, store).start()
+        reg = json.loads(store.get(REGISTRY_KEY).data)
+        reg["raw"] = {"retention_ns": HOUR, "index_enabled": False}
+        store.set(REGISTRY_KEY, json.dumps(reg).encode())
+        assert db.namespace(b"raw").index is None
+
+
+class TestCrossProcess:
+    def test_namespace_add_propagates_over_kv_service(self):
+        """Two databases watching one KV process: an admin add on one node
+        appears on the other via watch push, no restart."""
+        srv = KVServer().start()
+        try:
+            db_a, db_b = make_db(), make_db()
+            watch_a = NamespaceWatch(db_a, RemoteStore(srv.endpoint)).start()
+            watch_b = NamespaceWatch(db_b, RemoteStore(srv.endpoint)).start()
+            watch_a.add(b"new_ns", retention_ns=2 * HOUR)
+            assert b"new_ns" in db_a.namespaces  # immediate locally
+            assert _await(lambda: b"new_ns" in db_b.namespaces)
+            db_b.write(b"new_ns", b"s", T0, 7.0)
+            assert db_b.read(b"new_ns", b"s", 0, 2**62)[1].tolist() == [7.0]
+            watch_b.remove(b"new_ns")
+            assert _await(lambda: b"new_ns" not in db_a.namespaces)
+        finally:
+            srv.close()
