@@ -123,6 +123,16 @@ class MemStore:
         if cur is not None:
             fn(key, cur)
 
+    def off_change(self, key: str, fn: Callable[[str, Value], None]):
+        """Deregister a callback (see unwatch: long-lived stores must not
+        accumulate dead subscribers)."""
+        with self._lock:
+            fns = self._callbacks.get(key)
+            if fns is not None and fn in fns:
+                fns.remove(fn)
+                if not fns:
+                    del self._callbacks[key]
+
     def _fire(self, key: str):
         for w in self._watches.get(key, []):
             w._notify()

@@ -239,6 +239,15 @@ class RemoteStore:
         if cached is not None:
             fn(key, cached)
 
+    def off_change(self, key: str, fn: Callable):
+        """Deregister a callback (MemStore.off_change parity)."""
+        with self._watch_lock:
+            fns = self._callbacks.get(key)
+            if fns is not None and fn in fns:
+                fns.remove(fn)
+                if not fns:
+                    del self._callbacks[key]
+
     def _ensure_watch_thread(self, key: str):
         if key in self._watch_threads:
             return

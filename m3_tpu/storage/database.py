@@ -45,8 +45,7 @@ class Database:
             return ns
 
     def ensure_namespace(self, name: bytes,
-                         opts: Optional[NamespaceOptions] = None,
-                         index_enabled: Optional[bool] = None) -> Namespace:
+                         opts: Optional[NamespaceOptions] = None) -> Namespace:
         """Create-if-absent with the standard index wiring — the single
         namespace-creation path shared by config startup, the coordinator
         admin API, and the KV registry watch."""
@@ -54,9 +53,8 @@ class Database:
         if existing is not None:
             return existing
         opts = opts or NamespaceOptions()
-        enabled = opts.index_enabled if index_enabled is None else index_enabled
         index = None
-        if enabled:
+        if opts.index_enabled:
             from ..index.namespace_index import NamespaceIndex
 
             index = NamespaceIndex(clock=self.clock)

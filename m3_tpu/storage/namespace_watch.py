@@ -39,6 +39,11 @@ class NamespaceWatch:
         self.key = key
         self._started = False
         self._stopped = False
+        # Registry versions below this floor are stale deliveries (a watch
+        # event published before this node's own add/remove landed) and are
+        # skipped — applying one would transiently drop a just-added
+        # namespace and its buffered writes.
+        self._floor_version = 0
         self.updates_applied = 0
 
     # ------------------------------------------------------------ lifecycle
@@ -48,22 +53,37 @@ class NamespaceWatch:
         if self._started:
             return self
         self._started = True
-        cur = self.store.get(self.key)
-        if cur is None:
+        # Merge config-defined namespaces INTO the registry (not only when
+        # it is absent): a restart with a new config namespace must
+        # register it, not have the watch silently drop it. Names already
+        # registered keep their registry options (KV is authoritative).
+        local = {ns.name.decode(): _ns_entry(ns.opts)
+                 for ns in list(self.db.namespaces.values())}
+        for _ in range(8):
+            cur = self.store.get(self.key)
+            reg = json.loads(cur.data) if cur else {}
+            missing = {n: e for n, e in local.items() if n not in reg}
+            if not missing:
+                break
+            reg.update(missing)
             try:
-                self._publish({
-                    ns.name.decode(): _ns_entry(ns.opts)
-                    for ns in list(self.db.namespaces.values())
-                }, expect_version=0)
+                self._floor_version = max(
+                    self._floor_version,
+                    self._publish(reg, cur.version if cur else 0))
+                break
             except ValueError:
-                pass  # another node seeded first: adopt its registry
+                continue
         self.store.on_change(self.key, self._on_update)
         return self
 
     def stop(self):
-        """Detach from the registry: later watch deliveries no-op, so a
-        closed node's database is never mutated by registry churn."""
+        """Detach from the registry: the callback is deregistered (no
+        leak pinning this Database in a long-lived store) and any delivery
+        already in flight no-ops."""
         self._stopped = True
+        off = getattr(self.store, "off_change", None)
+        if off is not None:
+            off(self.key, self._on_update)
 
     # ---------------------------------------------------------------- admin
 
@@ -77,15 +97,23 @@ class NamespaceWatch:
         the namespace as unregistered and drop it, losing buffered writes.
         An existing namespace with different options is a conflict, not a
         silent divergence between this node and its peers."""
-        entry = {
-            "retention_ns": retention_ns,
-            "block_size_ns": block_size_ns or NamespaceOptions().block_size_ns,
-            "index_enabled": index_enabled,
-        }
         existing = self.db.namespaces.get(name)
-        if existing is not None and _ns_entry(existing.opts) != entry:
-            raise ValueError(
-                f"namespace {name!r} already exists with different options")
+        if existing is not None:
+            # Idempotent re-add (quickstart database_create against a
+            # config-defined namespace): adopt the live options, but a
+            # different requested retention is a real conflict.
+            if retention_ns != existing.opts.retention_ns:
+                raise ValueError(
+                    f"namespace {name!r} already exists with different "
+                    f"retention")
+            entry = _ns_entry(existing.opts)
+        else:
+            entry = {
+                "retention_ns": retention_ns,
+                "block_size_ns": (block_size_ns
+                                  or NamespaceOptions().block_size_ns),
+                "index_enabled": index_enabled,
+            }
         for _ in range(8):  # CAS loop against concurrent admins
             cur = self.store.get(self.key)
             reg = json.loads(cur.data) if cur else {}
@@ -97,14 +125,16 @@ class NamespaceWatch:
                 break
             reg[name.decode()] = entry
             try:
-                self._publish(reg, cur.version if cur else 0)
+                self._floor_version = max(
+                    self._floor_version,
+                    self._publish(reg, cur.version if cur else 0))
                 break
             except ValueError:
                 continue
         else:
             raise RuntimeError("namespace registry CAS contention")
-        self._create_local(name, retention_ns, entry["block_size_ns"],
-                           index_enabled)
+        self._create_local(name, entry["retention_ns"],
+                           entry["block_size_ns"], entry["index_enabled"])
 
     def remove(self, name: bytes):
         for _ in range(8):
@@ -114,20 +144,22 @@ class NamespaceWatch:
                 return
             del reg[name.decode()]
             try:
-                self._publish(reg, cur.version if cur else 0)
+                self._floor_version = max(
+                    self._floor_version,
+                    self._publish(reg, cur.version if cur else 0))
                 return
             except ValueError:
                 continue
         raise RuntimeError("namespace registry CAS contention")
 
-    def _publish(self, reg: dict, expect_version: int):
-        self.store.check_and_set(self.key, expect_version,
-                                 json.dumps(reg).encode())
+    def _publish(self, reg: dict, expect_version: int) -> int:
+        return self.store.check_and_set(self.key, expect_version,
+                                        json.dumps(reg).encode())
 
     # ---------------------------------------------------------------- watch
 
     def _on_update(self, _key: str, value: cluster_kv.Value):
-        if self._stopped:
+        if self._stopped or value.version < self._floor_version:
             return
         try:
             reg = json.loads(value.data)
@@ -135,12 +167,25 @@ class NamespaceWatch:
             return
         want = {name.encode(): entry for name, entry in reg.items()}
         for name, entry in want.items():
-            if name not in self.db.namespaces:
+            ns = self.db.namespaces.get(name)
+            if ns is None:
                 self._create_local(
                     name, int(entry["retention_ns"]),
                     int(entry.get("block_size_ns") or 0) or None,
                     bool(entry.get("index_enabled", True)))
-        for name in [n for n in self.db.namespaces if n not in want]:
+            elif int(entry["retention_ns"]) != ns.opts.retention_ns:
+                # Runtime-settable option update applied live (the
+                # reference's namespace watch applies registry option
+                # changes the same way); block size / indexing are
+                # immutable once data exists and are left untouched.
+                import dataclasses as _dc
+
+                ns.opts = _dc.replace(
+                    ns.opts, retention_ns=int(entry["retention_ns"]))
+                for sh in list(ns.shards.values()):
+                    sh.opts = _dc.replace(
+                        sh.opts, retention_ns=int(entry["retention_ns"]))
+        for name in [n for n in list(self.db.namespaces) if n not in want]:
             self.db.drop_namespace(name)
         self.updates_applied += 1
 

@@ -106,3 +106,55 @@ class TestCrossProcess:
             assert _await(lambda: b"new_ns" not in db_a.namespaces)
         finally:
             srv.close()
+
+
+class TestRegistryEvolution:
+    def test_config_namespace_merged_into_existing_registry(self):
+        """Restarting with a new config-defined namespace registers it in a
+        pre-existing registry instead of the watch dropping it."""
+        store = cluster_kv.MemStore()
+        db1 = make_db()
+        NamespaceWatch(db1, store).start()
+        # "Restart" with an extra config namespace.
+        db2 = make_db()
+        db2.create_namespace(b"from_config", NamespaceOptions(
+            retention_ns=6 * HOUR))
+        NamespaceWatch(db2, store).start()
+        assert b"from_config" in db2.namespaces  # not dropped
+        reg = json.loads(store.get(REGISTRY_KEY).data)
+        assert "from_config" in reg  # registered for peers
+        assert _await(lambda: b"from_config" in db1.namespaces)
+
+    def test_retention_update_applies_live(self):
+        db = make_db()
+        store = cluster_kv.MemStore()
+        NamespaceWatch(db, store).start()
+        reg = json.loads(store.get(REGISTRY_KEY).data)
+        reg["default"]["retention_ns"] = 99 * HOUR
+        store.set(REGISTRY_KEY, json.dumps(reg).encode())
+        ns = db.namespace(b"default")
+        assert ns.opts.retention_ns == 99 * HOUR
+        assert all(sh.opts.retention_ns == 99 * HOUR
+                   for sh in ns.shards.values())
+
+    def test_idempotent_readd_of_existing_namespace(self):
+        """Quickstart database_create against a config namespace must
+        no-op, not 500 (same retention adopts live options)."""
+        db = make_db()
+        store = cluster_kv.MemStore()
+        watch = NamespaceWatch(db, store).start()
+        opts = db.namespace(b"default").opts
+        watch.add(b"default", retention_ns=opts.retention_ns)  # no raise
+        with pytest.raises(ValueError):
+            watch.add(b"default", retention_ns=opts.retention_ns + HOUR)
+
+    def test_stop_deregisters_callback(self):
+        db = make_db()
+        store = cluster_kv.MemStore()
+        watch = NamespaceWatch(db, store).start()
+        watch.stop()
+        assert not store._callbacks.get(REGISTRY_KEY)
+        reg = {"phantom": {"retention_ns": HOUR, "index_enabled": False}}
+        store.set(REGISTRY_KEY, json.dumps(reg).encode())
+        assert b"phantom" not in db.namespaces
+        assert b"default" in db.namespaces

@@ -171,3 +171,50 @@ def test_peer_streaming_metadata_and_blocks(cluster):
     got = blocks[b"req.count.a"]["blocks"]
     assert got and all(b["npoints"] > 0 for b in got)
     s.close()
+
+
+def test_replica_conflict_resolution_end_to_end(cluster):
+    """Divergent replicas resolved through a real Session fetch (reference:
+    src/dbnode/encoding/iterators.go:60-105 current() conflict strategies):
+    each node holds a different value at the same timestamp, and the
+    session-side k-way merge picks per the configured strategy."""
+    now = cluster.clock.now_ns
+    sid = b"conflict.series"
+    # Write straight into each node's storage, bypassing the replicating
+    # session, so the three replicas genuinely diverge.
+    values = [1.0, 5.0, 3.0]
+    for node, val in zip(cluster.nodes.values(), values):
+        node.db.write(NS, sid, now, val, tags={b"__name__": b"conflict"})
+    # A second timestamp where only one replica has data: must pass through
+    # untouched regardless of strategy.
+    only_node = next(iter(cluster.nodes.values()))
+    only_node.db.write(NS, sid, now + xtime.SECOND, 77.0)
+
+    def fetch_with(strategy):
+        s = Session(cluster.topology, SessionOptions(
+            read_consistency=ReadConsistencyLevel.ALL,
+            conflict_strategy=strategy, timeout_s=10))
+        try:
+            return s.fetch(NS, sid, now - xtime.MINUTE, now + xtime.MINUTE)
+        finally:
+            s.close()
+
+    t_hi, v_hi = fetch_with(ConflictStrategy.HIGHEST_VALUE)
+    assert v_hi.tolist() == [5.0, 77.0], v_hi
+    t_lo, v_lo = fetch_with(ConflictStrategy.LOWEST_VALUE)
+    assert v_lo.tolist() == [1.0, 77.0], v_lo
+    t_lp, v_lp = fetch_with(ConflictStrategy.LAST_PUSHED)
+    assert v_lp[0] in values and v_lp[1] == 77.0
+    assert t_hi.tolist() == t_lo.tolist() == [now, now + xtime.SECOND]
+
+    # Same resolution through the tagged (query) path the coordinator uses.
+    s = Session(cluster.topology, SessionOptions(
+        read_consistency=ReadConsistencyLevel.ALL,
+        conflict_strategy=ConflictStrategy.HIGHEST_VALUE, timeout_s=10))
+    try:
+        res = s.fetch_tagged(NS, iq.TermQuery(b"__name__", b"conflict"),
+                             now - xtime.MINUTE, now + xtime.MINUTE)
+    finally:
+        s.close()
+    entry = res[sid]
+    assert entry["v"].tolist() == [5.0, 77.0]
