@@ -56,6 +56,9 @@ class HTTPApi:
             ("GET", r"/api/v1/graphite/find", self.graphite_find),
             ("GET", r"/routes", self.list_routes),
             ("GET", r"/debug/vars", self.debug_vars),
+            ("GET", r"/debug/traces", self.debug_traces),
+            ("GET", r"/debug/pprof/profile", self.debug_profile),
+            ("GET", r"/debug/pprof/goroutine", self.debug_stacks),
         ]
         if admin is not None:
             self.routes += [
@@ -85,6 +88,26 @@ class HTTPApi:
         from ..utils.instrument import ROOT
 
         return {"metrics": ROOT.snapshot()}
+
+    def debug_traces(self, req) -> dict:
+        """Recent finished span trees (opentracing-analog)."""
+        from ..utils.tracing import TRACER
+
+        return {"traces": TRACER.recent_traces()}
+
+    def debug_profile(self, req) -> dict:
+        """Statistical CPU profile: /debug/pprof/profile?seconds=N."""
+        from ..utils import tracing
+
+        seconds = min(float(req.param("seconds", "1")), 30.0)
+        return {"profile": tracing.profile(seconds=seconds)}
+
+    def debug_stacks(self, req):
+        """All-threads stack dump (goroutine-dump analog, debug=2 form)."""
+        from ..utils import tracing
+
+        return RawResponse("text/plain; charset=utf-8",
+                           tracing.thread_stacks().encode())
 
     def query_range(self, req) -> dict:
         q = req.param("query")

@@ -13,12 +13,14 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import threading
 from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
 from ..ops import series_agg, temporal
 from . import promql
+from ..utils.tracing import span
 from .block import Block, BlockMeta, consolidate_series
 from .model import Matcher, MatchType, METRIC_NAME, Tags
 from .promql import (
@@ -69,7 +71,10 @@ class Engine:
         # query finishes, so the global budget tracks only in-flight work.
         self.cost_enforcer = cost_enforcer
         self.per_query_cost_limit = per_query_cost_limit
-        self._active_enforcer = None
+        # Per-QUERY scoped enforcer: thread-local, because one Engine
+        # serves concurrent queries from the ThreadingHTTPServer and a
+        # shared slot would charge one query's datapoints to another.
+        self._local = threading.local()
 
     def execute_range(self, query: str, start_ns: int, end_ns: int,
                       step_ns: int) -> Block:
@@ -77,20 +82,21 @@ class Engine:
 
         ROOT.counter("query.executed").inc()
         timer = ROOT.timer("query.latency_s")
-        with timer:
+        with timer, span("query.execute_range", query=query):
             return self._execute_range(query, start_ns, end_ns, step_ns)
 
     def _execute_range(self, query: str, start_ns: int, end_ns: int,
                        step_ns: int) -> Block:
-        ast = promql.parse(query)
+        with span("query.parse"):
+            ast = promql.parse(query)
         params = QueryParams(start_ns, end_ns, step_ns)
         if self.cost_enforcer is not None:
             child = self.cost_enforcer.child(self.per_query_cost_limit)
-            self._active_enforcer = child
+            self._local.enforcer = child
             try:
                 val = self._eval(ast, params)
             finally:
-                self._active_enforcer = None
+                self._local.enforcer = None
                 child.release(child.current())
         else:
             val = self._eval(ast, params)
@@ -124,11 +130,15 @@ class Engine:
     # -- selectors ---------------------------------------------------------
 
     def _fetch(self, sel: VectorSelector, start_ns: int, end_ns: int):
-        series = self.storage.fetch_raw(
-            promql.selector_matchers(sel), start_ns, end_ns)
-        if self._active_enforcer is not None:
+        with span("query.fetch", metric=sel.name.decode(errors="replace")
+                  if sel.name else "") as sp:
+            series = self.storage.fetch_raw(
+                promql.selector_matchers(sel), start_ns, end_ns)
+            sp.set_tag("series", len(series))
+        enforcer = getattr(self._local, "enforcer", None)
+        if enforcer is not None:
             points = sum(len(e["t"]) for e in series.values())
-            self._active_enforcer.add(points)
+            enforcer.add(points)
         return series
 
     def _eval_instant_selector(self, sel: VectorSelector,

@@ -1,0 +1,148 @@
+"""Tracing + profiling (reference: the x/instrument + net/http/pprof
+surface the reference exposes on every service — opentracing spans via
+instrument.Options tracing, goroutine/profile dumps on /debug/pprof).
+
+Spans: context-manager tree with wall-clock timings, thread-local current
+span, and a ring buffer of recent finished roots for /debug/traces.
+
+Profiling: a sampling profiler (the statistical CPU profile analog of
+/debug/pprof/profile) that samples every thread's Python stack at a fixed
+interval and aggregates flattened stack counts, plus an all-threads stack
+dump (the goroutine-dump analog of /debug/pprof/goroutine?debug=2)."""
+
+from __future__ import annotations
+
+import collections
+import sys
+import threading
+import time
+import traceback
+from typing import Dict, List, Optional
+
+# ---------------------------------------------------------------- spans
+
+
+class Span:
+    __slots__ = ("name", "tags", "start_ns", "end_ns", "children", "_tracer",
+                 "_parent")
+
+    def __init__(self, name: str, tracer: "Tracer", parent: Optional["Span"],
+                 tags: Optional[dict] = None):
+        self.name = name
+        self.tags = dict(tags or {})
+        self.start_ns = time.perf_counter_ns()
+        self.end_ns: Optional[int] = None
+        self.children: List[Span] = []
+        self._tracer = tracer
+        self._parent = parent
+
+    @property
+    def duration_ns(self) -> int:
+        return (self.end_ns or time.perf_counter_ns()) - self.start_ns
+
+    def set_tag(self, key: str, value) -> "Span":
+        self.tags[key] = value
+        return self
+
+    def __enter__(self) -> "Span":
+        self._tracer._push(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.end_ns = time.perf_counter_ns()
+        if exc_type is not None:
+            self.tags["error"] = repr(exc)
+        self._tracer._pop(self)
+        return False
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "duration_us": round(self.duration_ns / 1000, 1),
+            **({"tags": self.tags} if self.tags else {}),
+            **({"children": [c.to_dict() for c in self.children]}
+               if self.children else {}),
+        }
+
+
+class Tracer:
+    """Per-process tracer; thread-local span stacks, bounded root history."""
+
+    def __init__(self, max_traces: int = 128):
+        self._local = threading.local()
+        self._lock = threading.Lock()
+        self._recent = collections.deque(maxlen=max_traces)
+
+    def span(self, name: str, **tags) -> Span:
+        parent = getattr(self._local, "current", None)
+        return Span(name, self, parent, tags)
+
+    def current(self) -> Optional[Span]:
+        return getattr(self._local, "current", None)
+
+    def _push(self, span: Span):
+        if span._parent is not None:
+            span._parent.children.append(span)
+        self._local.current = span
+
+    def _pop(self, span: Span):
+        self._local.current = span._parent
+        if span._parent is None:
+            with self._lock:
+                self._recent.append(span)
+
+    def recent_traces(self) -> List[dict]:
+        with self._lock:
+            return [s.to_dict() for s in self._recent]
+
+
+TRACER = Tracer()  # process default, like the global opentracing tracer
+
+
+def span(name: str, **tags) -> Span:
+    return TRACER.span(name, **tags)
+
+
+# ---------------------------------------------------------------- profiling
+
+
+def thread_stacks() -> str:
+    """All-threads stack dump (goroutine-dump analog)."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for ident, frame in sys._current_frames().items():
+        out.append(f"--- thread {names.get(ident, '?')} ({ident}) ---")
+        out.extend(l.rstrip("\n") for l in traceback.format_stack(frame))
+    return "\n".join(out)
+
+
+def profile(seconds: float = 1.0, hz: int = 100,
+            top: int = 40) -> List[dict]:
+    """Statistical CPU profile: sample every thread's stack at `hz` for
+    `seconds`, aggregate by flattened stack. Returns the hottest stacks
+    with sample counts (the /debug/pprof/profile analog; sampling has the
+    same bias/overhead profile as pprof's SIGPROF sampling)."""
+    counts: Dict[tuple, int] = collections.Counter()
+    me = threading.get_ident()
+    interval = 1.0 / hz
+    deadline = time.perf_counter() + seconds
+    total = 0
+    while time.perf_counter() < deadline:
+        for ident, frame in sys._current_frames().items():
+            if ident == me:
+                continue
+            stack = []
+            f = frame
+            while f is not None:
+                code = f.f_code
+                stack.append(f"{code.co_name} ({code.co_filename.rsplit('/', 1)[-1]}:{f.f_lineno})")
+                f = f.f_back
+            counts[tuple(reversed(stack))] += 1
+            total += 1
+        time.sleep(interval)
+    out = []
+    for stack, n in sorted(counts.items(), key=lambda kv: -kv[1])[:top]:
+        out.append({"samples": n,
+                    "fraction": round(n / max(total, 1), 4),
+                    "stack": list(stack)})
+    return out

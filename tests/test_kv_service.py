@@ -159,3 +159,56 @@ class TestServicesOverNetworkedKV:
         finally:
             for h in handles.values():
                 h.close()
+
+
+class TestClusterClient:
+    """Composed cluster client (reference: src/cluster/client/client.go +
+    etcd configservice client): one endpoint yields KV, scoped stores,
+    services, elections, and placements."""
+
+    def test_scoped_stores_isolate(self, server):
+        from m3_tpu.cluster.client import ClusterClient
+
+        c1 = ClusterClient(endpoint=server.endpoint, zone="z1", env="prod")
+        c2 = ClusterClient(endpoint=server.endpoint, zone="z2", env="prod")
+        c1.kv().set("cfg", b"one")
+        c2.kv().set("cfg", b"two")
+        assert c1.kv().get("cfg").data == b"one"
+        assert c2.kv().get("cfg").data == b"two"
+        sub = c1.store("rules")
+        sub.set("r1", b"x")
+        assert sub.keys() == ["r1"]
+        assert c1.kv().get("rules/r1").data == b"x"
+        c1.close()
+        c2.close()
+
+    def test_scoped_watch_pushes(self, server):
+        from m3_tpu.cluster.client import ClusterClient
+
+        ca = ClusterClient(endpoint=server.endpoint, zone="zz")
+        cb = ClusterClient(endpoint=server.endpoint, zone="zz")
+        seen = []
+        ca.kv().on_change("watched", lambda k, v: seen.append(v.data))
+        cb.kv().set("watched", b"pushed")
+        assert _await(lambda: b"pushed" in seen)
+        ca.close()
+        cb.close()
+
+    def test_composed_services_over_one_endpoint(self, server):
+        from m3_tpu.cluster.client import ClusterClient
+        from m3_tpu.cluster.placement import Instance
+        from m3_tpu.cluster.services import CampaignState, ServiceInstance
+
+        clock = lambda: time.time_ns()
+        c = ClusterClient(endpoint=server.endpoint)
+        svcs = c.services(clock=clock)
+        svcs.advertise("m3dbnode", ServiceInstance("n1", "h1:9000"))
+        assert [i.instance_id for i in svcs.instances("m3dbnode")] == ["n1"]
+        leader = c.leader_service("e1", "n1", clock=clock)
+        assert leader.campaign() == CampaignState.LEADER
+        psvc = c.placement_service("m3aggregator")
+        psvc.init([Instance("a", "a:1")], num_shards=4, replica_factor=1)
+        assert set(psvc.get().instances) == {"a"}
+        # Distinct per-service placements don't collide.
+        assert c.placement_service("m3db").get() is None
+        c.close()
