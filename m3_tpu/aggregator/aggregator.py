@@ -116,6 +116,10 @@ class Aggregator:
         self._flush_times = flush_times
         self._buffer_past_ns = buffer_past_ns
         self.writes_for_unowned_shard = 0
+        # Accepted forwarded partials (tally counter analog; lets tests and
+        # operators await "all N stage-1 partials arrived" instead of racing
+        # on first-entry creation).
+        self.forwarded_received = 0
 
     # -- placement ---------------------------------------------------------
 
@@ -176,8 +180,11 @@ class Aggregator:
     def add_forwarded(self, metric_type: MetricType, metric_id: bytes,
                       t_nanos: int, value: float, meta: ForwardMetadata) -> bool:
         shard = self._shard(metric_id)
-        return shard is not None and shard.map.add_forwarded(
+        ok = shard is not None and shard.map.add_forwarded(
             metric_type, metric_id, t_nanos, value, meta)
+        if ok:
+            self.forwarded_received += 1
+        return ok
 
     # -- flush/tick --------------------------------------------------------
 

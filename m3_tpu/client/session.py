@@ -423,6 +423,36 @@ class Session:
                     )
         return merged
 
+    def aggregate(self, ns: bytes, query, start_ns: int, end_ns: int,
+                  name_only: bool = False, field_filter=(),
+                  term_limit: int = 0) -> Dict[bytes, set]:
+        """session.go Aggregate: fan out the tags-only aggregate RPC and
+        union-merge per-host field dictionaries (no datapoints cross the
+        wire). Requires at least one responsive host; results are
+        best-effort-complete like query_ids."""
+        m = self._map()
+        q = wire.query_to_wire(query)
+        merged: Dict[bytes, set] = {}
+        ok = 0
+        errs: List[str] = []
+        for h in m.hosts.values():
+            try:
+                r = self._client(h).call(
+                    "aggregate", ns=ns, query=q, start_ns=start_ns,
+                    end_ns=end_ns, name_only=name_only,
+                    field_filter=list(field_filter), term_limit=term_limit)
+            except Exception as e:  # noqa: BLE001
+                errs.append(f"{h.id}: {e}")
+                continue
+            ok += 1
+            for f in r["fields"]:
+                merged.setdefault(f["name"], set()).update(f["values"])
+        if not ok:
+            raise ConsistencyError(f"aggregate: no hosts responded: {errs}")
+        if term_limit:
+            merged = {k: set(sorted(v)[:term_limit]) for k, v in merged.items()}
+        return merged
+
     def query_ids(self, ns: bytes, query, start_ns: int, end_ns: int) -> Dict[bytes, dict]:
         """ids + tags only (thrift Query / FetchTagged fetchData=false)."""
         m = self._map()

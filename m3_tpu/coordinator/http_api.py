@@ -47,7 +47,9 @@ class HTTPApi:
             ("GET", r"/api/v1/labels", self.labels),
             ("GET", r"/api/v1/label/(?P<name>[^/]+)/values", self.label_values),
             ("GET", r"/api/v1/series", self.series),
-            ("GET", r"/api/v1/search", self.series),
+            ("GET", r"/api/v1/search", self.complete_tags),
+            ("POST", r"/api/v1/search", self.complete_tags),
+            ("GET", r"/api/v1/openapi", self.openapi),
             ("POST", r"/api/v1/json/write", self.json_write),
             ("POST", r"/api/v1/prom/remote/write", self.prom_remote_write),
             ("POST", r"/api/v1/prom/remote/read", self.prom_remote_read),
@@ -135,20 +137,75 @@ class HTTPApi:
             out.update(self.engine.storage.fetch_raw(mset, start, end))
         return out
 
+    def _complete_tags_query(self, req, matchers, name_only, filter_names):
+        """Run CompleteTags through the storage's index-backed path when it
+        has one (no datapoints shipped), degrading to a raw fetch otherwise."""
+        from ..query.storage import _store_complete_tags
+
+        start = _parse_time(req.param("start", "0"))
+        end = _parse_time(req.param("end", str(time.time())))
+        return _store_complete_tags(self.engine.storage, matchers, start, end,
+                                    name_only, filter_names)
+
     def labels(self, req) -> dict:
-        names = set()
-        for entry in self._fetch_for_match(req).values():
-            names.update(k.decode() for k in entry["tags"])
-        return {"status": "success", "data": sorted(names)}
+        matchers = ()
+        for expr in req.params_all("match[]"):
+            matchers += _parse_series_matchers(expr)
+        fields = self._complete_tags_query(req, matchers, True, ())
+        return {"status": "success",
+                "data": sorted(n.decode() for n in fields)}
 
     def label_values(self, req) -> dict:
+        """prometheus/remote/tag_values.go — CompleteTags filtered to one
+        tag name."""
         name = req.path_params["name"].encode()
-        values = set()
-        for entry in self._fetch_for_match(req).values():
-            v = dict(entry["tags"]).get(name)
-            if v is not None:
-                values.add(v.decode())
-        return {"status": "success", "data": sorted(values)}
+        # With no match[] selectors, keep matchers empty: the AllQuery +
+        # filter_names path answers straight from the index's term
+        # dictionary instead of scanning per-series registry tags.
+        matchers = ()
+        for expr in req.params_all("match[]"):
+            matchers += _parse_series_matchers(expr)
+        fields = self._complete_tags_query(req, matchers, False, (name,))
+        return {"status": "success",
+                "data": sorted(v.decode() for v in fields.get(name, ()))}
+
+    def complete_tags(self, req) -> dict:
+        """prometheus/native/complete_tags.go — GET /api/v1/search tag
+        completion: ?query=<selector>, ?result=default|tagNamesOnly,
+        ?filterNameTags=<name> (repeatable). Default response is
+        {"hits": N, "tags": [{"key", "values"}]}, names-only is a list."""
+        matchers = _parse_series_matchers(req.param("query", "")) if \
+            req.param("query", None) else ()
+        mode = req.param("result", "default")
+        if mode not in ("default", "tagNamesOnly"):
+            raise HTTPError(400, f"invalid result parameter {mode!r}")
+        name_only = mode == "tagNamesOnly"
+        filter_names = tuple(f.encode() for f in req.params_all("filterNameTags"))
+        fields = self._complete_tags_query(req, matchers, name_only, filter_names)
+        if name_only:
+            return {"status": "success",
+                    "data": sorted(n.decode() for n in fields)}
+        return {"hits": len(fields),
+                "tags": [{"key": n.decode(),
+                          "values": sorted(v.decode() for v in fields[n])}
+                         for n in sorted(fields)]}
+
+    def openapi(self, req) -> dict:
+        """api/v1/httpd OpenAPI doc route: a generated spec of the live
+        route table (the reference serves bundled swagger assets; here the
+        spec is derived from the registered routes so it can't go stale)."""
+        paths: Dict[str, dict] = {}
+        for method, pattern, fn in self.routes:
+            path = re.sub(r"\(\?P<(\w+)>[^)]*\)", r"{\1}", pattern)
+            doc = (fn.__doc__ or "").strip().splitlines()
+            entry = paths.setdefault(path, {})
+            entry[method.lower()] = {
+                "summary": doc[0] if doc else fn.__name__,
+                "operationId": fn.__name__,
+            }
+        return {"openapi": "3.0.0",
+                "info": {"title": "m3_tpu coordinator", "version": "1.0"},
+                "paths": paths}
 
     def series(self, req) -> dict:
         out = []

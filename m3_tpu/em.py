@@ -150,7 +150,7 @@ class EMCluster:
         for nid, op in self.operators.items():
             try:
                 op.teardown()
-            except (OSError, RuntimeError) as e:
+            except Exception as e:  # noqa: BLE001 - must reach every node
                 errs.append(f"{nid}: {e!r}")
         self.operators.clear()
         if errs:

@@ -44,6 +44,16 @@ class LocalStorage:
               value: float):
         self._db.write(self._namespace, series_id, t_ns, value, tags=tags)
 
+    def complete_tags(self, matchers: Sequence[Matcher], start_ns: int,
+                      end_ns: int, name_only: bool = False,
+                      filter_names: Sequence[bytes] = ()) -> Dict[bytes, set]:
+        """storage/types.go CompleteTags: tag name -> distinct values for
+        series matching the matchers, from the index — no datapoints read.
+        name_only leaves the value sets empty (CompleteNameOnly)."""
+        return self._db.aggregate_tags(
+            self._namespace, matchers_to_index_query(matchers), start_ns,
+            end_ns, name_only=name_only, filter_names=filter_names)
+
 
 class SessionStorage:
     """Adapter over the replicating client session (storage/m3/storage.go
@@ -61,6 +71,14 @@ class SessionStorage:
     def write(self, series_id: bytes, tags: Dict[bytes, bytes], t_ns: int,
               value: float):
         self._session.write_tagged(self._namespace, series_id, tags, t_ns, value)
+
+    def complete_tags(self, matchers: Sequence[Matcher], start_ns: int,
+                      end_ns: int, name_only: bool = False,
+                      filter_names: Sequence[bytes] = ()) -> Dict[bytes, set]:
+        q = matchers_to_index_query(matchers)
+        return self._session.aggregate(
+            self._namespace, q, start_ns, end_ns, name_only=name_only,
+            field_filter=filter_names)
 
 
 class FanoutStorage:
@@ -94,3 +112,35 @@ class FanoutStorage:
     def write(self, series_id: bytes, tags, t_ns: int, value: float):
         for store in self._stores:
             store.write(series_id, tags, t_ns, value)
+
+    def complete_tags(self, matchers: Sequence[Matcher], start_ns: int,
+                      end_ns: int, name_only: bool = False,
+                      filter_names: Sequence[bytes] = ()) -> Dict[bytes, set]:
+        merged: Dict[bytes, set] = {}
+        for store in self._stores:
+            part = _store_complete_tags(store, matchers, start_ns, end_ns,
+                                        name_only, filter_names)
+            for name, vals in part.items():
+                merged.setdefault(name, set()).update(vals)
+        return merged
+
+
+def _store_complete_tags(store, matchers, start_ns, end_ns, name_only,
+                         filter_names) -> Dict[bytes, set]:
+    """CompleteTags for any store: use the store's index-backed fast path
+    when present, else derive from fetched series tags (the reference's
+    remote storages similarly degrade to a series fetch)."""
+    fn = getattr(store, "complete_tags", None)
+    if fn is not None:
+        return fn(matchers, start_ns, end_ns, name_only=name_only,
+                  filter_names=filter_names)
+    ff = set(filter_names) if filter_names else None
+    out: Dict[bytes, set] = {}
+    for entry in store.fetch_raw(matchers, start_ns, end_ns).values():
+        for k, v in dict(entry["tags"]).items():
+            if ff is not None and k not in ff:
+                continue
+            vals = out.setdefault(k, set())
+            if not name_only:
+                vals.add(v)
+    return out

@@ -132,6 +132,25 @@ class NodeService:
         r = self.rpc_fetch_tagged(ns, query, start_ns, end_ns, fetch_data=False)
         return {"series": [{"id": s["id"], "tags": s["tags"]} for s in r["series"]]}
 
+    def rpc_aggregate(self, ns: bytes, query: dict, start_ns: int, end_ns: int,
+                      name_only: bool = False, field_filter: list = (),
+                      term_limit: int = 0):
+        """AggregateRaw analog (service.go:474 Aggregate / AggregateRaw):
+        distinct tag names (and optionally values) for series matching the
+        query, computed server-side from the reverse index — no datapoints
+        shipped. An AllQuery short-circuits to the index's field/term
+        dictionaries instead of materializing postings."""
+        fields = self.db.aggregate_tags(
+            ns, wire.query_from_wire(query), start_ns, end_ns,
+            name_only=name_only, filter_names=field_filter)
+        out = []
+        for name in sorted(fields):
+            vals = sorted(fields[name])
+            if term_limit:
+                vals = vals[:term_limit]
+            out.append({"name": name, "values": vals})
+        return {"fields": out, "name_only": bool(name_only)}
+
     # -------------------------------------------- block/metadata peer streaming
 
     def rpc_fetch_blocks_metadata(self, ns: bytes, shard: int, start_ns: int,

@@ -127,6 +127,41 @@ class Database:
             raise RuntimeError(f"namespace {namespace!r} has no index")
         return ns.index.query(query, start_ns, end_ns)
 
+    def aggregate_tags(self, namespace: bytes, query, start_ns: int, end_ns: int,
+                       name_only: bool = False,
+                       filter_names=()) -> "Dict[bytes, set]":
+        """database.go AggregateQuery analog: tag name -> distinct values for
+        series matching the index query, without touching datapoints. An
+        AllQuery answers straight from the index's field/term dictionaries;
+        anything else materializes matching IDs and scans registry tags.
+        Shared by the node Aggregate RPC and the coordinator's embedded
+        CompleteTags path."""
+        from ..index import query as iq
+
+        ns = self.namespace(namespace)
+        ff = set(filter_names) if filter_names else None
+        out: Dict[bytes, set] = {}
+        if isinstance(query, iq.AllQuery) and ns.index is not None:
+            for name in ns.index.fields(start_ns, end_ns):
+                if ff is not None and name not in ff:
+                    continue
+                out[name] = (set() if name_only else
+                             set(ns.index.aggregate_terms(name, start_ns, end_ns)))
+            return out
+        for sid in self.query_ids(namespace, query, start_ns, end_ns):
+            shard = ns.shards.get(self.shard_set.lookup(sid))
+            if shard is None:
+                continue
+            idx = shard.registry.get(sid)
+            tags = shard.registry.tags_of(idx) if idx is not None else None
+            for k, v in (tags or {}).items():
+                if ff is not None and k not in ff:
+                    continue
+                vals = out.setdefault(k, set())
+                if not name_only:
+                    vals.add(v)
+        return out
+
     # -------------------------------------------------------------- lifecycle
 
     def tick(self, now_ns: Optional[int] = None) -> dict:

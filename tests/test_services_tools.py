@@ -157,7 +157,10 @@ class TestAggregatorNetworkPath:
             assert aggs["agg-b"].num_entries() == 0
             clock.advance(10 * S)
             aggs["agg-a"].flush()   # stage 1 -> forwards over the wire to B
-            assert _await(lambda: aggs["agg-b"].num_entries() == 1)
+            # Await BOTH stage-1 partials (one per source elem), not just the
+            # first entry creation — flushing between the two arrivals would
+            # split the rollup across windows.
+            assert _await(lambda: aggs["agg-b"].forwarded_received == 2)
             clock.advance(10 * S)
             for agg in aggs.values():
                 agg.flush()         # stage 2 on B consumes the partials
