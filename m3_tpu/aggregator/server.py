@@ -1,8 +1,9 @@
 """Aggregator network ingestion server (reference:
 src/aggregator/server/rawtcp/server.go:122 — raw TCP connections carrying
-unaggregated metrics with their staged metadatas; the msgpack/protobuf
-migration iterator is replaced by the framed binary codec shared with the
-rest of the data plane, m3_tpu.rpc.wire).
+unaggregated metrics with their staged metadatas). Each connection reads
+through the dual-format migration reader (m3_tpu.aggregator.migration):
+the framed binary codec below is the current generation, and legacy
+JSON-line clients keep working during migration.
 
 Wire frames:
   {"t": "untimed", "mtype": i64, "id": bytes, "value": f64|i64|list,
@@ -129,11 +130,24 @@ class RawTCPServer:
 
         class _Handler(socketserver.BaseRequestHandler):
             def handle(self):
+                # Per-message dual-format reader: current framed codec and
+                # the legacy JSON-line protocol share one port during client
+                # migration (encoding/migration/unaggregated_iterator.go).
+                from .migration import MigrationReader, RecoverableRecordError
+
+                reader = MigrationReader(self.request)
                 try:
                     while True:
-                        frame = wire.read_frame(self.request)
-                        entries = (frame["entries"] if frame.get("t") == "batch"
-                                   else [frame])
+                        try:
+                            entries = reader.read_entries()
+                        except RecoverableRecordError:
+                            # one bad legacy record, stream still aligned
+                            outer.errors += 1
+                            continue
+                        except ValueError:
+                            # binary framing is unrecoverable mid-stream
+                            outer.errors += 1
+                            break
                         for e in entries:
                             outer._handle(e)
                         outer.frames += len(entries)
@@ -167,6 +181,79 @@ class RawTCPServer:
         return f"{h}:{p}"
 
     def start(self) -> "RawTCPServer":
+        threading.Thread(target=self._server.serve_forever, daemon=True).start()
+        return self
+
+    def close(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class HTTPAdminServer:
+    """Aggregator HTTP sidecar (src/aggregator/server/http/handlers.go):
+    GET /health, GET /status (runtime flush/election status), and
+    POST /resign to step down from flush leadership before maintenance."""
+
+    def __init__(self, aggregator: Aggregator, host: str = "127.0.0.1",
+                 port: int = 0):
+        import json as _json
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        agg = aggregator
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _reply(self, code: int, obj: dict):
+                body = _json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/health":
+                    self._reply(200, {"state": "OK"})
+                elif self.path == "/status":
+                    election = getattr(agg, "_election", None)
+                    flush = {
+                        "electionState": (election.state().name.lower()
+                                          if election else "leader"),
+                        "canLead": (election.is_leader()
+                                    if election else True),
+                    }
+                    self._reply(200, {"status": {
+                        "flushStatus": flush,
+                        "numEntries": agg.num_entries(),
+                        "forwardedReceived": agg.forwarded_received,
+                    }})
+                else:
+                    self._reply(404, {"error": "not found"})
+
+            def do_POST(self):
+                if self.path == "/resign":
+                    election = getattr(agg, "_election", None)
+                    if election is None:
+                        self._reply(400, {"error": "not running an election"})
+                        return
+                    try:
+                        election.resign()
+                        self._reply(200, {"state": "OK"})
+                    except Exception as e:  # noqa: BLE001
+                        self._reply(500, {"error": str(e)})
+                else:
+                    self._reply(404, {"error": "not found"})
+
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+
+    @property
+    def endpoint(self) -> str:
+        h, p = self._server.server_address
+        return f"http://{h}:{p}"
+
+    def start(self) -> "HTTPAdminServer":
         threading.Thread(target=self._server.serve_forever, daemon=True).start()
         return self
 

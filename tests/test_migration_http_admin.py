@@ -1,0 +1,182 @@
+"""Dual-format ingestion migration + aggregator HTTP admin server
+(reference test model: src/metrics/encoding/migration/
+unaggregated_iterator_test.go mixed msgpack/protobuf streams, and
+src/aggregator/server/http/handlers.go health/status/resign)."""
+
+import json
+import socket
+import time
+import urllib.error
+import urllib.request
+
+from m3_tpu.aggregator import Aggregator, CaptureHandler
+from m3_tpu.aggregator.migration import (MIGRATION_MAX_FRAME,
+                                         MigrationReader, legacy_to_entry,
+                                         write_legacy)
+from m3_tpu.aggregator.server import (HTTPAdminServer, RawTCPServer,
+                                      TCPTransport, union_to_wire)
+from m3_tpu.metrics.metadata import Metadata, PipelineMetadata, StagedMetadata
+from m3_tpu.metrics.metric import MetricType, MetricUnion
+from m3_tpu.metrics.policy import StoragePolicy
+from m3_tpu.rpc import wire
+from m3_tpu.testing.cluster import SettableClock
+
+S = 1_000_000_000
+TEN_S = StoragePolicy.of("10s", "2d")
+
+
+def _await(cond, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_legacy_to_entry_conversion():
+    entry = legacy_to_entry({"type": "counter", "id": "req.count",
+                             "value": 7, "policies": ["10s:2d", "1m:40d"]})
+    assert entry["t"] == "untimed"
+    assert entry["mtype"] == int(MetricType.COUNTER)
+    assert entry["id"] == b"req.count"
+    assert entry["value"] == 7
+    pipelines = entry["metadatas"][0]["pipelines"]
+    assert pipelines[0]["policies"] == ["10s:2d", "1m:40d"]
+    assert pipelines[0]["agg_id"] == 0 and pipelines[0]["pipeline"] == []
+
+    timer = legacy_to_entry({"type": "timer", "id": "lat",
+                             "value": [1, 2.5], "policies": ["10s:2d"]})
+    assert timer["value"] == [1.0, 2.5]
+
+    try:
+        legacy_to_entry({"type": "histogram", "id": "x", "value": 1})
+        raise AssertionError("expected ValueError")
+    except ValueError:
+        pass
+
+
+def test_mixed_format_stream_one_connection():
+    """Current binary frames and legacy JSON lines interleaved on ONE
+    connection all land in the same aggregation (the migration scenario:
+    a proxy multiplexing migrated and unmigrated clients)."""
+    clock = SettableClock(100 * S)
+    cap = CaptureHandler()
+    agg = Aggregator(num_shards=8, clock=clock, flush_handler=cap)
+    srv = RawTCPServer(agg).start()
+    try:
+        host, _, port = srv.endpoint.rpartition(":")
+        sock = socket.create_connection((host, int(port)), timeout=5)
+        md = (StagedMetadata(0, False, Metadata(
+            (PipelineMetadata(0, (TEN_S,)),))),)
+        # binary frame (current generation)
+        wire.write_frame(sock, union_to_wire(
+            MetricUnion.counter(b"mixed.count", 3), md))
+        # legacy line (old generation), same metric id -> same entry
+        write_legacy(sock, "counter", "mixed.count", 4, ["10s:2d"])
+        # binary again: the reader switches per message, not per connection
+        wire.write_frame(sock, union_to_wire(
+            MetricUnion.counter(b"mixed.count", 5), md))
+        assert _await(lambda: srv.frames >= 3)
+        assert agg.num_entries() == 1
+        clock.advance(10 * S)
+        agg.flush()
+        out = cap.by_id(b"mixed.count")
+        assert len(out) == 1 and out[0].value == 12.0
+        assert srv.errors == 0
+        sock.close()
+    finally:
+        srv.close()
+
+
+def test_legacy_only_client_gauge_and_timer():
+    clock = SettableClock(100 * S)
+    cap = CaptureHandler()
+    agg = Aggregator(num_shards=8, clock=clock, flush_handler=cap)
+    srv = RawTCPServer(agg).start()
+    try:
+        host, _, port = srv.endpoint.rpartition(":")
+        sock = socket.create_connection((host, int(port)), timeout=5)
+        write_legacy(sock, "gauge", "legacy.gauge", 42.5, ["10s:2d"])
+        write_legacy(sock, "timer", "legacy.timer", [1.0, 3.0, 2.0],
+                     ["10s:2d"])
+        assert _await(lambda: srv.frames >= 2)
+        clock.advance(10 * S)
+        agg.flush()
+        gauges = cap.by_id(b"legacy.gauge")
+        assert len(gauges) == 1 and gauges[0].value == 42.5
+        # Timer default aggregations emit suffixed ids; just check presence.
+        assert any(m.id.startswith(b"legacy.timer") for m in cap.metrics)
+    finally:
+        srv.close()
+
+
+def test_bad_legacy_record_does_not_kill_connection():
+    """A malformed legacy record is consumed and counted; later messages on
+    the same connection still ingest (the binary-framing error path, by
+    contrast, closes the stream)."""
+    clock = SettableClock(100 * S)
+    cap = CaptureHandler()
+    agg = Aggregator(num_shards=8, clock=clock, flush_handler=cap)
+    srv = RawTCPServer(agg).start()
+    try:
+        host, _, port = srv.endpoint.rpartition(":")
+        sock = socket.create_connection((host, int(port)), timeout=5)
+        write_legacy(sock, "histogram", "bad.type", 1, ["10s:2d"])  # unknown
+        write_legacy(sock, "counter", "good.count", 2, ["10s:2d"])
+        assert _await(lambda: srv.frames >= 1)
+        assert srv.errors == 1
+        clock.advance(10 * S)
+        agg.flush()
+        out = cap.by_id(b"good.count")
+        assert len(out) == 1 and out[0].value == 2.0
+    finally:
+        srv.close()
+
+
+def test_migration_reader_oversize_frame_rejected():
+    a, b = socket.socketpair()
+    try:
+        a.sendall((MIGRATION_MAX_FRAME + 1).to_bytes(4, "little") + b"x")
+        reader = MigrationReader(b)
+        try:
+            reader.read_entries()
+            raise AssertionError("expected ValueError")
+        except ValueError:
+            pass
+    finally:
+        a.close()
+        b.close()
+
+
+def test_http_admin_health_status_resign():
+    clock = SettableClock(100 * S)
+    agg = Aggregator(num_shards=4, clock=clock,
+                     flush_handler=CaptureHandler())
+    srv = HTTPAdminServer(agg).start()
+    try:
+        def get(path):
+            with urllib.request.urlopen(srv.endpoint + path) as r:
+                return json.loads(r.read())
+
+        assert get("/health") == {"state": "OK"}
+        st = get("/status")["status"]
+        # Leaderless aggregator (embedded downsampler mode) always leads.
+        assert st["flushStatus"] == {"electionState": "leader",
+                                     "canLead": True}
+        assert st["numEntries"] == 0
+        # resign without an election manager is a client error
+        req = urllib.request.Request(srv.endpoint + "/resign", data=b"",
+                                     method="POST")
+        try:
+            urllib.request.urlopen(req)
+            raise AssertionError("expected 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+        try:
+            get("/nope")
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        srv.close()
