@@ -1060,3 +1060,385 @@ def _average_outside_percentile(eng, args, params):
     with np.errstate(invalid="ignore"):
         keep = np.flatnonzero(~((means > lo) & (means < hi)))
     return _pick_rows(block, keep)
+
+
+# --------------------------------------------------- presentation + synthesis
+
+def _rename_all(block: Block, fmt) -> Block:
+    tags = [t.with_tag(b"__alias__", fmt(series_name(t)))
+            for t in block.series_tags]
+    return block.with_values(block.values, tags)
+
+
+@_register("dashed")
+def _dashed(eng, args, params):
+    """Presentation-only in this renderer: records the dash request in the
+    alias (builtin_functions.go:1786 dashed)."""
+    block = eng._eval(args[0], params)
+    length = float(args[1].value) if len(args) > 1 else 5.0
+    if length <= 0:
+        raise GraphiteParseError(f"expected a positive dashLength, got {length}")
+    return _rename_all(
+        block, lambda n: b"dashed(%s, %.3f)" % (n, length))
+
+
+@_register("identity", "timeFunction", "time")
+def _time_function(eng, args, params):
+    """Series whose value at each step is that step's unix time in seconds
+    (builtin_functions.go:184 identity, :1767 timeFunction). The optional
+    step argument is subsumed by the render grid."""
+    name = args[0].value if args else "time"
+    meta = params.meta()
+    vals = (meta.times() / S).astype(np.float64)
+    return Block(meta, [Tags.of({b"__alias__": str(name).encode()})],
+                 vals[None, :])
+
+
+@_register("randomWalkFunction", "randomWalk")
+def _random_walk(eng, args, params):
+    """Uniform noise in [-0.5, 0.5) (builtin_functions.go:1513 — despite the
+    name, the reference emits independent draws, not a cumulative walk).
+    Seeded from the series name so renders are reproducible."""
+    import zlib
+
+    name = str(args[0].value)
+    meta = params.meta()
+    rng = np.random.default_rng(zlib.crc32(name.encode()))
+    vals = rng.random(meta.steps) - 0.5
+    return Block(meta, [Tags.of({b"__alias__": name.encode()})], vals[None, :])
+
+
+@_register("fallbackSeries")
+def _fallback_series(eng, args, params):
+    """builtin_functions.go:521: the fallback target renders only when the
+    primary returns no series."""
+    block = eng._eval(args[0], params)
+    if block.n_series:
+        return block
+    return eng._eval(args[1], params)
+
+
+@_register("removeEmptySeries")
+def _remove_empty_series(eng, args, params):
+    block = eng._eval(args[0], params)
+    keep = np.flatnonzero(np.isfinite(block.values).any(axis=1)) if \
+        block.n_series else []
+    return _pick_rows(block, keep)
+
+
+@_register("mostDeviant")
+def _most_deviant(eng, args, params):
+    """Top-N series by standard deviation (builtin_functions.go:533)."""
+    block = eng._eval(args[0], params)
+    n = int(args[1].value)
+    with np.errstate(invalid="ignore"):
+        s = np.nanstd(block.values, axis=1) if block.n_series else np.zeros(0)
+    s = np.where(np.isfinite(s), s, -np.inf)
+    return _pick_rows(block, np.argsort(-s, kind="stable")[:n])
+
+
+_LEGEND_STATS = {"avg": "average", "average": "average", "total": "total",
+                 "sum": "total", "min": "min", "max": "max",
+                 "last": "current", "current": "current"}
+
+
+@_register("aggregateLine")
+def _aggregate_line(eng, args, params):
+    """Horizontal line at f(first series) (builtin_functions.go:1532)."""
+    block = eng._eval(args[0], params)
+    fname = str(args[1].value) if len(args) > 1 else "avg"
+    stat = _LEGEND_STATS.get(fname)
+    if stat is None:
+        raise GraphiteParseError(f"invalid function {fname!r}")
+    if not block.n_series:
+        raise GraphiteParseError("aggregateLine: empty series list")
+    value = float(_series_stat(block, stat)[0])
+    name = b"aggregateLine(%s,%.3f)" % (series_name(block.series_tags[0]),
+                                        value)
+    meta = params.meta()
+    return Block(meta, [Tags.of({b"__alias__": name})],
+                 np.full((1, meta.steps), value))
+
+
+@_register("legendValue")
+def _legend_value(eng, args, params):
+    """Append '(type: value)' per requested stat to each legend name
+    (builtin_functions.go:1635)."""
+    block = eng._eval(args[0], params)
+    kinds = [str(a.value) for a in args[1:]] or ["avg"]
+    stats = []
+    for k in kinds:
+        stat = _LEGEND_STATS.get(k)
+        if stat is None:
+            raise GraphiteParseError(f"invalid function {k!r}")
+        stats.append((k, _series_stat(block, stat)))
+    tags = []
+    for i, t in enumerate(block.series_tags):
+        suffix = b"".join(b" (%s: %.3f)" % (k.encode(), col[i])
+                          for k, col in stats)
+        tags.append(t.with_tag(b"__alias__", series_name(t) + suffix))
+    return block.with_values(block.values, tags)
+
+
+@_register("cactiStyle")
+def _cacti_style(eng, args, params):
+    """Column-aligned 'name Current: Max: Min:' legends
+    (builtin_functions.go:1683)."""
+    block = eng._eval(args[0], params)
+    if not block.n_series:
+        return block
+    cur = _series_stat(block, "current")
+    mx = _series_stat(block, "max")
+    mn = _series_stat(block, "min")
+
+    def fmt(v):
+        return "nan" if not math.isfinite(v) else "%.2f" % v
+
+    names = [series_name(t).decode(errors="replace")
+             for t in block.series_tags]
+    name_w = max(len(n) for n in names)
+    cur_s, max_s, min_s = ([fmt(v) for v in col] for col in (cur, mx, mn))
+    cur_w = max(len(s) for s in cur_s)
+    max_w = max(len(s) for s in max_s)
+    min_w = max(len(s) for s in min_s)
+    tags = []
+    for i, t in enumerate(block.series_tags):
+        legend = (f"{names[i]:<{name_w}} Current:{cur_s[i]:<{cur_w}} "
+                  f"Max:{max_s[i]:<{max_w}} Min:{min_s[i]:<{min_w}} ")
+        tags.append(t.with_tag(b"__alias__", legend.encode()))
+    return block.with_values(block.values, tags)
+
+
+# ----------------------------------------------------- interval reductions
+
+@_register("hitcount")
+def _hitcount(eng, args, params):
+    """Integrate each series over interval buckets: value x seconds per
+    bucket, buckets anchored at the window end (builtin_functions.go:1039).
+    The render grid is regular, so each step contributes value*step_s to
+    the bucket containing its start."""
+    from .promql import parse_duration_ns
+
+    block = eng._eval(args[0], params)
+    interval_ns = parse_duration_ns(str(args[1].value))
+    if interval_ns <= 0 or interval_ns < params.step_ns:
+        raise GraphiteParseError(
+            f"hitcount interval must be >= step, got {args[1].value!r}")
+    n_buckets = max(1, math.ceil((params.end_ns - params.start_ns) / interval_ns))
+    new_start = params.end_ns - n_buckets * interval_ns
+    # The render grid is end-inclusive; a step starting at/after end_ns lies
+    # outside every bucket and is dropped, not folded into the last one.
+    bucket_of = (block.meta.times() - new_start) // interval_ns
+    bucket_of = np.where(bucket_of >= n_buckets, -1, bucket_of.clip(0))
+    step_s = params.step_ns / S
+    out = np.zeros((block.n_series, n_buckets))
+    v = np.where(np.isfinite(block.values), block.values, 0.0) * step_s
+    for b in range(n_buckets):
+        cols = bucket_of == b
+        if cols.any():
+            out[:, b] = v[:, cols].sum(axis=1)
+    meta = QueryParams(new_start + interval_ns, params.end_ns,
+                       interval_ns).meta()
+    tags = [t.with_tag(b"__alias__", b"hitcount(%s, '%s')" % (
+        series_name(t), str(args[1].value).encode()))
+        for t in block.series_tags]
+    return Block(meta, tags, out)
+
+
+def _sustained(eng, args, params, above: bool):
+    """Keep values only once the comparison has held for >= interval
+    (builtin_functions.go:401 sustainedCompare); earlier points of each run
+    flatten to the zero line threshold -/+ |threshold|."""
+    from .promql import parse_duration_ns
+
+    block = eng._eval(args[0], params)
+    threshold = float(args[1].value)
+    min_steps = max(1, parse_duration_ns(str(args[2].value)) // params.step_ns)
+    v = block.values
+    with np.errstate(invalid="ignore"):
+        ok = (v >= threshold) if above else (v <= threshold)
+    ok &= np.isfinite(v)
+    # Per-row consecutive-True run lengths, vectorized: within each run the
+    # count ascends; a False resets the base.
+    idx = np.arange(v.shape[1])
+    run = np.zeros_like(v, dtype=np.int64)
+    for i in range(v.shape[0]):
+        base = np.maximum.accumulate(np.where(~ok[i], idx, -1))
+        run[i] = np.where(ok[i], idx - base, 0)
+    zero = threshold - abs(threshold) if above else threshold + abs(threshold)
+    out = np.where(run >= min_steps, v, zero)
+    name = b"sustainedAbove" if above else b"sustainedBelow"
+    tags = [t.with_tag(b"__alias__", b"%s(%s, %f, '%s')" % (
+        name, series_name(t), threshold, str(args[2].value).encode()))
+        for t in block.series_tags]
+    return block.with_values(out, tags)
+
+
+@_register("sustainedAbove")
+def _sustained_above(eng, args, params):
+    return _sustained(eng, args, params, True)
+
+
+@_register("sustainedBelow")
+def _sustained_below(eng, args, params):
+    return _sustained(eng, args, params, False)
+
+
+@_register("weightedAverage")
+def _weighted_average(eng, args, params):
+    """sum(value*weight)/sum(weight) over series matched by path node
+    (aggregation_functions.go:307)."""
+    values = eng._eval(args[0], params)
+    weights = eng._eval(args[1], params)
+    node = int(args[2].value)
+
+    def keyed(block):
+        out = {}
+        for i, t in enumerate(block.series_tags):
+            parts = tags_to_path(t.as_dict()).split(b".")
+            if -len(parts) <= node < len(parts):
+                out.setdefault(parts[node], i)
+        return out
+
+    vk, wk = keyed(values), keyed(weights)
+    top = np.zeros(values.meta.steps)
+    bottom = np.zeros(values.meta.steps)
+    matched = False
+    for key, vi in vk.items():
+        wi = wk.get(key)
+        if wi is None:
+            continue  # no associated weight series
+        matched = True
+        v = values.values[vi]
+        w = weights.values[wi]
+        prod = v * w
+        top += np.where(np.isfinite(prod), prod, 0.0)
+        bottom += np.where(np.isfinite(w), w, 0.0)
+    if not matched:
+        return Block(values.meta, [], np.zeros((0, values.meta.steps)))
+    with np.errstate(divide="ignore", invalid="ignore"):
+        out = np.where(bottom != 0, top / bottom, np.nan)
+    return Block(values.meta, [Tags.of({b"__alias__": b"weightedAverage"})],
+                 out[None, :])
+
+
+# --------------------------------------------------------------- holt-winters
+
+_HW_ALPHA = 0.1    # builtin_functions.go:45-47
+_HW_GAMMA = 0.1
+_HW_BETA = 0.0035
+_HW_BOOTSTRAP_NS = 7 * 24 * 3600 * S   # one week of history seeds the model
+_HW_SEASON_NS = 24 * 3600 * S          # daily seasonality
+
+
+def _holt_winters_analysis(v: np.ndarray, season_steps: int):
+    """Triple-exponential analysis, vectorized across series and sequential
+    over time (builtin_functions.go:1371 holtWintersAnalysis). Returns
+    (predictions, deviations) aligned with the input grid."""
+    n, steps = v.shape
+    intercepts = np.zeros((n, steps))
+    slopes = np.zeros((n, steps))
+    seasonals = np.zeros((n, steps))
+    predictions = np.zeros((n, steps))
+    deviations = np.zeros((n, steps))
+    next_pred = np.full(n, np.nan)
+    for i in range(steps):
+        actual = v[:, i]
+        nan = ~np.isfinite(actual)
+        last_seasonal = seasonals[:, i - season_steps] if i >= season_steps \
+            else np.zeros(n)
+        j = i + 1 - season_steps
+        next_last_seasonal = seasonals[:, j] if j >= 0 else np.zeros(n)
+        last_dev = deviations[:, i - season_steps] if i >= season_steps \
+            else np.zeros(n)
+        if i == 0:
+            last_intercept = actual.copy()
+            last_slope = np.zeros(n)
+            prediction = actual.copy()
+        else:
+            last_intercept = intercepts[:, i - 1].copy()
+            last_slope = slopes[:, i - 1]
+            last_intercept = np.where(np.isfinite(last_intercept),
+                                      last_intercept, actual)
+            prediction = next_pred
+        with np.errstate(invalid="ignore"):
+            intercept = (_HW_ALPHA * (actual - last_seasonal)
+                         + (1 - _HW_ALPHA) * (last_intercept + last_slope))
+            slope = (_HW_BETA * (intercept - last_intercept)
+                     + (1 - _HW_BETA) * last_slope)
+            seasonal = (_HW_GAMMA * (actual - intercept)
+                        + (1 - _HW_GAMMA) * last_seasonal)
+            pred_for_dev = np.where(np.isfinite(prediction), prediction, 0.0)
+            deviation = (_HW_GAMMA * np.abs(actual - pred_for_dev)
+                         + (1 - _HW_GAMMA) * last_dev)
+        intercepts[:, i] = np.where(nan, np.nan, intercept)
+        slopes[:, i] = np.where(nan, last_slope, slope)
+        seasonals[:, i] = np.where(nan, last_seasonal, seasonal)
+        predictions[:, i] = prediction
+        deviations[:, i] = np.where(nan, 0.0, deviation)
+        next_pred = np.where(nan, np.nan,
+                             intercept + slope + next_last_seasonal)
+    return predictions, deviations
+
+
+def _hw_forecast_parts(eng, arg, params):
+    """Fetch with one week of bootstrap history, run the analysis, trim the
+    bootstrap prefix back off (builtin_functions.go:1224 + trimBootstrap)."""
+    boot_steps = _HW_BOOTSTRAP_NS // params.step_ns
+    season_steps = max(1, int(_HW_SEASON_NS // params.step_ns))
+    ext = QueryParams(params.start_ns - boot_steps * params.step_ns,
+                      params.end_ns, params.step_ns)
+    block = eng._eval(arg, ext)
+    pred, dev = _holt_winters_analysis(block.values, season_steps)
+    keep = params.meta().steps
+    return block, pred[:, -keep:], dev[:, -keep:]
+
+
+@_register("holtWintersForecast")
+def _holt_winters_forecast(eng, args, params):
+    block, pred, _ = _hw_forecast_parts(eng, args[0], params)
+    tags = [t.with_tag(b"__alias__",
+                       b"holtWintersForecast(" + series_name(t) + b")")
+            for t in block.series_tags]
+    return Block(params.meta(), tags, pred)
+
+
+@_register("holtWintersConfidenceBands")
+def _holt_winters_confidence_bands(eng, args, params):
+    delta = float(args[1].value) if len(args) > 1 else 3.0
+    block, pred, dev = _hw_forecast_parts(eng, args[0], params)
+    scaled = delta * dev
+    lower, upper = pred - scaled, pred + scaled
+    tags, rows = [], []
+    for i, t in enumerate(block.series_tags):
+        name = series_name(t)
+        tags.append(t.with_tag(b"__alias__",
+                               b"holtWintersConfidenceLower(" + name + b")"))
+        rows.append(lower[i])
+        tags.append(t.with_tag(b"__alias__",
+                               b"holtWintersConfidenceUpper(" + name + b")"))
+        rows.append(upper[i])
+    vals = np.stack(rows) if rows else np.zeros((0, params.meta().steps))
+    return Block(params.meta(), tags, vals)
+
+
+@_register("holtWintersAberration")
+def _holt_winters_aberration(eng, args, params):
+    """Deviation of the actual outside the confidence bands; 0 inside
+    (builtin_functions.go:1298)."""
+    delta = float(args[1].value) if len(args) > 1 else 3.0
+    block, pred, dev = _hw_forecast_parts(eng, args[0], params)
+    keep = params.meta().steps
+    actual = block.values[:, -keep:]
+    scaled = delta * dev
+    lower, upper = pred - scaled, pred + scaled
+    with np.errstate(invalid="ignore"):
+        out = np.where(np.isfinite(actual) & np.isfinite(upper)
+                       & (actual > upper), actual - upper, 0.0)
+        out = np.where(np.isfinite(actual) & np.isfinite(lower)
+                       & (actual < lower), actual - lower, out)
+        out = np.where(np.isfinite(actual), out, 0.0)
+    tags = [t.with_tag(b"__alias__",
+                       b"holtWintersAberration(" + series_name(t) + b")")
+            for t in block.series_tags]
+    return Block(params.meta(), tags, out)

@@ -304,3 +304,151 @@ class TestExtendedBuiltins:
         np.testing.assert_allclose(d[0, 2:], v0[0, :-2], equal_nan=True)
         ch = self.render(env, "changed(apps.api.req)").values
         assert (ch[0, 1:][np.isfinite(v0[0, 1:])] == 1.0).all()
+
+
+class TestRound4Builtins:
+    """This round's additions: presentation/synthesis functions, interval
+    reductions, and the Holt-Winters family (builtin_functions.go parity)."""
+
+    @pytest.fixture
+    def env(self, genv):
+        c, db, now = genv
+        ingest_paths(c, now, [(b"apps.api.req", 10.0),
+                              (b"apps.api.err", 1.0),
+                              (b"apps.db.req", 100.0)])
+        return GraphiteEngine(c.engine.storage), T0 + 30 * S, T0 + 110 * S
+
+    def render(self, env, target):
+        eng, start, end = env
+        return eng.render(target, start, end, 10 * S)
+
+    def test_time_identity_random(self, env):
+        eng, start, end = env
+        blk = self.render(env, 'timeFunction("t")')
+        np.testing.assert_allclose(blk.values[0], blk.meta.times() / S)
+        assert series_name(blk.series_tags[0]) == b"t"
+        blk2 = self.render(env, 'identity("x")')
+        np.testing.assert_allclose(blk2.values, blk.values)
+        r1 = self.render(env, 'randomWalkFunction("r")')
+        r2 = self.render(env, 'randomWalk("r")')
+        np.testing.assert_allclose(r1.values, r2.values)  # name-seeded
+        assert (np.abs(r1.values) <= 0.5).all()
+
+    def test_dashed_and_legend_value(self, env):
+        blk = self.render(env, "dashed(apps.api.req)")
+        assert series_name(blk.series_tags[0]) == \
+            b"dashed(apps.api.req, 5.000)"
+        v0 = self.render(env, "apps.api.req").values
+        blk = self.render(env, 'legendValue(apps.api.req, "max")')
+        expected = b"apps.api.req (max: %.3f)" % np.nanmax(v0)
+        assert series_name(blk.series_tags[0]) == expected
+
+    def test_cacti_style(self, env):
+        blk = self.render(env, "cactiStyle(apps.*.req)")
+        names = sorted(series_name(t) for t in blk.series_tags)
+        assert all(b"Current:" in n and b"Max:" in n and b"Min:" in n
+                   for n in names)
+        # column alignment: equal lengths
+        assert len({len(n) for n in names}) == 1
+
+    def test_fallback_and_remove_empty(self, env):
+        blk = self.render(env, "fallbackSeries(apps.nothing.req, apps.db.req)")
+        assert blk.n_series == 1
+        assert series_name(blk.series_tags[0]) == b"apps.db.req"
+        blk = self.render(env, "fallbackSeries(apps.db.req, apps.api.req)")
+        assert series_name(blk.series_tags[0]) == b"apps.db.req"
+        eng, start, end = env
+        # beyond the ingested window every series is empty
+        blk = eng.render("removeEmptySeries(apps.*.req)", end + 3600 * S,
+                         end + 3700 * S, 10 * S)
+        assert blk.n_series == 0
+        blk = self.render(env, "removeEmptySeries(apps.*.req)")
+        assert blk.n_series == 2
+
+    def test_most_deviant(self, env):
+        blk = self.render(env, "mostDeviant(apps.*.*, 1)")
+        # all series ramp identically (+1/step) except err starts lower —
+        # equal stddev; stable sort keeps first. Add a flat line to compare.
+        assert blk.n_series == 1
+        blk = self.render(env, "mostDeviant(group(apps.api.req, constantLine(5)), 1)")
+        assert series_name(blk.series_tags[0]) == b"apps.api.req"
+
+    def test_aggregate_line(self, env):
+        v0 = self.render(env, "apps.api.req").values
+        blk = self.render(env, 'aggregateLine(apps.api.req, "max")')
+        np.testing.assert_allclose(blk.values[0], np.nanmax(v0))
+        assert series_name(blk.series_tags[0]).startswith(b"aggregateLine(")
+
+    def test_hitcount(self, env):
+        eng, start, end = env
+        blk = eng.render('hitcount(apps.api.req, "30s")', start, start + 90 * S,
+                         10 * S)
+        assert blk.meta.step_ns == 30 * S
+        # every step contributes value*10s into the bucket containing its
+        # start; the end-inclusive grid point at t=end starts outside all
+        # buckets and is dropped
+        plain = eng.render("apps.api.req", start, start + 90 * S, 10 * S)
+        total_hits = np.nansum(plain.values[:, :-1]) * 10
+        np.testing.assert_allclose(np.nansum(blk.values), total_hits)
+        first_bucket = np.nansum(plain.values[:, :3]) * 10
+        np.testing.assert_allclose(blk.values[0, 0], first_bucket)
+
+    def test_sustained_above_below(self, env):
+        eng, start, end = env
+        # req ramps 13..21 over the window; threshold 15 holds from the 3rd
+        # point on. With a 30s interval (3 steps) the first 2 qualifying
+        # points flatten to the zero line.
+        blk = eng.render('sustainedAbove(apps.api.req, 15, "30s")',
+                         start, start + 80 * S, 10 * S)
+        v = blk.values[0]
+        plain = eng.render("apps.api.req", start, start + 80 * S, 10 * S).values[0]
+        qualified = plain >= 15
+        run = 0
+        for i in range(v.size):
+            run = run + 1 if qualified[i] else 0
+            if run >= 3:
+                assert v[i] == plain[i]
+            else:
+                assert v[i] == 0.0  # 15 - |15|
+        blk = eng.render('sustainedBelow(apps.api.req, 14, "20s")',
+                         start, start + 80 * S, 10 * S)
+        # run starts at point 0 (13<=14) but only sustains 20s at point 1
+        assert (blk.values[0][:2] == [28.0, 14.0]).all()
+        assert (blk.values[0][2:] == 28.0).all()
+
+    def test_weighted_average(self, env):
+        # weight req by err per app node 1: only 'api' has both
+        blk = self.render(env,
+                          "weightedAverage(apps.*.req, apps.*.err, 1)")
+        assert blk.n_series == 1
+        req = self.render(env, "apps.api.req").values[0]
+        err = self.render(env, "apps.api.err").values[0]
+        with np.errstate(invalid="ignore"):
+            expected = np.where(err != 0, req * err / err, np.nan)
+        np.testing.assert_allclose(blk.values[0], expected, equal_nan=True)
+
+    def test_holt_winters_family(self, env):
+        eng, start, end = env
+        fc = eng.render("holtWintersForecast(apps.api.req)", start, end, 10 * S)
+        assert fc.n_series == 1
+        assert series_name(fc.series_tags[0]) == \
+            b"holtWintersForecast(apps.api.req)"
+        assert fc.values.shape == (1, fc.meta.steps)
+        bands = eng.render("holtWintersConfidenceBands(apps.api.req, 3)",
+                           start, end, 10 * S)
+        assert bands.n_series == 2
+        lower, upper = bands.values
+        finite = np.isfinite(lower) & np.isfinite(upper)
+        assert (upper[finite] >= lower[finite]).all()
+        ab = eng.render("holtWintersAberration(apps.api.req, 3)",
+                        start, end, 10 * S)
+        assert ab.n_series == 1
+        assert np.isfinite(ab.values).all()
+        # aberration == excursion outside the bands, 0 inside/NaN
+        plain = eng.render("apps.api.req", start, end, 10 * S).values[0]
+        expected = np.zeros_like(plain)
+        over = np.isfinite(plain) & np.isfinite(upper) & (plain > upper)
+        under = np.isfinite(plain) & np.isfinite(lower) & (plain < lower)
+        expected[over] = (plain - upper)[over]
+        expected[under] = (plain - lower)[under]
+        np.testing.assert_allclose(ab.values[0], expected)
