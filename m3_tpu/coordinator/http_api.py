@@ -137,35 +137,40 @@ class HTTPApi:
             out.update(self.engine.storage.fetch_raw(mset, start, end))
         return out
 
-    def _complete_tags_query(self, req, matchers, name_only, filter_names):
+    def _complete_tags_query(self, req, matcher_sets, name_only, filter_names):
         """Run CompleteTags through the storage's index-backed path when it
-        has one (no datapoints shipped), degrading to a raw fetch otherwise."""
+        has one (no datapoints shipped), degrading to a raw fetch otherwise.
+        Repeated match[] selectors are separate queries whose results union
+        (the Prometheus API contract), so each set runs independently."""
         from ..query.storage import _store_complete_tags
 
         start = _parse_time(req.param("start", "0"))
         end = _parse_time(req.param("end", str(time.time())))
-        return _store_complete_tags(self.engine.storage, matchers, start, end,
-                                    name_only, filter_names)
+        merged: Dict[bytes, set] = {}
+        for matchers in matcher_sets or [()]:
+            part = _store_complete_tags(self.engine.storage, matchers, start,
+                                        end, name_only, filter_names)
+            for n, vals in part.items():
+                merged.setdefault(n, set()).update(vals)
+        return merged
+
+    def _match_sets(self, req):
+        """One matcher tuple per match[] param (empty list = match all)."""
+        return [_parse_series_matchers(expr)
+                for expr in req.params_all("match[]")]
 
     def labels(self, req) -> dict:
-        matchers = ()
-        for expr in req.params_all("match[]"):
-            matchers += _parse_series_matchers(expr)
-        fields = self._complete_tags_query(req, matchers, True, ())
+        fields = self._complete_tags_query(req, self._match_sets(req), True, ())
         return {"status": "success",
                 "data": sorted(n.decode() for n in fields)}
 
     def label_values(self, req) -> dict:
         """prometheus/remote/tag_values.go — CompleteTags filtered to one
-        tag name."""
+        tag name. With no match[] selectors the AllQuery + filter_names path
+        answers straight from the index's term dictionary."""
         name = req.path_params["name"].encode()
-        # With no match[] selectors, keep matchers empty: the AllQuery +
-        # filter_names path answers straight from the index's term
-        # dictionary instead of scanning per-series registry tags.
-        matchers = ()
-        for expr in req.params_all("match[]"):
-            matchers += _parse_series_matchers(expr)
-        fields = self._complete_tags_query(req, matchers, False, (name,))
+        fields = self._complete_tags_query(req, self._match_sets(req), False,
+                                           (name,))
         return {"status": "success",
                 "data": sorted(v.decode() for v in fields.get(name, ()))}
 
@@ -181,7 +186,8 @@ class HTTPApi:
             raise HTTPError(400, f"invalid result parameter {mode!r}")
         name_only = mode == "tagNamesOnly"
         filter_names = tuple(f.encode() for f in req.params_all("filterNameTags"))
-        fields = self._complete_tags_query(req, matchers, name_only, filter_names)
+        fields = self._complete_tags_query(req, [matchers], name_only,
+                                           filter_names)
         if name_only:
             return {"status": "success",
                     "data": sorted(n.decode() for n in fields)}

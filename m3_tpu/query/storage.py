@@ -134,13 +134,10 @@ def _store_complete_tags(store, matchers, start_ns, end_ns, name_only,
     if fn is not None:
         return fn(matchers, start_ns, end_ns, name_only=name_only,
                   filter_names=filter_names)
+    from ..storage.database import fold_tags
+
     ff = set(filter_names) if filter_names else None
     out: Dict[bytes, set] = {}
     for entry in store.fetch_raw(matchers, start_ns, end_ns).values():
-        for k, v in dict(entry["tags"]).items():
-            if ff is not None and k not in ff:
-                continue
-            vals = out.setdefault(k, set())
-            if not name_only:
-                vals.add(v)
+        fold_tags(out, dict(entry["tags"]), ff, name_only)
     return out

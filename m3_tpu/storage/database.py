@@ -17,6 +17,18 @@ import numpy as np
 from .namespace import Namespace, NamespaceOptions
 
 
+def fold_tags(out: Dict[bytes, set], tags, filter_set, name_only: bool):
+    """Fold one series' tags into a CompleteTags accumulator — the single
+    definition of filter/name-only semantics shared by the index-backed
+    aggregate path and the fetch-derived fallback in query.storage."""
+    for k, v in (tags or {}).items():
+        if filter_set is not None and k not in filter_set:
+            continue
+        vals = out.setdefault(k, set())
+        if not name_only:
+            vals.add(v)
+
+
 class Database:
     def __init__(self, shard_set, commitlog=None, clock: Callable[[], int] = None,
                  retriever=None):
@@ -154,12 +166,7 @@ class Database:
                 continue
             idx = shard.registry.get(sid)
             tags = shard.registry.tags_of(idx) if idx is not None else None
-            for k, v in (tags or {}).items():
-                if ff is not None and k not in ff:
-                    continue
-                vals = out.setdefault(k, set())
-                if not name_only:
-                    vals.add(v)
+            fold_tags(out, tags, ff, name_only)
         return out
 
     # -------------------------------------------------------------- lifecycle

@@ -142,6 +142,12 @@ def test_http_labels_and_label_values_via_index(api):
                                   "match[]": ['{dc="west"}']},
                                  path_params={"name": "host"}))
     assert out["data"] == ["db01"]
+    # Repeated match[] selectors UNION (Prometheus API contract), they are
+    # not ANDed into one impossible conjunction.
+    out = api_.label_values(_req({"end": _end(now),
+                                  "match[]": ['{dc="west"}', '{dc="east"}']},
+                                 path_params={"name": "host"}))
+    assert out["data"] == ["db01", "web01", "web02"]
 
 
 def test_openapi_reflects_routes(api):
