@@ -21,11 +21,22 @@ def pair(hi, lo):
 
 
 def from_u64_np(x):
-    """Host helper: split numpy uint64/int64 array into (hi, lo) u32 arrays."""
+    """Host helper: split numpy uint64/int64 array into (hi, lo) u32 arrays.
+
+    Uses a zero-copy u32-pair view of the 64-bit buffer instead of
+    shift/mask arithmetic (4 full passes -> 2 strided copies; this runs
+    over every datapoint of every sealed block on the ingest path)."""
     import numpy as np
 
-    x = np.asarray(x).astype(np.uint64, copy=False) if np.asarray(x).dtype.kind in "iu" else np.asarray(x).view(np.uint64)
-    return (x >> np.uint64(32)).astype(np.uint32), (x & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    x = np.ascontiguousarray(x)
+    if x.dtype.kind not in "iu" or x.dtype.itemsize != 8:
+        x = x.view(np.uint64)
+    import sys
+
+    pairs = x.view(np.uint32).reshape(*x.shape, 2)
+    if sys.byteorder == "big":  # pragma: no cover - LE everywhere
+        return np.ascontiguousarray(pairs[..., 0]), np.ascontiguousarray(pairs[..., 1])
+    return np.ascontiguousarray(pairs[..., 1]), np.ascontiguousarray(pairs[..., 0])
 
 
 def to_u64_np(hi, lo):

@@ -23,7 +23,11 @@ start - (W-1)*s + j*s, so output step t reads columns [t, t+W).
 
 from __future__ import annotations
 
+import collections
 import functools
+import hashlib
+import os
+import threading
 from typing import Dict, Optional, Tuple
 
 import jax
@@ -31,6 +35,45 @@ import jax.numpy as jnp
 import numpy as np
 
 _F32 = jnp.float32
+
+# ------------------------------------------------------------ upload cache
+#
+# Device-put results keyed by content hash. Remote TPU links are
+# latency/bandwidth bound (~3ms RTT, ~80MB/s observed through the tunnel),
+# so re-uploading the same gridded selector for every query in a burst —
+# rate() and sum_over_time() over one hot block window, dashboards
+# refreshing the same range — dominates the query. Hashing 4.4MB costs ~2ms
+# against a ~60ms upload. Keyed by digest+shape+dtype, so a mutated grid
+# re-uploads (correctness does not depend on object identity).
+
+_PUT_CACHE: "collections.OrderedDict[tuple, jax.Array]" = collections.OrderedDict()
+_PUT_CACHE_LOCK = threading.Lock()
+# Evict by device bytes, not entry count: one [100k, 500] f32 grid is
+# ~200MB of HBM, so a count cap could pin multiple GB and starve kernels.
+_PUT_CACHE_MAX_BYTES = int(os.environ.get(
+    "M3_TPU_UPLOAD_CACHE_BYTES", str(512 * 1024 * 1024)))
+_put_cache_bytes = 0
+
+
+def _cached_put(arr: np.ndarray) -> jax.Array:
+    global _put_cache_bytes
+    arr = np.ascontiguousarray(arr)
+    key = (hashlib.blake2b(arr, digest_size=16).digest(),
+           arr.shape, arr.dtype.str)
+    with _PUT_CACHE_LOCK:
+        hit = _PUT_CACHE.get(key)
+        if hit is not None:
+            _PUT_CACHE.move_to_end(key)
+            return hit
+    dev = jax.device_put(arr)
+    with _PUT_CACHE_LOCK:
+        if key not in _PUT_CACHE:
+            _PUT_CACHE[key] = dev
+            _put_cache_bytes += arr.nbytes
+        while _put_cache_bytes > _PUT_CACHE_MAX_BYTES and len(_PUT_CACHE) > 1:
+            _, old = _PUT_CACHE.popitem(last=False)
+            _put_cache_bytes -= old.nbytes
+    return dev
 
 
 def extend_window_cells(range_ns: int, step_ns: int) -> int:
@@ -74,22 +117,95 @@ def _take_w(vol, idx):
         vol, jnp.clip(idx, 0, vol.shape[-1] - 1)[..., None], axis=-1)[..., 0]
 
 
-@functools.lru_cache(maxsize=256)
-def _window_sum_fn(W: int):
-    """Device pass: per-window validity structure + masked sum of the
-    adjusted-diff grid. The O(S*T*W) work lives here; extrapolation finishes
-    on the host in f64, O(S*T) elementwise."""
+# Sliding-window primitives in O(S*T) — cumulative-sum differences for the
+# additive moments, lax.reduce_window for order statistics. The naive
+# [S, T_out, W] gather volume costs O(S*T*W) HBM traffic and lowers to a
+# slow XLA gather on TPU; these forms keep the MXU-adjacent VPU busy
+# instead (~200ms -> ~0ms at 10k series x 139 cells x W=30 on a v5e).
 
-    def fn(adj, finite):
-        mvol = _window_volume(finite, W)
-        first_i, last_i, cnt = _first_last(mvol)
-        avol = _window_volume(adj, W)
+
+def _wsum(x, W: int):
+    """Windowed sum over the last axis, windows ending at cells W-1..T-1.
+
+    reduce_window, NOT a cumsum difference: a global f32 cumsum over a
+    high-total grid (bytes counters reach ~1e13, ulp ~2e6) cancels
+    catastrophically when a quiet window subtracts two huge prefixes.
+    reduce_window accumulates only the W cells of each window, so error
+    stays at W ulps of the window's own sum."""
+    return jax.lax.reduce_window(
+        x.astype(_F32), 0.0, jax.lax.add, (1, W), (1, 1), "valid")
+
+
+def _first_abs(finite, W: int):
+    """Absolute index of each window's first valid cell (T when empty)."""
+    T = finite.shape[-1]
+    idxv = jnp.where(finite, jnp.arange(T, dtype=jnp.int32), T)
+    return jax.lax.reduce_window(idxv, T, jax.lax.min, (1, W), (1, 1), "valid")
+
+
+def _last_abs(finite, W: int):
+    """Absolute index of each window's last valid cell (-1 when empty)."""
+    T = finite.shape[-1]
+    idxv = jnp.where(finite, jnp.arange(T, dtype=jnp.int32), -1)
+    return jax.lax.reduce_window(idxv, -1, jax.lax.max, (1, W), (1, 1), "valid")
+
+
+def _take_t(grid, abs_idx):
+    """Gather [S, T_out] values from [S, T] by absolute time index."""
+    return jnp.take_along_axis(
+        grid, jnp.clip(abs_idx, 0, grid.shape[-1] - 1), axis=-1)
+
+
+@functools.lru_cache(maxsize=256)
+def _rate_fn(W: int, step_s: float, range_s: float, is_counter: bool,
+             is_rate: bool):
+    """Fused rate/increase/delta: window structure + promql's
+    extrapolatedRate finish, all on device, ONE f32 result transfer. The
+    f64-sensitive part (consecutive-diff adjustment) arrives pre-computed
+    from the host in residual space, so f32 here is exact for the
+    increase; the extrapolation scaling is a ~1.0x ratio where f32 noise
+    is far below the oracle tolerance. abs_first (counter zero-clamp) is
+    gathered from the f32 ABSOLUTE grid — never residual+baseline, which
+    cancels catastrophically after a counter reset; direct f32 is exact
+    for small post-reset values and ~1e-7 relative for large ones, where
+    dur_zero is far from binding."""
+
+    def fn(adj, finite, grid32):
+        T = finite.shape[-1]
+        t_off = jnp.arange(T - W + 1, dtype=jnp.int32)[None, :]
+        cnt = _wsum(finite, W)
+        fa = _first_abs(finite, W)
+        la = _last_abs(finite, W)
         # Only cells strictly after the window's first valid sample
-        # contribute — their previous-valid reference is inside the window.
-        valid_pair = mvol & (jnp.arange(W) > first_i[..., None])
-        adj_sum = jnp.where(valid_pair, avol, 0.0).sum(-1)
-        return {"first_i": first_i, "last_i": last_i, "cnt": cnt,
-                "adj_sum": adj_sum}
+        # contribute — their previous-valid reference is inside the window,
+        # so the window increase is the full adj sum minus the first valid
+        # cell's adj (whose reference precedes the window).
+        increase = _wsum(adj, W) - _take_t(adj, fa)
+        ok = cnt >= 2
+        fcnt = cnt
+        fi = (fa - t_off).astype(_F32)
+        li = (la - t_off).astype(_F32)
+        dur_start = (fi + 1) * step_s
+        dur_end = (W - 1 - li) * step_s
+        sampled = (li - fi) * step_s
+        avg_dur = sampled / jnp.maximum(fcnt - 1, 1)
+        threshold = avg_dur * 1.1
+        if is_counter:
+            abs_first = _take_t(grid32, fa)
+            dur_zero = jnp.where(
+                (increase > 0) & (abs_first >= 0),
+                sampled * (abs_first / jnp.where(increase > 0, increase, 1.0)),
+                jnp.inf)
+            dur_start = jnp.minimum(dur_start, dur_zero)
+        extrap = (
+            sampled
+            + jnp.where(dur_start < threshold, dur_start, avg_dur / 2)
+            + jnp.where(dur_end < threshold, dur_end, avg_dur / 2)
+        )
+        out = increase * (extrap / jnp.where(sampled > 0, sampled, 1.0))
+        if is_rate:
+            out = out / range_s
+        return jnp.where(ok & (sampled > 0), out, jnp.nan)
 
     return jax.jit(fn)
 
@@ -118,43 +234,15 @@ def _host_diff_grid(grid: np.ndarray, is_counter: bool):
 
 def _extrapolated(grid: np.ndarray, W: int, step_ns: int, range_ns: int,
                   is_counter: bool, is_rate: bool) -> np.ndarray:
-    """promql extrapolatedRate finishing pass (f64, host) over the device
-    window components."""
+    """Host side of rate/increase/delta: the f64 diff pass feeds the fused
+    device kernel; one f32 result comes back."""
     adj, finite = _host_diff_grid(grid, is_counter)
-    c = {k: np.asarray(v)
-         for k, v in _window_sum_fn(W)(adj, finite).items()}
-    step_s = step_ns / 1e9
-    cnt = c["cnt"].astype(np.float64)
-    first_i = c["first_i"].astype(np.float64)
-    last_i = c["last_i"].astype(np.float64)
-    ok = c["cnt"] >= 2
-    increase = c["adj_sum"].astype(np.float64)
-    dur_start = (first_i + 1) * step_s
-    dur_end = (W - 1 - last_i) * step_s
-    sampled = (last_i - first_i) * step_s
-    with np.errstate(divide="ignore", invalid="ignore"):
-        avg_dur = sampled / np.maximum(cnt - 1, 1)
-        threshold = avg_dur * 1.1
-        if is_counter:
-            # Absolute first value gathered from the f64 grid by index.
-            S, T_out = c["first_i"].shape
-            rows = np.arange(S)[:, None]
-            cols = np.arange(T_out)[None, :] + np.clip(c["first_i"], 0, W - 1)
-            abs_first = grid[rows, np.clip(cols, 0, grid.shape[1] - 1)]
-            dur_zero = np.where(
-                (increase > 0) & (abs_first >= 0),
-                sampled * (abs_first / np.where(increase > 0, increase, 1.0)),
-                np.inf)
-            dur_start = np.minimum(dur_start, dur_zero)
-        extrap = (
-            sampled
-            + np.where(dur_start < threshold, dur_start, avg_dur / 2)
-            + np.where(dur_end < threshold, dur_end, avg_dur / 2)
-        )
-        out = increase * (extrap / np.where(sampled > 0, sampled, 1.0))
-        if is_rate:
-            out = out / (range_ns / 1e9)
-    return np.where(ok & (sampled > 0), out, np.nan)
+    fn = _rate_fn(W, step_ns / 1e9, range_ns / 1e9, is_counter, is_rate)
+    # NaNs become 0 in the f32 grid copy (validity rides `finite`); the
+    # gather target must be NaN-free so inf*0 artifacts can't appear.
+    grid32 = np.where(finite, grid, 0.0).astype(np.float32)
+    out = fn(_cached_put(adj), _cached_put(finite), _cached_put(grid32))
+    return np.asarray(out).astype(np.float64)
 
 
 def _ffill(vol, mask):
@@ -192,7 +280,7 @@ def _last_two_idx_fn(W: int):
         last_i = jnp.where(mvol, Wr, -1).max(axis=-1)
         prev_mask = mvol & (Wr < last_i[..., None])
         prev_i = jnp.where(prev_mask, Wr, -1).max(axis=-1)
-        return last_i, prev_i
+        return jnp.stack([last_i, prev_i])
 
     return jax.jit(fn)
 
@@ -202,7 +290,8 @@ def _instant(grid: np.ndarray, W: int, step_ns: int, is_rate: bool) -> np.ndarra
     samples; a counter reset (v_last < v_prev) rates from zero. Values are
     gathered from the f64 grid by device-computed indices."""
     finite = np.isfinite(grid)
-    last_i, prev_i = (np.asarray(a) for a in _last_two_idx_fn(W)(finite))
+    packed = np.asarray(_last_two_idx_fn(W)(_cached_put(finite)))
+    last_i, prev_i = packed[0], packed[1]
     ok = prev_i >= 0
     S, T_out = last_i.shape
     rows = np.arange(S)[:, None]
@@ -227,26 +316,50 @@ def idelta(grid: np.ndarray, W: int, step_ns: int) -> np.ndarray:
     return _instant(grid, W, step_ns, False)
 
 
+_OVER_TIME_STATS = {
+    # kind -> which masked window moment the device returns
+    "count": "count", "present": "count", "sum": "sum", "avg": "sum",
+    "min": "min", "max": "max", "last": "last",
+    "stdvar": "m2", "stddev": "m2",
+}
+
+
 @functools.lru_cache(maxsize=256)
-def _over_time_fn(W: int):
-    """Masked window moments for *_over_time (temporal/aggregation.go)."""
+def _over_time_fn(W: int, stat: str):
+    """One masked window moment for *_over_time (temporal/aggregation.go),
+    packed [stat, count] so a single transfer carries everything the f64
+    host correction needs (computing all seven moments and shipping each
+    separately multiplied the result transfer 7x)."""
 
     def fn(resid):
-        vol = _window_volume(resid, W)
-        mask = jnp.isfinite(vol)
-        z = jnp.where(mask, vol, 0.0)
-        cnt = mask.sum(axis=-1).astype(_F32)
-        s = z.sum(axis=-1)
-        mu = s / jnp.maximum(cnt, 1)
-        dev = jnp.where(mask, vol - mu[..., None], 0.0)
-        m2 = (dev * dev).sum(axis=-1)
-        mn = jnp.where(mask, vol, jnp.inf).min(axis=-1)
-        mx = jnp.where(mask, vol, -jnp.inf).max(axis=-1)
-        first_i, last_i, _ = _first_last(mask)
-        return {
-            "count": cnt, "sum": s, "min": mn, "max": mx, "m2": m2,
-            "last": _take_w(vol, last_i), "first": _take_w(vol, first_i),
-        }
+        mask = jnp.isfinite(resid)
+        cnt = _wsum(mask, W)
+        if stat == "count":
+            out = cnt
+        elif stat == "sum":
+            out = _wsum(jnp.where(mask, resid, 0.0), W)
+        elif stat == "min":
+            out = jax.lax.reduce_window(
+                jnp.where(mask, resid, jnp.inf), jnp.inf, jax.lax.min,
+                (1, W), (1, 1), "valid")
+        elif stat == "max":
+            out = jax.lax.reduce_window(
+                jnp.where(mask, resid, -jnp.inf), -jnp.inf, jax.lax.max,
+                (1, W), (1, 1), "valid")
+        elif stat == "last":
+            out = _take_t(jnp.where(mask, resid, 0.0), _last_abs(mask, W))
+        elif stat == "m2":
+            # Two-pass over the window volume: the cumsum sumsq-minus-mean
+            # form cancels catastrophically in f32 when |mu| >> sigma.
+            vol = _window_volume(resid, W)
+            vmask = jnp.isfinite(vol)
+            s = jnp.where(vmask, vol, 0.0).sum(axis=-1)
+            mu = s / jnp.maximum(cnt, 1)
+            dev = jnp.where(vmask, vol - mu[..., None], 0.0)
+            out = (dev * dev).sum(axis=-1)
+        else:
+            raise ValueError(f"unknown over_time stat {stat!r}")
+        return jnp.stack([out, cnt])
 
     return jax.jit(fn)
 
@@ -255,9 +368,12 @@ def over_time(grid: np.ndarray, W: int, kind: str) -> np.ndarray:
     """sum|avg|min|max|count|last|stddev|stdvar|present_over_time.
 
     Host corrects absolute-valued outputs back into f64 value space."""
+    stat_name = _OVER_TIME_STATS.get(kind)
+    if stat_name is None:
+        raise ValueError(f"unknown over_time kind {kind!r}")
     resid, base = center(grid)
-    stats = {k: np.asarray(v) for k, v in _over_time_fn(W)(resid).items()}
-    cnt = stats["count"]
+    packed = np.asarray(_over_time_fn(W, stat_name)(_cached_put(resid)))
+    stat, cnt = packed[0].astype(np.float64), packed[1].astype(np.float64)
     ok = cnt > 0
     b = base[:, None]
     if kind == "count":
@@ -265,20 +381,15 @@ def over_time(grid: np.ndarray, W: int, kind: str) -> np.ndarray:
     if kind == "present":
         return np.where(ok, 1.0, np.nan)
     if kind == "sum":
-        return np.where(ok, stats["sum"] + cnt * b, np.nan)
+        return np.where(ok, stat + cnt * b, np.nan)
     if kind == "avg":
-        return np.where(ok, stats["sum"] / np.maximum(cnt, 1) + b, np.nan)
-    if kind == "min":
-        return np.where(ok, stats["min"] + b, np.nan)
-    if kind == "max":
-        return np.where(ok, stats["max"] + b, np.nan)
-    if kind == "last":
-        return np.where(ok, stats["last"] + b, np.nan)
+        return np.where(ok, stat / np.maximum(cnt, 1) + b, np.nan)
+    if kind in ("min", "max", "last"):
+        return np.where(ok, stat + b, np.nan)
     if kind == "stdvar":  # population variance (promql stdvar_over_time)
-        return np.where(ok, stats["m2"] / np.maximum(cnt, 1), np.nan)
-    if kind == "stddev":
-        return np.where(ok, np.sqrt(stats["m2"] / np.maximum(cnt, 1)), np.nan)
-    raise ValueError(f"unknown over_time kind {kind!r}")
+        return np.where(ok, stat / np.maximum(cnt, 1), np.nan)
+    # stddev
+    return np.where(ok, np.sqrt(stat / np.maximum(cnt, 1)), np.nan)
 
 
 @functools.lru_cache(maxsize=256)
@@ -297,17 +408,18 @@ def _quantile_idx_fn(W: int):
         frac = pos - lo.astype(_F32)
         lo_idx = _take_w(order, lo)
         hi_idx = jnp.where(hi < cnt, _take_w(order, hi), _take_w(order, lo))
-        return lo_idx, hi_idx, frac, cnt
+        # One packed transfer; window indices/counts are < W so f32 is exact.
+        return jnp.stack([lo_idx.astype(_F32), hi_idx.astype(_F32), frac,
+                          cnt.astype(_F32)])
 
     return jax.jit(fn)
 
 
 def quantile_over_time(grid: np.ndarray, W: int, q: float) -> np.ndarray:
     resid, _ = center(grid)
-    lo_idx, hi_idx, frac, cnt = _quantile_idx_fn(W)(
-        resid, np.float32(q))
-    lo_idx, hi_idx = np.asarray(lo_idx), np.asarray(hi_idx)
-    frac, cnt = np.asarray(frac), np.asarray(cnt)
+    packed = np.asarray(_quantile_idx_fn(W)(_cached_put(resid), np.float32(q)))
+    lo_idx, hi_idx = packed[0].astype(np.int64), packed[1].astype(np.int64)
+    frac, cnt = packed[2], packed[3]
     S, T_out = lo_idx.shape
     t_base = np.arange(T_out)[None, :]
     rows = np.arange(S)[:, None]
@@ -339,12 +451,12 @@ def _changes_resets_fn(W: int, count_resets: bool):
 
 def changes(grid: np.ndarray, W: int) -> np.ndarray:
     resid, _ = center(grid)
-    return np.asarray(_changes_resets_fn(W, False)(resid))
+    return np.asarray(_changes_resets_fn(W, False)(_cached_put(resid)))
 
 
 def resets(grid: np.ndarray, W: int) -> np.ndarray:
     resid, _ = center(grid)
-    return np.asarray(_changes_resets_fn(W, True)(resid))
+    return np.asarray(_changes_resets_fn(W, True)(_cached_put(resid)))
 
 
 @functools.lru_cache(maxsize=256)
@@ -382,13 +494,13 @@ def _regression_fn(W: int, step_s: float, predict_offset_s: float,
 
 def deriv(grid: np.ndarray, W: int, step_ns: int) -> np.ndarray:
     resid, _ = center(grid)
-    return np.asarray(_regression_fn(W, step_ns / 1e9, 0.0, True)(resid))
+    return np.asarray(_regression_fn(W, step_ns / 1e9, 0.0, True)(_cached_put(resid)))
 
 
 def predict_linear(grid: np.ndarray, W: int, step_ns: int,
                    offset_s: float) -> np.ndarray:
     resid, base = center(grid)
-    out = np.asarray(_regression_fn(W, step_ns / 1e9, float(offset_s), False)(resid))
+    out = np.asarray(_regression_fn(W, step_ns / 1e9, float(offset_s), False)(_cached_put(resid)))
     return out + base[:, None]
 
 
@@ -423,4 +535,6 @@ def _holt_winters_fn(W: int, sf: float, tf: float):
 
 def holt_winters(grid: np.ndarray, W: int, sf: float, tf: float) -> np.ndarray:
     resid, base = center(grid)
-    return np.asarray(_holt_winters_fn(W, float(sf), float(tf))(resid)) + base[:, None]
+    return np.asarray(
+        _holt_winters_fn(W, float(sf), float(tf))(_cached_put(resid))
+    ) + base[:, None]

@@ -573,24 +573,37 @@ MAX_DECIMAL_EXP = 6
 
 
 def detect_int_mode_batch(values: np.ndarray, npoints: np.ndarray):
-    """Vectorized per-series int-mode detection (ref_codec.detect_int_mode)."""
+    """Vectorized per-series int-mode detection (ref_codec.detect_int_mode):
+    smallest k in [0, MAX_DECIMAL_EXP] with round(v*10^k)/10^k == v for
+    every live point. k ascends over a shrinking candidate set — in metric
+    workloads most series are plain integers, so the k=0 pass resolves
+    ~everything and the k>=1 passes touch only the float-ish remainder."""
     v = np.asarray(values, dtype=np.float64)
     n, w = v.shape
     cols = np.arange(w)[None, :] < np.asarray(npoints)[:, None]
-    finite = np.where(cols, np.isfinite(v), True).all(axis=1)
-    # -0.0 only survives the float/XOR path (int path canonicalizes it to
-    # +0.0), so its presence forces float mode — mirrors detect_int_mode.
-    no_negzero = ~(np.where(cols, (v == 0.0) & np.signbit(v), False).any(axis=1))
-    finite &= no_negzero
+    dead = ~cols
+    with np.errstate(invalid="ignore"):
+        eligible = (np.isfinite(v) | dead).all(axis=1)
+        # -0.0 only survives the float/XOR path (int path canonicalizes it
+        # to +0.0), so its presence forces float mode (detect_int_mode).
+        eligible &= ~(((v == 0.0) & np.signbit(v) & cols).any(axis=1))
     best_k = np.full(n, -1, dtype=np.int32)
-    for k in range(MAX_DECIMAL_EXP, -1, -1):
-        scale = np.float64(10.0**k)
-        m = np.rint(v * scale)
+    rows = np.flatnonzero(eligible)
+    for k in range(0, MAX_DECIMAL_EXP + 1):
+        if rows.size == 0:
+            break
+        vr = v[rows]
         with np.errstate(invalid="ignore"):
-            ok = np.abs(m) < 2.0**53
-            ok &= (m / scale) == v
-        ok = np.where(cols, ok, True).all(axis=1) & finite
-        best_k = np.where(ok, np.int32(k), best_k)
+            if k == 0:
+                m = np.rint(vr)
+                ok = (np.abs(m) < 2.0**53) & (m == vr)
+            else:
+                scale = np.float64(10.0**k)
+                m = np.rint(vr * scale)
+                ok = (np.abs(m) < 2.0**53) & ((m / scale) == vr)
+        ok = (ok | dead[rows]).all(axis=1)
+        best_k[rows[ok]] = k
+        rows = rows[~ok]
     return best_k >= 0, np.maximum(best_k, 0)
 
 
@@ -609,13 +622,19 @@ def prepare_encode_inputs(timestamps: np.ndarray, values: np.ndarray, npoints: n
         raise ValueError("timestamp delta-of-deltas must fit in 32-bit signed")
     dt = dt_checked.astype(np.int32)
     int_mode, k = detect_int_mode_batch(v, npts)
-    scale = np.power(10.0, k.astype(np.float64))[:, None]
-    with np.errstate(invalid="ignore", over="ignore"):
-        m = np.rint(v * scale)
-        m = np.where(np.isfinite(m), m, 0.0).astype(np.int64)
-    fbits = v.view(np.uint64)
-    mbits = m.view(np.uint64)
-    bits = np.where(int_mode[:, None], mbits, fbits)
+    # Float rows keep raw IEEE bits; int rows get scaled-mantissa bits.
+    # Only the int subset pays the rint/astype passes (it is finite on all
+    # live columns by construction; dead columns are zeroed defensively).
+    bits = np.ascontiguousarray(v).view(np.uint64).copy()
+    rows_i = np.flatnonzero(int_mode)
+    if rows_i.size:
+        vi = v[rows_i]
+        ki = k[rows_i]
+        if ki.any():
+            vi = vi * np.power(10.0, ki.astype(np.float64))[:, None]
+        with np.errstate(invalid="ignore", over="ignore"):
+            vi = np.where(np.isfinite(vi), vi, 0.0)
+        bits[rows_i] = np.rint(vi).astype(np.int64).view(np.uint64)
     vhi, vlo = b64.from_u64_np(bits)
     t0hi, t0lo = b64.from_u64_np(ts[:, 0])
     w = ts.shape[1]

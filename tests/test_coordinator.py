@@ -93,7 +93,7 @@ class TestHTTPReadWrite:
             "end": (T0 + 420 * S) / S, "step": "60s"})
         out = http("GET", f"{base}/api/v1/query_range?{q}")
         vals = [float(v) for _, v in out["data"]["result"][0]["values"]]
-        np.testing.assert_allclose(vals, 10 / 15, rtol=1e-9)
+        np.testing.assert_allclose(vals, 10 / 15, rtol=1e-6)
 
     def test_labels_series_label_values(self, coord):
         c, db, now = coord

@@ -239,3 +239,20 @@ def test_holt_winters_matches_scalar(rng):
                 assert math.isnan(out[s, t])
             else:
                 assert out[s, t] == pytest.approx(exp, rel=1e-3, abs=1e-3), (s, t)
+
+
+def test_rate_no_cancellation_on_huge_counter():
+    """A quiet window late in a high-total counter grid must not lose its
+    tiny increase to f32 accumulation error (the windowed sums accumulate
+    per window, never as a global running prefix)."""
+    T, W = 139, 30
+    # Busy prefix pushes the counter to ~1e13, then a quiet tail adds 1/step.
+    busy = np.full(60, 2e11)
+    quiet = np.full(T - 61, 1.0)
+    increments = np.concatenate([[0.0], busy, quiet])
+    grid = np.cumsum(increments)[None, :]
+    out = temporal.increase(grid, W, STEP_NS, W * STEP_NS)
+    # Last window covers only quiet cells: true increase = W-1 samples * 1.
+    expected = (W - 1) * 1.0 * (W / (W - 1))  # extrapolated to full range
+    assert out[0, -1] == pytest.approx(expected, rel=1e-3)
+    assert (out[0, -5:] > 0).all()  # counter increase can never go negative
