@@ -29,7 +29,10 @@ def from_u64_np(x):
     import numpy as np
 
     x = np.ascontiguousarray(x)
-    if x.dtype.kind not in "iu" or x.dtype.itemsize != 8:
+    if x.dtype.kind in "iu" and x.dtype.itemsize < 8:
+        x = x.astype(np.uint64)  # widen narrow ints; a raw view would pair
+        # adjacent elements into bogus 64-bit values
+    elif x.dtype.kind not in "iu" or x.dtype.itemsize != 8:
         x = x.view(np.uint64)
     import sys
 

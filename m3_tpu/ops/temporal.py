@@ -46,7 +46,7 @@ _F32 = jnp.float32
 # against a ~60ms upload. Keyed by digest+shape+dtype, so a mutated grid
 # re-uploads (correctness does not depend on object identity).
 
-_PUT_CACHE: "collections.OrderedDict[tuple, jax.Array]" = collections.OrderedDict()
+_PUT_CACHE: "collections.OrderedDict[tuple, tuple]" = collections.OrderedDict()  # key -> (device array, charged bytes)
 _PUT_CACHE_LOCK = threading.Lock()
 # Evict by device bytes, not entry count: one [100k, 500] f32 grid is
 # ~200MB of HBM, so a count cap could pin multiple GB and starve kernels.
@@ -55,8 +55,18 @@ _PUT_CACHE_MAX_BYTES = int(os.environ.get(
 _put_cache_bytes = 0
 
 
-def _cached_put(arr: np.ndarray) -> jax.Array:
+@functools.lru_cache(maxsize=1)
+def _cache_enabled() -> bool:
+    # Only a real accelerator has a transfer to save; on host CPU the hash
+    # costs more than the memcpy it avoids and the cache would just pin
+    # duplicate host arrays.
+    return jax.default_backend() != "cpu"
+
+
+def _cached_put(arr: np.ndarray):
     global _put_cache_bytes
+    if not _cache_enabled():
+        return arr
     arr = np.ascontiguousarray(arr)
     key = (hashlib.blake2b(arr, digest_size=16).digest(),
            arr.shape, arr.dtype.str)
@@ -64,15 +74,18 @@ def _cached_put(arr: np.ndarray) -> jax.Array:
         hit = _PUT_CACHE.get(key)
         if hit is not None:
             _PUT_CACHE.move_to_end(key)
-            return hit
+            return hit[0]
     dev = jax.device_put(arr)
     with _PUT_CACHE_LOCK:
         if key not in _PUT_CACHE:
-            _PUT_CACHE[key] = dev
+            # Charge the HOST size we measured; device_put may canonicalize
+            # dtypes, so re-reading device nbytes at evict time would drift
+            # the counter.
+            _PUT_CACHE[key] = (dev, arr.nbytes)
             _put_cache_bytes += arr.nbytes
         while _put_cache_bytes > _PUT_CACHE_MAX_BYTES and len(_PUT_CACHE) > 1:
-            _, old = _PUT_CACHE.popitem(last=False)
-            _put_cache_bytes -= old.nbytes
+            _, (_, freed) = _PUT_CACHE.popitem(last=False)
+            _put_cache_bytes -= freed
     return dev
 
 
@@ -170,7 +183,7 @@ def _rate_fn(W: int, step_s: float, range_s: float, is_counter: bool,
     for small post-reset values and ~1e-7 relative for large ones, where
     dur_zero is far from binding."""
 
-    def fn(adj, finite, grid32):
+    def fn(adj, finite, grid32=None):
         T = finite.shape[-1]
         t_off = jnp.arange(T - W + 1, dtype=jnp.int32)[None, :]
         cnt = _wsum(finite, W)
@@ -238,10 +251,14 @@ def _extrapolated(grid: np.ndarray, W: int, step_ns: int, range_ns: int,
     device kernel; one f32 result comes back."""
     adj, finite = _host_diff_grid(grid, is_counter)
     fn = _rate_fn(W, step_ns / 1e9, range_ns / 1e9, is_counter, is_rate)
-    # NaNs become 0 in the f32 grid copy (validity rides `finite`); the
-    # gather target must be NaN-free so inf*0 artifacts can't appear.
-    grid32 = np.where(finite, grid, 0.0).astype(np.float32)
-    out = fn(_cached_put(adj), _cached_put(finite), _cached_put(grid32))
+    if is_counter:
+        # NaNs become 0 in the f32 grid copy (validity rides `finite`); the
+        # gather target must be NaN-free so inf*0 artifacts can't appear.
+        # Only the counter zero-clamp reads it — delta() skips the upload.
+        grid32 = np.where(finite, grid, 0.0).astype(np.float32)
+        out = fn(_cached_put(adj), _cached_put(finite), _cached_put(grid32))
+    else:
+        out = fn(_cached_put(adj), _cached_put(finite))
     return np.asarray(out).astype(np.float64)
 
 

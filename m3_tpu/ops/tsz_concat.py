@@ -1,0 +1,313 @@
+"""Sequential-scan-free merge of adjacent TTSZ blocks by bit concatenation.
+
+The reference merges filesets by iterating both streams point-by-point and
+re-encoding (src/dbnode/persist/fs merge path); the batched TPU analog
+(bench flush config #5) decodes both blocks with the sequential bit-cursor
+scan and re-encodes — the scan dominates the merge (~80% of device time).
+
+This module removes the scan for the common case. For two time-adjacent
+blocks from one encoding epoch (same mode/k, both timestamp-regular with the
+same delta0, boundary gap == delta0 — i.e., continuous scrapes cut at a
+block boundary), the merged stream is:
+
+    block1's bits unchanged
+    ++ a re-encoded boundary point (block2's v0 as a delta code vs
+       block1's last value)
+    ++ [int mode] a re-encoded second point (its value double-delta now
+       references the boundary delta)
+    ++ the REST of block2's bits verbatim, funnel-shifted to the new offset
+
+Why the verbatim tail stays decodable (see ref_codec wire format):
+  * timestamps: regular blocks carry no per-point timestamp codes at all;
+  * int mode: value codes are stateless double-deltas — only the first two
+    codes of block2 reference pre-boundary state, everything later differs
+    from direct encoding by nothing;
+  * float mode: XOR codes carry window state, but the boundary point is
+    emitted as a '111' rewrite, which is decode-valid in ANY state, and
+    block2's own bits never reference a window they didn't establish
+    themselves (the encoder never emits reuse of an invalid window), so the
+    state divergence is unobservable.
+
+Consequences: int-mode concat output is BIT-IDENTICAL to directly encoding
+the full window (codes are deterministic); float-mode output decodes to the
+same values but may spend a few more bits at the boundary than a direct
+encode whose window-reuse policy saw block1's history.
+
+Everything is elementwise over [N] series and [N, MW] words — gathers and
+32-bit funnel shifts, no scan: the merge becomes O(words) data movement.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import bits64 as b64
+from . import tsz
+from .tsz import I32, U32, _read32, _read64, _shl32, _shr32
+
+_ONES = U32(0xFFFFFFFF)
+
+
+# ---------------------------------------------------------------- header peek
+
+
+def parse_header(words):
+    """Vectorized header parse: flags + t0/delta0/v0 + total header bits
+    (mirrors the prefix of decode_batch without entering the scan)."""
+    n = words.shape[0]
+    zero = jnp.zeros((n,), I32)
+    b0 = _read32(words, zero)
+    int_mode = (b0 >> 31) == 1
+    kexp = ((b0 >> 28) & 7).astype(I32)
+    ts_regular = ((b0 >> 27) & 1) == 1
+    t0c = ((b0 >> 26) & 1).astype(I32)
+    vc = ((b0 >> 25) & 1).astype(I32)
+    dc = ((b0 >> 24) & 1).astype(I32)
+    nt0 = 32 + 32 * t0c
+    t0 = b64.unzigzag64(
+        b64.shr64(_read64(words, zero + 8), (64 - nt0).astype(U32)))
+    pos = zero + 8 + nt0
+    nd = jnp.where(ts_regular, 8 + 24 * dc, 0)
+    dzz = b64.shr64(_read64(words, pos), (64 - nd).astype(U32))
+    delta0 = jnp.where(ts_regular, b64.pair_to_i32(b64.unzigzag64(dzz)), 0)
+    pos = pos + nd
+    nv = jnp.where(int_mode, 32 + 32 * vc, 64)
+    vraw = b64.shr64(_read64(words, pos), (64 - nv).astype(U32))
+    v0un = b64.unzigzag64(vraw)
+    v0 = tuple(jnp.where(int_mode, a, b) for a, b in zip(v0un, vraw))
+    return {
+        "int_mode": int_mode, "k": kexp, "ts_regular": ts_regular,
+        "delta0": delta0, "t0": t0, "v0": v0,
+        "header_bits": pos + nv,
+    }
+
+
+def _peek_int_code(words, pos):
+    """(vdod pair, code bit length) of the int-mode value code at bit pos."""
+    int_payload = jnp.array([0, 4, 7, 12, 20, 32, 64], I32)
+    ci = _read32(words, pos)
+    ones_i = jnp.minimum(b64.clz32(~ci), 6)
+    iz = ones_i == 0
+    iplen = jnp.where(iz, 1, jnp.where(ones_i <= 4, ones_i + 1, 6))
+    inb = jnp.take(int_payload, ones_i)
+    p64i = _read64(words, pos + iplen)
+    zz = b64.shr64(p64i, (64 - inb).astype(U32))
+    vdod = b64.unzigzag64(zz)
+    vdod = tuple(jnp.where(iz, 0, x) for x in vdod)
+    return vdod, jnp.where(iz, 1, iplen + inb)
+
+
+# ---------------------------------------------------------------- code emit
+
+
+def _int_code_chunk(vdod):
+    """One int-mode value code as a (chunk96, nbits) pair (v2 buckets)."""
+    zz = b64.zigzag64(vdod)
+    chunk, cn = tsz._int_value_chunks(
+        (zz[0][:, None], zz[1][:, None]),
+        jnp.ones((zz[0].shape[0], 1), bool))
+    return tuple(c[:, 0] for c in chunk), cn[:, 0]
+
+
+def _float_rewrite_chunk(xor):
+    """One float-mode value code: '0' for zero xor, else a '111' rewrite
+    (valid in any window state)."""
+    n = xor[0].shape[0]
+    lz = b64.clz64(xor).astype(I32)
+    tz = b64.ctz64(xor).astype(I32)
+    xor0 = (xor[0] | xor[1]) == 0
+    mlen = jnp.where(xor0, 1, 64 - lz - tz)  # avoid 0-size payload math
+    payload = b64.shr64(xor, tz.astype(U32))
+    chunk, cn = tsz.chunk_empty((n,))
+    ctrl = jnp.where(xor0, U32(0), U32(0b111))
+    chunk, cn = tsz._append_u32(chunk, cn, ctrl, jnp.where(xor0, 1, 3))
+    rw = jnp.where(xor0, 0, 1)
+    chunk, cn = tsz._append_u32(chunk, cn, lz.astype(U32), 6 * rw)
+    chunk, cn = tsz._append_u32(chunk, cn, (mlen - 1).astype(U32), 6 * rw)
+    chunk, cn = tsz.chunk_append(chunk, cn, payload, mlen * rw)
+    return chunk, cn
+
+
+# ------------------------------------------------------------- bit placement
+
+
+def _range_mask(j32, start, end):
+    """Per-word u32 mask keeping global bit positions [start, end)."""
+    a = jnp.clip(start - j32, 0, 32).astype(U32)
+    b = jnp.clip(end - j32, 0, 32).astype(U32)
+    return _shr32(_ONES, a) & ~_shr32(_ONES, b)
+
+
+def _place_at(x, s, out_width: int):
+    """View each row's bitstream shifted right by s bits (s >= 0, dynamic
+    per row) in an out_width-word row.
+
+    No gathers: the sub-word part is one neighbour funnel, the word part is
+    a binary-decomposed chain of static pad/slice selects (the same pattern
+    _pack_segments uses) — element-level XLA gathers serialize on TPU and
+    cost ~1000x more than these shifts."""
+    n, K = x.shape
+    if K < out_width:
+        x = jnp.pad(x, ((0, 0), (0, out_width - K)))
+    else:
+        x = x[:, :out_width]
+    r = (s & 31).astype(U32)[:, None]
+    xprev = jnp.pad(x, ((0, 0), (1, 0)))[:, :-1]
+    y = _shr32(x, r) | _shl32(xprev, U32(32) - r)
+    q = (s >> 5)[:, None]
+    p = 1
+    while p < out_width:
+        shifted = jnp.pad(y, ((0, 0), (p, 0)))[:, :out_width]
+        y = jnp.where((q & p) != 0, shifted, y)
+        p <<= 1
+    return y
+
+
+@functools.partial(jax.jit, static_argnames=("max_words",))
+def concat_regular_batch(words1, nbits1, np1, words2, nbits2, np2,
+                         last_v, last_vdelta, *, max_words):
+    """Merge time-adjacent tsreg blocks by bit concatenation (no scan).
+
+    Args:
+      words1/words2: u32 [N, MW*] packed streams; nbits*/np*: int32 [N].
+      last_v: u32 pair [N] — block1's last value in stream space (scaled-m
+        two's complement in int mode, raw f64 bits in float mode); block
+        metadata recorded at encode time.
+      last_vdelta: u32 pair [N] — m[np1-1] - m[np1-2] (int mode; zero pair
+        when np1 < 2). Ignored in float mode.
+      max_words: static output width (>= max_words_for(total window)).
+
+    Caller must pre-check eligibility (concat_eligible). Returns
+    (words u32 [N, max_words], nbits int32 [N]).
+    """
+    n = words1.shape[0]
+    h2 = parse_header(words2)
+    int_mode = h2["int_mode"]
+    m0_2 = h2["v0"]
+    hbits2 = h2["header_bits"]
+
+    # Boundary point: block2's v0 re-expressed as a delta code.
+    step_v = b64.sub64(m0_2, last_v)  # m0 - last_m (int); unused for float
+    vdod_b = b64.sub64(step_v, last_vdelta)
+    int_b, int_b_len = _int_code_chunk(vdod_b)
+    xor_b = b64.xor64(m0_2, last_v)
+    flt_b, flt_b_len = _float_rewrite_chunk(xor_b)
+    im = int_mode
+    cb = tuple(jnp.where(im, a, f) for a, f in zip(int_b, flt_b))
+    cb_len = jnp.where(im, int_b_len, flt_b_len)
+
+    # Second point of block2 (int mode, np2 >= 2): its double-delta now
+    # references the boundary step instead of zero.
+    vdod1_old, len1_old = _peek_int_code(words2, hbits2)
+    has_v1 = im & (np2 >= 2)
+    vdod1_new = b64.sub64(vdod1_old, step_v)
+    c1, c1_len = _int_code_chunk(
+        tuple(jnp.where(has_v1, x, 0) for x in vdod1_new))
+    c1_len = jnp.where(has_v1, c1_len, 0)
+    skip2 = jnp.where(has_v1, len1_old, 0)
+
+    src_start = hbits2 + skip2
+    tail_len = jnp.maximum(nbits2 - src_start, 0)
+    o_cb = nbits1
+    dst = o_cb + cb_len + c1_len
+    nbits_out = dst + tail_len
+
+    j32 = (jnp.arange(max_words, dtype=I32) * 32)[None, :]
+
+    # Part 1: block1 verbatim (its own padding bits are zero, mask anyway).
+    w1 = jnp.pad(words1, ((0, 0), (0, max(0, max_words - words1.shape[1]))))
+    w1 = w1[:, :max_words]
+    out = w1 & _range_mask(j32, jnp.zeros((n, 1), I32), nbits1[:, None])
+
+    # Parts 2+3: both boundary codes packed into one 8-word mini-stream
+    # (cb || c1, <= 192 bits), then shifted into place as a unit.
+    mini = jnp.pad(jnp.stack(cb, axis=1), ((0, 0), (0, 5)))
+    mini = mini | _place_at(jnp.stack(c1, axis=1), cb_len, 8)
+    out = out | (_place_at(mini, o_cb, max_words)
+                 & _range_mask(j32, o_cb[:, None], dst[:, None]))
+
+    # Part 4: block2's tail moved from src_start to dst. The shift can be
+    # slightly negative (tiny block1 + wide block2 header), so bias by 8
+    # words and drop them after the shift.
+    shift = dst - src_start
+    tail = _place_at(words2, shift + 8 * 32, max_words + 8)[:, 8:]
+    out = out | (tail & _range_mask(j32, dst[:, None],
+                                    (dst + tail_len)[:, None]))
+    return out, nbits_out
+
+
+def concat_eligible(h1, h2, np1, np2, boundary_dt):
+    """Per-series eligibility for scan-free concat: both blocks regular,
+    one encoding epoch, and the boundary gap continues the cadence. h1/h2
+    are parse_header dicts."""
+    same_epoch = (h1["int_mode"] == h2["int_mode"]) & (h1["k"] == h2["k"])
+    cadence = boundary_dt == h1["delta0"]
+    d2_ok = (np2 < 2) | (h2["delta0"] == h1["delta0"])
+    # np1 >= 2 so block1's header delta0 is the real cadence (a 1-point
+    # block encodes delta0 = 0, which the merged header would inherit).
+    return (h1["ts_regular"] & h2["ts_regular"] & same_epoch & cadence
+            & d2_ok & (np1 >= 2) & (np2 >= 1))
+
+
+def merge_adjacent(words1, nbits1, np1, words2, nbits2, np2, boundary_dt,
+                   last_v, last_vdelta, *, half_window, max_words,
+                   strategy: str = "auto"):
+    """Full merge: concat for eligible series, decode+re-encode fallback
+    for the rest (one jit each; the caller supplies block1 boundary values
+    recorded at seal time). Returns (words, nbits) for the union.
+
+    boundary_dt: int32 [N] — t2[0] - t1[np1-1].
+    half_window: static per-input-block point capacity.
+    strategy: "auto" picks concat on TPU and recode-everything on host CPU
+    (the word-shift select chains lose to a straight recode there — same
+    backend split as encode_batch's pack= selection); "concat"/"recode"
+    force a path.
+    """
+    h1 = parse_header(words1)
+    h2 = parse_header(words2)
+    ok = np.asarray(concat_eligible(h1, h2, np1, np2, boundary_dt))
+    if strategy == "recode" or (
+            strategy == "auto" and jax.default_backend() != "tpu"):
+        ok = np.zeros_like(ok)
+    idx_fast = np.flatnonzero(ok)
+    idx_slow = np.flatnonzero(~ok)
+    n = words1.shape[0]
+    out_words = np.zeros((n, max_words), np.uint32)
+    out_nbits = np.zeros(n, np.int32)
+    if idx_fast.size:
+        w, nb = concat_regular_batch(
+            words1[idx_fast], nbits1[idx_fast], np1[idx_fast],
+            words2[idx_fast], nbits2[idx_fast], np2[idx_fast],
+            tuple(a[idx_fast] for a in last_v),
+            tuple(a[idx_fast] for a in last_vdelta),
+            max_words=max_words)
+        out_words[idx_fast] = np.asarray(w)
+        out_nbits[idx_fast] = np.asarray(nb)
+    if idx_slow.size:
+        w, nb = _merge_by_recode(
+            words1[idx_slow], np1[idx_slow], words2[idx_slow], np2[idx_slow],
+            boundary_dt[idx_slow], half_window=half_window,
+            max_words=max_words)
+        out_words[idx_slow] = np.asarray(w)
+        out_nbits[idx_slow] = np.asarray(nb)
+    return out_words, out_nbits
+
+
+@functools.partial(jax.jit, static_argnames=("half_window", "max_words"))
+def _merge_by_recode(words1, np1, words2, np2, boundary_dt, *, half_window,
+                     max_words):
+    """Fallback: decode both halves, concat columns, re-encode (the general
+    path for irregular/mode-mismatched series)."""
+    d1 = tsz.decode_batch(words1, np1, window=half_window)
+    d2 = tsz.decode_batch(words2, np2, window=half_window)
+    dt2 = d2["dt"].at[:, 0].set(boundary_dt)
+    dt = jnp.concatenate([d1["dt"], dt2], axis=1)
+    vhi = jnp.concatenate([d1["vhi"], d2["vhi"]], axis=1)
+    vlo = jnp.concatenate([d1["vlo"], d2["vlo"]], axis=1)
+    return tsz.encode_batch(
+        dt, d1["t0"], vhi, vlo, d1["int_mode"], d1["k"], np1 + np2,
+        max_words=max_words)

@@ -1,0 +1,179 @@
+"""Scan-free TTSZ block concat-merge tests (reference merge semantics:
+src/dbnode/persist/fs merge path — decode+re-encode; here the eligible
+common case is pure bit concatenation, see m3_tpu/ops/tsz_concat.py).
+
+Invariants proven here:
+  * int-mode concat output is bit-identical to directly encoding the full
+    window (value codes are stateless double-deltas);
+  * float-mode concat decodes to exactly the original values (the forced
+    boundary rewrite is decode-neutral);
+  * ineligible series (irregular timestamps, cadence breaks) take the
+    decode+re-encode fallback and still round-trip.
+"""
+
+import numpy as np
+import pytest
+
+from m3_tpu.ops import bits64 as b64
+from m3_tpu.ops import tsz
+from m3_tpu.ops import tsz_concat
+
+
+def _encode_half(ts, v, max_words):
+    npts = np.full(ts.shape[0], ts.shape[1], np.int32)
+    words, nbits = tsz.encode(ts, v, npts, max_words=max_words)
+    return np.asarray(words), np.asarray(nbits), npts
+
+
+def _boundary_meta(ts1, v1):
+    """Block1 seal-time metadata: last value in stream space + last m-delta."""
+    im, k = tsz.detect_int_mode_batch(
+        v1, np.full(v1.shape[0], v1.shape[1], np.int32))
+    scale = np.power(10.0, k.astype(np.float64))[:, None]
+    m = np.rint(v1 * scale).astype(np.int64)
+    last_bits = np.where(im, m[:, -1].view(np.uint64),
+                         v1[:, -1].view(np.uint64))
+    last_delta = np.where(im & (v1.shape[1] >= 2), m[:, -1] - m[:, -2], 0)
+    return (b64.from_u64_np(last_bits),
+            b64.from_u64_np(last_delta.view(np.uint64)))
+
+
+def _mixed_series(n, w, rng, regular=True):
+    start = 1_600_000_000
+    ts = np.int64(start) + np.arange(w, dtype=np.int64)[None, :] * 10
+    ts = np.broadcast_to(ts, (n, w)).copy()
+    if not regular:
+        ts[:, 1::2] += 3
+    kind = rng.integers(0, 3, size=(n, 1))
+    ints = rng.integers(0, 1000, (n, w)).astype(np.float64)
+    decs = np.round(rng.random((n, w)) * 100, 2)
+    flts = rng.standard_normal((n, w)) * np.pi
+    v = np.where(kind == 0, ints, np.where(kind == 1, decs, flts))
+    return ts, v
+
+
+@pytest.mark.parametrize("half", [4, 60])
+def test_int_mode_concat_bit_exact(half):
+    rng = np.random.default_rng(42)
+    n, w = 64, 2 * half
+    ts = (np.int64(1_600_000_000)
+          + np.arange(w, dtype=np.int64)[None, :] * 10)
+    ts = np.broadcast_to(ts, (n, w)).copy()
+    v = rng.integers(-500, 500, (n, w)).astype(np.float64)
+    v[: n // 4] = np.round(rng.random((n // 4, w)) * 10, 3)  # k=3 series
+    mw_half = tsz.max_words_for(half)
+    mw_full = tsz.max_words_for(w)
+    w1, nb1, np1 = _encode_half(ts[:, :half], v[:, :half], mw_half)
+    w2, nb2, np2 = _encode_half(ts[:, half:], v[:, half:], mw_half)
+    last_v, last_vd = _boundary_meta(ts[:, :half], v[:, :half])
+    boundary = (ts[:, half] - ts[:, half - 1]).astype(np.int32)
+
+    h1 = {k2: np.asarray(a) for k2, a in tsz_concat.parse_header(w1).items()}
+    assert np.asarray(h1["ts_regular"]).all()
+    assert np.asarray(h1["int_mode"]).all()
+
+    merged_w, merged_nb = tsz_concat.concat_regular_batch(
+        w1, nb1, np1, w2, nb2, np2, last_v, last_vd, max_words=mw_full)
+    ref_w, ref_nb = tsz.encode(ts, v, np.full(n, w, np.int32),
+                               max_words=mw_full)
+    np.testing.assert_array_equal(np.asarray(merged_nb), np.asarray(ref_nb))
+    np.testing.assert_array_equal(np.asarray(merged_w), np.asarray(ref_w))
+
+
+def test_float_mode_concat_round_trips():
+    rng = np.random.default_rng(7)
+    n, half = 48, 30
+    w = 2 * half
+    ts = (np.int64(1_700_000_000)
+          + np.arange(w, dtype=np.int64)[None, :] * 15)
+    ts = np.broadcast_to(ts, (n, w)).copy()
+    v = rng.standard_normal((n, w)) * 1e3 + 0.1  # floats: XOR mode
+    assert not tsz.detect_int_mode_batch(
+        v, np.full(n, w, np.int32))[0].any()
+    mw_half, mw_full = tsz.max_words_for(half), tsz.max_words_for(w)
+    w1, nb1, np1 = _encode_half(ts[:, :half], v[:, :half], mw_half)
+    w2, nb2, np2 = _encode_half(ts[:, half:], v[:, half:], mw_half)
+    last_v, last_vd = _boundary_meta(ts[:, :half], v[:, :half])
+    merged_w, merged_nb = tsz_concat.concat_regular_batch(
+        w1, nb1, np1, w2, nb2, np2, last_v, last_vd, max_words=mw_full)
+    dts, dv = tsz.decode(np.asarray(merged_w), np.full(n, w, np.int32),
+                         window=w)
+    np.testing.assert_array_equal(dts, ts)
+    np.testing.assert_array_equal(dv, v)
+    # Compression parity: the copied tail's window choices differ from a
+    # direct encode's (either way), plus <= 79 bits of boundary rewrite.
+    # Bound the AVERAGE overhead, not per-series.
+    _, ref_nb = tsz.encode(ts, v, np.full(n, w, np.int32), max_words=mw_full)
+    excess = np.asarray(merged_nb) - np.asarray(ref_nb)
+    assert excess.mean() < 2.0 * w  # < 2 bits/point on gaussian floats
+
+
+def test_float_zero_xor_boundary():
+    """Identical values across the boundary emit the 1-bit '0' code."""
+    n, half = 4, 8
+    w = 2 * half
+    ts = (np.int64(1_600_000_000)
+          + np.arange(w, dtype=np.int64)[None, :] * 10)
+    ts = np.broadcast_to(ts, (n, w)).copy()
+    v = np.full((n, w), 2.5)
+    mw_half, mw_full = tsz.max_words_for(half), tsz.max_words_for(w)
+    w1, nb1, np1 = _encode_half(ts[:, :half], v[:, :half], mw_half)
+    w2, nb2, np2 = _encode_half(ts[:, half:], v[:, half:], mw_half)
+    last_v, last_vd = _boundary_meta(ts[:, :half], v[:, :half])
+    merged_w, merged_nb = tsz_concat.concat_regular_batch(
+        w1, nb1, np1, w2, nb2, np2, last_v, last_vd, max_words=mw_full)
+    dts, dv = tsz.decode(np.asarray(merged_w), np.full(n, w, np.int32),
+                         window=w)
+    np.testing.assert_array_equal(dv, v)
+    np.testing.assert_array_equal(dts, ts)
+
+
+def test_merge_adjacent_mixed_eligibility():
+    """Regular series concat; irregular ones fall back to recode — the
+    union round-trips and eligibility splits as expected."""
+    rng = np.random.default_rng(3)
+    n, half = 40, 20
+    w = 2 * half
+    ts_r, v_r = _mixed_series(n // 2, w, rng, regular=True)
+    ts_i, v_i = _mixed_series(n - n // 2, w, rng, regular=False)
+    ts = np.concatenate([ts_r, ts_i])
+    v = np.concatenate([v_r, v_i])
+    mw_half, mw_full = tsz.max_words_for(half), tsz.max_words_for(w)
+    w1, nb1, np1 = _encode_half(ts[:, :half], v[:, :half], mw_half)
+    w2, nb2, np2 = _encode_half(ts[:, half:], v[:, half:], mw_half)
+    last_v, last_vd = _boundary_meta(ts[:, :half], v[:, :half])
+    boundary = (ts[:, half] - ts[:, half - 1]).astype(np.int32)
+
+    h1 = tsz_concat.parse_header(w1)
+    h2 = tsz_concat.parse_header(w2)
+    ok = np.asarray(tsz_concat.concat_eligible(h1, h2, np1, np2, boundary))
+    assert ok[: n // 2].all() and not ok[n // 2:].any()
+
+    merged_w, merged_nb = tsz_concat.merge_adjacent(
+        w1, nb1, np1, w2, nb2, np2, boundary, last_v, last_vd,
+        half_window=half, max_words=mw_full, strategy="concat")
+    dts, dv = tsz.decode(merged_w, np.full(n, w, np.int32), window=w)
+    np.testing.assert_array_equal(dts, ts)
+    np.testing.assert_array_equal(dv, v)
+
+
+def test_concat_short_second_block():
+    """np2 == 1 (a single trailing point) has no second code to rewrite."""
+    rng = np.random.default_rng(11)
+    n, half = 16, 10
+    ts = (np.int64(1_600_000_000)
+          + np.arange(half + 1, dtype=np.int64)[None, :] * 10)
+    ts = np.broadcast_to(ts, (n, half + 1)).copy()
+    v = rng.integers(0, 100, (n, half + 1)).astype(np.float64)
+    mw_half = tsz.max_words_for(half)
+    mw_full = tsz.max_words_for(half + 1)
+    w1, nb1, np1 = _encode_half(ts[:, :half], v[:, :half], mw_half)
+    w2, nb2, np2 = _encode_half(ts[:, half:], v[:, half:],
+                                tsz.max_words_for(1))
+    last_v, last_vd = _boundary_meta(ts[:, :half], v[:, :half])
+    merged_w, merged_nb = tsz_concat.concat_regular_batch(
+        w1, nb1, np1, w2, nb2, np2, last_v, last_vd, max_words=mw_full)
+    ref_w, ref_nb = tsz.encode(ts, v, np.full(n, half + 1, np.int32),
+                               max_words=mw_full)
+    np.testing.assert_array_equal(np.asarray(merged_nb), np.asarray(ref_nb))
+    np.testing.assert_array_equal(np.asarray(merged_w), np.asarray(ref_w))
