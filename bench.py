@@ -278,18 +278,15 @@ def bench_flush_merge():
     npts_half = np.full(n, half, np.int32)
     boundary = (raw_ts[:, half] - raw_ts[:, half - 1]).astype(np.int32)
 
-    # Seal-time boundary metadata for block1 (last stream-space value +
-    # last m-delta) — free at encode time, from the already-prepped columns.
+    # Seal-time boundary metadata for block1 — free at encode time, from
+    # the already-prepped columns (the same helper the storage layer uses).
     imode_np = np.asarray(full.int_mode)
-    lastb = np.asarray(b64.to_u64_np(
-        np.asarray(full.vhi[:, half - 1]), np.asarray(full.vlo[:, half - 1])))
-    prevb = np.asarray(b64.to_u64_np(
-        np.asarray(full.vhi[:, half - 2]), np.asarray(full.vlo[:, half - 2])))
-    last_vd_u64 = np.where(
-        imode_np, (lastb.astype(np.int64) - prevb.astype(np.int64)), 0
-    ).view(np.uint64)
-    last_v = b64.from_u64_np(lastb)
-    last_vd = b64.from_u64_np(last_vd_u64)
+    half1 = half_inputs(0, half)
+    bmeta = tsz.boundary_metadata({
+        "dt": half1[0], "t0": half1[1], "vhi": half1[2], "vlo": half1[3],
+        "int_mode": half1[4], "npoints": half1[6]})
+    last_v = b64.from_u64_np(bmeta["last_v_bits"])
+    last_vd = b64.from_u64_np(bmeta["last_vdelta_bits"])
 
     # Partition once (seal time); both sub-batches live on device. The
     # concat path's word-shift select chains win big on TPU but lose to a

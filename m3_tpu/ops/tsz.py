@@ -681,6 +681,50 @@ def encode(timestamps: np.ndarray, values: np.ndarray, npoints=None, max_words: 
     return words, nbits
 
 
+def boundary_metadata(inp: dict) -> dict:
+    """Seal-time boundary metadata from prepared encode inputs: everything
+    the scan-free concat merge (tsz_concat) needs to append a later block
+    without decoding this one. Free at encode time — it reads the prepared
+    columns the encoder already holds."""
+    npts = np.asarray(inp["npoints"])
+    rows = np.arange(npts.shape[0])
+    last_col = np.maximum(npts - 1, 0)
+    prev_col = np.maximum(npts - 2, 0)
+    vhi = np.asarray(inp["vhi"])
+    vlo = np.asarray(inp["vlo"])
+    last_bits = b64.to_u64_np(vhi[rows, last_col], vlo[rows, last_col])
+    prev_bits = b64.to_u64_np(vhi[rows, prev_col], vlo[rows, prev_col])
+    int_mode = np.asarray(inp["int_mode"])
+    last_vdelta = np.where(
+        int_mode & (npts >= 2),
+        last_bits.astype(np.int64) - prev_bits.astype(np.int64), 0
+    ).view(np.uint64)
+    dt = np.asarray(inp["dt"])
+    t0 = b64.to_u64_np(*(np.asarray(a) for a in inp["t0"])).astype(np.int64)
+    last_ticks = t0 + np.cumsum(dt, axis=1)[rows, last_col]
+    return {"last_ticks": last_ticks, "last_v_bits": last_bits,
+            "last_vdelta_bits": last_vdelta,
+            # valid=False marks rows whose metadata no longer describes the
+            # stream's epoch (set by merges that re-detected int mode).
+            "valid": np.ones(npts.shape[0], bool)}
+
+
+def encode_with_boundary(timestamps, values, npoints=None,
+                         max_words: int | None = None):
+    """encode() that also returns the boundary metadata dict (seal path)."""
+    ts = np.asarray(timestamps)
+    if npoints is None:
+        npoints = np.full(ts.shape[0], ts.shape[1], dtype=np.int32)
+    if max_words is None:
+        max_words = max_words_for(ts.shape[1])
+    inp = prepare_encode_inputs(ts, values, npoints)
+    words, nbits = encode_batch(
+        inp["dt"], inp["t0"], inp["vhi"], inp["vlo"], inp["int_mode"],
+        inp["k"], inp["npoints"], inp["ts_regular"], inp["delta0"],
+        max_words=max_words)
+    return words, nbits, boundary_metadata(inp)
+
+
 def decode(words, npoints, window: int):
     """Decode device streams -> host (timestamps int64 [N, W], values f64)."""
     out = decode_batch(jnp.asarray(words), jnp.asarray(npoints, I32), window=window)

@@ -255,10 +255,10 @@ def concat_eligible(h1, h2, np1, np2, boundary_dt):
 
 def merge_adjacent(words1, nbits1, np1, words2, nbits2, np2, boundary_dt,
                    last_v, last_vdelta, *, half_window, max_words,
-                   strategy: str = "auto"):
-    """Full merge: concat for eligible series, decode+re-encode fallback
-    for the rest (one jit each; the caller supplies block1 boundary values
-    recorded at seal time). Returns (words, nbits) for the union.
+                   strategy: str = "auto", force_recode=None):
+    """Full merge: concat for eligible series; same-epoch leftovers decode
+    + re-encode in stream space; epoch-mismatched pairs decode to real
+    values and re-encode with fresh mode detection. Returns (words, nbits).
 
     boundary_dt: int32 [N] — t2[0] - t1[np1-1].
     half_window: static per-input-block point capacity.
@@ -266,15 +266,21 @@ def merge_adjacent(words1, nbits1, np1, words2, nbits2, np2, boundary_dt,
     (the word-shift select chains lose to a straight recode there — same
     backend split as encode_batch's pack= selection); "concat"/"recode"
     force a path.
+    force_recode: optional bool [N] — rows whose seal metadata is stale.
     """
     h1 = parse_header(words1)
     h2 = parse_header(words2)
-    ok = np.asarray(concat_eligible(h1, h2, np1, np2, boundary_dt))
+    ok = np.array(concat_eligible(h1, h2, np1, np2, boundary_dt))
+    same_epoch = np.asarray((h1["int_mode"] == h2["int_mode"])
+                            & (h1["k"] == h2["k"]))
+    if force_recode is not None:
+        ok &= ~np.asarray(force_recode)
     if strategy == "recode" or (
             strategy == "auto" and jax.default_backend() != "tpu"):
         ok = np.zeros_like(ok)
     idx_fast = np.flatnonzero(ok)
-    idx_slow = np.flatnonzero(~ok)
+    idx_slow = np.flatnonzero(~ok & same_epoch)
+    idx_values = np.flatnonzero(~ok & ~same_epoch)
     n = words1.shape[0]
     out_words = np.zeros((n, max_words), np.uint32)
     out_nbits = np.zeros(n, np.int32)
@@ -294,20 +300,59 @@ def merge_adjacent(words1, nbits1, np1, words2, nbits2, np2, boundary_dt,
             max_words=max_words)
         out_words[idx_slow] = np.asarray(w)
         out_nbits[idx_slow] = np.asarray(nb)
+    if idx_values.size:
+        w, nb = _merge_values_recode(
+            words1[idx_values], np1[idx_values], words2[idx_values],
+            np2[idx_values], half_window=half_window, max_words=max_words)
+        out_words[idx_values] = np.asarray(w)
+        out_nbits[idx_values] = np.asarray(nb)
     return out_words, out_nbits
+
+
+def _splice_cols(a1, a2, np1, half_window: int):
+    """Per-series column splice: output col j reads a1[j] for j < np1[s],
+    else a2[j - np1[s]] — blocks may be partially filled, so block2's
+    points land immediately after block1's LIVE points, not at a fixed
+    offset."""
+    W = 2 * half_window
+    j = jnp.arange(W, dtype=I32)[None, :]
+    from1 = j < np1[:, None]
+    idx2 = jnp.clip(j - np1[:, None], 0, half_window - 1)
+    a1p = jnp.pad(a1, ((0, 0), (0, W - a1.shape[1])))
+    return jnp.where(from1, a1p, jnp.take_along_axis(a2, idx2, axis=1))
 
 
 @functools.partial(jax.jit, static_argnames=("half_window", "max_words"))
 def _merge_by_recode(words1, np1, words2, np2, boundary_dt, *, half_window,
                      max_words):
-    """Fallback: decode both halves, concat columns, re-encode (the general
-    path for irregular/mode-mismatched series)."""
+    """Same-epoch fallback: decode both halves in stream space, splice the
+    live columns, re-encode (irregular-timestamp series etc.)."""
     d1 = tsz.decode_batch(words1, np1, window=half_window)
     d2 = tsz.decode_batch(words2, np2, window=half_window)
     dt2 = d2["dt"].at[:, 0].set(boundary_dt)
-    dt = jnp.concatenate([d1["dt"], dt2], axis=1)
-    vhi = jnp.concatenate([d1["vhi"], d2["vhi"]], axis=1)
-    vlo = jnp.concatenate([d1["vlo"], d2["vlo"]], axis=1)
+    dt = _splice_cols(d1["dt"], dt2, np1, half_window)
+    vhi = _splice_cols(d1["vhi"], d2["vhi"], np1, half_window)
+    vlo = _splice_cols(d1["vlo"], d2["vlo"], np1, half_window)
     return tsz.encode_batch(
         dt, d1["t0"], vhi, vlo, d1["int_mode"], d1["k"], np1 + np2,
         max_words=max_words)
+
+
+def _merge_values_recode(words1, np1, words2, np2, *, half_window,
+                         max_words):
+    """Epoch-mismatched fallback: decode to REAL values (stream-space bits
+    are not comparable across int_mode/k epochs), splice, re-encode with
+    fresh int-mode detection over the merged series."""
+    t1, v1 = tsz.decode(words1, np1, window=half_window)
+    t2, v2 = tsz.decode(words2, np2, window=half_window)
+    n = words1.shape[0]
+    W = 2 * half_window
+    j = np.arange(W)[None, :]
+    from1 = j < np1[:, None]
+    idx2 = np.clip(j - np1[:, None], 0, half_window - 1)
+    rows = np.arange(n)[:, None]
+    ts = np.where(from1, np.pad(t1, ((0, 0), (0, W - half_window))),
+                  t2[rows, idx2])
+    vs = np.where(from1, np.pad(v1, ((0, 0), (0, W - half_window))),
+                  v2[rows, idx2])
+    return tsz.encode(ts, vs, np1 + np2, max_words=max_words)

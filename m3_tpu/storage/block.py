@@ -43,6 +43,11 @@ class SealedBlock:
     npoints: np.ndarray            # int32 [S]
     time_unit: xtime.Unit = xtime.Unit.NANOSECOND  # tick scale of the streams
     checksum: int = 0
+    # Seal-time boundary metadata (tsz.boundary_metadata): lets a later
+    # adjacent block be appended by scan-free bit concat without decoding
+    # this one. None for blocks paged in from disk — those merge via the
+    # decode fallback.
+    boundary: Optional[dict] = None
 
     def __post_init__(self):
         if self.checksum == 0:
@@ -109,10 +114,12 @@ def encode_block(block_start: int, series_indices, tdense, vdense, npoints,
         npoints = np.concatenate([npoints, np.ones(sp - s, np.int32)])
     window = wp
     unit = choose_time_unit(tdense)
-    words, nbits = tsz.encode(tdense // unit.nanos, vdense, npoints, max_words=max_words)
+    words, nbits, boundary = tsz.encode_with_boundary(
+        tdense // unit.nanos, vdense, npoints, max_words=max_words)
     words = np.asarray(words)[:s]
     nbits = np.asarray(nbits)[:s]
     npoints = npoints[:s]
+    boundary = {k: v[:s] for k, v in boundary.items()}
     return SealedBlock(
         block_start=block_start,
         window=window,
@@ -121,7 +128,132 @@ def encode_block(block_start: int, series_indices, tdense, vdense, npoints,
         nbits=np.asarray(nbits),
         npoints=np.asarray(npoints, np.int32),
         time_unit=unit,
+        boundary=boundary,
     )
+
+
+def merge_sealed_blocks(b1: SealedBlock, b2: SealedBlock) -> SealedBlock:
+    """Compact two time-adjacent sealed blocks into one (block compaction;
+    the reference's fs merge re-encodes point streams — here series present
+    in both blocks ride the scan-free concat fast path when eligible, see
+    m3_tpu/ops/tsz_concat.py). b2 must start at or after b1's window end.
+
+    Series in only one input copy through untouched. Requires b1's
+    seal-time boundary metadata and a shared time unit; otherwise both
+    blocks are decoded and re-encoded wholesale."""
+    from ..ops import bits64 as b64
+    from ..ops import tsz_concat
+
+    if b1.block_start >= b2.block_start:
+        raise ValueError("merge_sealed_blocks: blocks must be time-ordered")
+    if b1.boundary is None or b1.time_unit != b2.time_unit:
+        return _merge_by_full_recode(b1, b2)
+
+    window = b1.window + b2.window
+    max_words = tsz.max_words_for(window)
+    union = np.union1d(b1.series_indices, b2.series_indices)
+    r1 = np.searchsorted(b1.series_indices, union)
+    r2 = np.searchsorted(b2.series_indices, union)
+    in1 = (r1 < len(b1.series_indices)) & \
+        (b1.series_indices[np.minimum(r1, len(b1.series_indices) - 1)] == union)
+    in2 = (r2 < len(b2.series_indices)) & \
+        (b2.series_indices[np.minimum(r2, len(b2.series_indices) - 1)] == union)
+
+    words = np.zeros((len(union), max_words), np.uint32)
+    nbits = np.zeros(len(union), np.int32)
+    npoints = np.zeros(len(union), np.int32)
+
+    only1 = in1 & ~in2
+    only2 = ~in1 & in2
+    for only, blk, rows in ((only1, b1, r1), (only2, b2, r2)):
+        src = rows[only]
+        w = blk.words[src]
+        words[only, :w.shape[1]] = w[:, :max_words]
+        nbits[only] = blk.nbits[src]
+        npoints[only] = blk.npoints[src]
+
+    both = in1 & in2
+    same_epoch = np.ones(len(union), bool)
+    if both.any():
+        i1, i2 = r1[both], r2[both]
+        h1 = tsz_concat.parse_header(b1.words[i1])
+        h2 = tsz_concat.parse_header(b2.words[i2])
+        t0_2 = np.asarray(b64.to_u64_np(*(np.asarray(a) for a in h2["t0"]))
+                          ).astype(np.int64)
+        gap = t0_2 - b1.boundary["last_ticks"][i1]
+        if (np.abs(gap) >= 2**31).any():
+            # The DoD payload is 32-bit: a gap this wide cannot be encoded
+            # in one stream at this time unit (prepare_encode_inputs raises
+            # the same way on the ingest path).
+            raise ValueError(
+                "merge_sealed_blocks: inter-block gap exceeds int32 ticks")
+        boundary_dt = gap.astype(np.int32)
+        stale = ~b1.boundary.get(
+            "valid", np.ones(len(b1.series_indices), bool))[i1]
+        mw, mnb = tsz_concat.merge_adjacent(
+            b1.words[i1], b1.nbits[i1], b1.npoints[i1],
+            b2.words[i2], b2.nbits[i2], b2.npoints[i2], boundary_dt,
+            b64.from_u64_np(b1.boundary["last_v_bits"][i1]),
+            b64.from_u64_np(b1.boundary["last_vdelta_bits"][i1]),
+            half_window=max(b1.window, b2.window), max_words=max_words,
+            force_recode=stale)
+        words[both] = mw
+        nbits[both] = mnb
+        npoints[both] = b1.npoints[i1] + b2.npoints[i2]
+        same_epoch[both] = np.asarray(
+            (h1["int_mode"] == h2["int_mode"]) & (h1["k"] == h2["k"]))
+
+    boundary2 = None
+    if b2.boundary is not None:
+        boundary2 = {}
+        for key in ("last_ticks", "last_v_bits", "last_vdelta_bits"):
+            col = np.zeros(len(union), b2.boundary[key].dtype)
+            col[in2] = b2.boundary[key][r2[in2]]
+            if b1.boundary is not None:
+                col[only1] = b1.boundary[key][r1[only1]]
+            boundary2[key] = col
+        valid = np.zeros(len(union), bool)
+        valid[in2] = b2.boundary.get(
+            "valid", np.ones(len(b2.series_indices), bool))[r2[in2]]
+        if b1.boundary is not None:
+            valid[only1] = b1.boundary.get(
+                "valid", np.ones(len(b1.series_indices), bool))[r1[only1]]
+        # Epoch-mismatched rows were re-encoded with fresh mode detection:
+        # b2's stream-space metadata no longer describes the merged stream.
+        valid &= same_epoch
+        boundary2["valid"] = valid
+
+    return SealedBlock(
+        block_start=b1.block_start, window=window,
+        series_indices=union.astype(np.int32), words=words, nbits=nbits,
+        npoints=npoints, time_unit=b1.time_unit, boundary=boundary2)
+
+
+def _merge_by_full_recode(b1: SealedBlock, b2: SealedBlock) -> SealedBlock:
+    """General fallback: decode both blocks and re-encode the union."""
+    t1, v1, n1 = b1.read_all()
+    t2, v2, n2 = b2.read_all()
+    union = np.union1d(b1.series_indices, b2.series_indices)
+    w = b1.window + b2.window
+    ts = np.zeros((len(union), w), np.int64)
+    vs = np.zeros((len(union), w), np.float64)
+    npts = np.zeros(len(union), np.int32)
+    for i, sid in enumerate(union):
+        t_parts, v_parts = [], []
+        for blk, t, v, n in ((b1, t1, v1, n1), (b2, t2, v2, n2)):
+            row = blk.row_of(int(sid))
+            if row is not None:
+                t_parts.append(t[row, : n[row]])
+                v_parts.append(v[row, : n[row]])
+        tt = np.concatenate(t_parts)
+        vv = np.concatenate(v_parts)
+        npts[i] = tt.size
+        ts[i, : tt.size] = tt
+        vs[i, : tt.size] = vv
+        if tt.size < w:
+            ts[i, tt.size:] = tt[-1]
+            vs[i, tt.size:] = vv[-1]
+    return encode_block(b1.block_start, union.astype(np.int32), ts, vs, npts)
 
 
 class WiredList:

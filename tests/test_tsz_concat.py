@@ -177,3 +177,144 @@ def test_concat_short_second_block():
                                max_words=mw_full)
     np.testing.assert_array_equal(np.asarray(merged_nb), np.asarray(ref_nb))
     np.testing.assert_array_equal(np.asarray(merged_w), np.asarray(ref_w))
+
+
+class TestSealedBlockMerge:
+    """Storage-level block compaction (m3_tpu/storage/block.py
+    merge_sealed_blocks) over the scan-free concat."""
+
+    def _block(self, start, sids, ts, v, npts=None):
+        from m3_tpu.storage.block import encode_block
+        if npts is None:
+            npts = np.full(ts.shape[0], ts.shape[1], np.int32)
+        return encode_block(start, np.asarray(sids, np.int32), ts, v, npts)
+
+    def test_merge_shared_and_disjoint_series(self):
+        from m3_tpu.storage.block import merge_sealed_blocks
+        rng = np.random.default_rng(5)
+        S = 10**9
+        half = 16
+        t1 = (np.int64(1_600_000_000) * S
+              + np.arange(half, dtype=np.int64)[None, :] * 10 * S)
+        t2 = t1 + half * 10 * S
+        # series 1,2,3 in block1; 2,3,4 in block2
+        v1 = rng.integers(0, 100, (3, half)).astype(np.float64)
+        v2 = rng.integers(0, 100, (3, half)).astype(np.float64)
+        b1 = self._block(0, [1, 2, 3], np.broadcast_to(t1, (3, half)).copy(), v1)
+        b2 = self._block(1, [2, 3, 4], np.broadcast_to(t2, (3, half)).copy(), v2)
+        assert b1.boundary is not None
+        merged = merge_sealed_blocks(b1, b2)
+        assert merged.series_indices.tolist() == [1, 2, 3, 4]
+        # shared series: both halves, in order
+        ts_m, v_m = merged.read(2)
+        np.testing.assert_array_equal(ts_m, np.concatenate([t1[0], t2[0]]))
+        np.testing.assert_array_equal(v_m, np.concatenate([v1[1], v2[0]]))
+        # one-sided series copy through
+        ts_1, v_1 = merged.read(1)
+        np.testing.assert_array_equal(v_1, v1[0])
+        ts_4, v_4 = merged.read(4)
+        np.testing.assert_array_equal(v_4, v2[2])
+        np.testing.assert_array_equal(ts_4, t2[0])
+        # boundary metadata carries forward for a further merge
+        assert merged.boundary is not None
+        t3 = t2 + half * 10 * S
+        v3 = rng.integers(0, 100, (1, half)).astype(np.float64)
+        b3 = self._block(2, [2], np.broadcast_to(t3, (1, half)).copy(), v3)
+        merged2 = merge_sealed_blocks(merged, b3)
+        ts_m2, v_m2 = merged2.read(2)
+        np.testing.assert_array_equal(
+            v_m2, np.concatenate([v1[1], v2[0], v3[0]]))
+
+    def test_merge_without_metadata_falls_back(self):
+        from m3_tpu.storage.block import merge_sealed_blocks
+        rng = np.random.default_rng(9)
+        S = 10**9
+        half = 8
+        t1 = (np.int64(1_700_000_000) * S
+              + np.arange(half, dtype=np.int64)[None, :] * 10 * S)
+        t2 = t1 + half * 10 * S
+        v1 = rng.standard_normal((2, half)) * 3
+        v2 = rng.standard_normal((2, half)) * 3
+        b1 = self._block(0, [5, 6], np.broadcast_to(t1, (2, half)).copy(), v1)
+        b2 = self._block(1, [5, 6], np.broadcast_to(t2, (2, half)).copy(), v2)
+        b1.boundary = None  # as if paged in from disk
+        merged = merge_sealed_blocks(b1, b2)
+        ts_m, v_m = merged.read(5)
+        np.testing.assert_array_equal(v_m, np.concatenate([v1[0], v2[0]]))
+        np.testing.assert_array_equal(ts_m, np.concatenate([t1[0], t2[0]]))
+
+
+class TestRecodeFallbackCorrectness:
+    """Regression tests for the general fallback paths: partially-filled
+    blocks must splice at the live-point boundary, and epoch-mismatched
+    pairs must re-encode from real values, never reinterpreting stream
+    bits across int_mode/k epochs."""
+
+    def test_partial_blocks_splice_correctly(self):
+        from m3_tpu.storage.block import encode_block, merge_sealed_blocks
+        S = 10**9
+        n, cap, live = 3, 16, 10  # window padded to 16, only 10 live points
+        t1 = (np.int64(1_600_000_000) * S
+              + np.arange(cap, dtype=np.int64)[None, :] * 10 * S)
+        t2 = t1 + cap * 10 * S
+        rng = np.random.default_rng(2)
+        # Irregular timestamps force the recode path.
+        t1 = np.broadcast_to(t1, (n, cap)).copy()
+        t2 = np.broadcast_to(t2, (n, cap)).copy()
+        t1[:, 1::2] += 3 * S
+        t2[:, 1::2] += 3 * S
+        v1 = rng.integers(0, 100, (n, cap)).astype(np.float64)
+        v2 = rng.integers(0, 100, (n, cap)).astype(np.float64)
+        npts = np.full(n, live, np.int32)
+        b1 = encode_block(0, [1, 2, 3], t1, v1, npts)
+        b2 = encode_block(1, [1, 2, 3], t2, v2, npts)
+        merged = merge_sealed_blocks(b1, b2)
+        ts_m, v_m = merged.read(2)
+        assert ts_m.size == 2 * live
+        np.testing.assert_array_equal(
+            v_m, np.concatenate([v1[1, :live], v2[1, :live]]))
+        np.testing.assert_array_equal(
+            ts_m, np.concatenate([t1[1, :live], t2[1, :live]]))
+
+    def test_epoch_mismatch_reencodes_values(self):
+        from m3_tpu.storage.block import encode_block, merge_sealed_blocks
+        S = 10**9
+        n, half = 2, 8
+        t1 = (np.int64(1_600_000_000) * S
+              + np.arange(half, dtype=np.int64)[None, :] * 10 * S)
+        t2 = t1 + half * 10 * S
+        # block1: plain ints (k=0); block2: 2-decimal values (k=2) — one
+        # counter crossing a precision boundary between blocks.
+        v1 = np.arange(n * half, dtype=np.float64).reshape(n, half)
+        v2 = v1 + 0.25
+        npts = np.full(n, half, np.int32)
+        b1 = encode_block(0, [1, 2], np.broadcast_to(t1, (n, half)).copy(),
+                          v1, npts)
+        b2 = encode_block(1, [1, 2], np.broadcast_to(t2, (n, half)).copy(),
+                          v2, npts)
+        merged = merge_sealed_blocks(b1, b2)
+        ts_m, v_m = merged.read(1)
+        np.testing.assert_array_equal(
+            v_m, np.concatenate([v1[0], v2[0]]))
+        # staleness propagates: a further merge must not trust b2's epoch
+        assert merged.boundary is not None
+        assert not merged.boundary["valid"].any()
+        t3 = t2 + half * 10 * S
+        b3 = encode_block(2, [1, 2], np.broadcast_to(t3, (n, half)).copy(),
+                          v1, npts)
+        merged2 = merge_sealed_blocks(merged, b3)
+        _, v_m2 = merged2.read(1)
+        np.testing.assert_array_equal(
+            v_m2, np.concatenate([v1[0], v2[0], v1[0]]))
+
+    def test_oversize_gap_rejected(self):
+        from m3_tpu.storage.block import encode_block, merge_sealed_blocks
+        n, half = 1, 4
+        t1 = np.arange(half, dtype=np.int64)[None, :] * 10 * 10**9
+        t2 = t1 + 2**32 * 10**9  # ~4.3e18 ns: beyond int32 second-ticks
+        v = np.ones((n, half))
+        npts = np.full(n, half, np.int32)
+        b1 = encode_block(0, [1], t1.copy(), v, npts)
+        b2 = encode_block(1, [1], t2.copy(), v, npts)
+        with pytest.raises(ValueError, match="gap exceeds int32"):
+            merge_sealed_blocks(b1, b2)
