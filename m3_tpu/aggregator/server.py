@@ -126,6 +126,9 @@ class RawTCPServer:
         self.aggregator = aggregator
         self.frames = 0
         self.errors = 0
+        # Counters are bumped from per-connection handler threads; a plain
+        # += is a non-atomic load/add/store that loses increments.
+        self._stats_lock = threading.Lock()
         outer = self
 
         class _Handler(socketserver.BaseRequestHandler):
@@ -142,15 +145,18 @@ class RawTCPServer:
                             entries = reader.read_entries()
                         except RecoverableRecordError:
                             # one bad legacy record, stream still aligned
-                            outer.errors += 1
+                            with outer._stats_lock:
+                                outer.errors += 1
                             continue
                         except ValueError:
                             # binary framing is unrecoverable mid-stream
-                            outer.errors += 1
+                            with outer._stats_lock:
+                                outer.errors += 1
                             break
                         for e in entries:
                             outer._handle(e)
-                        outer.frames += len(entries)
+                        with outer._stats_lock:
+                            outer.frames += len(entries)
                 except (ConnectionError, OSError):
                     pass
 
@@ -173,7 +179,8 @@ class RawTCPServer:
                 mt, mid, t_nanos, value, meta = forwarded_from_wire(e)
                 self.aggregator.add_forwarded(mt, mid, t_nanos, value, meta)
         except Exception:  # noqa: BLE001 - bad frame must not kill the conn
-            self.errors += 1
+            with self._stats_lock:
+                self.errors += 1
 
     @property
     def endpoint(self) -> str:

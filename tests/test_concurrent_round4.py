@@ -1,0 +1,186 @@
+"""Threaded stress over this round's new concurrent surfaces (the -race
+breadth analog, SURVEY §5): the temporal device-upload cache, the
+dual-format aggregator ingest server, and the tags-only aggregate path
+under concurrent writes."""
+
+import concurrent.futures as cf
+import socket
+import threading
+import time
+
+import numpy as np
+
+S = 1_000_000_000
+
+
+class TestUploadCacheRaces:
+    def test_cached_put_concurrent_hammer(self, monkeypatch):
+        """Many threads inserting/reading overlapping keys must never raise
+        and must keep the byte ledger consistent (the cache serves every
+        query thread of a ThreadingHTTPServer coordinator). The cache
+        normally bypasses on the cpu backend, so force it on — the locking
+        under test is backend-independent."""
+        from m3_tpu.ops import temporal
+
+        monkeypatch.setattr(temporal, "_cache_enabled", lambda: True)
+        with temporal._PUT_CACHE_LOCK:
+            temporal._PUT_CACHE.clear()
+            temporal._put_cache_bytes = 0
+        rng = np.random.default_rng(0)
+        # Small budget forces constant eviction while threads hold hits.
+        old = temporal._PUT_CACHE_MAX_BYTES
+        temporal._PUT_CACHE_MAX_BYTES = 64 * 1024
+        arrays = [np.ascontiguousarray(rng.random((64, 64), np.float32))
+                  for _ in range(12)]
+        errors = []
+
+        def worker(seed):
+            r = np.random.default_rng(seed)
+            try:
+                for _ in range(200):
+                    a = arrays[r.integers(len(arrays))]
+                    out = temporal._cached_put(a)
+                    assert out.shape == a.shape
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        try:
+            with cf.ThreadPoolExecutor(8) as ex:
+                list(ex.map(worker, range(8)))
+        finally:
+            temporal._PUT_CACHE_MAX_BYTES = old
+        assert not errors, errors
+        with temporal._PUT_CACHE_LOCK:
+            assert temporal._PUT_CACHE  # the cache actually ran
+            ledger = sum(nb for _, nb in temporal._PUT_CACHE.values())
+            assert ledger == temporal._put_cache_bytes
+
+    def test_concurrent_rate_queries_share_cache(self, monkeypatch):
+        from m3_tpu.ops import temporal
+
+        monkeypatch.setattr(temporal, "_cache_enabled", lambda: True)
+        rng = np.random.default_rng(1)
+        grid = np.cumsum(rng.poisson(3.0, (64, 48)), axis=1).astype(np.float64)
+        expected = temporal.rate(grid, 6, 10 * S, 60 * S)
+        results = []
+
+        def q(_):
+            results.append(temporal.rate(grid, 6, 10 * S, 60 * S))
+
+        with cf.ThreadPoolExecutor(6) as ex:
+            list(ex.map(q, range(12)))
+        for r in results:
+            np.testing.assert_array_equal(
+                np.isnan(r), np.isnan(expected))
+            np.testing.assert_allclose(r[np.isfinite(r)],
+                                       expected[np.isfinite(expected)])
+
+
+class TestMixedFormatIngestStress:
+    def test_many_connections_both_generations(self):
+        """8 client threads, each interleaving binary frames and legacy
+        JSON lines on its own connection; every accepted metric must be
+        aggregated exactly once."""
+        from m3_tpu.aggregator import Aggregator, CaptureHandler
+        from m3_tpu.aggregator.migration import write_legacy
+        from m3_tpu.aggregator.server import RawTCPServer, union_to_wire
+        from m3_tpu.metrics.metadata import (Metadata, PipelineMetadata,
+                                             StagedMetadata)
+        from m3_tpu.metrics.metric import MetricUnion
+        from m3_tpu.metrics.policy import StoragePolicy
+        from m3_tpu.rpc import wire
+        from m3_tpu.testing.cluster import SettableClock
+
+        clock = SettableClock(100 * S)
+        cap = CaptureHandler()
+        agg = Aggregator(num_shards=16, clock=clock, flush_handler=cap)
+        srv = RawTCPServer(agg).start()
+        md = (StagedMetadata(0, False, Metadata(
+            (PipelineMetadata(0, (StoragePolicy.of("10s", "2d"),)),))),)
+        per_thread = 40
+        n_threads = 8
+        host, _, port = srv.endpoint.rpartition(":")
+
+        def client(tid):
+            sock = socket.create_connection((host, int(port)), timeout=10)
+            mid = b"stress.counter"
+            for i in range(per_thread):
+                if i % 2 == 0:
+                    wire.write_frame(sock, union_to_wire(
+                        MetricUnion.counter(mid, 1), md))
+                else:
+                    write_legacy(sock, "counter", mid.decode(), 1,
+                                 ["10s:2d"])
+            sock.close()
+
+        try:
+            with cf.ThreadPoolExecutor(n_threads) as ex:
+                list(ex.map(client, range(n_threads)))
+            deadline = time.time() + 10
+            want = per_thread * n_threads
+            while srv.frames < want and time.time() < deadline:
+                time.sleep(0.02)
+            assert srv.frames == want and srv.errors == 0
+            clock.advance(10 * S)
+            agg.flush()
+            out = cap.by_id(b"stress.counter")
+            assert len(out) == 1 and out[0].value == float(want)
+        finally:
+            srv.close()
+
+
+class TestAggregatePathUnderWrites:
+    def test_complete_tags_during_concurrent_writes(self):
+        """aggregate_tags served while writers add new series must never
+        raise and must always return a subset-consistent snapshot."""
+        from m3_tpu.index.namespace_index import NamespaceIndex
+        from m3_tpu.parallel.sharding import ShardSet
+        from m3_tpu.storage.database import Database
+        from m3_tpu.storage.namespace import NamespaceOptions
+        from m3_tpu.index import query as iq
+
+        now = {"t": 1_600_000_000 * S}
+        db = Database(ShardSet(8), clock=lambda: now["t"])
+        db.create_namespace(b"default", NamespaceOptions(),
+                            index=NamespaceIndex(clock=lambda: now["t"]))
+        stop = threading.Event()
+        errors = []
+
+        def writer(tid):
+            try:
+                # Bounded: an unbounded loop grows the term dictionary
+                # quadratically under the readers' full scans.
+                for i in range(400):
+                    if stop.is_set():
+                        break
+                    sid = b"m|%d|%d" % (tid, i)
+                    db.write(b"default", sid, now["t"], 1.0,
+                             tags={b"__name__": b"m",
+                                   b"w": str(tid).encode(),
+                                   b"i": str(i).encode()})
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        def reader(_):
+            try:
+                for _ in range(15):
+                    fields = db.aggregate_tags(
+                        b"default", iq.AllQuery(), 0, 2**62)
+                    if fields:
+                        assert b"__name__" in fields
+                        assert fields[b"__name__"] == {b"m"}
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=writer, args=(t,))
+                   for t in range(4)]
+        for t in threads:
+            t.start()
+        try:
+            with cf.ThreadPoolExecutor(4) as ex:
+                list(ex.map(reader, range(4)))
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+        assert not errors, errors[:3]
