@@ -183,44 +183,50 @@ def _rate_fn(W: int, step_s: float, range_s: float, is_counter: bool,
     for small post-reset values and ~1e-7 relative for large ones, where
     dur_zero is far from binding."""
 
-    def fn(adj, finite, grid32=None):
-        T = finite.shape[-1]
-        t_off = jnp.arange(T - W + 1, dtype=jnp.int32)[None, :]
-        cnt = _wsum(finite, W)
-        fa = _first_abs(finite, W)
-        la = _last_abs(finite, W)
-        # Only cells strictly after the window's first valid sample
-        # contribute — their previous-valid reference is inside the window,
-        # so the window increase is the full adj sum minus the first valid
-        # cell's adj (whose reference precedes the window).
-        increase = _wsum(adj, W) - _take_t(adj, fa)
-        ok = cnt >= 2
-        fcnt = cnt
-        fi = (fa - t_off).astype(_F32)
-        li = (la - t_off).astype(_F32)
-        dur_start = (fi + 1) * step_s
-        dur_end = (W - 1 - li) * step_s
-        sampled = (li - fi) * step_s
-        avg_dur = sampled / jnp.maximum(fcnt - 1, 1)
-        threshold = avg_dur * 1.1
-        if is_counter:
-            abs_first = _take_t(grid32, fa)
-            dur_zero = jnp.where(
-                (increase > 0) & (abs_first >= 0),
-                sampled * (abs_first / jnp.where(increase > 0, increase, 1.0)),
-                jnp.inf)
-            dur_start = jnp.minimum(dur_start, dur_zero)
-        extrap = (
-            sampled
-            + jnp.where(dur_start < threshold, dur_start, avg_dur / 2)
-            + jnp.where(dur_end < threshold, dur_end, avg_dur / 2)
-        )
-        out = increase * (extrap / jnp.where(sampled > 0, sampled, 1.0))
-        if is_rate:
-            out = out / range_s
-        return jnp.where(ok & (sampled > 0), out, jnp.nan)
+    return jax.jit(functools.partial(
+        rate_math, W=W, step_s=step_s, range_s=range_s,
+        is_counter=is_counter, is_rate=is_rate))
 
-    return jax.jit(fn)
+
+def rate_math(adj, finite, grid32=None, *, W, step_s, range_s, is_counter,
+              is_rate):
+    """The traceable body of the fused rate kernel — importable by sharded
+    query paths (m3_tpu/parallel/query.py wraps it in shard_map)."""
+    T = finite.shape[-1]
+    t_off = jnp.arange(T - W + 1, dtype=jnp.int32)[None, :]
+    cnt = _wsum(finite, W)
+    fa = _first_abs(finite, W)
+    la = _last_abs(finite, W)
+    # Only cells strictly after the window's first valid sample
+    # contribute — their previous-valid reference is inside the window,
+    # so the window increase is the full adj sum minus the first valid
+    # cell's adj (whose reference precedes the window).
+    increase = _wsum(adj, W) - _take_t(adj, fa)
+    ok = cnt >= 2
+    fcnt = cnt
+    fi = (fa - t_off).astype(_F32)
+    li = (la - t_off).astype(_F32)
+    dur_start = (fi + 1) * step_s
+    dur_end = (W - 1 - li) * step_s
+    sampled = (li - fi) * step_s
+    avg_dur = sampled / jnp.maximum(fcnt - 1, 1)
+    threshold = avg_dur * 1.1
+    if is_counter:
+        abs_first = _take_t(grid32, fa)
+        dur_zero = jnp.where(
+            (increase > 0) & (abs_first >= 0),
+            sampled * (abs_first / jnp.where(increase > 0, increase, 1.0)),
+            jnp.inf)
+        dur_start = jnp.minimum(dur_start, dur_zero)
+    extrap = (
+        sampled
+        + jnp.where(dur_start < threshold, dur_start, avg_dur / 2)
+        + jnp.where(dur_end < threshold, dur_end, avg_dur / 2)
+    )
+    out = increase * (extrap / jnp.where(sampled > 0, sampled, 1.0))
+    if is_rate:
+        out = out / range_s
+    return jnp.where(ok & (sampled > 0), out, jnp.nan)
 
 
 def _host_diff_grid(grid: np.ndarray, is_counter: bool):
@@ -245,17 +251,25 @@ def _host_diff_grid(grid: np.ndarray, is_counter: bool):
     return adj.astype(np.float32), finite
 
 
+def rate_inputs(grid: np.ndarray, is_counter: bool):
+    """Host prep shared by the single-device and sharded rate paths:
+    (adj f32, finite bool, grid32 f32-or-None). NaNs become 0 in the f32
+    grid copy (validity rides `finite`); the gather target must be
+    NaN-free so inf*0 artifacts can't appear. grid32 is None for
+    non-counters — only the counter zero-clamp reads it."""
+    adj, finite = _host_diff_grid(grid, is_counter)
+    grid32 = (np.where(finite, grid, 0.0).astype(np.float32)
+              if is_counter else None)
+    return adj, finite, grid32
+
+
 def _extrapolated(grid: np.ndarray, W: int, step_ns: int, range_ns: int,
                   is_counter: bool, is_rate: bool) -> np.ndarray:
     """Host side of rate/increase/delta: the f64 diff pass feeds the fused
     device kernel; one f32 result comes back."""
-    adj, finite = _host_diff_grid(grid, is_counter)
+    adj, finite, grid32 = rate_inputs(grid, is_counter)
     fn = _rate_fn(W, step_ns / 1e9, range_ns / 1e9, is_counter, is_rate)
     if is_counter:
-        # NaNs become 0 in the f32 grid copy (validity rides `finite`); the
-        # gather target must be NaN-free so inf*0 artifacts can't appear.
-        # Only the counter zero-clamp reads it — delta() skips the upload.
-        grid32 = np.where(finite, grid, 0.0).astype(np.float32)
         out = fn(_cached_put(adj), _cached_put(finite), _cached_put(grid32))
     else:
         out = fn(_cached_put(adj), _cached_put(finite))
