@@ -226,7 +226,7 @@ class HTTPAdminServer:
                 elif self.path == "/status":
                     election = getattr(agg, "_election", None)
                     flush = {
-                        "electionState": (election.state().name.lower()
+                        "electionState": (election.state.name.lower()
                                           if election else "leader"),
                         "canLead": (election.is_leader()
                                     if election else True),

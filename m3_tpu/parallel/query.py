@@ -88,3 +88,16 @@ def sum_rate(grid: np.ndarray, mesh: Mesh, *, W: int, step_ns: int,
     total = np.asarray(total, np.float64)
     n = np.asarray(n)
     return np.where(n > 0, total, np.nan)
+
+
+def sum_rate_host_reference(grid: np.ndarray, *, W: int, step_ns: int,
+                            range_ns: int) -> np.ndarray:
+    """Single-device reference semantics for sum_rate — the definition the
+    sharded path is verified against (per-series rate, NaN-excluding sum,
+    NaN where no series had a full window). Used by the multichip dryrun
+    and tests so the oracle lives in exactly one place."""
+    per_series = temporal.rate(grid, W, step_ns, range_ns)
+    finite = np.isfinite(per_series)
+    return np.where(finite.any(axis=0),
+                    np.nansum(np.where(finite, per_series, 0.0), axis=0),
+                    np.nan)

@@ -38,6 +38,9 @@ def main(argv=None):
             on_placement=lambda shards: print(
                 f"placement update: owned={shards}", flush=True))
         print(f"m3_tpu aggregator listening on {handle.endpoint}", flush=True)
+        if handle.admin is not None:
+            print(f"m3_tpu aggregator admin on {handle.admin_endpoint}",
+                  flush=True)
     elif args.service == "kv":
         handle = runmod.run_kv(cfg)
         print(f"m3_tpu kv listening on {handle.endpoint}", flush=True)

@@ -68,6 +68,8 @@ class CoordinatorConfig:
 class AggregatorConfig:
     instance_id: str = "agg_local"
     listen_address: str = "127.0.0.1:0"
+    # HTTP admin sidecar (health/status/resign); empty disables it.
+    admin_address: str = ""
     num_shards: int = 64
     shard_set_id: str = "shardset-0"
     election_id: str = "agg-election"

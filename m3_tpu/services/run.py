@@ -158,14 +158,21 @@ class AggregatorHandle:
     server: RawTCPServer
     flush_thread: Optional[threading.Thread]
     kv: cluster_kv.MemStore
+    admin: Optional[object] = None   # HTTPAdminServer when configured
     _stop: threading.Event = dataclasses.field(default_factory=threading.Event)
 
     @property
     def endpoint(self) -> str:
         return self.server.endpoint
 
+    @property
+    def admin_endpoint(self) -> str:
+        return self.admin.endpoint if self.admin is not None else ""
+
     def close(self):
         self._stop.set()
+        if self.admin is not None:
+            self.admin.close()
         self.server.close()
 
 
@@ -224,7 +231,19 @@ def run_aggregator(cfg: AggregatorConfig, flush_handler=None,
 
         kv.on_change(cfg.placement_key, _on_placement)
 
-    handle = AggregatorHandle(agg, server, None, kv)
+    admin = None
+    if cfg.admin_address:
+        from ..aggregator.server import HTTPAdminServer
+
+        try:
+            ah, ap = _host_port(cfg.admin_address)
+            admin = HTTPAdminServer(agg, host=ah, port=ap).start()
+        except Exception:
+            # Don't leak the already-bound ingest server/threads when the
+            # admin port can't bind — the caller gets no handle to close.
+            server.close()
+            raise
+    handle = AggregatorHandle(agg, server, None, kv, admin)
     interval_s = parse_duration_ns(cfg.flush_interval) / 1e9
 
     def flush_loop():

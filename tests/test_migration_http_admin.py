@@ -180,3 +180,29 @@ def test_http_admin_health_status_resign():
             assert e.code == 404
     finally:
         srv.close()
+
+
+def test_admin_sidecar_via_service_assembly():
+    """run_aggregator with admin_address starts the sidecar; /status
+    reflects the election-managed aggregator and /resign steps down."""
+    from m3_tpu.services import config as svc_config
+    from m3_tpu.services import run as svc_run
+
+    cfg = svc_config.load_dict(
+        {"flush_interval": "1s", "num_shards": 4,
+         "admin_address": "127.0.0.1:0"}, "aggregator")
+    handle = svc_run.run_aggregator(cfg, flush_handler=CaptureHandler())
+    try:
+        assert handle.admin_endpoint
+        with urllib.request.urlopen(handle.admin_endpoint + "/health") as r:
+            assert json.loads(r.read()) == {"state": "OK"}
+        with urllib.request.urlopen(handle.admin_endpoint + "/status") as r:
+            st = json.loads(r.read())["status"]
+        assert st["flushStatus"]["electionState"] in (
+            "leader", "follower", "pending_follower")
+        req = urllib.request.Request(handle.admin_endpoint + "/resign",
+                                     data=b"", method="POST")
+        with urllib.request.urlopen(req) as r:
+            assert json.loads(r.read()) == {"state": "OK"}
+    finally:
+        handle.close()
