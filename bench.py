@@ -401,6 +401,20 @@ def _child_main():
         raise SystemExit(1)
 
 
+def _strip_accel_site(env: dict) -> dict:
+    """Remove the TPU-plugin site hook from PYTHONPATH for CPU children.
+    The hook contacts the accelerator relay at interpreter start; when the
+    tunnel is down that hangs `import jax` even under JAX_PLATFORMS=cpu —
+    which would turn the CPU FALLBACK into a second hang. Observed live
+    (axon relay death mid-session). Matches the exact site-dir component
+    (".axon_site"), not a substring, so unrelated user paths survive.
+    Shared with __graft_entry__.dryrun_multichip."""
+    parts = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+             if p and os.path.basename(os.path.normpath(p)) != ".axon_site"]
+    env["PYTHONPATH"] = os.pathsep.join(parts)
+    return env
+
+
 def _spawn_child(force_cpu: bool, only=None):
     env = dict(os.environ)
     if only is not None:
@@ -408,6 +422,7 @@ def _spawn_child(force_cpu: bool, only=None):
     if force_cpu:
         env["BENCH_FORCE_CPU"] = "1"
         env["JAX_PLATFORMS"] = "cpu"
+        env = _strip_accel_site(env)
     timeout_s = _CPU_TIMEOUT_S if force_cpu else _TPU_TIMEOUT_S
     try:
         proc = subprocess.run(
