@@ -52,6 +52,9 @@ def main(argv=None):
             return 2
         handle = runmod.run_coordinator_standalone(cfg)
         print(f"m3_tpu coordinator listening on {handle.endpoint}", flush=True)
+        carbon = getattr(handle, "carbon", None)
+        if carbon is not None:
+            print(f"m3_tpu carbon listening on {carbon.endpoint}", flush=True)
     else:
         print("collector runs embedded; see m3_tpu.services.run.run_collector",
               file=sys.stderr)

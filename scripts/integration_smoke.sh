@@ -72,12 +72,15 @@ EOF
 cat > "$WORKDIR/coord.yml" <<EOF
 namespace: default
 kv_endpoint: $KV
+carbon_listen_address: 127.0.0.1:0
 EOF
 python -m m3_tpu.services coordinator -f "$WORKDIR/coord.yml" > "$WORKDIR/coord.log" 2>&1 &
 PIDS+=($!)
 await_log "$WORKDIR/coord.log" "m3_tpu coordinator listening on"
 COORD=$(grep "m3_tpu coordinator listening on" "$WORKDIR/coord.log" | awk '{print $NF}')
-echo "coordinator: $COORD"
+await_log "$WORKDIR/coord.log" "m3_tpu carbon listening on"
+CARBON=$(grep "m3_tpu carbon listening on" "$WORKDIR/coord.log" | awk '{print $NF}')
+echo "coordinator: $COORD  carbon: $CARBON"
 
 curl -fsS "$COORD/health" > /dev/null
 
@@ -155,5 +158,56 @@ print("aggregator placement changed (added agg-b)")
 EOF
 await_log "$WORKDIR/aggb.log" "placement update: owned=\[[0-7]"
 echo "agg-b picked up shards from the placement change via watch (no restart)"
+
+# --- 7. prometheus flavor: real snappy+protobuf remote write/read ---------
+# (reference: scripts/docker-integration-tests/prometheus/test.sh — a real
+# Prometheus remote_write body, not JSON.)
+python - "$COORD" "$NOW" <<'EOF'
+import sys, urllib.request, json
+from m3_tpu.coordinator import promremote
+coord, now = sys.argv[1], int(sys.argv[2])
+body = promremote.snappy_compress(promremote.encode_write_request([
+    ({b"__name__": b"prom_remote_metric", b"job": b"smoke"},
+     [((now - 20 + i * 10) * 1000, 5.0 + i) for i in range(3)]),
+]))
+req = urllib.request.Request(coord + "/api/v1/prom/remote/write", data=body,
+                             method="POST",
+                             headers={"Content-Encoding": "snappy",
+                                      "Content-Type": "application/x-protobuf"})
+with urllib.request.urlopen(req) as r:
+    assert json.loads(r.read())["wrote"] == 3
+q = f"{coord}/api/v1/query_range?query=prom_remote_metric&start={now-30}&end={now}&step=10"
+with urllib.request.urlopen(q) as r:
+    out = json.loads(r.read())
+vals = [float(v) for _, v in out["data"]["result"][0]["values"]]
+assert vals[-1] == 7.0, vals
+print("prometheus snappy+protobuf remote write -> query_range OK")
+EOF
+
+# --- 8. carbon flavor: graphite line in -> render out ---------------------
+# (reference: scripts/docker-integration-tests/carbon/test.sh)
+python - "$CARBON" "$COORD" "$NOW" <<'EOF'
+import sys, socket, time, urllib.request, json
+carbon, coord, now = sys.argv[1], sys.argv[2], int(sys.argv[3])
+host, _, port = carbon.rpartition(":")
+with socket.create_connection((host, int(port)), timeout=5) as s:
+    for i in range(3):
+        s.sendall(b"smoke.carbon.count %d %d\n" % (100 + i, now - 20 + i * 10))
+deadline = time.time() + 10
+out, vals = None, []
+while time.time() < deadline:
+    q = f"{coord}/api/v1/graphite/render?target=smoke.carbon.count&from={now-30}&until={now}&step=10"
+    with urllib.request.urlopen(q) as r:
+        out = json.loads(r.read())
+    vals = [v for v, _ in out[0]["datapoints"] if v is not None] if out else []
+    # All three lines ingest asynchronously: wait for the full batch, not
+    # the first arrival, before asserting the final value.
+    if len(vals) == 3:
+        break
+    time.sleep(0.2)
+assert len(vals) == 3 and vals[-1] == 102.0, out
+assert out[0]["target"] == "smoke.carbon.count"
+print("carbon line in -> graphite render OK")
+EOF
 
 echo "SMOKE PASS"
