@@ -179,6 +179,7 @@ class Engine:
         "sum_over_time", "avg_over_time", "min_over_time", "max_over_time",
         "count_over_time", "last_over_time", "stddev_over_time",
         "stdvar_over_time", "present_over_time", "quantile_over_time",
+        "absent_over_time",
     }
 
     def _eval_call(self, node: Call, params: QueryParams) -> Value:
@@ -219,6 +220,18 @@ class Engine:
             out = temporal.resets(grid, W)
         elif f == "quantile_over_time":
             out = temporal.quantile_over_time(grid, W, _const_param(node.args[0]))
+        elif f == "absent_over_time":
+            # 1 at steps where NO series has a sample in the window
+            # (functions.go funcAbsentOverTime), labelled from the
+            # selector's equality matchers like absent().
+            t_out = ext.meta.steps - W + 1
+            if ext.n_series:
+                cnt = temporal.over_time(grid, W, "count")
+                present = np.nan_to_num(cnt).sum(axis=0) > 0
+            else:
+                present = np.zeros(t_out, dtype=bool)
+            out = np.where(present, np.nan, 1.0)[None, ::stride]
+            return Block(params.meta(), [_absent_tags(sel)], out)
         else:
             kind = f[: -len("_over_time")]
             out = temporal.over_time(grid, W, kind)
@@ -231,6 +244,23 @@ class Engine:
         f = node.func
         if f == "time":
             return params.meta().times() / 1e9
+        if f == "pi":
+            return float(np.pi)
+        if f in _DATE_FUNCS:
+            # promql date functions: no argument means "now" per step
+            # (functions.go dateWrapper); with a vector, per-sample values.
+            if node.args:
+                block = self._eval(node.args[0], params)
+                if not isinstance(block, Block):
+                    raise QueryError(f"{f} expects an instant vector")
+                vals = _date_part(f, block.values)
+                return block.with_values(
+                    vals, [_strip_name(t) for t in block.series_tags])
+            # dateWrapper emits a one-series vector with empty labels, so
+            # `x and on() (hour() < 6)` vector-matches like in Prometheus.
+            times = params.meta().times() / 1e9
+            return Block(params.meta(), [Tags.of({})],
+                         _date_part(f, times)[None, :])
         if f == "scalar":
             block = self._eval(node.args[0], params)
             if not isinstance(block, Block):
@@ -396,7 +426,58 @@ _MATH_FUNCS: Dict[str, Callable] = {
     "clamp": lambda v, lo, hi: np.clip(v, lo, hi),
     "clamp_min": lambda v, lo: np.maximum(v, lo),
     "clamp_max": lambda v, hi: np.minimum(v, hi),
+    # trigonometry (promql functions.go funcSin..funcAtanh; domain errors
+    # yield NaN like Go's math package)
+    "sin": np.sin, "cos": np.cos, "tan": np.tan,
+    "asin": lambda v: _guard(np.arcsin, v),
+    "acos": lambda v: _guard(np.arccos, v),
+    "atan": np.arctan,
+    "sinh": np.sinh, "cosh": np.cosh, "tanh": np.tanh,
+    "asinh": np.arcsinh,
+    "acosh": lambda v: _guard(np.arccosh, v),
+    "atanh": lambda v: _guard(np.arctanh, v),
+    "deg": np.degrees, "rad": np.radians,
 }
+
+
+def _date_part(kind: str, sec: np.ndarray) -> np.ndarray:
+    """One calendar component of unix-seconds values (UTC), NaN-preserving
+    — promql functions.go funcDaysInMonth..funcYear. Computes only the
+    requested component (a vector query pays one decomposition, not 8)."""
+    finite = np.isfinite(sec)
+    s = np.where(finite, sec, 0.0).astype(np.int64)
+    if kind == "minute":
+        v = (s // 60) % 60
+    elif kind == "hour":
+        v = (s // 3600) % 24
+    elif kind == "day_of_week":
+        # unix epoch was a Thursday; promql uses 0=Sunday
+        v = (s // 86400 + 4) % 7
+    else:
+        dt = s.astype("datetime64[s]")
+        if kind == "year":
+            v = dt.astype("datetime64[Y]").astype(np.int64) + 1970
+        elif kind == "month":
+            v = dt.astype("datetime64[M]").astype(np.int64) % 12 + 1
+        elif kind == "day_of_month":
+            v = (dt.astype("datetime64[D]")
+                 - dt.astype("datetime64[M]").astype("datetime64[D]")
+                 ).astype(np.int64) + 1
+        elif kind == "day_of_year":
+            v = (dt.astype("datetime64[D]")
+                 - dt.astype("datetime64[Y]").astype("datetime64[D]")
+                 ).astype(np.int64) + 1
+        elif kind == "days_in_month":
+            months = dt.astype("datetime64[M]")
+            v = ((months + np.timedelta64(1, "M")).astype("datetime64[D]")
+                 - months.astype("datetime64[D]")).astype(np.int64)
+        else:
+            raise QueryError(f"unknown date function {kind}")
+    return np.where(finite, v.astype(np.float64), np.nan)
+
+
+_DATE_FUNCS = ("minute", "hour", "day_of_week", "day_of_month",
+               "day_of_year", "days_in_month", "month", "year")
 
 _BIN_FUNCS: Dict[str, Callable] = {
     "+": np.add, "-": np.subtract, "*": np.multiply,

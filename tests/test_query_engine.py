@@ -357,3 +357,61 @@ class TestHistogramQuantile:
         blk = run(eng, "histogram_quantile(0.99, req_duration_bucket)")
         # above 90% -> +Inf bucket -> returns lower bound 0.5
         np.testing.assert_allclose(blk.values[0], 0.5)
+
+
+class TestMathDateFunctions:
+    """Round-4 function-table completion: trig, date, pi, absent_over_time
+    (promql functions.go parity)."""
+
+    def test_trig_family(self, engine):
+        base = run(engine, "http_requests_total")
+        for name, fn in [("sin", np.sin), ("cos", np.cos), ("tan", np.tan),
+                         ("atan", np.arctan), ("sinh", np.sinh),
+                         ("tanh", np.tanh), ("asinh", np.arcsinh)]:
+            blk = run(engine, f"{name}(http_requests_total)")
+            np.testing.assert_allclose(blk.values, fn(base.values),
+                                       rtol=1e-9, equal_nan=True)
+        blk = run(engine, "deg(rad(http_requests_total))")
+        np.testing.assert_allclose(blk.values, base.values, rtol=1e-9)
+        # domain errors yield NaN, not exceptions
+        blk = run(engine, "acos(http_requests_total)")
+        assert np.isnan(blk.values[base.values > 1]).all()
+
+    def test_pi_scalar(self, engine):
+        blk = run(engine, "vector(pi())")
+        np.testing.assert_allclose(blk.values, np.pi)
+
+    def test_date_functions_on_known_timestamp(self, engine):
+        # 2021-02-15T12:34:56Z
+        ts = 1613392496.0
+        for name, want in [("year", 2021), ("month", 2), ("day_of_month", 15),
+                           ("day_of_week", 1), ("hour", 12), ("minute", 34),
+                           ("day_of_year", 46), ("days_in_month", 28)]:
+            blk = run(engine, f"{name}(vector({ts}))")
+            assert (blk.values == float(want)).all(), (name, blk.values)
+
+    def test_date_no_arg_uses_eval_time(self, engine):
+        blk = run(engine, "year()")
+        t = run(engine, "vector(time())")
+        import datetime as dt
+        want = [dt.datetime.fromtimestamp(v, dt.timezone.utc).year
+                for v in t.values[0]]
+        np.testing.assert_array_equal(blk.values[0], want)
+
+    def test_absent_over_time(self, engine):
+        blk = run(engine, "absent_over_time(http_requests_total[2m])")
+        assert blk.n_series == 1
+        assert np.isnan(blk.values).all()  # data exists everywhere
+        blk = run(engine, 'absent_over_time(no_such_metric{job="x"}[2m])')
+        assert blk.n_series == 1
+        assert (blk.values == 1.0).all()
+        assert blk.series_tags[0].as_dict().get(b"job") == b"x"
+
+    def test_date_no_arg_is_vector(self, engine):
+        """dateWrapper emits a one-series vector with empty labels, so
+        `x and on() (hour() < 24)` vector-matches (the alerting idiom)."""
+        blk = run(engine, "memory_bytes and on() (hour() < 24)")
+        assert blk.n_series == 2
+        blk = run(engine, "memory_bytes and on() (hour() > 24)")
+        finite = np.isfinite(blk.values)
+        assert not finite.any()
