@@ -356,6 +356,13 @@ class Engine:
                    if vals.shape[0] < 4096 else
                    series_agg.grouped_reduce(vals, group_ids, G, op))
             return Block(block.meta, group_tags, out)
+        if op == "group":
+            # promql group(): 1 for every group with any present series.
+            cnt = (series_agg.grouped_reduce_f64(vals, group_ids, G, "count")
+                   if vals.shape[0] < 4096 else
+                   series_agg.grouped_reduce(vals, group_ids, G, "count"))
+            out = np.where(np.nan_to_num(cnt) > 0, 1.0, np.nan)
+            return Block(block.meta, group_tags, out)
         if op == "quantile":
             q = _const_param(node.param)
             out = series_agg.grouped_quantile(vals, group_ids, G, q)

@@ -132,7 +132,7 @@ class Unary(Node):
 
 
 AGG_OPS = {"sum", "min", "max", "avg", "count", "stddev", "stdvar",
-           "topk", "bottomk", "quantile", "count_values"}
+           "topk", "bottomk", "quantile", "count_values", "group"}
 _PARAM_AGGS = {"topk", "bottomk", "quantile", "count_values"}
 
 # precedence (prom): or < and/unless < comparisons < +- < */% < ^

@@ -415,3 +415,11 @@ class TestMathDateFunctions:
         blk = run(engine, "memory_bytes and on() (hour() > 24)")
         finite = np.isfinite(blk.values)
         assert not finite.any()
+
+
+def test_group_aggregation(engine):
+    blk = run(engine, "group by (job) (http_requests_total)")
+    assert blk.n_series == 2
+    assert (blk.values == 1.0).all()
+    blk = run(engine, "group(memory_bytes)")
+    assert blk.n_series == 1 and (blk.values == 1.0).all()
