@@ -348,20 +348,18 @@ class Engine:
         G = len(group_tags)
         vals = block.values
         op = node.op
-        if op in ("sum", "avg", "min", "max", "count", "stddev", "stdvar"):
+        if op in ("sum", "avg", "min", "max", "count", "stddev", "stdvar",
+                  "group"):
             # f64 host reduce keeps counter-sum exactness; the jitted f32
             # segment kernel (series_agg.grouped_reduce) is the fast path
             # for large fan-in where 24-bit mantissas suffice.
-            out = (series_agg.grouped_reduce_f64(vals, group_ids, G, op)
+            kind = "count" if op == "group" else op
+            out = (series_agg.grouped_reduce_f64(vals, group_ids, G, kind)
                    if vals.shape[0] < 4096 else
-                   series_agg.grouped_reduce(vals, group_ids, G, op))
-            return Block(block.meta, group_tags, out)
-        if op == "group":
-            # promql group(): 1 for every group with any present series.
-            cnt = (series_agg.grouped_reduce_f64(vals, group_ids, G, "count")
-                   if vals.shape[0] < 4096 else
-                   series_agg.grouped_reduce(vals, group_ids, G, "count"))
-            out = np.where(np.nan_to_num(cnt) > 0, 1.0, np.nan)
+                   series_agg.grouped_reduce(vals, group_ids, G, kind))
+            if op == "group":
+                # promql group(): 1 per group with any present series.
+                out = np.where(out > 0, 1.0, np.nan)
             return Block(block.meta, group_tags, out)
         if op == "quantile":
             q = _const_param(node.param)
