@@ -202,6 +202,25 @@ def merge_sealed_blocks(b1: SealedBlock, b2: SealedBlock) -> SealedBlock:
         npoints[both] = b1.npoints[i1] + b2.npoints[i2]
         same_epoch[both] = np.asarray(
             (h1["int_mode"] == h2["int_mode"]) & (h1["k"] == h2["k"]))
+        # When b2 contributed exactly ONE point, b2's sealed
+        # last_vdelta_bits is 0 (there is no intra-b2 value delta), but the
+        # MERGED stream's final value-delta is m2[0] - m1[last] — the
+        # boundary delta the merge just encoded. Copying b2's 0 verbatim
+        # would make a later concat of the compacted block encode the next
+        # double-delta against 0 while the decoder's prev_vdelta register
+        # (ref_codec int-mode codes are stateful double-deltas) holds the
+        # true delta, silently corrupting values. Recompute it from b1's
+        # seal metadata where trustworthy; rows with stale b1 metadata are
+        # pushed onto the recode path of the NEXT merge instead.
+        single2 = b2.npoints[i2] < 2
+        m0_2 = b64.to_u64_np(*(np.asarray(a) for a in h2["v0"]))
+        fixed_vdelta = np.where(
+            np.asarray(h2["int_mode"]),
+            (m0_2.astype(np.int64)
+             - b1.boundary["last_v_bits"][i1].astype(np.int64)
+             ).view(np.uint64),
+            np.uint64(0))
+        vdelta_trusted = ~stale & single2
 
     boundary2 = None
     if b2.boundary is not None:
@@ -221,6 +240,11 @@ def merge_sealed_blocks(b1: SealedBlock, b2: SealedBlock) -> SealedBlock:
         # Epoch-mismatched rows were re-encoded with fresh mode detection:
         # b2's stream-space metadata no longer describes the merged stream.
         valid &= same_epoch
+        if both.any():
+            u_both = np.flatnonzero(both)
+            boundary2["last_vdelta_bits"][u_both[vdelta_trusted]] = \
+                fixed_vdelta[vdelta_trusted]
+            valid[u_both[single2 & stale]] = False
         boundary2["valid"] = valid
 
     return SealedBlock(

@@ -225,6 +225,71 @@ class TestSealedBlockMerge:
         np.testing.assert_array_equal(
             v_m2, np.concatenate([v1[1], v2[0], v3[0]]))
 
+    def test_chained_merge_single_point_middle_block(self):
+        """Regression: when b2 contributes exactly ONE point, the merged
+        block's last_vdelta_bits must be m2[0] - m1[last] (the boundary
+        delta), NOT b2's sealed 0 — otherwise a later concat of the
+        compacted block encodes the next double-delta against a stale 0
+        and silently corrupts decoded values."""
+        from m3_tpu.storage.block import encode_block, merge_sealed_blocks
+        S = 10**9
+        half = 8
+        # +1s offset keeps every block on SECOND ticks (a minute-aligned
+        # single-point b2 would pick a coarser unit and dodge the concat
+        # metadata path via the full-recode fallback).
+        t1 = (np.int64(1_600_000_001) * S
+              + np.arange(half, dtype=np.int64)[None, :] * 10 * S)
+        # single-point middle block at the next cadence slot
+        t2 = t1[:, :1] + half * 10 * S
+        t3 = t1 + (half + 1) * 10 * S
+        v1 = 100.0 + 2.0 * np.arange(half, dtype=np.float64)[None, :]
+        v2 = np.array([[200.0]])  # boundary vdelta = 200 - 114 = 86, not 0
+        v3 = 210.0 + 10.0 * np.arange(half, dtype=np.float64)[None, :]
+        full = np.array([half], np.int32)
+        b1 = encode_block(0, [7], t1.copy(), v1, full)
+        b2 = encode_block(1, [7], t2.copy(), v2, np.array([1], np.int32))
+        b3 = encode_block(2, [7], t3.copy(), v3, full)
+        merged = merge_sealed_blocks(b1, b2)
+        assert int(merged.npoints[0]) == half + 1
+        # Ground truth boundary metadata: encode the union from scratch.
+        t12 = np.concatenate([t1, np.broadcast_to(t2, (1, 1))], axis=1)
+        v12 = np.concatenate([v1, v2], axis=1)
+        fresh = encode_block(0, [7], t12, v12,
+                             np.array([half + 1], np.int32))
+        assert merged.boundary is not None and merged.boundary["valid"][0]
+        np.testing.assert_array_equal(
+            merged.boundary["last_vdelta_bits"],
+            fresh.boundary["last_vdelta_bits"])
+        # Chained merge through the storage layer round-trips.
+        merged2 = merge_sealed_blocks(merged, b3)
+        ts_m, v_m = merged2.read(7)
+        np.testing.assert_array_equal(
+            v_m, np.concatenate([v1[0], v2[0], v3[0]]))
+        # And the scan-free concat itself (forced, since host CPU defaults
+        # to the recode path) must produce a decode-equal stream when fed
+        # the merged block's carried-forward metadata.
+        unit = merged.time_unit.nanos
+        h3 = tsz_concat.parse_header(b3.words)
+        t3_0 = b64.to_u64_np(*(np.asarray(a) for a in h3["t0"])
+                             ).astype(np.int64)
+        boundary_dt = (t3_0 - merged.boundary["last_ticks"]).astype(np.int32)
+        mw = tsz.max_words_for(merged.window + b3.window)
+        w, nb = tsz_concat.merge_adjacent(
+            merged.words, merged.nbits, merged.npoints,
+            b3.words, b3.nbits, b3.npoints, boundary_dt,
+            b64.from_u64_np(merged.boundary["last_v_bits"]),
+            b64.from_u64_np(merged.boundary["last_vdelta_bits"]),
+            half_window=max(merged.window, b3.window), max_words=mw,
+            strategy="concat")
+        ts_c, v_c = tsz.decode(w, merged.npoints + b3.npoints,
+                               window=merged.window + b3.window)
+        n_all = half + 1 + half
+        np.testing.assert_array_equal(
+            v_c[0, :n_all], np.concatenate([v1[0], v2[0], v3[0]]))
+        np.testing.assert_array_equal(
+            ts_c[0, :n_all] * unit,
+            np.concatenate([t1[0], t2[0], t3[0]]))
+
     def test_merge_without_metadata_falls_back(self):
         from m3_tpu.storage.block import merge_sealed_blocks
         rng = np.random.default_rng(9)
