@@ -11,6 +11,7 @@ aggregation ring (forwarded_writer.go)."""
 
 from __future__ import annotations
 
+import threading
 import time as _time
 from typing import Callable, Dict, List, Optional, Sequence
 
@@ -118,8 +119,12 @@ class Aggregator:
         self.writes_for_unowned_shard = 0
         # Accepted forwarded partials (tally counter analog; lets tests and
         # operators await "all N stage-1 partials arrived" instead of racing
-        # on first-entry creation).
+        # on first-entry creation). Incremented from concurrent
+        # per-connection handler threads — guard the non-atomic += the same
+        # way RawTCPServer guards frames/errors.
         self.forwarded_received = 0
+        self._stats_lock = threading.Lock()
+        self._shards_lock = threading.Lock()
 
     # -- placement ---------------------------------------------------------
 
@@ -155,12 +160,20 @@ class Aggregator:
     def _shard(self, metric_id: bytes) -> Optional[AggregatorShard]:
         sid = self.shard_for(metric_id)
         if sid not in self._owned:
-            self.writes_for_unowned_shard += 1
+            with self._stats_lock:
+                self.writes_for_unowned_shard += 1
             return None
         shard = self._shards.get(sid)
         if shard is None:
-            shard = self._shards[sid] = AggregatorShard(
-                sid, self._clock, self._rate_limit, self._default_policies)
+            # Check-then-create under the lock: concurrent connection
+            # handler threads must not each construct the shard — the loser's
+            # writes would land in an orphaned object and never flush.
+            with self._shards_lock:
+                shard = self._shards.get(sid)
+                if shard is None:
+                    shard = self._shards[sid] = AggregatorShard(
+                        sid, self._clock, self._rate_limit,
+                        self._default_policies)
         return shard if shard.is_writeable() else None
 
     # -- ingest ------------------------------------------------------------
@@ -183,7 +196,8 @@ class Aggregator:
         ok = shard is not None and shard.map.add_forwarded(
             metric_type, metric_id, t_nanos, value, meta)
         if ok:
-            self.forwarded_received += 1
+            with self._stats_lock:
+                self.forwarded_received += 1
         return ok
 
     # -- flush/tick --------------------------------------------------------
@@ -210,8 +224,9 @@ class Aggregator:
 
         now = self._clock() if now_nanos is None else now_nanos
         jobs, commits = [], []
-        for sid in sorted(self._shards):
-            shard = self._shards[sid]
+        with self._shards_lock:  # snapshot: handler threads insert shards
+            shards = {sid: self._shards[sid] for sid in sorted(self._shards)}
+        for sid, shard in shards.items():
             if self._election is not None:
                 shard_jobs, commit = self._flush_mgr(shard).plan(now)
                 jobs.extend(shard_jobs)
@@ -226,7 +241,11 @@ class Aggregator:
 
     def tick(self) -> int:
         """Expire idle entries across shards (aggregator.go tickInternal)."""
-        return sum(s.map.tick() for s in self._shards.values())
+        with self._shards_lock:
+            shards = list(self._shards.values())
+        return sum(s.map.tick() for s in shards)
 
     def num_entries(self) -> int:
-        return sum(len(s.map) for s in self._shards.values())
+        with self._shards_lock:
+            shards = list(self._shards.values())
+        return sum(len(s.map) for s in shards)

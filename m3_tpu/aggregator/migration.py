@@ -123,10 +123,20 @@ class MigrationReader:
                 self._fill(len(self._buf) + 1)
             line, _, rest = bytes(self._buf).partition(b"\n")
             self._buf = bytearray(rest)
-            # The line is consumed either way: a malformed record is
-            # recoverable, the next read starts at the next message.
+            # A line that isn't JSON at all means the sniff mis-fired — most
+            # likely a corrupt/oversize binary frame whose length LSB
+            # happened to be '{' — and the bytes consumed up to this
+            # arbitrary newline desynchronized the stream. That is NOT
+            # recoverable: re-raise as a plain error so the server tears the
+            # connection down instead of ingesting garbage. Only a
+            # well-formed JSON object with a bad schema keeps the
+            # frame-aligned recoverable contract.
             try:
-                return [legacy_to_entry(json.loads(line))]
+                rec = json.loads(line)
+            except ValueError as e:
+                raise ValueError(f"migration: stream desync (not JSON): {e}")
+            try:
+                return [legacy_to_entry(rec)]
             except (ValueError, KeyError, TypeError) as e:
                 raise RecoverableRecordError(f"bad legacy record: {e}")
         (n,) = _U32.unpack(self._take(4))

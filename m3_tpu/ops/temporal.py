@@ -19,7 +19,14 @@ Window convention: prom range selector (t-R, t] at step s with data grid at
 the same step: W = R/s cells, window w covers offsets (w+1-W)*s relative to
 the output time; column j of the extended grid is time
 start - (W-1)*s + j*s, so output step t reads columns [t, t+W).
-"""
+
+Result-transfer strategy (remote-tunnel TPUs are D2H-bound, ~20-80MB/s):
+every kernel takes a `stride` and consolidates to the query's OUTPUT step
+grid on device — when the window grid is finer than the query step (gcd
+gridding), the subsample happens before the transfer, not after. Counts
+ship as uint16 (window populations, exact), results as f32, and the
+*_async variants start the device->host copy eagerly so it overlaps the
+next query's host prep (double-buffering across a dashboard burst)."""
 
 from __future__ import annotations
 
@@ -61,6 +68,43 @@ def _cache_enabled() -> bool:
     # costs more than the memcpy it avoids and the cache would just pin
     # duplicate host arrays.
     return jax.default_backend() != "cpu"
+
+
+# Derived-input cache: device-resident (adj/finite/grid32) and
+# (resid/baseline) tuples keyed by the f64 source grid's content. A
+# dashboard burst re-derives the SAME grid for every query; one 16-byte
+# blake2b of the grid replaces the f64 diff/center host passes plus three
+# per-array upload-cache hashes. Entries hold device memory, so the budget
+# is device bytes, shared-lock with the upload cache.
+_DERIVED_CACHE: "collections.OrderedDict[tuple, tuple]" = collections.OrderedDict()
+_DERIVED_CACHE_MAX_BYTES = int(os.environ.get(
+    "M3_TPU_DERIVED_CACHE_BYTES", str(256 * 1024 * 1024)))
+_derived_cache_bytes = 0
+
+
+def _derived(grid: np.ndarray, kind: str, build):
+    """build(grid) -> (value tuple, charged bytes); cached by grid content
+    when a real accelerator is attached, rebuilt every call on host CPU."""
+    global _derived_cache_bytes
+    if not _cache_enabled():
+        return build(grid)[0]
+    g = np.ascontiguousarray(grid)
+    key = (hashlib.blake2b(g, digest_size=16).digest(), g.shape, kind)
+    with _PUT_CACHE_LOCK:
+        hit = _DERIVED_CACHE.get(key)
+        if hit is not None:
+            _DERIVED_CACHE.move_to_end(key)
+            return hit[0]
+    val, nbytes = build(g)
+    with _PUT_CACHE_LOCK:
+        if key not in _DERIVED_CACHE:
+            _DERIVED_CACHE[key] = (val, nbytes)
+            _derived_cache_bytes += nbytes
+        while (_derived_cache_bytes > _DERIVED_CACHE_MAX_BYTES
+               and len(_DERIVED_CACHE) > 1):
+            _, (_, freed) = _DERIVED_CACHE.popitem(last=False)
+            _derived_cache_bytes -= freed
+    return val
 
 
 def _cached_put(arr: np.ndarray):
@@ -171,25 +215,26 @@ def _take_t(grid, abs_idx):
 
 @functools.lru_cache(maxsize=256)
 def _rate_fn(W: int, step_s: float, range_s: float, is_counter: bool,
-             is_rate: bool):
+             is_rate: bool, stride: int = 1):
     """Fused rate/increase/delta: window structure + promql's
-    extrapolatedRate finish, all on device, ONE f32 result transfer. The
-    f64-sensitive part (consecutive-diff adjustment) arrives pre-computed
-    from the host in residual space, so f32 here is exact for the
-    increase; the extrapolation scaling is a ~1.0x ratio where f32 noise
-    is far below the oracle tolerance. abs_first (counter zero-clamp) is
-    gathered from the f32 ABSOLUTE grid — never residual+baseline, which
-    cancels catastrophically after a counter reset; direct f32 is exact
-    for small post-reset values and ~1e-7 relative for large ones, where
-    dur_zero is far from binding."""
+    extrapolatedRate finish, all on device, ONE f32 result transfer
+    already consolidated to the output step grid. The f64-sensitive part
+    (consecutive-diff adjustment) arrives pre-computed from the host in
+    residual space, so f32 here is exact for the increase; the
+    extrapolation scaling is a ~1.0x ratio where f32 noise is far below
+    the oracle tolerance. abs_first (counter zero-clamp) is gathered from
+    the f32 ABSOLUTE grid — never residual+baseline, which cancels
+    catastrophically after a counter reset; direct f32 is exact for small
+    post-reset values and ~1e-7 relative for large ones, where dur_zero
+    is far from binding."""
 
     return jax.jit(functools.partial(
         rate_math, W=W, step_s=step_s, range_s=range_s,
-        is_counter=is_counter, is_rate=is_rate))
+        is_counter=is_counter, is_rate=is_rate, stride=stride))
 
 
 def rate_math(adj, finite, grid32=None, *, W, step_s, range_s, is_counter,
-              is_rate):
+              is_rate, stride=1):
     """The traceable body of the fused rate kernel — importable by sharded
     query paths (m3_tpu/parallel/query.py wraps it in shard_map)."""
     T = finite.shape[-1]
@@ -226,7 +271,7 @@ def rate_math(adj, finite, grid32=None, *, W, step_s, range_s, is_counter,
     out = increase * (extrap / jnp.where(sampled > 0, sampled, 1.0))
     if is_rate:
         out = out / range_s
-    return jnp.where(ok & (sampled > 0), out, jnp.nan)
+    return jnp.where(ok & (sampled > 0), out, jnp.nan)[..., ::stride]
 
 
 def _host_diff_grid(grid: np.ndarray, is_counter: bool):
@@ -263,17 +308,50 @@ def rate_inputs(grid: np.ndarray, is_counter: bool):
     return adj, finite, grid32
 
 
+def _copy_async(*arrs):
+    """Kick off device->host transfers without blocking (overlaps the next
+    query's host prep); a backend without the API just fetches later."""
+    for a in arrs:
+        start = getattr(a, "copy_to_host_async", None)
+        if start is not None:
+            try:
+                start()
+            except Exception:  # noqa: BLE001 - purely an overlap hint
+                pass
+
+
+def _rate_args(grid: np.ndarray, is_counter: bool):
+    """(adj, finite[, grid32]) ready for the fused rate kernel — device
+    resident and content-cached behind one grid digest on accelerators."""
+
+    def build(g):
+        adj, finite, grid32 = rate_inputs(g, is_counter)
+        arrs = (adj, finite) + ((grid32,) if is_counter else ())
+        if not _cache_enabled():
+            return arrs, 0
+        return tuple(jax.device_put(a) for a in arrs), sum(
+            a.nbytes for a in arrs)
+
+    return _derived(grid, f"rate:{is_counter}", build)
+
+
+def _extrapolated_async(grid: np.ndarray, W: int, step_ns: int, range_ns: int,
+                        is_counter: bool, is_rate: bool, stride: int):
+    """Dispatch side of rate/increase/delta: the f64 diff pass feeds the
+    fused device kernel; returns a fetch closure for the one f32 result
+    (already output-strided), whose async copy is started here."""
+    fn = _rate_fn(W, step_ns / 1e9, range_ns / 1e9, is_counter, is_rate,
+                  stride)
+    out = fn(*_rate_args(grid, is_counter))
+    _copy_async(out)
+    return lambda: np.asarray(out).astype(np.float64)
+
+
 def _extrapolated(grid: np.ndarray, W: int, step_ns: int, range_ns: int,
-                  is_counter: bool, is_rate: bool) -> np.ndarray:
-    """Host side of rate/increase/delta: the f64 diff pass feeds the fused
-    device kernel; one f32 result comes back."""
-    adj, finite, grid32 = rate_inputs(grid, is_counter)
-    fn = _rate_fn(W, step_ns / 1e9, range_ns / 1e9, is_counter, is_rate)
-    if is_counter:
-        out = fn(_cached_put(adj), _cached_put(finite), _cached_put(grid32))
-    else:
-        out = fn(_cached_put(adj), _cached_put(finite))
-    return np.asarray(out).astype(np.float64)
+                  is_counter: bool, is_rate: bool,
+                  stride: int = 1) -> np.ndarray:
+    return _extrapolated_async(grid, W, step_ns, range_ns, is_counter,
+                               is_rate, stride)()
 
 
 def _ffill(vol, mask):
@@ -289,20 +367,39 @@ def _gather_last(vol, run):
     return jnp.take_along_axis(vol, jnp.clip(run, 0, vol.shape[-1] - 1), axis=-1)
 
 
-def rate(grid: np.ndarray, W: int, step_ns: int, range_ns: int) -> np.ndarray:
-    return _extrapolated(grid, W, step_ns, range_ns, True, True)
+def rate(grid: np.ndarray, W: int, step_ns: int, range_ns: int,
+         stride: int = 1) -> np.ndarray:
+    return _extrapolated(grid, W, step_ns, range_ns, True, True, stride)
 
 
-def increase(grid: np.ndarray, W: int, step_ns: int, range_ns: int) -> np.ndarray:
-    return _extrapolated(grid, W, step_ns, range_ns, True, False)
+def rate_async(grid: np.ndarray, W: int, step_ns: int, range_ns: int,
+               stride: int = 1):
+    return _extrapolated_async(grid, W, step_ns, range_ns, True, True, stride)
 
 
-def delta(grid: np.ndarray, W: int, step_ns: int, range_ns: int) -> np.ndarray:
-    return _extrapolated(grid, W, step_ns, range_ns, False, False)
+def increase(grid: np.ndarray, W: int, step_ns: int, range_ns: int,
+             stride: int = 1) -> np.ndarray:
+    return _extrapolated(grid, W, step_ns, range_ns, True, False, stride)
+
+
+def increase_async(grid: np.ndarray, W: int, step_ns: int, range_ns: int,
+                   stride: int = 1):
+    return _extrapolated_async(grid, W, step_ns, range_ns, True, False, stride)
+
+
+def delta(grid: np.ndarray, W: int, step_ns: int, range_ns: int,
+          stride: int = 1) -> np.ndarray:
+    return _extrapolated(grid, W, step_ns, range_ns, False, False, stride)
+
+
+def delta_async(grid: np.ndarray, W: int, step_ns: int, range_ns: int,
+                stride: int = 1):
+    return _extrapolated_async(grid, W, step_ns, range_ns, False, False,
+                               stride)
 
 
 @functools.lru_cache(maxsize=256)
-def _last_two_idx_fn(W: int):
+def _last_two_idx_fn(W: int, stride: int = 1):
     """irate/idelta index pass: last two valid window indices."""
 
     def fn(finite):
@@ -311,22 +408,23 @@ def _last_two_idx_fn(W: int):
         last_i = jnp.where(mvol, Wr, -1).max(axis=-1)
         prev_mask = mvol & (Wr < last_i[..., None])
         prev_i = jnp.where(prev_mask, Wr, -1).max(axis=-1)
-        return jnp.stack([last_i, prev_i])
+        return jnp.stack([last_i, prev_i])[..., ::stride]
 
     return jax.jit(fn)
 
 
-def _instant(grid: np.ndarray, W: int, step_ns: int, is_rate: bool) -> np.ndarray:
+def _instant(grid: np.ndarray, W: int, step_ns: int, is_rate: bool,
+             stride: int = 1) -> np.ndarray:
     """temporal/rate.go irateFn / promql instantValue: last two valid
     samples; a counter reset (v_last < v_prev) rates from zero. Values are
     gathered from the f64 grid by device-computed indices."""
     finite = np.isfinite(grid)
-    packed = np.asarray(_last_two_idx_fn(W)(_cached_put(finite)))
+    packed = np.asarray(_last_two_idx_fn(W, stride)(_cached_put(finite)))
     last_i, prev_i = packed[0], packed[1]
     ok = prev_i >= 0
     S, T_out = last_i.shape
     rows = np.arange(S)[:, None]
-    t_base = np.arange(T_out)[None, :]
+    t_base = np.arange(T_out)[None, :] * stride
     v_last = grid[rows, t_base + np.clip(last_i, 0, W - 1)]
     v_prev = grid[rows, t_base + np.clip(prev_i, 0, W - 1)]
     dt = (last_i - prev_i) * (step_ns / 1e9)
@@ -339,12 +437,14 @@ def _instant(grid: np.ndarray, W: int, step_ns: int, is_rate: bool) -> np.ndarra
     return np.where(ok, out, np.nan)
 
 
-def irate(grid: np.ndarray, W: int, step_ns: int) -> np.ndarray:
-    return _instant(grid, W, step_ns, True)
+def irate(grid: np.ndarray, W: int, step_ns: int,
+          stride: int = 1) -> np.ndarray:
+    return _instant(grid, W, step_ns, True, stride)
 
 
-def idelta(grid: np.ndarray, W: int, step_ns: int) -> np.ndarray:
-    return _instant(grid, W, step_ns, False)
+def idelta(grid: np.ndarray, W: int, step_ns: int,
+           stride: int = 1) -> np.ndarray:
+    return _instant(grid, W, step_ns, False, stride)
 
 
 _OVER_TIME_STATS = {
@@ -355,76 +455,157 @@ _OVER_TIME_STATS = {
 }
 
 
+def _window_stat(resid, W: int, stat: str):
+    """Shared masked window-moment core: (stat plane, count plane)."""
+    mask = jnp.isfinite(resid)
+    cnt = _wsum(mask, W)
+    if stat == "count":
+        out = cnt
+    elif stat == "sum":
+        out = _wsum(jnp.where(mask, resid, 0.0), W)
+    elif stat == "min":
+        out = jax.lax.reduce_window(
+            jnp.where(mask, resid, jnp.inf), jnp.inf, jax.lax.min,
+            (1, W), (1, 1), "valid")
+    elif stat == "max":
+        out = jax.lax.reduce_window(
+            jnp.where(mask, resid, -jnp.inf), -jnp.inf, jax.lax.max,
+            (1, W), (1, 1), "valid")
+    elif stat == "last":
+        out = _take_t(jnp.where(mask, resid, 0.0), _last_abs(mask, W))
+    elif stat == "m2":
+        # Two-pass over the window volume: the cumsum sumsq-minus-mean
+        # form cancels catastrophically in f32 when |mu| >> sigma.
+        vol = _window_volume(resid, W)
+        vmask = jnp.isfinite(vol)
+        s = jnp.where(vmask, vol, 0.0).sum(axis=-1)
+        mu = s / jnp.maximum(cnt, 1)
+        dev = jnp.where(vmask, vol - mu[..., None], 0.0)
+        out = (dev * dev).sum(axis=-1)
+    else:
+        raise ValueError(f"unknown over_time stat {stat!r}")
+    return out, cnt
+
+
 @functools.lru_cache(maxsize=256)
-def _over_time_fn(W: int, stat: str):
-    """One masked window moment for *_over_time (temporal/aggregation.go),
-    packed [stat, count] so a single transfer carries everything the f64
-    host correction needs (computing all seven moments and shipping each
-    separately multiplied the result transfer 7x)."""
+def _over_time_fn(W: int, stat: str, stride: int = 1):
+    """One masked window moment for *_over_time (temporal/aggregation.go):
+    (stat f32, count uint16) planes, both consolidated to the output step
+    grid on device. Counts are window populations (<= W, exact in uint16 at
+    1/2 the bytes of f32); shipping one stat instead of all seven moments
+    and striding before the transfer are what keep this D2H-lean."""
 
     def fn(resid):
-        mask = jnp.isfinite(resid)
-        cnt = _wsum(mask, W)
-        if stat == "count":
-            out = cnt
-        elif stat == "sum":
-            out = _wsum(jnp.where(mask, resid, 0.0), W)
-        elif stat == "min":
-            out = jax.lax.reduce_window(
-                jnp.where(mask, resid, jnp.inf), jnp.inf, jax.lax.min,
-                (1, W), (1, 1), "valid")
-        elif stat == "max":
-            out = jax.lax.reduce_window(
-                jnp.where(mask, resid, -jnp.inf), -jnp.inf, jax.lax.max,
-                (1, W), (1, 1), "valid")
-        elif stat == "last":
-            out = _take_t(jnp.where(mask, resid, 0.0), _last_abs(mask, W))
-        elif stat == "m2":
-            # Two-pass over the window volume: the cumsum sumsq-minus-mean
-            # form cancels catastrophically in f32 when |mu| >> sigma.
-            vol = _window_volume(resid, W)
-            vmask = jnp.isfinite(vol)
-            s = jnp.where(vmask, vol, 0.0).sum(axis=-1)
-            mu = s / jnp.maximum(cnt, 1)
-            dev = jnp.where(vmask, vol - mu[..., None], 0.0)
-            out = (dev * dev).sum(axis=-1)
-        else:
-            raise ValueError(f"unknown over_time stat {stat!r}")
-        return jnp.stack([out, cnt])
+        out, cnt = _window_stat(resid, W, stat)
+        cnt_dtype = jnp.uint16 if W <= 0xFFFF else jnp.int32
+        return (out.astype(_F32)[..., ::stride],
+                cnt.astype(cnt_dtype)[..., ::stride])
 
     return jax.jit(fn)
 
 
-def over_time(grid: np.ndarray, W: int, kind: str) -> np.ndarray:
-    """sum|avg|min|max|count|last|stddev|stdvar|present_over_time.
-
-    Host corrects absolute-valued outputs back into f64 value space."""
-    stat_name = _OVER_TIME_STATS.get(kind)
-    if stat_name is None:
-        raise ValueError(f"unknown over_time kind {kind!r}")
-    resid, base = center(grid)
-    packed = np.asarray(_over_time_fn(W, stat_name)(_cached_put(resid)))
-    stat, cnt = packed[0].astype(np.float64), packed[1].astype(np.float64)
-    ok = cnt > 0
-    b = base[:, None]
+def _finish_over_time(xp, kind: str, stat, cnt, b):
+    """The *_over_time correction ladder — ONE source of truth shared by
+    the device finish (xp=jnp, f32) and the host finish (xp=np, f64);
+    callers apply their own cnt>0 NaN mask around it."""
     if kind == "count":
-        return np.where(ok, cnt, np.nan)
+        return cnt
     if kind == "present":
-        return np.where(ok, 1.0, np.nan)
+        return xp.ones_like(cnt)
     if kind == "sum":
-        return np.where(ok, stat + cnt * b, np.nan)
+        return stat + cnt * b
     if kind == "avg":
-        return np.where(ok, stat / np.maximum(cnt, 1) + b, np.nan)
+        return stat / xp.maximum(cnt, 1) + b
     if kind in ("min", "max", "last"):
-        return np.where(ok, stat + b, np.nan)
+        return stat + b
     if kind == "stdvar":  # population variance (promql stdvar_over_time)
-        return np.where(ok, stat / np.maximum(cnt, 1), np.nan)
-    # stddev
-    return np.where(ok, np.sqrt(stat / np.maximum(cnt, 1)), np.nan)
+        return stat / xp.maximum(cnt, 1)
+    if kind == "stddev":
+        return xp.sqrt(stat / xp.maximum(cnt, 1))
+    raise ValueError(f"unknown over_time kind {kind!r}")
 
 
 @functools.lru_cache(maxsize=256)
-def _quantile_idx_fn(W: int):
+def _over_time_finish_fn(W: int, kind: str, stride: int = 1):
+    """Fully-fused *_over_time: stat + baseline correction + NaN masking on
+    device, ONE f32 plane on the wire (the count plane and the host f64
+    correction pass disappear). Used for large result grids where the D2H
+    transfer is the floor; precision is that of the f32 result itself
+    (baseline products round at f32, ~1e-7 relative — recorded in
+    DIVERGENCES.md), which is why small blocks keep the exact host finish."""
+    stat_name = _OVER_TIME_STATS[kind]
+
+    def fn(resid, base32):
+        stat, cnt = _window_stat(resid, W, stat_name)
+        out = _finish_over_time(jnp, kind, stat, cnt, base32[:, None])
+        return jnp.where(cnt > 0, out, jnp.nan).astype(_F32)[..., ::stride]
+
+    return jax.jit(fn)
+
+
+# A result grid this big is transfer-bound on a tunneled accelerator, so
+# it finishes on device and ships one f32 plane; smaller grids keep the
+# exact f64 host finish. Cells, not bytes: the choice is about the D2H.
+_F32_FINISH_MIN_CELLS = int(os.environ.get(
+    "M3_TPU_F32_RESULT_MIN_CELLS", str(256 * 1024)))
+
+
+def _resid_args(grid: np.ndarray):
+    """(resid f32, baseline f64 host, baseline f32) for the centered-kernel
+    family, device-resident and content-cached behind one grid digest."""
+
+    def build(g):
+        resid, base = center(g)
+        base32 = base.astype(np.float32)
+        if not _cache_enabled():
+            return (resid, base, base32), 0
+        return ((jax.device_put(resid), base, jax.device_put(base32)),
+                resid.nbytes + base32.nbytes)
+
+    return _derived(grid, "resid", build)
+
+
+def over_time_async(grid: np.ndarray, W: int, kind: str, stride: int = 1,
+                    finish: str = "host"):
+    """Dispatch side of sum|avg|min|max|count|last|stddev|stdvar|present
+    _over_time; returns a fetch closure.
+
+    finish="host": (stat, count) planes come back and the absolute-valued
+    correction happens on the host in f64 (exact). "device": everything
+    fuses on device and ONE f32 plane crosses the link. "auto": device for
+    large result grids (see _F32_FINISH_MIN_CELLS), host otherwise."""
+    stat_name = _OVER_TIME_STATS.get(kind)
+    if stat_name is None:
+        raise ValueError(f"unknown over_time kind {kind!r}")
+    if finish == "auto":
+        t_out = max(0, grid.shape[1] - W + 1)
+        result_cells = grid.shape[0] * ((t_out + stride - 1) // stride)
+        finish = ("device" if result_cells >= _F32_FINISH_MIN_CELLS
+                  else "host")
+    resid, base, base32 = _resid_args(grid)
+    if finish == "device":
+        out = _over_time_finish_fn(W, kind, stride)(resid, base32)
+        _copy_async(out)
+        return lambda: np.asarray(out).astype(np.float64)
+    stat_dev, cnt_dev = _over_time_fn(W, stat_name, stride)(resid)
+    _copy_async(stat_dev, cnt_dev)
+
+    def fetch() -> np.ndarray:
+        stat = np.asarray(stat_dev).astype(np.float64)
+        cnt = np.asarray(cnt_dev).astype(np.float64)
+        out = _finish_over_time(np, kind, stat, cnt, base[:, None])
+        return np.where(cnt > 0, out, np.nan)
+
+    return fetch
+
+
+def over_time(grid: np.ndarray, W: int, kind: str, stride: int = 1,
+              finish: str = "host") -> np.ndarray:
+    return over_time_async(grid, W, kind, stride, finish)()
+
+
+@functools.lru_cache(maxsize=256)
+def _quantile_idx_fn(W: int, stride: int = 1):
     """Window-quantile index selection; host gathers exact f64 values."""
 
     def fn(resid, q):
@@ -441,18 +622,20 @@ def _quantile_idx_fn(W: int):
         hi_idx = jnp.where(hi < cnt, _take_w(order, hi), _take_w(order, lo))
         # One packed transfer; window indices/counts are < W so f32 is exact.
         return jnp.stack([lo_idx.astype(_F32), hi_idx.astype(_F32), frac,
-                          cnt.astype(_F32)])
+                          cnt.astype(_F32)])[..., ::stride]
 
     return jax.jit(fn)
 
 
-def quantile_over_time(grid: np.ndarray, W: int, q: float) -> np.ndarray:
-    resid, _ = center(grid)
-    packed = np.asarray(_quantile_idx_fn(W)(_cached_put(resid), np.float32(q)))
+def quantile_over_time(grid: np.ndarray, W: int, q: float,
+                       stride: int = 1) -> np.ndarray:
+    resid, _, _ = _resid_args(grid)
+    packed = np.asarray(
+        _quantile_idx_fn(W, stride)(resid, np.float32(q)))
     lo_idx, hi_idx = packed[0].astype(np.int64), packed[1].astype(np.int64)
     frac, cnt = packed[2], packed[3]
     S, T_out = lo_idx.shape
-    t_base = np.arange(T_out)[None, :]
+    t_base = np.arange(T_out)[None, :] * stride
     rows = np.arange(S)[:, None]
     v_lo = grid[rows, t_base + lo_idx]
     v_hi = grid[rows, t_base + hi_idx]
@@ -461,7 +644,7 @@ def quantile_over_time(grid: np.ndarray, W: int, q: float) -> np.ndarray:
 
 
 @functools.lru_cache(maxsize=256)
-def _changes_resets_fn(W: int, count_resets: bool):
+def _changes_resets_fn(W: int, count_resets: bool, stride: int = 1):
     def fn(resid):
         vol = _window_volume(resid, W)
         mask = jnp.isfinite(vol)
@@ -475,24 +658,25 @@ def _changes_resets_fn(W: int, count_resets: bool):
             hits = valid_pair & (d < 0)
         else:
             hits = valid_pair & (d != 0)
-        return jnp.where(cnt > 0, hits.sum(axis=-1).astype(_F32), jnp.nan)
+        out = jnp.where(cnt > 0, hits.sum(axis=-1).astype(_F32), jnp.nan)
+        return out[..., ::stride]
 
     return jax.jit(fn)
 
 
-def changes(grid: np.ndarray, W: int) -> np.ndarray:
-    resid, _ = center(grid)
-    return np.asarray(_changes_resets_fn(W, False)(_cached_put(resid)))
+def changes(grid: np.ndarray, W: int, stride: int = 1) -> np.ndarray:
+    resid, _, _ = _resid_args(grid)
+    return np.asarray(_changes_resets_fn(W, False, stride)(resid))
 
 
-def resets(grid: np.ndarray, W: int) -> np.ndarray:
-    resid, _ = center(grid)
-    return np.asarray(_changes_resets_fn(W, True)(_cached_put(resid)))
+def resets(grid: np.ndarray, W: int, stride: int = 1) -> np.ndarray:
+    resid, _, _ = _resid_args(grid)
+    return np.asarray(_changes_resets_fn(W, True, stride)(resid))
 
 
 @functools.lru_cache(maxsize=256)
 def _regression_fn(W: int, step_s: float, predict_offset_s: float,
-                   is_deriv: bool):
+                   is_deriv: bool, stride: int = 1):
     """Least-squares over valid (t, v) window points; t relative to the
     window's first valid sample for stability (promql linearRegression;
     temporal/linear_regression.go)."""
@@ -513,30 +697,33 @@ def _regression_fn(W: int, step_s: float, predict_offset_s: float,
         denom = n * stt - st * st
         slope = jnp.where(denom != 0, (n * stv - st * sv) / denom, jnp.nan)
         if is_deriv:
-            return jnp.where(ok, slope, jnp.nan)
+            return jnp.where(ok, slope, jnp.nan)[..., ::stride]
         intercept = (sv - slope * st) / n
         # Evaluate at output time + offset: output time is the last window
         # cell, i.e. t = (W-1-first_i)*step relative to the reference point.
         t_eval = (W - 1 - first_i).astype(_F32) * step_s + predict_offset_s
-        return jnp.where(ok, intercept + slope * t_eval, jnp.nan)
+        return jnp.where(ok, intercept + slope * t_eval, jnp.nan)[..., ::stride]
 
     return jax.jit(fn)
 
 
-def deriv(grid: np.ndarray, W: int, step_ns: int) -> np.ndarray:
-    resid, _ = center(grid)
-    return np.asarray(_regression_fn(W, step_ns / 1e9, 0.0, True)(_cached_put(resid)))
+def deriv(grid: np.ndarray, W: int, step_ns: int,
+          stride: int = 1) -> np.ndarray:
+    resid, _, _ = _resid_args(grid)
+    return np.asarray(
+        _regression_fn(W, step_ns / 1e9, 0.0, True, stride)(resid))
 
 
 def predict_linear(grid: np.ndarray, W: int, step_ns: int,
-                   offset_s: float) -> np.ndarray:
-    resid, base = center(grid)
-    out = np.asarray(_regression_fn(W, step_ns / 1e9, float(offset_s), False)(_cached_put(resid)))
+                   offset_s: float, stride: int = 1) -> np.ndarray:
+    resid, base, _ = _resid_args(grid)
+    out = np.asarray(_regression_fn(
+        W, step_ns / 1e9, float(offset_s), False, stride)(resid))
     return out + base[:, None]
 
 
 @functools.lru_cache(maxsize=256)
-def _holt_winters_fn(W: int, sf: float, tf: float):
+def _holt_winters_fn(W: int, sf: float, tf: float, stride: int = 1):
     """Double exponential smoothing (temporal/holt_winters.go; promql
     holt_winters): scan over the window, skipping invalid cells."""
 
@@ -559,13 +746,14 @@ def _holt_winters_fn(W: int, sf: float, tf: float):
     def fn(resid):
         vol = _window_volume(resid, W)
         mask = jnp.isfinite(vol)
-        return jax.vmap(jax.vmap(one_window))(vol, mask)
+        return jax.vmap(jax.vmap(one_window))(vol, mask)[..., ::stride]
 
     return jax.jit(fn)
 
 
-def holt_winters(grid: np.ndarray, W: int, sf: float, tf: float) -> np.ndarray:
-    resid, base = center(grid)
+def holt_winters(grid: np.ndarray, W: int, sf: float, tf: float,
+                 stride: int = 1) -> np.ndarray:
+    resid, base, _ = _resid_args(grid)
     return np.asarray(
-        _holt_winters_fn(W, float(sf), float(tf))(_cached_put(resid))
+        _holt_winters_fn(W, float(sf), float(tf), stride)(resid)
     ) + base[:, None]

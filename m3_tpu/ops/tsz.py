@@ -30,6 +30,7 @@ All 64-bit math is on (hi, lo) u32 pairs — see m3_tpu/ops/bits64.py.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -565,6 +566,87 @@ def decode_batch(words, npoints, *, window):
     return {"dt": dt, "vhi": vhi, "vlo": vlo, "int_mode": int_mode, "k": kexp, "t0": t0}
 
 
+def prepare_on_device_math(ts_hi, ts_lo, vhi, vlo, npoints):
+    """Traceable encode prep from RAW inputs — the device-side twin of
+    prepare_encode_inputs, so the whole ingest hot path (prep + encode +
+    rollup) is ONE XLA program and the host's per-block work shrinks to
+    u32-pair view splits.
+
+    ts_*: u32 pairs of int64 timestamps (ticks) [N, W]; v*: u32 pairs of
+    raw f64 bits [N, W]; npoints int32 [N].
+
+    Int-mode detection happens by f64 BIT inspection (no f64 arithmetic
+    exists on TPU): value v with biased exponent e and 52-bit mantissa is
+    an integer with |v| < 2^53 iff it is +/-0, or 1023 <= e <= 1075 with
+    the low (1075 - e) mantissa bits zero; its exact int64 value is
+    +/-((2^52 | mantissa) >> (1075 - e)). DIVERGENCE from the host prep:
+    only k=0 (plain integer) rows take the int path — decimal series
+    (host k in 1..6, needs exact f64 multiplies) encode as floats, which
+    costs bytes on decimal-heavy shards but changes no values
+    (DIVERGENCES.md). Returns (prep dict, range_ok bool scalar) —
+    range_ok mirrors the host's int32 delta/DoD ValueErrors."""
+    n, w = ts_hi.shape
+    ts = (ts_hi, ts_lo)
+    valid = jnp.arange(w, dtype=I32)[None, :] < npoints[:, None]
+    prev = tuple(jnp.concatenate([a[:, :1], a[:, :-1]], axis=1) for a in ts)
+    dt64 = b64.sub64(ts, prev)
+    zero = (jnp.zeros_like(ts_hi), jnp.zeros_like(ts_hi))
+    dt64 = tuple(jnp.where(valid, a, z) for a, z in zip(dt64, zero))
+
+    def fits_i32(p):
+        hi, lo = p
+        return ((hi == 0) & (lo < U32(1 << 31))) | (
+            (hi == U32(0xFFFFFFFF)) & (lo >= U32(1 << 31)))
+
+    prev_dt = tuple(jnp.concatenate([z[:, :1], a[:, :-1]], axis=1)
+                    for a, z in zip(dt64, zero))
+    dod64 = b64.sub64(dt64, prev_dt)
+    range_ok = jnp.where(
+        valid, fits_i32(dt64) & fits_i32(dod64), True).all()
+    dt = b64.pair_to_i32(dt64)
+
+    # f64 bit classification (see docstring).
+    e = ((vhi >> U32(20)) & U32(0x7FF)).astype(I32)
+    sign = vhi >> U32(31)
+    mhi = vhi & U32(0xFFFFF)
+    is_zero = (e == 0) & (mhi == 0) & (vlo == 0)
+    neg_zero = is_zero & (sign == 1)
+    frac = jnp.clip(1075 - e, 0, 63).astype(jnp.uint32)
+    mask_lo = jnp.where(
+        frac >= 32, U32(0xFFFFFFFF),
+        (U32(1) << jnp.minimum(frac, jnp.uint32(31))) - U32(1))
+    mask_hi = jnp.where(
+        frac <= 32, U32(0),
+        (U32(1) << jnp.minimum(frac - 32, jnp.uint32(31))) - U32(1))
+    low_zero = ((vlo & mask_lo) == 0) & ((mhi & mask_hi) == 0)
+    col_int = is_zero | ((e >= 1023) & (e <= 1075) & low_zero)
+    mag = b64.shr64((mhi | U32(0x100000), vlo), frac)
+    m = tuple(jnp.where(sign == 1, a, b)
+              for a, b in zip(b64.neg64(mag), mag))
+    m = tuple(jnp.where(is_zero | ~valid, z, a) for a, z in zip(m, zero))
+    live_int = jnp.where(valid, col_int, True).all(axis=1)
+    row_int = live_int & ~(neg_zero & valid).any(axis=1)
+    vhi_out = jnp.where(row_int[:, None], m[0], vhi)
+    vlo_out = jnp.where(row_int[:, None], m[1], vlo)
+
+    delta0 = (dt[:, 1] if w > 1 else jnp.zeros(n, I32)) * (npoints > 1)
+    cols1 = jnp.arange(w, dtype=I32)[None, :] >= 1
+    ts_regular = jnp.where(
+        valid & cols1, dt == delta0[:, None], True).all(axis=1)
+    prep = dict(
+        dt=dt,
+        t0=(ts_hi[:, 0], ts_lo[:, 0]),
+        vhi=vhi_out,
+        vlo=vlo_out,
+        int_mode=row_int,
+        k=jnp.zeros(n, I32),
+        npoints=npoints,
+        ts_regular=ts_regular,
+        delta0=delta0,
+    )
+    return prep, range_ok
+
+
 # ---------------------------------------------------------------------------
 # host wrappers: f64/int64 <-> u32-pair prep (vectorized numpy)
 # ---------------------------------------------------------------------------
@@ -607,11 +689,10 @@ def detect_int_mode_batch(values: np.ndarray, npoints: np.ndarray):
     return best_k >= 0, np.maximum(best_k, 0)
 
 
-def prepare_encode_inputs(timestamps: np.ndarray, values: np.ndarray, npoints: np.ndarray):
-    """Host prep: int64/f64 arrays -> u32-pair device inputs."""
-    ts = np.asarray(timestamps, dtype=np.int64)
-    v = np.asarray(values, dtype=np.float64)
-    npts = np.asarray(npoints, dtype=np.int32)
+def _prepare_slice(ts, v, npts, out, lo):
+    """Row-slice worker for prepare_encode_inputs: writes [lo:lo+rows) of
+    every output array. All passes are per-row, so slices are independent."""
+    hi = lo + ts.shape[0]
     dt64 = np.diff(ts, axis=1, prepend=ts[:, :1])
     valid = np.arange(ts.shape[1])[None, :] < npts[:, None]
     dt_checked = np.where(valid, dt64, 0)
@@ -641,17 +722,67 @@ def prepare_encode_inputs(timestamps: np.ndarray, values: np.ndarray, npoints: n
     delta0 = (dt[:, 1] if w > 1 else np.zeros(len(dt), np.int32)) * (npts > 1)
     cols1 = np.arange(w)[None, :] >= 1
     ts_regular = np.where(valid & cols1, dt == delta0[:, None], True).all(axis=1)
-    return dict(
-        dt=dt,
-        t0=(t0hi, t0lo),
-        vhi=vhi,
-        vlo=vlo,
-        int_mode=int_mode,
-        k=k.astype(np.int32),
+    out["dt"][lo:hi] = dt
+    out["t0"][0][lo:hi] = t0hi
+    out["t0"][1][lo:hi] = t0lo
+    out["vhi"][lo:hi] = vhi
+    out["vlo"][lo:hi] = vlo
+    out["int_mode"][lo:hi] = int_mode
+    out["k"][lo:hi] = k
+    out["ts_regular"][lo:hi] = ts_regular
+    out["delta0"][lo:hi] = delta0
+
+
+# Persistent worker pool for the ingest prep path: every pass is a big
+# per-row numpy ufunc that releases the GIL, so row-chunking across threads
+# scales near-linearly — this is the host half of the sealed-block encode,
+# and it must keep up with the device step when the two are pipelined.
+_PREP_POOL = None
+_PREP_WORKERS = max(1, min(8, (os.cpu_count() or 2) - 1))
+_PREP_MIN_ROWS_PER_WORKER = 4096
+
+
+def _prep_pool():
+    global _PREP_POOL
+    if _PREP_POOL is None:
+        import concurrent.futures
+
+        _PREP_POOL = concurrent.futures.ThreadPoolExecutor(
+            max_workers=_PREP_WORKERS, thread_name_prefix="tsz-prep")
+    return _PREP_POOL
+
+
+def prepare_encode_inputs(timestamps: np.ndarray, values: np.ndarray, npoints: np.ndarray):
+    """Host prep: int64/f64 arrays -> u32-pair device inputs. Large batches
+    fan out row-chunks across the prep pool; small ones stay inline."""
+    ts = np.asarray(timestamps, dtype=np.int64)
+    v = np.asarray(values, dtype=np.float64)
+    npts = np.asarray(npoints, dtype=np.int32)
+    n, w = ts.shape
+    out = dict(
+        dt=np.empty((n, w), np.int32),
+        t0=(np.empty(n, np.uint32), np.empty(n, np.uint32)),
+        vhi=np.empty((n, w), np.uint32),
+        vlo=np.empty((n, w), np.uint32),
+        int_mode=np.empty(n, bool),
+        k=np.empty(n, np.int32),
         npoints=npts,
-        ts_regular=ts_regular,
-        delta0=delta0.astype(np.int32),
+        ts_regular=np.empty(n, bool),
+        delta0=np.empty(n, np.int32),
     )
+    workers = min(_PREP_WORKERS, max(1, n // _PREP_MIN_ROWS_PER_WORKER))
+    if workers <= 1:
+        _prepare_slice(ts, v, npts, out, 0)
+        return out
+    bounds = np.linspace(0, n, workers + 1, dtype=np.int64)
+    futs = [
+        _prep_pool().submit(_prepare_slice, ts[b0:b1], v[b0:b1],
+                            npts[b0:b1], out, int(b0))
+        for b0, b1 in zip(bounds[:-1], bounds[1:])
+    ]
+    for f in futs:
+        f.result()  # re-raises range-check ValueErrors from any slice
+    return out
 
 
 def encode(timestamps: np.ndarray, values: np.ndarray, npoints=None, max_words: int | None = None):
@@ -709,18 +840,48 @@ def boundary_metadata(inp: dict) -> dict:
             "valid": np.ones(npts.shape[0], bool)}
 
 
+@functools.lru_cache(maxsize=1)
+def _seal_mesh():
+    """1-D "s" mesh over every attached device for the seal-path encode,
+    or None single-chip. The sealed-block encode is row-parallel, so
+    sharding the prepared columns lets XLA SPMD split one block across
+    the mesh — the storage tier's own use of multi-chip, mirroring how
+    the reference splits flush work across its worker pool."""
+    devs = jax.devices()
+    if len(devs) <= 1:
+        return None
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(devs), ("s",))
+
+
 def encode_with_boundary(timestamps, values, npoints=None,
                          max_words: int | None = None):
-    """encode() that also returns the boundary metadata dict (seal path)."""
+    """encode() that also returns the boundary metadata dict (seal path).
+    On a multi-device platform, blocks whose (padded) series count divides
+    the mesh run as ONE SPMD program sharded over the "s" axis."""
     ts = np.asarray(timestamps)
     if npoints is None:
         npoints = np.full(ts.shape[0], ts.shape[1], dtype=np.int32)
     if max_words is None:
         max_words = max_words_for(ts.shape[1])
     inp = prepare_encode_inputs(ts, values, npoints)
+    dt, t0, vhi, vlo = inp["dt"], inp["t0"], inp["vhi"], inp["vlo"]
+    int_mode, k, npts = inp["int_mode"], inp["k"], inp["npoints"]
+    ts_regular, delta0 = inp["ts_regular"], inp["delta0"]
+    mesh = _seal_mesh()
+    if mesh is not None and ts.shape[0] % mesh.shape["s"] == 0:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        row = NamedSharding(mesh, P("s"))
+        rowc = NamedSharding(mesh, P("s", None))
+        put = jax.device_put
+        dt, vhi, vlo = (put(a, rowc) for a in (dt, vhi, vlo))
+        t0 = tuple(put(a, row) for a in t0)
+        int_mode, k, npts, ts_regular, delta0 = (
+            put(a, row) for a in (int_mode, k, npts, ts_regular, delta0))
     words, nbits = encode_batch(
-        inp["dt"], inp["t0"], inp["vhi"], inp["vlo"], inp["int_mode"],
-        inp["k"], inp["npoints"], inp["ts_regular"], inp["delta0"],
+        dt, t0, vhi, vlo, int_mode, k, npts, ts_regular, delta0,
         max_words=max_words)
     return words, nbits, boundary_metadata(inp)
 

@@ -87,6 +87,54 @@ def ingest_step(batch: IngestBatch, *, rollup_factor: int, max_words: int, quant
     return words, nbits, roll, blk, qs
 
 
+class RawIngestBatch(NamedTuple):
+    """Raw device inputs for the fused prep+encode ingest step: u32-pair
+    views of the int64 timestamps / f64 value bits plus an f32 value copy
+    for the aggregation kernels. Host cost to build one: three zero-copy
+    pair splits and one f32 cast (make_raw_batch)."""
+
+    ts_hi: jax.Array     # u32 [N, W]
+    ts_lo: jax.Array
+    vhi: jax.Array       # u32 [N, W] raw f64 bits
+    vlo: jax.Array
+    npoints: jax.Array   # int32 [N]
+    values: jax.Array    # f32 [N, W]
+
+
+def make_raw_batch(ts: np.ndarray, values: np.ndarray,
+                   npoints: np.ndarray) -> RawIngestBatch:
+    """Cheap host prep for ingest_step_raw: pair splits + f32 cast only —
+    delta/int-mode/mantissa work all happens on device."""
+    from ..ops import bits64 as b64
+
+    ts_hi, ts_lo = b64.from_u64_np(np.asarray(ts, np.int64))
+    vhi, vlo = b64.from_u64_np(
+        np.ascontiguousarray(np.asarray(values, np.float64)).view(np.uint64))
+    return RawIngestBatch(ts_hi, ts_lo, vhi, vlo,
+                          np.asarray(npoints, np.int32),
+                          np.asarray(values, np.float32))
+
+
+def ingest_step_raw(raw: RawIngestBatch, *, rollup_factor: int,
+                    max_words: int, quantile_qs=(0.5, 0.99)):
+    """Fused prep+encode+aggregate from raw inputs: ONE XLA program covers
+    what prepare_encode_inputs did on the host plus ingest_step's device
+    work. Returns ingest_step's outputs plus a range_ok bool scalar (the
+    device twin of the host prep's int32 delta/DoD ValueErrors — callers
+    must check it once per block)."""
+    prep, range_ok = tsz.prepare_on_device_math(
+        raw.ts_hi, raw.ts_lo, raw.vhi, raw.vlo, raw.npoints)
+    batch = IngestBatch(
+        dt=prep["dt"], t0_hi=prep["t0"][0], t0_lo=prep["t0"][1],
+        vhi=prep["vhi"], vlo=prep["vlo"], int_mode=prep["int_mode"],
+        k=prep["k"], npoints=prep["npoints"],
+        ts_regular=prep["ts_regular"], delta0=prep["delta0"],
+        values=raw.values)
+    return (*ingest_step(batch, rollup_factor=rollup_factor,
+                         max_words=max_words, quantile_qs=quantile_qs),
+            range_ok)
+
+
 def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
     """Build the ("shard", "time") device mesh.
 

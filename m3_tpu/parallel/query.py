@@ -25,32 +25,57 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..ops import temporal
 
 
-@functools.lru_cache(maxsize=64)
-def make_sharded_sum_rate(mesh: Mesh, *, W: int, step_ns: int, range_ns: int,
-                          is_counter: bool = True):
-    """jit a sum-of-rate step over the mesh: inputs [S, T] sharded on the
-    "shard" axis; output the dense [T_out] global sum-by-step plus the
-    contributing-series count (both replicated).
+# (is_counter, is_rate) per supported range function — the rate family all
+# reduces to temporal.rate_math.
+RANGE_FUNCS = {"rate": (True, True), "increase": (True, False),
+               "delta": (False, False)}
+AGG_OPS = ("sum", "avg", "count", "min", "max")
 
-    sum(rate(m[5m])) is the canonical dashboard aggregation; NaN cells
-    (insufficient window samples) are excluded per series like the
-    executor's host-side nansum. Accumulation is f32 on device (TPU has no
-    native f64), so the sum carries ~sqrt(S)*2^-24 relative error — about
-    2e-5 at 100k series — where the host path is exact f64.
+
+@functools.lru_cache(maxsize=64)
+def make_sharded_agg_rate(mesh: Mesh, *, op: str, func: str, W: int,
+                          step_ns: int, range_ns: int, stride: int = 1):
+    """jit one dashboard-shaped aggregation over the mesh: inputs [S, T]
+    sharded on the "shard" axis; output the dense [T_out] global
+    aggregate-by-step plus the contributing-series count (replicated).
+
+    op(rate(m[5m])) for op in sum/avg/count/min/max is the canonical
+    dashboard shape; NaN cells (insufficient window samples) are excluded
+    per series like the executor's host-side nan-aware reduce. Each device
+    runs the fused rate kernel on its series slice and reduces locally;
+    ONE psum/pmin/pmax over ICI yields the global answer — no host in the
+    loop until the final [T_out] vector. Accumulation is f32 on device
+    (TPU has no native f64), so sums carry ~sqrt(S)*2^-24 relative error —
+    about 2e-5 at 100k series — where the host path is exact f64
+    (DIVERGENCES.md).
 
     lru-cached on (mesh, shape params): repeated dashboard queries reuse
     the compiled executable instead of retracing (Mesh is hashable)."""
+    if op not in AGG_OPS:
+        raise ValueError(f"unsupported sharded aggregation {op!r}")
+    is_counter, is_rate = RANGE_FUNCS[func]
     math = functools.partial(
         temporal.rate_math, W=W, step_s=step_ns / 1e9,
-        range_s=range_ns / 1e9, is_counter=is_counter, is_rate=True)
+        range_s=range_ns / 1e9, is_counter=is_counter, is_rate=is_rate,
+        stride=stride)
 
     def local(adj, finite, grid32):
         out = math(adj, finite, grid32)  # [S_local, T_out]
         fin = jnp.isfinite(out)
-        part = jnp.where(fin, out, 0.0).sum(axis=0)
-        cnt = fin.sum(axis=0)
-        total = jax.lax.psum(part, "shard")
-        n = jax.lax.psum(cnt, "shard")
+        n = jax.lax.psum(fin.sum(axis=0), "shard")
+        if op in ("sum", "avg"):
+            part = jnp.where(fin, out, 0.0).sum(axis=0)
+            total = jax.lax.psum(part, "shard")
+            if op == "avg":
+                total = total / jnp.maximum(n, 1)
+        elif op == "count":
+            total = n.astype(out.dtype)
+        elif op == "min":
+            total = jax.lax.pmin(
+                jnp.where(fin, out, jnp.inf).min(axis=0), "shard")
+        else:  # max
+            total = jax.lax.pmax(
+                jnp.where(fin, out, -jnp.inf).max(axis=0), "shard")
         return total, n
 
     spec = P("shard", None)
@@ -58,6 +83,12 @@ def make_sharded_sum_rate(mesh: Mesh, *, W: int, step_ns: int, range_ns: int,
                        in_specs=(spec, spec, spec),
                        out_specs=(P(), P()))
     return jax.jit(fn)
+
+
+def make_sharded_sum_rate(mesh: Mesh, *, W: int, step_ns: int, range_ns: int):
+    """Back-compat alias for the op="sum", func="rate" kernel."""
+    return make_sharded_agg_rate(mesh, op="sum", func="rate", W=W,
+                                 step_ns=step_ns, range_ns=range_ns)
 
 
 def shard_grid(grid: np.ndarray, mesh: Mesh, is_counter: bool = True):
@@ -78,16 +109,27 @@ def shard_grid(grid: np.ndarray, mesh: Mesh, is_counter: bool = True):
     return tuple(jax.device_put(a, sharding) for a in (adj, finite, grid32))
 
 
-def sum_rate(grid: np.ndarray, mesh: Mesh, *, W: int, step_ns: int,
-             range_ns: int):
-    """Convenience wrapper: sum(rate(...)) over the mesh, NaN where no
-    series had a full window."""
-    args = shard_grid(grid, mesh)
-    fn = make_sharded_sum_rate(mesh, W=W, step_ns=step_ns, range_ns=range_ns)
+def agg_rate(grid: np.ndarray, mesh: Mesh, *, op: str, func: str, W: int,
+             step_ns: int, range_ns: int, stride: int = 1) -> np.ndarray:
+    """op(func(...)) over the mesh, NaN where no series had a full window
+    — the serving entry the query executor dispatches dashboard
+    aggregations through (query/executor.py _eval_sharded_agg)."""
+    is_counter, _ = RANGE_FUNCS[func]
+    args = shard_grid(grid, mesh, is_counter)
+    fn = make_sharded_agg_rate(mesh, op=op, func=func, W=W, step_ns=step_ns,
+                               range_ns=range_ns, stride=stride)
     total, n = fn(*args)
     total = np.asarray(total, np.float64)
     n = np.asarray(n)
     return np.where(n > 0, total, np.nan)
+
+
+def sum_rate(grid: np.ndarray, mesh: Mesh, *, W: int, step_ns: int,
+             range_ns: int):
+    """Convenience wrapper: sum(rate(...)) over the mesh, NaN where no
+    series had a full window."""
+    return agg_rate(grid, mesh, op="sum", func="rate", W=W, step_ns=step_ns,
+                    range_ns=range_ns)
 
 
 def sum_rate_host_reference(grid: np.ndarray, *, W: int, step_ns: int,

@@ -66,6 +66,40 @@ class Block:
         return Block(meta, [], np.zeros((0, meta.steps)))
 
 
+class LazyBlock(Block):
+    """Block whose values materialize on first access.
+
+    The device->host result copy is started asynchronously at construction
+    (ops/temporal.py _copy_async), so any host work done before `.values`
+    is touched — parsing/fetching/gridding the NEXT query of a dashboard
+    burst — overlaps the transfer instead of serializing behind it. On a
+    remote-tunnel accelerator the result D2H is the per-query floor, which
+    makes this the double-buffering lever for BASELINE config #3."""
+
+    def __init__(self, meta: BlockMeta, series_tags: List[Tags], fetch):
+        # No super().__init__: values don't exist yet, so the dataclass
+        # shape assert runs at materialization instead.
+        self.meta = meta
+        self.series_tags = series_tags
+        self._fetch = fetch
+        self._cache: Optional[np.ndarray] = None
+
+    @property
+    def values(self) -> np.ndarray:  # type: ignore[override]
+        if self._cache is None:
+            vals = np.asarray(self._fetch())
+            assert vals.shape == (len(self.series_tags), self.meta.steps), (
+                vals.shape, len(self.series_tags), self.meta.steps)
+            self._cache = vals
+            self._fetch = None
+        return self._cache
+
+    @values.setter
+    def values(self, vals: np.ndarray):
+        self._cache = np.asarray(vals)
+        self._fetch = None
+
+
 def _grid_snap(sorted_ts: np.ndarray, step_times: np.ndarray,
                lookback_ns: int) -> Tuple[np.ndarray, np.ndarray]:
     """Grid-snap rule shared by every consolidation path: for each step time
@@ -96,6 +130,21 @@ def consolidate(timestamps: np.ndarray, values: np.ndarray, meta: BlockMeta,
     return out
 
 
+def _entry_tags(entry: dict) -> Tags:
+    """Tags object for a fetch-result entry, memoized INTO the entry —
+    storages that serve the same entry dicts across queries (hot-block
+    serving, dashboard bursts) pay tag interning once, not per query.
+    Keyed on the tags object's identity so a later reassignment of
+    entry["tags"] (e.g. FanoutStorage's cross-store merge) invalidates
+    the memo instead of serving stale labels."""
+    raw = entry["tags"]
+    cached = entry.get("_tags")
+    if cached is None or cached[0] is not raw:
+        cached = (raw, Tags.of(dict(raw)))
+        entry["_tags"] = cached
+    return cached[1]
+
+
 def consolidate_series(series: Dict[bytes, dict], meta: BlockMeta,
                        lookback_ns: int) -> Tuple[List[Tags], np.ndarray]:
     """Consolidate a fetch result ({id: {tags, t, v}}) onto the step grid.
@@ -103,35 +152,73 @@ def consolidate_series(series: Dict[bytes, dict], meta: BlockMeta,
     Series sharing an identical timestamp grid (the scrape-aligned common
     case) are consolidated as one vectorized batch: argsort/searchsorted run
     once per distinct grid instead of once per series, which is what makes
-    10k-series range queries host-cheap.
+    10k-series range queries host-cheap. Grids are grouped by array object
+    IDENTITY first (series from one storage batch share one grid object —
+    zero per-series work), then by a cheap content key verified with
+    array_equal.
     """
     items = sorted(series.items())
-    tags_list = [Tags.of(dict(entry["tags"])) for _, entry in items]
-    rows = np.full((len(items), meta.steps), NAN)
-    groups: Dict[tuple, List[int]] = {}
-    ts_arrays = []
+    tags_list = [_entry_tags(entry) for _, entry in items]
+    rows: Optional[np.ndarray] = None  # lazy: fast path below skips it
+    id_groups: Dict[int, List[int]] = {}
+    raw_ts = []
     for i, (_, entry) in enumerate(items):
-        t = np.asarray(entry["t"], dtype=np.int64)
-        ts_arrays.append(t)
-        key = (t.size, int(t[0]) if t.size else 0, int(t[-1]) if t.size else 0)
-        groups.setdefault(key, []).append(i)
+        t = entry["t"]
+        raw_ts.append(t)
+        id_groups.setdefault(id(t), []).append(i)
+    # Singleton identity groups (distinct array objects) coalesce by
+    # content key + array_equal check; shared-object groups skip both.
+    groups: List[List[int]] = []
+    by_key: Dict[tuple, List[List[int]]] = {}
+    ts_arrays: List[Optional[np.ndarray]] = [None] * len(items)
+    for idxs in id_groups.values():
+        t = np.asarray(raw_ts[idxs[0]], dtype=np.int64)
+        for i in idxs:
+            ts_arrays[i] = t
+        if len(idxs) > 1:
+            groups.append(idxs)
+            continue
+        key = (t.size, int(t[0]) if t.size else 0,
+               int(t[-1]) if t.size else 0)
+        merged = False
+        for g in by_key.setdefault(key, []):
+            if np.array_equal(ts_arrays[g[0]], t):
+                g.extend(idxs)
+                merged = True
+                break
+        if not merged:
+            by_key[key].append(idxs)
+    for gl in by_key.values():
+        groups.extend(gl)
     step_times = meta.times()
-    for idxs in groups.values():
-        rep = ts_arrays[idxs[0]]
-        same = [i for i in idxs if ts_arrays[i] is rep
-                or np.array_equal(ts_arrays[i], rep)]
-        for i in set(idxs) - set(same):  # rare: key collision, per-series path
-            rows[i] = consolidate(
-                ts_arrays[i], np.asarray(items[i][1]["v"], np.float64),
-                meta, lookback_ns)
+    for same in groups:
+        rep = ts_arrays[same[0]]
         if rep.size == 0:
             continue
-        order = np.argsort(rep, kind="stable")
-        take, safe = _grid_snap(rep[order], step_times, lookback_ns)
+        # Skip the argsort for already-sorted grids (the storage layers
+        # emit sorted timestamps) and fuse sort-order + grid-snap into ONE
+        # gather — at 10k x 360 each avoided intermediate is a ~30MB copy.
+        if rep.size > 1 and not (rep[1:] >= rep[:-1]).all():
+            order = np.argsort(rep, kind="stable")
+            sorted_rep = rep[order]
+        else:
+            order = None
+            sorted_rep = rep
+        take, safe = _grid_snap(sorted_rep, step_times, lookback_ns)
         vs = np.stack([np.asarray(items[i][1]["v"], np.float64) for i in same])
-        vs = vs[:, order]
         cols = np.nonzero(take)[0]
-        rows[np.ix_(same, cols)] = vs[:, safe[cols]]
+        src = safe[cols] if order is None else order[safe[cols]]
+        if (rows is None and len(groups) == 1 and cols.size == meta.steps
+                and len(same) == len(items)):
+            # ONE shared grid covering every step (the hot dashboard
+            # shape): the gather IS the result — no NaN canvas, no fancy
+            # double-index write (each a full-matrix pass at 10k series).
+            return tags_list, vs[:, src]
+        if rows is None:
+            rows = np.full((len(items), meta.steps), NAN)
+        rows[np.ix_(same, cols)] = vs[:, src]
+    if rows is None:
+        rows = np.full((len(items), meta.steps), NAN)
     return tags_list, rows
 
 

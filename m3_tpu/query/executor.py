@@ -58,13 +58,43 @@ class QueryParams:
         return BlockMeta(self.start_ns, self.step_ns, self.steps)
 
 
+def _default_query_mesh():
+    """One 1-D "shard" mesh over every attached device, or None single-chip.
+    Cached after first use — the serving processes build engines per
+    coordinator but share the device topology."""
+    global _QUERY_MESH
+    if _QUERY_MESH is _UNSET:
+        import jax
+        from jax.sharding import Mesh
+
+        devs = jax.devices()
+        _QUERY_MESH = (Mesh(np.asarray(devs), ("shard",))
+                       if len(devs) > 1 else None)
+    return _QUERY_MESH
+
+
+_UNSET = object()
+_QUERY_MESH = _UNSET
+
+
 class Engine:
     """executor/engine.go: compile -> plan -> execute. Storage is anything
-    with fetch_raw(matchers, start_ns, end_ns) -> {id: {tags, t, v}}."""
+    with fetch_raw(matchers, start_ns, end_ns) -> {id: {tags, t, v}}.
+
+    mesh: "auto" (default) shards dashboard-shaped aggregations over every
+    attached device (the in-mesh expression of the reference's coordinator
+    fanout, src/query/storage/fanout/storage.go:1); None forces
+    single-device evaluation; or pass an explicit jax Mesh with a "shard"
+    axis."""
 
     def __init__(self, storage, lookback_ns: int = DEFAULT_LOOKBACK_NS,
-                 cost_enforcer=None, per_query_cost_limit=None):
+                 cost_enforcer=None, per_query_cost_limit=None, mesh="auto"):
         self.storage = storage
+        # "auto" resolves LAZILY on the first sharded-eligible query: the
+        # resolution touches jax.devices(), i.e. backend init, and a server
+        # must not block its startup on accelerator health (a downed tunnel
+        # hangs backend init indefinitely).
+        self._mesh = mesh
         self.lookback_ns = lookback_ns
         # Per-process datapoint budget (x/cost/enforcer.go). Each query
         # charges a scoped child enforcer whose total is released when the
@@ -75,6 +105,16 @@ class Engine:
         # serves concurrent queries from the ThreadingHTTPServer and a
         # shared slot would charge one query's datapoints to another.
         self._local = threading.local()
+
+    @property
+    def mesh(self):
+        if isinstance(self._mesh, str):  # "auto"
+            self._mesh = _default_query_mesh()
+        return self._mesh
+
+    @mesh.setter
+    def mesh(self, value):
+        self._mesh = value
 
     def execute_range(self, query: str, start_ns: int, end_ns: int,
                       step_ns: int) -> Block:
@@ -188,6 +228,8 @@ class Engine:
         return self._eval_instant_func(node, params)
 
     def _eval_range_func(self, node: Call, params: QueryParams) -> Block:
+        from .block import LazyBlock
+
         sel_args = [a for a in node.args if isinstance(a, VectorSelector)]
         if not sel_args or not sel_args[-1].range_ns:
             raise QueryError(f"{node.func} expects a range vector")
@@ -196,48 +238,60 @@ class Engine:
         grid = ext.values
         step_ns = ext.meta.step_ns
         f = node.func
+        # Every kernel consolidates to the query's output step grid ON
+        # DEVICE (stride) — the D2H result transfer is the per-query floor
+        # on tunneled accelerators, so nothing wider than [series, steps]
+        # ever crosses the link. The hot dashboard shapes (rate-family and
+        # *_over_time moments) additionally return fetch closures whose
+        # async copy overlaps the next query's host prep (LazyBlock).
+        fetch = None
         if f == "rate":
-            out = temporal.rate(grid, W, step_ns, sel.range_ns)
+            fetch = temporal.rate_async(grid, W, step_ns, sel.range_ns, stride)
         elif f == "increase":
-            out = temporal.increase(grid, W, step_ns, sel.range_ns)
+            fetch = temporal.increase_async(
+                grid, W, step_ns, sel.range_ns, stride)
         elif f == "delta":
-            out = temporal.delta(grid, W, step_ns, sel.range_ns)
+            fetch = temporal.delta_async(
+                grid, W, step_ns, sel.range_ns, stride)
         elif f == "irate":
-            out = temporal.irate(grid, W, step_ns)
+            out = temporal.irate(grid, W, step_ns, stride)
         elif f == "idelta":
-            out = temporal.idelta(grid, W, step_ns)
+            out = temporal.idelta(grid, W, step_ns, stride)
         elif f == "deriv":
-            out = temporal.deriv(grid, W, step_ns)
+            out = temporal.deriv(grid, W, step_ns, stride)
         elif f == "predict_linear":
             out = temporal.predict_linear(
-                grid, W, step_ns, _const_param(node.args[1]))
+                grid, W, step_ns, _const_param(node.args[1]), stride)
         elif f == "holt_winters":
             out = temporal.holt_winters(
-                grid, W, _const_param(node.args[1]), _const_param(node.args[2]))
+                grid, W, _const_param(node.args[1]), _const_param(node.args[2]),
+                stride)
         elif f == "changes":
-            out = temporal.changes(grid, W)
+            out = temporal.changes(grid, W, stride)
         elif f == "resets":
-            out = temporal.resets(grid, W)
+            out = temporal.resets(grid, W, stride)
         elif f == "quantile_over_time":
-            out = temporal.quantile_over_time(grid, W, _const_param(node.args[0]))
+            out = temporal.quantile_over_time(
+                grid, W, _const_param(node.args[0]), stride)
         elif f == "absent_over_time":
             # 1 at steps where NO series has a sample in the window
             # (functions.go funcAbsentOverTime), labelled from the
             # selector's equality matchers like absent().
-            t_out = ext.meta.steps - W + 1
             if ext.n_series:
-                cnt = temporal.over_time(grid, W, "count")
+                cnt = temporal.over_time(grid, W, "count", stride)
                 present = np.nan_to_num(cnt).sum(axis=0) > 0
             else:
-                present = np.zeros(t_out, dtype=bool)
-            out = np.where(present, np.nan, 1.0)[None, ::stride]
+                present = np.zeros(params.meta().steps, dtype=bool)
+            out = np.where(present, np.nan, 1.0)[None, :]
             return Block(params.meta(), [_absent_tags(sel)], out)
         else:
             kind = f[: -len("_over_time")]
-            out = temporal.over_time(grid, W, kind)
-        out = out[:, ::stride]
+            fetch = temporal.over_time_async(grid, W, kind, stride,
+                                             finish="auto")
         drop_name = f not in ("last_over_time",)
         tags = [_strip_name(t) if drop_name else t for t in ext.series_tags]
+        if fetch is not None:
+            return LazyBlock(params.meta(), tags, fetch)
         return Block(params.meta(), tags, out)
 
     def _eval_instant_func(self, node: Call, params: QueryParams) -> Value:
@@ -339,7 +393,46 @@ class Engine:
 
     # -- aggregation -------------------------------------------------------
 
+    def _eval_sharded_agg(self, node: Aggregation,
+                          params: QueryParams) -> Optional[Block]:
+        """Mesh fast path for dashboard-shaped aggregations: a GLOBAL
+        op(rate|increase|delta(selector[R])) evaluates as one SPMD program
+        — each device runs the fused rate kernel on its series slice and a
+        single psum/pmin/pmax over the "shard" axis produces the [steps]
+        answer (parallel/query.py; the reference fans the same shape out
+        across dbnodes and merges at the coordinator,
+        src/query/storage/fanout/storage.go:1). Returns None when the
+        query shape doesn't match, falling back to the host path.
+        Device sums are f32 (DIVERGENCES.md)."""
+        if self.mesh is None or node.grouping or node.without:
+            return None
+        from ..parallel import query as pq
+
+        if node.op not in pq.AGG_OPS or not isinstance(node.expr, Call):
+            return None
+        func = node.expr.func
+        if func not in pq.RANGE_FUNCS:
+            return None
+        sel_args = [a for a in node.expr.args
+                    if isinstance(a, VectorSelector)]
+        if not sel_args or not sel_args[-1].range_ns:
+            return None
+        sel = sel_args[-1]
+        ext, W, stride = self._eval_range_selector(sel, params)
+        if ext.n_series == 0:
+            return Block(params.meta(), [], np.zeros((0, params.steps)))
+        out = pq.agg_rate(ext.values, self.mesh, op=node.op, func=func, W=W,
+                          step_ns=ext.meta.step_ns, range_ns=sel.range_ns,
+                          stride=stride)
+        from ..utils.instrument import ROOT
+
+        ROOT.counter("query.sharded_agg").inc()
+        return Block(params.meta(), [Tags.of({})], out[None, :])
+
     def _eval_aggregation(self, node: Aggregation, params: QueryParams) -> Block:
+        sharded = self._eval_sharded_agg(node, params)
+        if sharded is not None:
+            return sharded
         block = self._eval(node.expr, params)
         if not isinstance(block, Block):
             raise QueryError(f"{node.op} expects an instant vector")

@@ -29,6 +29,58 @@ def test_single_chip_ingest_roundtrip(rng):
     )
 
 
+def test_device_prep_matches_host_prep(rng):
+    """prepare_on_device_math must reproduce the host prep bit-for-bit on
+    live cells for k=0/float rows (decimal rows intentionally take the
+    float path on device — DIVERGENCES.md)."""
+    n, w = 256, 24
+    raw_ts, raw_vals, npoints = ingest.make_example_raw(n, w, rng)
+    npoints[:32] = rng.integers(1, w, 32)
+    raw_vals[1, 2] = -0.0           # forces float mode
+    raw_vals[2, 3] = np.nan
+    raw_vals[3, :] = 2.0**52        # int-mode edge: still < 2^53
+    raw_vals[4, :] = 2.0**53        # too big for the int path
+    raw_vals[5, :] = -(2.0**52 + 1)
+    raw_vals[6, :] = 0.25           # decimal: host k=2, device float mode
+    host = tsz.prepare_encode_inputs(raw_ts, raw_vals, npoints)
+    raw = ingest.make_raw_batch(raw_ts, raw_vals, npoints)
+    prep, ok = jax.jit(tsz.prepare_on_device_math)(
+        raw.ts_hi, raw.ts_lo, raw.vhi, raw.vlo, raw.npoints)
+    assert bool(ok)
+    decimal = host["int_mode"] & (host["k"] > 0)
+    assert decimal[6] and not bool(np.asarray(prep["int_mode"])[6])
+    rows = ~decimal
+    np.testing.assert_array_equal(
+        np.asarray(prep["int_mode"])[rows], host["int_mode"][rows])
+    for key in ("dt", "ts_regular", "delta0"):
+        np.testing.assert_array_equal(np.asarray(prep[key]), host[key],
+                                      err_msg=key)
+    live = (np.arange(w)[None, :] < npoints[:, None]) & rows[:, None]
+    np.testing.assert_array_equal(np.asarray(prep["vhi"])[live],
+                                  host["vhi"][live])
+    np.testing.assert_array_equal(np.asarray(prep["vlo"])[live],
+                                  host["vlo"][live])
+
+
+def test_raw_ingest_step_decodes_and_flags_range(rng):
+    n, w = 64, 24
+    raw_ts, raw_vals, npoints = ingest.make_example_raw(n, w, rng)
+    mw = tsz.max_words_for(w)
+    raw = ingest.make_raw_batch(raw_ts, raw_vals, npoints)
+    out = jax.jit(functools.partial(
+        ingest.ingest_step_raw, rollup_factor=6, max_words=mw))(raw)
+    assert bool(out[-1])
+    ts, vals = tsz.decode(np.asarray(out[0]), npoints, window=w)
+    np.testing.assert_array_equal(ts, raw_ts)
+    np.testing.assert_array_equal(vals, raw_vals)
+    bad_ts = raw_ts.copy()
+    bad_ts[0, 10] += 2**33  # delta overflows int32 ticks
+    raw_bad = ingest.make_raw_batch(bad_ts, raw_vals, npoints)
+    out_bad = jax.jit(functools.partial(
+        ingest.ingest_step_raw, rollup_factor=6, max_words=mw))(raw_bad)
+    assert not bool(out_bad[-1])
+
+
 def test_sharded_ingest_on_virtual_mesh(rng):
     mesh = ingest.make_mesh(8)
     assert mesh.shape == {"shard": 4, "time": 2}
@@ -54,6 +106,59 @@ def test_sharded_ingest_on_virtual_mesh(rng):
     for i in range(t):
         ts, vals = tsz.decode(np.asarray(words[i]), np.full(n, w, np.int32), window=w)
         np.testing.assert_allclose(vals, np.asarray(batch.values[i], np.float64), rtol=1e-6)
+
+
+class TestShardedServingPath:
+    """The executor's mesh fast path (query/executor.py _eval_sharded_agg)
+    must fire for dashboard-shaped aggregations on a multi-device platform
+    and agree with the single-device evaluation."""
+
+    def _engine(self, n=37, npts=48, mesh="auto"):
+        from m3_tpu.query import Engine
+
+        s_ns = 1_000_000_000
+        rng = np.random.default_rng(5)
+        t = 1_700_000_000 * s_ns + np.arange(npts, dtype=np.int64) * 10 * s_ns
+        vals = np.cumsum(rng.poisson(3.0, (n, npts)), axis=1).astype(float)
+        vals[rng.random((n, npts)) < 0.05] = np.nan
+        series = {
+            b"m{i=%d}" % i: {
+                "tags": {b"__name__": b"m", b"i": str(i).encode()},
+                "t": t, "v": vals[i]}
+            for i in range(n)
+        }
+
+        class _S:
+            def fetch_raw(self, matchers, start_ns, end_ns):
+                return {k: dict(v) for k, v in series.items()}
+
+        return Engine(_S(), mesh=mesh), int(t[12]), int(t[-1]), 30 * s_ns
+
+    def test_sharded_agg_fires_and_matches_host(self):
+        from m3_tpu.utils.instrument import ROOT
+
+        eng, start, end, step = self._engine()
+        eng_host, *_ = self._engine(mesh=None)
+        assert eng.mesh is not None, "conftest provides 8 virtual devices"
+        for q in ("sum(rate(m[1m]))", "avg(increase(m[1m]))",
+                  "count(delta(m[1m]))", "max(rate(m[1m]))",
+                  "min(rate(m[1m]))"):
+            before = ROOT.counter("query.sharded_agg").value()
+            got = eng.execute_range(q, start, end, step)
+            assert ROOT.counter("query.sharded_agg").value() == before + 1, q
+            want = eng_host.execute_range(q, start, end, step)
+            assert got.n_series == want.n_series == 1
+            np.testing.assert_allclose(got.values, want.values, rtol=1e-5,
+                                       equal_nan=True, err_msg=q)
+
+    def test_grouped_and_nonrate_fall_back_to_host(self):
+        from m3_tpu.utils.instrument import ROOT
+
+        eng, start, end, step = self._engine()
+        before = ROOT.counter("query.sharded_agg").value()
+        eng.execute_range("sum by (i) (rate(m[1m]))", start, end, step)
+        eng.execute_range("sum(m)", start, end, step)
+        assert ROOT.counter("query.sharded_agg").value() == before
 
 
 def test_graft_entry_compiles():

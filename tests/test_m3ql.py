@@ -1,0 +1,74 @@
+"""M3QL parser tests (reference: src/query/parser/m3ql/grammar_test.go —
+pipelines, keyword arguments, macros, nesting, comments)."""
+
+import pytest
+
+from m3_tpu.query.m3ql import M3QLError, Call, Pipeline, parse
+
+
+def test_simple_pipeline():
+    s = parse("fetch name:cpu.util host:web* | transform perSecond")
+    assert s.macros == ()
+    assert [c.name for c in s.pipeline.stages] == ["fetch", "transform"]
+    fetch = s.pipeline.stages[0]
+    assert fetch.kwargs == (("name", "cpu.util"), ("host", "web*"))
+    assert s.pipeline.stages[1].args == ("perSecond",)
+
+
+def test_operator_stage_and_numbers():
+    s = parse("fetch name:errors | > 0.5")
+    gt = s.pipeline.stages[1]
+    assert gt.name == ">" and gt.args == (0.5,)
+
+
+def test_booleans_and_strings():
+    s = parse('fetch name:x | summarize 1h sum alignToFrom:true '
+              '| alias "cpu usage"')
+    assert s.pipeline.stages[1].kwargs == (("alignToFrom", True),)
+    assert s.pipeline.stages[2].args == ("cpu usage",)
+
+
+def test_macro_definition_and_splice():
+    s = parse("cpu = fetch name:cpu.util | transform perSecond;\n"
+              "cpu | moving 5min avg")
+    assert s.macros[0][0] == "cpu"
+    # macro reference splices its stages into the pipeline
+    assert [c.name for c in s.pipeline.stages] == [
+        "fetch", "transform", "moving"]
+
+
+def test_nested_pipeline_argument():
+    s = parse("asPercent (fetch name:used) (fetch name:total)")
+    top = s.pipeline.stages[0]
+    assert top.name == "asPercent"
+    assert all(isinstance(a, Pipeline) for a in top.args)
+    assert top.args[0].stages[0].kwargs == (("name", "used"),)
+
+
+def test_comments_and_whitespace():
+    s = parse("# top-level comment\nfetch name:x  # trailing\n | head 5")
+    assert [c.name for c in s.pipeline.stages] == ["fetch", "head"]
+    assert s.pipeline.stages[1].args == (5.0,)
+
+
+def test_float_lookalikes_stay_strings():
+    """Identifier/pattern arguments that Python's float() happens to accept
+    must NOT parse as numbers (the reference PEG's Number rule is
+    digit-based)."""
+    s = parse("fetch name:inf | filter host:1_000 | keep nan")
+    assert s.pipeline.stages[0].kwargs == (("name", "inf"),)
+    assert s.pipeline.stages[1].kwargs == (("host", "1_000"),)
+    assert s.pipeline.stages[2].args == ("nan",)
+    s2 = parse("head 5 | scale -0.5 | shift 1e3")
+    assert s2.pipeline.stages[0].args == (5.0,)
+    assert s2.pipeline.stages[1].args == (-0.5,)
+    assert s2.pipeline.stages[2].args == (1000.0,)
+
+
+def test_parse_errors():
+    with pytest.raises(M3QLError):
+        parse("fetch name:x |")
+    with pytest.raises(M3QLError):
+        parse("(fetch name:x")
+    with pytest.raises(M3QLError):
+        parse("m = fetch name:x")  # macro def missing ';' + pipeline

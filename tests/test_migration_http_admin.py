@@ -11,8 +11,9 @@ import urllib.request
 
 from m3_tpu.aggregator import Aggregator, CaptureHandler
 from m3_tpu.aggregator.migration import (MIGRATION_MAX_FRAME,
-                                         MigrationReader, legacy_to_entry,
-                                         write_legacy)
+                                         MigrationReader,
+                                         RecoverableRecordError,
+                                         legacy_to_entry, write_legacy)
 from m3_tpu.aggregator.server import (HTTPAdminServer, RawTCPServer,
                                       TCPTransport, union_to_wire)
 from m3_tpu.metrics.metadata import Metadata, PipelineMetadata, StagedMetadata
@@ -144,6 +145,27 @@ def test_migration_reader_oversize_frame_rejected():
             raise AssertionError("expected ValueError")
         except ValueError:
             pass
+    finally:
+        a.close()
+        b.close()
+
+
+def test_migration_reader_desync_line_is_unrecoverable():
+    """Bytes that sniff as a legacy line (byte0=='{', byte3!=0) but are not
+    JSON mean the sniff mis-fired on binary data — the consumed-to-newline
+    bytes desynchronized the stream, so the reader must raise a plain
+    (connection-tearing) error, NOT RecoverableRecordError."""
+    a, b = socket.socketpair()
+    try:
+        a.sendall(b"\x7b\xff\xfe\xfd\x00\x01binary\n")
+        reader = MigrationReader(b)
+        try:
+            reader.read_entries()
+            raise AssertionError("expected ValueError")
+        except RecoverableRecordError:
+            raise AssertionError("desync must not be recoverable")
+        except ValueError as e:
+            assert "desync" in str(e)
     finally:
         a.close()
         b.close()
