@@ -51,6 +51,29 @@ class CaptureHandler(Handler):
         return [m for m in self.metrics if m.id == metric_id]
 
 
+class FileHandler(Handler):
+    """Appends one durable line per aggregated datapoint:
+    `id<TAB>time_nanos<TAB>value<TAB>policy`. Each line is flushed+fsynced
+    before handle() returns, so datapoints a leader emitted survive a
+    SIGKILL — what lets the failover smoke assert exactly-once flushing
+    across a leader crash (the durable analog of handler/logging.go for
+    multi-process tests)."""
+
+    def __init__(self, path: str):
+        self._f = open(path, "ab", buffering=0)
+
+    def handle(self, metric: AggregatedMetric):
+        import os as _os
+
+        self._f.write(b"%s\t%d\t%r\t%s\n" % (
+            metric.id, metric.time_nanos, metric.value,
+            str(metric.storage_policy).encode()))
+        _os.fsync(self._f.fileno())
+
+    def close(self):
+        self._f.close()
+
+
 class LoggingHandler(Handler):
     """handler/logging.go"""
 

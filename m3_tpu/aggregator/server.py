@@ -168,16 +168,7 @@ class RawTCPServer:
 
     def _handle(self, e: dict):
         try:
-            if e["t"] == "untimed":
-                mu, metadatas = union_from_wire(e)
-                self.aggregator.add_untimed(mu, metadatas)
-            elif e["t"] == "timed":
-                self.aggregator.add_timed(
-                    MetricType(e["mtype"]), e["id"], e["time"], e["value"],
-                    StoragePolicy.parse(e["policy"]), e.get("agg_id", 0))
-            elif e["t"] == "forwarded":
-                mt, mid, t_nanos, value, meta = forwarded_from_wire(e)
-                self.aggregator.add_forwarded(mt, mid, t_nanos, value, meta)
+            dispatch_entry(self.aggregator, e)
         except Exception:  # noqa: BLE001 - bad frame must not kill the conn
             with self._stats_lock:
                 self.errors += 1
@@ -196,10 +187,31 @@ class RawTCPServer:
         self._server.server_close()
 
 
+def dispatch_entry(agg: Aggregator, e: dict):
+    """Route one current-schema entry into the aggregator — the shared
+    sink behind both transports (rawtcp frames and HTTP ingest)."""
+    if e["t"] == "untimed":
+        mu, metadatas = union_from_wire(e)
+        agg.add_untimed(mu, metadatas)
+    elif e["t"] == "timed":
+        agg.add_timed(
+            MetricType(e["mtype"]), e["id"], e["time"], e["value"],
+            StoragePolicy.parse(e["policy"]), e.get("agg_id", 0))
+    elif e["t"] == "forwarded":
+        mt, mid, t_nanos, value, meta = forwarded_from_wire(e)
+        agg.add_forwarded(mt, mid, t_nanos, value, meta)
+    else:
+        raise ValueError(f"unknown entry type {e.get('t')!r}")
+
+
 class HTTPAdminServer:
     """Aggregator HTTP sidecar (src/aggregator/server/http/handlers.go):
     GET /health, GET /status (runtime flush/election status), and
-    POST /resign to step down from flush leadership before maintenance."""
+    POST /resign to step down from flush leadership before maintenance —
+    plus an HTTP INGEST variant: POST /ingest accepts newline-delimited
+    legacy-schema JSON records (the migration reader's entry model,
+    migration.legacy_to_entry), so collectors behind an HTTP-only network
+    path can write without speaking the framed binary codec."""
 
     def __init__(self, aggregator: Aggregator, host: str = "127.0.0.1",
                  port: int = 0):
@@ -250,6 +262,24 @@ class HTTPAdminServer:
                         self._reply(200, {"state": "OK"})
                     except Exception as e:  # noqa: BLE001
                         self._reply(500, {"error": str(e)})
+                elif self.path == "/ingest":
+                    from .migration import legacy_to_entry
+
+                    length = int(self.headers.get("Content-Length") or 0)
+                    body = self.rfile.read(length)
+                    accepted, errors = 0, []
+                    for i, line in enumerate(body.splitlines()):
+                        if not line.strip():
+                            continue
+                        try:
+                            dispatch_entry(
+                                agg, legacy_to_entry(_json.loads(line)))
+                            accepted += 1
+                        except Exception as e:  # noqa: BLE001
+                            errors.append(f"record {i}: {e}")
+                    code = 200 if not errors else 400
+                    self._reply(code, {"accepted": accepted,
+                                       "errors": errors[:16]})
                 else:
                     self._reply(404, {"error": "not found"})
 
@@ -269,26 +299,99 @@ class HTTPAdminServer:
         self._server.server_close()
 
 
-class TCPTransport:
-    """Client-side connection to one aggregator instance, usable as an
-    AggregatorClient transport (aggregator/client queue.go: buffered
-    connection with reconnect)."""
+class _BatchingTransport:
+    """Shared client-side batching scaffolding: __call__ encodes one metric
+    and appends; a full batch (or flush()) sends via the subclass's
+    _send_batch. Encoding failures return False like delivery failures —
+    the AggregatorClient transport contract is bool, never an exception."""
 
-    def __init__(self, endpoint: str, batch_size: int = 64):
-        self._endpoint = endpoint
-        self._sock = None
+    def __init__(self, batch_size: int = 64):
         self._lock = threading.Lock()
-        self._batch: List[dict] = []
+        self._batch: List = []
         self._batch_size = batch_size
 
+    def _encode(self, mu: MetricUnion, metadatas: Sequence[StagedMetadata]):
+        raise NotImplementedError
+
+    def _send_batch(self, batch: List) -> bool:
+        raise NotImplementedError
+
     def __call__(self, mu: MetricUnion, metadatas: Sequence[StagedMetadata]) -> bool:
-        entry = union_to_wire(mu, metadatas)
+        try:
+            entry = self._encode(mu, metadatas)
+        except Exception:  # noqa: BLE001 - count as a dropped write
+            return False
         with self._lock:
             self._batch.append(entry)
             if len(self._batch) < self._batch_size:
                 return True
             batch, self._batch = self._batch, []
         return self._send_batch(batch)
+
+    def flush(self) -> bool:
+        with self._lock:
+            batch, self._batch = self._batch, []
+        return self._send_batch(batch) if batch else True
+
+
+class HTTPTransport(_BatchingTransport):
+    """Client-side HTTP ingest to one aggregator admin endpoint, usable as
+    an AggregatorClient transport anywhere only HTTP traverses the network
+    path. Serializes each metric as a legacy-schema record (the migration
+    entry model) and POSTs newline-delimited batches to /ingest; staged
+    metadatas flatten to their storage policies, which is exactly the
+    information the legacy schema carries. Ids must be UTF-8 (the legacy
+    JSON schema is text); non-decodable ids count as dropped writes."""
+
+    def __init__(self, endpoint: str, batch_size: int = 64, timeout_s: float = 5.0):
+        super().__init__(batch_size)
+        self._url = endpoint.rstrip("/") + "/ingest"
+        self._timeout_s = timeout_s
+
+    def _encode(self, mu: MetricUnion, metadatas: Sequence[StagedMetadata]) -> bytes:
+        import json as _json
+
+        from .migration import _LEGACY_TYPES
+
+        # inverse of the migration reader's type table, so /ingest always
+        # accepts this transport's output
+        type_names = {v: k for k, v in _LEGACY_TYPES.items()}
+        policies = [str(p) for sm in metadatas
+                    for pm in sm.metadata.pipelines
+                    for p in pm.storage_policies]
+        value = (list(mu.batch_timer_val) if mu.type == MetricType.TIMER
+                 else mu.counter_val if mu.type == MetricType.COUNTER
+                 else mu.gauge_val)
+        return _json.dumps({"type": type_names[mu.type],
+                            "id": mu.id.decode(),
+                            "value": value, "policies": policies}).encode()
+
+    def _send_batch(self, batch: List[bytes]) -> bool:
+        import json as _json
+        import urllib.request
+
+        req = urllib.request.Request(
+            self._url, data=b"\n".join(batch) + b"\n", method="POST",
+            headers={"Content-Type": "application/x-ndjson"})
+        try:
+            with urllib.request.urlopen(req, timeout=self._timeout_s) as r:
+                return _json.loads(r.read()).get("accepted", 0) == len(batch)
+        except OSError:
+            return False
+
+
+class TCPTransport(_BatchingTransport):
+    """Client-side connection to one aggregator instance, usable as an
+    AggregatorClient transport (aggregator/client queue.go: buffered
+    connection with reconnect)."""
+
+    def __init__(self, endpoint: str, batch_size: int = 64):
+        super().__init__(batch_size)
+        self._endpoint = endpoint
+        self._sock = None
+
+    def _encode(self, mu: MetricUnion, metadatas: Sequence[StagedMetadata]) -> dict:
+        return union_to_wire(mu, metadatas)
 
     def send_forwarded(self, metric_type: MetricType, metric_id: bytes,
                        t_nanos: int, value: float,
@@ -303,11 +406,6 @@ class TCPTransport:
         batch.append(forwarded_to_wire(metric_type, metric_id, t_nanos,
                                        value, meta))
         return self._send_batch(batch)
-
-    def flush(self) -> bool:
-        with self._lock:
-            batch, self._batch = self._batch, []
-        return self._send_batch(batch) if batch else True
 
     def _send_batch(self, batch: List[dict]) -> bool:
         frame = {"t": "batch", "entries": batch}

@@ -78,6 +78,13 @@ class AggregatorConfig:
     kv_endpoint: str = ""
     placement_key: str = ""    # empty = static: own all shards
     topic: str = "aggregated_metrics"
+    # Durable per-datapoint flush sink (handler.FileHandler); empty
+    # disables. Used by the multi-process failover smoke to observe
+    # exactly-once flushing across a leader crash.
+    flush_log: str = ""
+    # Leader lease TTL: a dead leader's lease expires after this long and a
+    # follower's campaign wins (services/leader etcd-session TTL analog).
+    election_ttl: str = "10s"
 
 
 @dataclasses.dataclass

@@ -159,6 +159,7 @@ class AggregatorHandle:
     flush_thread: Optional[threading.Thread]
     kv: cluster_kv.MemStore
     admin: Optional[object] = None   # HTTPAdminServer when configured
+    flush_handler: Optional[object] = None  # closed with the handle
     _stop: threading.Event = dataclasses.field(default_factory=threading.Event)
 
     @property
@@ -174,6 +175,9 @@ class AggregatorHandle:
         if self.admin is not None:
             self.admin.close()
         self.server.close()
+        closer = getattr(self.flush_handler, "close", None)
+        if closer is not None:
+            closer()
 
 
 def run_aggregator(cfg: AggregatorConfig, flush_handler=None,
@@ -186,7 +190,13 @@ def run_aggregator(cfg: AggregatorConfig, flush_handler=None,
     routing targets the peers named by the placement's endpoints."""
     kv = _kv_store(cfg.kv_path, cfg.kv_endpoint)
     clock = clock or time.time_ns
-    leader = LeaderService(kv, cfg.election_id, cfg.instance_id, clock=clock)
+    owned_handler = None
+    if flush_handler is None and cfg.flush_log:
+        from ..aggregator.handler import FileHandler
+
+        flush_handler = owned_handler = FileHandler(cfg.flush_log)
+    leader = LeaderService(kv, cfg.election_id, cfg.instance_id, clock=clock,
+                           lease_ttl_ns=parse_duration_ns(cfg.election_ttl))
     election = ElectionManager(leader)
     flush_times = FlushTimesManager(kv, cfg.shard_set_id)
     agg = Aggregator(num_shards=cfg.num_shards, clock=clock,
@@ -243,7 +253,7 @@ def run_aggregator(cfg: AggregatorConfig, flush_handler=None,
             # admin port can't bind — the caller gets no handle to close.
             server.close()
             raise
-    handle = AggregatorHandle(agg, server, None, kv, admin)
+    handle = AggregatorHandle(agg, server, None, kv, admin, owned_handler)
     interval_s = parse_duration_ns(cfg.flush_interval) / 1e9
 
     def flush_loop():

@@ -210,4 +210,100 @@ assert out[0]["target"] == "smoke.carbon.count"
 print("carbon line in -> graphite render OK")
 EOF
 
+# --- 9. leader/follower failover: SIGKILL the leader mid-stream -----------
+# (reference: src/aggregator/integration election suites + election_mgr.go:99)
+# Two HA aggregators share one election; both ingest the same dual-written
+# counter stream; the leader is SIGKILLed and the follower must promote and
+# resume flushing from the KV flush times — every window flushed EXACTLY
+# once across the two processes' durable flush logs.
+for a in ha-a ha-b; do
+  cat > "$WORKDIR/$a.yml" <<EOF
+instance_id: $a
+listen_address: 127.0.0.1:0
+num_shards: 8
+kv_endpoint: $KV
+election_id: agg-ha
+election_ttl: 3s
+flush_interval: 1s
+flush_log: $WORKDIR/$a.flush.log
+EOF
+done
+python -m m3_tpu.services aggregator -f "$WORKDIR/ha-a.yml" > "$WORKDIR/ha-a.log" 2>&1 &
+HA_A_PID=$!
+PIDS+=($HA_A_PID)
+await_log "$WORKDIR/ha-a.log" "m3_tpu aggregator listening on"
+sleep 1.5  # let ha-a win the election before the follower starts
+python -m m3_tpu.services aggregator -f "$WORKDIR/ha-b.yml" > "$WORKDIR/ha-b.log" 2>&1 &
+PIDS+=($!)
+await_log "$WORKDIR/ha-b.log" "m3_tpu aggregator listening on"
+HA_A=$(grep "m3_tpu aggregator listening on" "$WORKDIR/ha-a.log" | awk '{print $NF}')
+HA_B=$(grep "m3_tpu aggregator listening on" "$WORKDIR/ha-b.log" | awk '{print $NF}')
+
+# Dual-write one TIMED counter point per 10s window, spanning windows that
+# close progressively over the next ~25s (mirrored-replica ingest).
+python - "$HA_A" "$HA_B" <<'EOF'
+import socket, sys, time
+from m3_tpu.metrics.metric import MetricType
+from m3_tpu.rpc import wire
+S = 10**9
+now = time.time_ns()
+first = now // (10 * S) * (10 * S) - 20 * S
+entries = [
+    {"t": "timed", "mtype": int(MetricType.COUNTER), "id": b"ha.count",
+     "time": first + i * 10 * S + 5 * S, "value": float(100 + i),
+     "policy": "10s:2d"}
+    for i in range(5)  # windows closing from ~now to ~now+25s
+]
+for ep in sys.argv[1:3]:
+    host, _, port = ep.rpartition(":")
+    with socket.create_connection((host, int(port)), timeout=5) as s:
+        wire.write_frame(s, {"t": "batch", "entries": entries})
+print("dual-wrote 5 windows to both HA aggregators")
+EOF
+
+for i in $(seq 1 40); do
+  [ -s "$WORKDIR/ha-a.flush.log" ] && break
+  sleep 0.5
+done
+[ -s "$WORKDIR/ha-a.flush.log" ] || { echo "leader never flushed"; cat "$WORKDIR/ha-a.log"; exit 1; }
+# The flush loop emits (durable log line) THEN commits flush times to KV —
+# an at-least-once window of a few ms. Killing right on the observed line
+# could land inside it and legitimately double-flush; a 1s grace puts the
+# SIGKILL well past the commit (the next window is ~10s away).
+sleep 1
+kill -9 "$HA_A_PID"
+echo "leader ha-a SIGKILLed after $(wc -l < "$WORKDIR/ha-a.flush.log") flushed window(s)"
+
+# Wait until the promoted follower has drained every remaining window
+# (the last one only closes ~30s after the writes).
+for i in $(seq 1 120); do
+  TOTAL=$(cat "$WORKDIR/ha-a.flush.log" "$WORKDIR/ha-b.flush.log" 2>/dev/null | wc -l)
+  [ "$TOTAL" -ge 5 ] && break
+  sleep 0.5
+done
+python - "$WORKDIR/ha-a.flush.log" "$WORKDIR/ha-b.flush.log" <<'EOF'
+import sys
+S = 10**9
+windows = {}
+for who, path in (("ha-a", sys.argv[1]), ("ha-b", sys.argv[2])):
+    for line in open(path, "rb").read().splitlines():
+        mid, t, v, pol = line.split(b"\t")
+        assert mid == b"ha.count", line
+        windows.setdefault(int(t), []).append((who, float(v)))
+assert windows, "nothing flushed"
+ends = sorted(windows)
+dupes = {t: w for t, w in windows.items() if len(w) > 1}
+assert not dupes, f"double-flushed windows: {dupes}"
+span = [ends[0] + i * 10 * S for i in range(len(ends))]
+assert ends == span, f"lost windows (gaps): {[e // S for e in ends]}"
+assert len(ends) == 5, f"expected 5 windows, got {len(ends)}"
+by_who = {w for t in windows for (w, _) in windows[t]}
+assert by_who == {"ha-a", "ha-b"}, f"failover not exercised: {by_who}"
+vals = [windows[t][0][1] for t in ends]
+assert vals == [100.0, 101.0, 102.0, 103.0, 104.0], vals
+print(f"failover OK: {len(ends)} windows flushed exactly once "
+      f"({sum(1 for t in ends if windows[t][0][0]=='ha-a')} by ha-a, "
+      f"{sum(1 for t in ends if windows[t][0][0]=='ha-b')} by ha-b)")
+EOF
+
 echo "SMOKE PASS"

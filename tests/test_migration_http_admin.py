@@ -171,6 +171,51 @@ def test_migration_reader_desync_line_is_unrecoverable():
         b.close()
 
 
+def test_http_ingest_variant():
+    """HTTP ingest (src/aggregator/server/http analog + task: collectors
+    behind HTTP-only paths): legacy-schema NDJSON POSTed to /ingest lands
+    in the aggregator via the same dispatch as rawtcp, and the
+    HTTPTransport client wraps a MetricUnion write end-to-end."""
+    import json as _json
+    import urllib.request
+
+    from m3_tpu.aggregator.server import HTTPAdminServer, HTTPTransport
+    from m3_tpu.metrics.metadata import (Metadata, PipelineMetadata,
+                                         StagedMetadata)
+    from m3_tpu.metrics.metric import MetricUnion
+    from m3_tpu.metrics.policy import StoragePolicy
+
+    clock = SettableClock(100 * S)
+    cap = CaptureHandler()
+    agg = Aggregator(num_shards=8, clock=clock, flush_handler=cap)
+    srv = HTTPAdminServer(agg).start()
+    try:
+        # raw NDJSON ingest, including one bad record -> 400 + partial accept
+        body = (b'{"type": "counter", "id": "http.count", "value": 7, '
+                b'"policies": ["10s:2d"]}\n'
+                b'{"type": "bogus", "id": "x", "value": 1}\n')
+        req = urllib.request.Request(srv.endpoint + "/ingest", data=body,
+                                     method="POST")
+        try:
+            urllib.request.urlopen(req)
+            raise AssertionError("expected HTTP 400 for the bad record")
+        except urllib.error.HTTPError as e:
+            out = _json.loads(e.read())
+            assert e.code == 400 and out["accepted"] == 1, out
+        # transport client: a collector-side write over HTTP
+        tr = HTTPTransport(srv.endpoint, batch_size=1)
+        md = (StagedMetadata(0, False, Metadata((PipelineMetadata(
+            0, (StoragePolicy.parse("10s:2d"),)),))),)
+        assert tr(MetricUnion.counter(b"http.count", 5), md)
+        assert agg.num_entries() == 1
+        clock.advance(10 * S)
+        agg.flush()
+        out = cap.by_id(b"http.count")
+        assert len(out) == 1 and out[0].value == 12.0  # 7 + 5 summed
+    finally:
+        srv.close()
+
+
 def test_http_admin_health_status_resign():
     clock = SettableClock(100 * S)
     agg = Aggregator(num_shards=4, clock=clock,
