@@ -81,6 +81,22 @@ class Elem:
                 agg_types = magg.AggID.decompress(key.aggregation_id)
         self.agg_types: Tuple[magg.AggType, ...] = tuple(agg_types)
         self.resolution_ns = key.storage_policy.resolution.window_ns
+        # Static per-elem facts, precomputed: the flush hot loop touches
+        # every elem every window, and recomputing these 250k times per
+        # flush dominated the aggregation tier's cost.
+        self._quantiles: Tuple[float, ...] = tuple(
+            sorted({q for t in self.agg_types
+                    if (q := t.quantile()) is not None}))
+        self._out_ids: Dict[magg.AggType, bytes] = {
+            at: self._output_id(at) for at in self.agg_types}
+        # The vectorized-emission shape (list.py reduce_and_emit): ONE
+        # non-quantile agg type, no pipeline — counters (Sum) and gauges
+        # (Last), i.e. the overwhelming majority of a metrics workload.
+        self._simple_type: Optional[magg.AggType] = (
+            self.agg_types[0]
+            if (key.pipeline.is_empty() and len(self.agg_types) == 1
+                and self.agg_types[0].quantile() is None)
+            else None)
         self._buckets: Dict[int, _Bucket] = {}
         # Per-pipeline-transform previous datapoint, for binary transforms
         # (PerSecond needs the prior window's value: generic_elem.go:300
@@ -129,7 +145,7 @@ class Elem:
     # -- post-reduction emission ------------------------------------------
 
     def quantiles_needed(self) -> Tuple[float, ...]:
-        return tuple(sorted({q for t in self.agg_types if (q := t.quantile()) is not None}))
+        return self._quantiles
 
     def emit(self, window_start: int, stats_row: Dict[str, float],
              quantile_row: Dict[float, float],
@@ -147,7 +163,7 @@ class Elem:
             q = at.quantile()
             value = quantile_row[q] if q is not None else _stat_value(at, stats_row)
             if self.key.pipeline.is_empty():
-                flush_fn(self._output_id(at), end_nanos, value, self.key.storage_policy)
+                flush_fn(self._out_ids[at], end_nanos, value, self.key.storage_policy)
             else:
                 self._process_pipeline(at, end_nanos, value, flush_fn, forward_fn)
 
@@ -181,7 +197,7 @@ class Elem:
                 return
             else:
                 raise ValueError(f"unsupported pipeline op {op.type} in elem")
-        flush_fn(self._output_id(at), dp.time_nanos, dp.value, self.key.storage_policy)
+        flush_fn(self._out_ids[at], dp.time_nanos, dp.value, self.key.storage_policy)
 
     def _output_id(self, at: magg.AggType) -> bytes:
         """Aggregated output ID: metric name + '.' + type suffix, suppressed
@@ -197,22 +213,42 @@ class Elem:
         return suffixed + sep + rest if rest else suffixed
 
 
-def _stat_value(at: magg.AggType, stats: Dict[str, float]) -> float:
+# Moment columns each non-quantile agg type reads ("count" is always
+# available — it gates the empty-window defaults).
+STAT_DEPS: Dict[magg.AggType, Tuple[str, ...]] = {
+    magg.AggType.SUM: ("sum",), magg.AggType.SUMSQ: ("sumsq",),
+    magg.AggType.COUNT: (), magg.AggType.MIN: ("min",),
+    magg.AggType.MAX: ("max",), magg.AggType.LAST: ("last",),
+    magg.AggType.MEAN: ("sum",), magg.AggType.STDEV: ("m2",),
+}
+
+
+def stat_column(at: magg.AggType, m: Dict[str, np.ndarray]):
+    """Output value(s) for one non-quantile agg type over moment columns —
+    the ONE stat mapping, shared by the per-window scalar path (via
+    _stat_value) and list.py's vectorized flush emission (scalars and
+    arrays both work; numpy broadcasting carries either)."""
+    cnt = m["count"]
     if at == magg.AggType.SUM:
-        return stats["sum"]
+        return m["sum"]
     if at == magg.AggType.SUMSQ:
-        return stats["sumsq"]
+        return m["sumsq"]
     if at == magg.AggType.COUNT:
-        return stats["count"]
+        return cnt
     if at == magg.AggType.MIN:
-        return stats["min"] if stats["count"] > 0 else 0.0
+        return np.where(cnt > 0, m["min"], 0.0)
     if at == magg.AggType.MAX:
-        return stats["max"] if stats["count"] > 0 else 0.0
+        return np.where(cnt > 0, m["max"], 0.0)
     if at == magg.AggType.LAST:
-        return stats["last"]
+        return m["last"]
     if at == magg.AggType.MEAN:
-        return stats["sum"] / stats["count"] if stats["count"] > 0 else 0.0
+        return np.where(cnt > 0, m["sum"] / np.maximum(cnt, 1), 0.0)
     if at == magg.AggType.STDEV:
-        n = stats["count"]
-        return float(np.sqrt(stats["m2"] / (n - 1))) if n > 1 else 0.0
+        with np.errstate(invalid="ignore", divide="ignore"):
+            return np.where(cnt > 1,
+                            np.sqrt(m["m2"] / np.maximum(cnt - 1, 1)), 0.0)
     raise ValueError(f"no stat mapping for {at}")
+
+
+def _stat_value(at: magg.AggType, stats: Dict[str, float]) -> float:
+    return float(stat_column(at, stats))

@@ -52,17 +52,15 @@ def _quantile_rank_fn(width: int, qs: Tuple[float, ...]):
     return jax.jit(fn)
 
 
-def batched_reduce(buckets: List[np.ndarray], qs: Tuple[float, ...]):
-    """Reduce a ragged list of value arrays: mergeable moments + quantiles.
+def _columnar_moments(buckets: List[np.ndarray], needed=None) -> dict:
+    """Mergeable moments over a ragged bucket list as COLUMNAR f64 arrays
+    (np.reduceat — exact f64, matching the reference's float64
+    accumulators): sum/sumsq/count/min/max/first/last/m2, each [B].
 
-    Moments (sum/sumsq/count/min/max/first/last/m2) are one vectorized host
-    pass over the concatenated values (np.reduceat — exact f64, matching the
-    reference's float64 accumulators); the heavy O(W log W) work, batched
-    quantile ordering, runs on device. Returns (stats_rows, quantile_rows):
-    per-bucket dicts of python floats.
-    """
-    if not buckets:
-        return [], []
+    `needed` limits which columns are computed ("count" always is): a
+    pure counter/gauge flush only pays for the sums/lasts it emits, not
+    the m2 chain's extra full-length passes."""
+    need = set(_STAT_KEYS if needed is None else needed)
     counts = np.array([b.size for b in buckets], dtype=np.int64)
     nonempty = counts > 0
     safe = [b if b.size else np.zeros(1) for b in buckets]
@@ -70,26 +68,30 @@ def batched_reduce(buckets: List[np.ndarray], qs: Tuple[float, ...]):
     starts = np.zeros(len(safe), dtype=np.int64)
     starts[1:] = np.cumsum(sizes)[:-1]
     cat = np.concatenate(safe)
-    sums = np.where(nonempty, np.add.reduceat(cat, starts), 0.0)
-    sumsq = np.where(nonempty, np.add.reduceat(cat * cat, starts), 0.0)
-    mins = np.where(nonempty, np.minimum.reduceat(cat, starts), np.inf)
-    maxs = np.where(nonempty, np.maximum.reduceat(cat, starts), -np.inf)
-    first = np.where(nonempty, cat[starts], 0.0)
-    last = np.where(nonempty, cat[starts + sizes - 1], 0.0)
-    mu = np.where(nonempty, sums / sizes, 0.0)
-    dev = cat - np.repeat(mu, sizes)
-    m2 = np.where(nonempty, np.add.reduceat(dev * dev, starts), 0.0)
-    stats_rows = [
-        {
-            "sum": float(sums[i]), "sumsq": float(sumsq[i]),
-            "count": float(counts[i]), "min": float(mins[i]),
-            "max": float(maxs[i]), "first": float(first[i]),
-            "last": float(last[i]), "m2": float(m2[i]),
-        }
-        for i in range(len(buckets))
-    ]
-    if not qs:
-        return stats_rows, [{} for _ in buckets]
+    m = {"count": counts.astype(np.float64)}
+    if need & {"sum", "m2"}:
+        m["sum"] = sums = np.where(nonempty, np.add.reduceat(cat, starts), 0.0)
+    if "sumsq" in need:
+        m["sumsq"] = np.where(nonempty, np.add.reduceat(cat * cat, starts), 0.0)
+    if "min" in need:
+        m["min"] = np.where(nonempty, np.minimum.reduceat(cat, starts), np.inf)
+    if "max" in need:
+        m["max"] = np.where(nonempty, np.maximum.reduceat(cat, starts), -np.inf)
+    if "first" in need:
+        m["first"] = np.where(nonempty, cat[starts], 0.0)
+    if "last" in need:
+        m["last"] = np.where(nonempty, cat[starts + sizes - 1], 0.0)
+    if "m2" in need:
+        mu = np.where(nonempty, sums / sizes, 0.0)
+        dev = cat - np.repeat(mu, sizes)
+        m["m2"] = np.where(nonempty, np.add.reduceat(dev * dev, starts), 0.0)
+    return m
+
+
+def _quantile_rows_for(buckets: List[np.ndarray], qs: Tuple[float, ...]):
+    """Batched device quantile ordering over a bucket list -> per-bucket
+    {q: value} dicts (host gathers exact f64 values by device index)."""
+    counts = np.array([b.size for b in buckets], dtype=np.int64)
     max_n = max(1, int(counts.max()))
     width = ((max_n + _LANE - 1) // _LANE) * _LANE
     tile = np.zeros((len(buckets), width), dtype=np.float32)
@@ -98,30 +100,86 @@ def batched_reduce(buckets: List[np.ndarray], qs: Tuple[float, ...]):
     idx = np.asarray(
         _quantile_rank_fn(width, qs)(tile, counts.astype(np.int32))
     )
-    quantile_rows = [
+    return [
         {
             q: float(buckets[i][min(idx[i, j], counts[i] - 1)]) if counts[i] else 0.0
             for j, q in enumerate(qs)
         }
         for i in range(len(buckets))
     ]
-    return stats_rows, quantile_rows
+
+
+def batched_reduce(buckets: List[np.ndarray], qs: Tuple[float, ...]):
+    """Reduce a ragged list of value arrays: mergeable moments + quantiles.
+
+    Moments are one vectorized host pass (_columnar_moments); the heavy
+    O(W log W) work, batched quantile ordering, runs on device. Returns
+    (stats_rows, quantile_rows): per-bucket dicts of python floats.
+    """
+    if not buckets:
+        return [], []
+    m = _columnar_moments(buckets)
+    stats_rows = _stats_rows(m, range(len(buckets)))
+    if not qs:
+        return stats_rows, [{} for _ in buckets]
+    return stats_rows, _quantile_rows_for(buckets, qs)
+
+
+def _stats_rows(m: dict, idxs) -> list:
+    cols = [m[k] for k in _STAT_KEYS]
+    return [dict(zip(_STAT_KEYS, (float(c[i]) for c in cols))) for i in idxs]
+
+
+_STAT_KEYS = ("sum", "sumsq", "count", "min", "max", "first", "last", "m2")
 
 
 def reduce_and_emit(jobs) -> int:
     """Reduce a batch of (elem, window_start, values, flush_fn, forward_fn)
     jobs — possibly gathered across many lists and shards — in one device
-    call, then emit each window through its own sink."""
+    call, then emit each window through its own sink.
+
+    Emission is two-speed: elems with ONE non-quantile agg type and no
+    pipeline (counters/gauges — the bulk of a metrics workload) emit
+    straight from the columnar moment arrays with precomputed output ids;
+    everything else (timers, pipelines, custom agg sets) goes through the
+    general per-elem emit with its per-bucket stat/quantile dicts. The
+    device quantile ordering only ever sees the buckets that need it."""
     if not jobs:
         return 0
-    qset = set()
-    for elem, _, _, _, _ in jobs:
-        qset.update(elem.quantiles_needed())
-    qs = tuple(sorted(qset))
-    stats_rows, quantile_rows = batched_reduce([j[2] for j in jobs], qs)
-    for (elem, start, _, flush_fn, forward_fn), srow, qrow in zip(
-            jobs, stats_rows, quantile_rows):
-        elem.emit(start, srow, qrow, flush_fn, forward_fn)
+    slow_idx = [i for i, j in enumerate(jobs) if j[0]._simple_type is None]
+    if slow_idx:
+        needed = None  # slow emit reads the full stats row
+    else:
+        from .elem import STAT_DEPS
+
+        needed = {k for j in jobs for k in STAT_DEPS[j[0]._simple_type]}
+    m = _columnar_moments([j[2] for j in jobs], needed)
+    # quantile ordering only over the slow jobs that want quantiles
+    q_idx = [i for i in slow_idx if jobs[i][0]._quantiles]
+    qrows = {}
+    if q_idx:
+        qs = tuple(sorted({q for i in q_idx for q in jobs[i][0]._quantiles}))
+        for i, row in zip(q_idx, _quantile_rows_for(
+                [jobs[i][2] for i in q_idx], qs)):
+            qrows[i] = row
+    if slow_idx:
+        for i, srow in zip(slow_idx, _stats_rows(m, slow_idx)):
+            elem, start, _, flush_fn, forward_fn = jobs[i]
+            elem.emit(start, srow, qrows.get(i, {}), flush_fn, forward_fn)
+    if len(slow_idx) < len(jobs):
+        from .elem import stat_column
+
+        slow = set(slow_idx)
+        cols = {}
+        for i, (elem, start, _, flush_fn, _fw) in enumerate(jobs):
+            if i in slow:
+                continue
+            at = elem._simple_type
+            col = cols.get(at)
+            if col is None:
+                col = cols[at] = stat_column(at, m)
+            flush_fn(elem._out_ids[at], start + elem.resolution_ns,
+                     float(col[i]), elem.key.storage_policy)
     return len(jobs)
 
 
