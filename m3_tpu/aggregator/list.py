@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .elem import Elem, ElemKey
+from .elem import STAT_DEPS, Elem, ElemKey, stat_column
 
 _LANE = 128  # pad the value axis to lane multiples to limit recompiles
 
@@ -150,8 +150,6 @@ def reduce_and_emit(jobs) -> int:
     if slow_idx:
         needed = None  # slow emit reads the full stats row
     else:
-        from .elem import STAT_DEPS
-
         needed = {k for j in jobs for k in STAT_DEPS[j[0]._simple_type]}
     m = _columnar_moments([j[2] for j in jobs], needed)
     # quantile ordering only over the slow jobs that want quantiles
@@ -167,8 +165,6 @@ def reduce_and_emit(jobs) -> int:
             elem, start, _, flush_fn, forward_fn = jobs[i]
             elem.emit(start, srow, qrows.get(i, {}), flush_fn, forward_fn)
     if len(slow_idx) < len(jobs):
-        from .elem import stat_column
-
         slow = set(slow_idx)
         cols = {}
         for i, (elem, start, _, flush_fn, _fw) in enumerate(jobs):

@@ -444,3 +444,33 @@ class TestTombstoneRevive:
                  if m.storage_policy == TEN_S]
         assert len(ten_s) == 2
         assert ten_s[-1].value == 5.0
+
+
+class TestStatMappingParity:
+    def test_scalar_twin_matches_columnar_mapping(self):
+        """_stat_value (per-window scalar emit) and stat_column (vectorized
+        flush emission) are hand-kept twins of the same agg-type -> value
+        mapping; this pins their parity, including empty-window defaults
+        (count==0 -> 0.0 for min/max/mean, count<=1 -> 0.0 for stdev)."""
+        import numpy as np
+
+        from m3_tpu.aggregator.elem import STAT_DEPS, _stat_value, stat_column
+
+        rng = np.random.default_rng(42)
+        for _ in range(100):
+            cnt = int(rng.integers(0, 6))
+            vals = rng.standard_normal(cnt) if cnt else np.zeros(0)
+            m = {
+                "count": float(cnt),
+                "sum": float(vals.sum()),
+                "sumsq": float((vals ** 2).sum()),
+                "min": float(vals.min()) if cnt else float("inf"),
+                "max": float(vals.max()) if cnt else float("-inf"),
+                "last": float(vals[-1]) if cnt else float("nan"),
+                "m2": float(((vals - vals.mean()) ** 2).sum()) if cnt else 0.0,
+            }
+            for at in STAT_DEPS:
+                a = _stat_value(at, m)
+                b = float(stat_column(at, m))
+                assert (a == b or (np.isnan(a) and np.isnan(b))
+                        or abs(a - b) < 1e-12), (at, a, b)
