@@ -30,11 +30,16 @@ from .promql import (
     Node,
     NumberLiteral,
     StringLiteral,
+    Subquery,
     Unary,
     VectorSelector,
 )
 
 DEFAULT_LOOKBACK_NS = 5 * 60 * 1_000_000_000
+# Floor for the default subquery resolution (`[1h:]` with no explicit res):
+# the stand-in for prometheus' default evaluation interval, so an instant
+# query (step 1s) doesn't evaluate the inner expression per second of range.
+DEFAULT_SUBQUERY_RES_NS = 15 * 1_000_000_000
 
 Scalar = np.ndarray  # [steps] float
 Value = Union[Block, np.ndarray, float]
@@ -130,6 +135,9 @@ class Engine:
         with span("query.parse"):
             ast = promql.parse(query)
         params = QueryParams(start_ns, end_ns, step_ns)
+        # @ start()/end() resolve against the OUTERMOST query range even
+        # inside subqueries (prom promql/parser/ast.go StartOrEnd).
+        self._local.outer_params = params
         if self.cost_enforcer is not None:
             child = self.cost_enforcer.child(self.per_query_cost_limit)
             self._local.enforcer = child
@@ -156,6 +164,8 @@ class Engine:
             if node.range_ns:
                 raise QueryError("matrix selector used outside a function")
             return self._eval_instant_selector(node, params)
+        if isinstance(node, Subquery):
+            raise QueryError("subquery result used outside a range function")
         if isinstance(node, Unary):
             val = self._eval(node.expr, params)
             return _map_values(val, lambda v: -v)
@@ -181,8 +191,38 @@ class Engine:
             enforcer.add(points)
         return series
 
+    def _resolve_at(self, at) -> int:
+        """Absolute eval timestamp for an @-modifier. start()/end() come
+        from the outermost query range, not any inner subquery grid."""
+        if isinstance(at, str):
+            outer: QueryParams = self._local.outer_params
+            if at == "start":
+                return outer.start_ns
+            return outer.start_ns + (outer.steps - 1) * outer.step_ns
+        return int(at)
+
+    def _pin_at(self, node, sel, params: QueryParams) -> Block:
+        """Evaluate `node` (with range/instant selector `sel` carrying an
+        @-modifier) at the pinned timestamp, then tile the single-step
+        result across the query's steps — an @-pinned expression is
+        constant over the output grid (prom promql/engine.go)."""
+        t = self._resolve_at(sel.at_ns)
+        pinned = QueryParams(t, t, params.step_ns)
+        sel2 = dataclasses.replace(sel, at_ns=None)
+        if node is sel:
+            out = self._eval(sel2, pinned)
+        else:
+            node2 = dataclasses.replace(node, args=tuple(
+                sel2 if a is sel else a for a in node.args))
+            out = self._eval_range_func(node2, pinned)
+        blk = _to_block(out, pinned)
+        return Block(params.meta(), blk.series_tags,
+                     np.repeat(np.asarray(blk.values), params.steps, axis=1))
+
     def _eval_instant_selector(self, sel: VectorSelector,
                                params: QueryParams) -> Block:
+        if sel.at_ns is not None:
+            return self._pin_at(sel, sel, params)
         off = sel.offset_ns
         meta = params.meta()
         series = self._fetch(sel, params.start_ns - self.lookback_ns - off,
@@ -211,6 +251,79 @@ class Engine:
         tags_list, values = consolidate_series(series, ext_meta, wgrid)
         return Block(ext_meta, tags_list, values), W, stride
 
+    def _eval_subquery_grid(self, sub: Subquery, params: QueryParams
+                            ) -> Tuple[Block, int, int]:
+        """Evaluate `expr[range:res]`: run the inner expression as ONE
+        instant-style evaluation over a fine grid of resolution-aligned
+        timestamps covering every outer step's trailing window, then hand
+        the [series x fine-steps] block to the same W/stride reduce-window
+        machinery matrix selectors use (prometheus promql/engine.go
+        evalSubquery; each window sees the inner values at the res-aligned
+        times in (T-range, T]).
+
+        Default resolution (`[1h:]`) is the query step floored at 15s —
+        this engine's stand-in for prometheus' default evaluation interval
+        (an unfloored default would make an instant query, step 1s,
+        evaluate the inner expression 3601 times per hour of range). Eval
+        timestamps are absolute multiples of res (prometheus aligns
+        subquery steps independently of the query time). When res divides
+        the query step and covers the range at least once, the res grid
+        feeds the kernels directly; otherwise the windows are gathered
+        into a packed [steps x Wmax] layout (W=stride=Wmax) — sample
+        membership per window stays exactly (T-range, T] either way, and
+        when res divides the range the packed windows carry no padding
+        lanes, so the rate family's position-based extrapolation sees the
+        true window span (a non-dividing res leaves one NaN lane whose
+        res-sized skew is documented in DIVERGENCES.md)."""
+        res = sub.step_ns or max(params.step_ns, DEFAULT_SUBQUERY_RES_NS)
+        off = sub.offset_ns
+        x0 = params.start_ns - off
+        # Window for output T: res-multiples k*res with
+        # (T-off-range)//res < k <= (T-off)//res.
+        k_min = (x0 - sub.range_ns) // res + 1
+        # Last OUTPUT step, not params.end_ns: end is only "last step <=
+        # end" and may overshoot the step grid by a fraction of a step.
+        k_max = (x0 + (params.steps - 1) * params.step_ns) // res
+        # k_max < k_min: no window contains any res-aligned timestamp
+        # (single-step query with range < res off-phase). Evaluate one
+        # token timestamp so the series set is known; every lane masks
+        # invalid below and the result is all-NaN, like prometheus'
+        # empty matrix.
+        k_max = max(k_max, k_min)
+        inner = QueryParams(k_min * res, k_max * res, res)
+        val = self._eval(sub.expr, inner)
+        block = _to_block(val, inner)
+        if params.step_ns % res == 0 and sub.range_ns >= res:
+            # Shared grid: every output step's window is a contiguous run
+            # ending at a constant offset + i*stride (constant width — the
+            # phase x mod res is the same for every step).
+            W = x0 // res - (x0 - sub.range_ns) // res
+            stride = params.step_ns // res
+        else:
+            # Packed gather: per-step window ends drift across the res
+            # grid (or the range is shorter than one res cell), so windows
+            # go side by side. res | range => every window holds exactly
+            # range/res samples and no padding lane exists.
+            Wmax = max(sub.range_ns // res + (1 if sub.range_ns % res else 0),
+                       1)
+            steps = params.steps
+            x = x0 + np.arange(steps, dtype=np.int64) * params.step_ns
+            k_end = x // res
+            k_start = (x - sub.range_ns) // res + 1
+            cols = (k_end[:, None] - (Wmax - 1) + np.arange(Wmax)[None, :]
+                    - k_min)                                # [steps, Wmax]
+            valid = cols >= (k_start - k_min)[:, None]
+            vals = block.values
+            packed = np.where(valid[None, :, :],
+                              vals[:, np.clip(cols, 0, vals.shape[1] - 1)],
+                              np.nan).reshape(vals.shape[0], steps * Wmax)
+            block = Block(BlockMeta(inner.start_ns, res, steps * Wmax),
+                          block.series_tags, packed)
+            W = stride = Wmax
+        assert block.meta.steps == (W - 1) + (params.steps - 1) * stride + 1, (
+            block.meta.steps, W, stride, params.steps)
+        return block, W, stride
+
     # -- functions ---------------------------------------------------------
 
     _RANGE_FUNCS = {
@@ -230,11 +343,18 @@ class Engine:
     def _eval_range_func(self, node: Call, params: QueryParams) -> Block:
         from .block import LazyBlock
 
-        sel_args = [a for a in node.args if isinstance(a, VectorSelector)]
-        if not sel_args or not sel_args[-1].range_ns:
+        range_args = [a for a in node.args
+                      if isinstance(a, (VectorSelector, Subquery))]
+        if not range_args or not (isinstance(range_args[-1], Subquery)
+                                  or range_args[-1].range_ns):
             raise QueryError(f"{node.func} expects a range vector")
-        sel = sel_args[-1]
-        ext, W, stride = self._eval_range_selector(sel, params)
+        sel = range_args[-1]
+        if sel.at_ns is not None:
+            return self._pin_at(node, sel, params)
+        if isinstance(sel, Subquery):
+            ext, W, stride = self._eval_subquery_grid(sel, params)
+        else:
+            ext, W, stride = self._eval_range_selector(sel, params)
         grid = ext.values
         step_ns = ext.meta.step_ns
         f = node.func
@@ -415,7 +535,8 @@ class Engine:
             return None
         sel_args = [a for a in node.expr.args
                     if isinstance(a, VectorSelector)]
-        if not sel_args or not sel_args[-1].range_ns:
+        if (not sel_args or not sel_args[-1].range_ns
+                or sel_args[-1].at_ns is not None):
             return None
         sel = sel_args[-1]
         ext, W, stride = self._eval_range_selector(sel, params)
@@ -810,6 +931,8 @@ def _string_param(node: Node) -> str:
 
 
 def _absent_tags(node: Node) -> Tags:
+    if isinstance(node, Subquery):
+        return _absent_tags(node.expr)
     if isinstance(node, VectorSelector):
         d = {}
         if node.name:

@@ -4,8 +4,11 @@ parser; this build implements the grammar natively — selectors with label
 matchers and range/offset, function calls, aggregations with by/without,
 binary operators with precedence, bool modifier and vector matching).
 
-Covers the PromQL surface of the 2018-era engine the reference embeds:
-no subqueries or @-modifiers (which postdate it)."""
+Covers the PromQL surface of the 2018-era engine the reference embeds,
+plus the features that postdate it and exist in the upstream engine modern
+M3 tracks: subqueries (`expr[range:resolution]`) and @-modifiers
+(`expr @ <ts>`, `@ start()`, `@ end()`; end() resolves to the last output
+step on the query grid)."""
 
 from __future__ import annotations
 
@@ -23,7 +26,7 @@ _TOKEN_RE = re.compile(r"""
   | (?P<NUMBER>(?:0x[0-9a-fA-F]+)|(?:[0-9]*\.[0-9]+(?:[eE][+-]?[0-9]+)?)|(?:[0-9]+(?:[eE][+-]?[0-9]+)?)|[iI][nN][fF]|[nN][aA][nN])
   | (?P<IDENT>[a-zA-Z_:][a-zA-Z0-9_:.]*)
   | (?P<STRING>"(?:\\.|[^"\\])*"|'(?:\\.|[^'\\])*')
-  | (?P<OP>=~|!~|==|!=|<=|>=|<|>|\+|-|\*|/|%|\^|=)
+  | (?P<OP>=~|!~|==|!=|<=|>=|<|>|\+|-|\*|/|%|\^|=|@)
   | (?P<LPAREN>\()|(?P<RPAREN>\))
   | (?P<LBRACE>\{)|(?P<RBRACE>\})
   | (?P<LBRACKET>\[)|(?P<RBRACKET>\])
@@ -90,6 +93,24 @@ class VectorSelector(Node):
     matchers: Tuple[Matcher, ...] = ()
     range_ns: int = 0          # 0 = instant vector; >0 = matrix selector
     offset_ns: int = 0
+    # @-modifier: None, absolute ns timestamp, or "start"/"end" (resolved
+    # against the query range at eval time).
+    at_ns: object = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Subquery(Node):
+    """`expr[range:resolution]` — evaluate expr as an instant query at
+    each resolution-aligned timestamp in the trailing range window,
+    producing a range vector for an outer *_over_time/rate-family call.
+    step_ns == 0 means "default resolution" (the engine substitutes the
+    query step floored at 15s — executor.DEFAULT_SUBQUERY_RES_NS — its
+    stand-in for prometheus' eval interval)."""
+    expr: Node
+    range_ns: int
+    step_ns: int = 0
+    offset_ns: int = 0
+    at_ns: object = None       # see VectorSelector.at_ns
 
 
 @dataclasses.dataclass(frozen=True)
@@ -236,19 +257,93 @@ class Parser:
         return self.parse_postfix(self.parse_atom())
 
     def parse_postfix(self, node: Node) -> Node:
-        # range selector [5m] and offset modifier
-        if self.accept("LBRACKET"):
-            dur = self.expect("DURATION").text
-            self.expect("RBRACKET")
-            if not isinstance(node, VectorSelector):
-                raise ParseError("range selector on non-selector expression")
-            node = dataclasses.replace(node, range_ns=parse_duration_ns(dur))
-        if self.accept("IDENT", "offset"):
-            dur = self.expect("DURATION").text
-            if not isinstance(node, VectorSelector):
-                raise ParseError("offset on non-selector expression")
-            node = dataclasses.replace(node, offset_ns=parse_duration_ns(dur))
-        return node
+        # range selector [5m], subquery [30m:1m] / [30m:], offset modifier;
+        # loops so `min_over_time(rate(x[5m])[30m:])[...]`-style chains and
+        # an offset AFTER a subquery both parse.
+        offset_seen = False
+        while True:
+            if self.accept("LBRACKET"):
+                tok = self.expect("DURATION")
+                rng = parse_duration_ns(tok.text)
+                if rng == 0:
+                    raise ParseError(f"zero range at {tok.pos}")
+                res = self._accept_subquery_resolution()
+                self.expect("RBRACKET")
+                if res is not None:
+                    node = Subquery(node, rng, res)
+                    offset_seen = False  # the subquery is a new modifier target
+                elif isinstance(node, VectorSelector) and not node.range_ns:
+                    node = dataclasses.replace(node, range_ns=rng)
+                else:
+                    raise ParseError("range selector on non-selector expression")
+                continue
+            if self.accept("IDENT", "offset"):
+                dur = parse_duration_ns(self.expect("DURATION").text)
+                if not isinstance(node, (VectorSelector, Subquery)):
+                    raise ParseError("offset on non-selector expression")
+                if offset_seen:
+                    # prom rejects repeated offset modifiers; silently
+                    # letting the last win would query the wrong window
+                    # (a flag, not a field truthiness check: `offset 0s`
+                    # must arm the rejection too).
+                    raise ParseError("duplicate offset modifier")
+                offset_seen = True
+                node = dataclasses.replace(node, offset_ns=dur)
+                continue
+            if self.accept("OP", "@"):
+                if not isinstance(node, (VectorSelector, Subquery)):
+                    raise ParseError("@ modifier on non-selector expression")
+                if node.at_ns is not None:
+                    raise ParseError("duplicate @ modifier")
+                node = dataclasses.replace(node, at_ns=self._parse_at())
+                continue
+            return node
+
+    def _parse_at(self):
+        """`@ <unix-seconds>` (possibly negative/float) or `@ start()` /
+        `@ end()` — pins the selector's evaluation time."""
+        neg = bool(self.accept("OP", "-"))
+        t = self.peek()
+        if t.kind == "NUMBER":
+            self.next()
+            sec = _parse_number(t.text)
+            return int((-sec if neg else sec) * 1e9)
+        if not neg and t.kind == "IDENT" and t.text in ("start", "end"):
+            self.next()
+            self.expect("LPAREN")
+            self.expect("RPAREN")
+            return t.text
+        raise ParseError(f"expected timestamp, start() or end() after @ "
+                         f"at {t.pos}")
+
+    _RESOLUTION_RE = re.compile(
+        r"(?:[0-9]+(?:\.[0-9]+)?(?:ms|[smhdwy]))+\Z")
+
+    def _accept_subquery_resolution(self) -> Optional[int]:
+        """After the range duration inside brackets: ':' or ':<dur>' marks a
+        subquery. The lexer folds ':1m' into one IDENT (':' is an ident
+        char for recording-rule names), so the resolution is split back out
+        here; a bare ':' may also be followed by a separate DURATION token
+        (`[1h : 5m]`). Returns resolution ns (0 = default), or None when
+        the bracket is a plain range selector."""
+        t = self.peek()
+        if t.kind != "IDENT" or not t.text.startswith(":"):
+            return None
+        self.next()
+        res_txt = t.text[1:]
+        if not res_txt:
+            d = self.accept("DURATION")
+            res_txt = d.text if d else ""
+        if not res_txt:
+            return 0
+        if not self._RESOLUTION_RE.match(res_txt):
+            raise ParseError(
+                f"bad subquery resolution {res_txt!r} at {t.pos}")
+        ns = parse_duration_ns(res_txt)
+        if ns == 0:
+            # explicit zero ([5m:0s]) must not alias the bare-':' default
+            raise ParseError(f"zero resolution in subquery at {t.pos}")
+        return ns
 
     def parse_atom(self) -> Node:
         t = self.peek()

@@ -423,3 +423,193 @@ def test_group_aggregation(engine):
     assert (blk.values == 1.0).all()
     blk = run(engine, "group(memory_bytes)")
     assert blk.n_series == 1 and (blk.values == 1.0).all()
+
+
+class TestSubqueries:
+    """`expr[range:res]` — prometheus promql/engine.go evalSubquery: the
+    inner expression evaluates at res-aligned absolute timestamps, each
+    outer window sees the inner values in (T-range, T]."""
+
+    def test_parse_shapes(self):
+        ast = parse("max_over_time(rate(m[5m])[30m:1m])")
+        sub = ast.args[0]
+        assert isinstance(sub, promql.Subquery)
+        assert sub.range_ns == 30 * MIN and sub.step_ns == MIN
+        assert parse("avg_over_time(x[1h:])").args[0].step_ns == 0
+        off = parse("sum_over_time((a + b)[10m:30s] offset 5m)").args[0]
+        assert off.offset_ns == 5 * MIN and off.step_ns == 30 * S
+        with pytest.raises(promql.ParseError):
+            parse("x[5m:bogus]")
+        with pytest.raises(QueryError):
+            # bare subquery outside a range function
+            Engine(MemStorage()).execute_range("x[5m:1m]", 0, MIN, STEP)
+
+    def test_max_over_time_of_rate_subquery(self, engine):
+        """Brute-force reference: evaluate rate() per res-aligned timestamp
+        with instant queries, take the max of each trailing window."""
+        q = "max_over_time(rate(http_requests_total[2m])[6m:1m])"
+        got = run(engine, q)
+        res, rng = MIN, 6 * MIN
+        for si in range(got.n_series):
+            tags = got.series_tags[si]
+            sel = "rate(http_requests_total{instance=\"%s\"}[2m])" % (
+                tags.get(b"instance").decode())
+            for i, T in enumerate(got.meta.times()):
+                ks = [k * res for k in range(int(T - rng) // res + 1,
+                                             int(T) // res + 1)]
+                vals = []
+                for t_ev in ks:
+                    b = engine.execute_range(sel, t_ev, t_ev, res)
+                    if b.n_series:
+                        v = float(b.values[0][0])
+                        if math.isfinite(v):
+                            vals.append(v)
+                want = max(vals) if vals else float("nan")
+                have = float(got.values[si][i])
+                if math.isnan(want):
+                    assert math.isnan(have)
+                else:
+                    assert have == pytest.approx(want, rel=1e-9), (si, i)
+
+    def test_default_resolution_is_query_step(self, engine):
+        a = run(engine, "avg_over_time(memory_bytes[3m:])")
+        b = run(engine, "avg_over_time(memory_bytes[3m:30s])")
+        assert np.allclose(a.values, b.values, equal_nan=True)
+
+    def test_subquery_over_binary_expr(self, engine):
+        got = run(engine, "sum_over_time((memory_bytes * 2)[2m:1m])")
+        # memory series are constant 100/300 -> each 2m window holds 2
+        # res-aligned evals of the doubled value.
+        by_inst = {t.get(b"instance"): v for t, v in
+                   zip(got.series_tags, got.values)}
+        assert np.allclose(by_inst[b"a"], 400.0)
+        assert np.allclose(by_inst[b"b"], 1200.0)
+
+    def test_subquery_offset(self, engine):
+        plain = run(engine, "avg_over_time(memory_bytes[2m:30s])")
+        off = run(engine, "avg_over_time(memory_bytes[2m:30s] offset 2m)",
+                  start=7 * MIN)
+        # constant series: offset shifts the window but values are equal
+        assert np.allclose(off.values, plain.values[:, : off.values.shape[1]])
+
+    def test_non_dividing_resolution_counts_exact_samples(self, engine):
+        # 45s does not divide the 30s query step -> the packed-gather path;
+        # windows must hold exactly the 45s-aligned timestamps in
+        # (T-3m, T], i.e. 4 per window.
+        got = run(engine, "count_over_time(memory_bytes[3m:45s])")
+        assert np.allclose(got.values, 4.0)
+        got = run(engine, "min_over_time(memory_bytes[3m:45s])")
+        by_inst = {t.get(b"instance"): v for t, v in
+                   zip(got.series_tags, got.values)}
+        assert np.allclose(by_inst[b"a"], 100.0)
+
+
+    def test_end_not_on_step_grid(self, engine):
+        # end - start not a multiple of step: the last output step is
+        # BELOW end, and the fine grid must size to it (regression: the
+        # HTTP drive passes arbitrary epoch-second ranges).
+        got = engine.execute_range("avg_over_time(memory_bytes[2m:30s])",
+                                   5 * MIN, 9 * MIN + 15 * S, STEP)
+        ref = engine.execute_range("avg_over_time(memory_bytes[2m:30s])",
+                                   5 * MIN, 9 * MIN, STEP)
+        assert got.values.shape == ref.values.shape
+        assert np.allclose(got.values, ref.values, equal_nan=True)
+
+    def test_duplicate_offset_rejected(self):
+        with pytest.raises(promql.ParseError):
+            parse("rate(x[5m] offset 1h offset 0s)")
+
+    def test_range_shorter_than_resolution(self, engine):
+        # prom-legal: each window holds 0 or 1 res-aligned evals.
+        got = run(engine, "last_over_time(memory_bytes[30s:1m])")
+        finite = np.isfinite(got.values)
+        assert finite.any() and not finite.all()
+        assert np.all(np.isin(got.values[finite], (100.0, 300.0)))
+
+    def test_increase_subquery_matches_plain_range(self, engine):
+        # res | range with a continuously-sampled counter: the subquery
+        # form must agree with the plain matrix selector to within the
+        # extrapolation of one sample step (here the grids coincide).
+        a = run(engine, "increase(http_requests_total[3m:15s])")
+        b = run(engine, "increase(http_requests_total[3m])")
+        av = {t.get(b"instance"): v for t, v in zip(a.series_tags, a.values)}
+        bv = {t.get(b"instance"): v for t, v in zip(b.series_tags, b.values)}
+        for inst in (b"a", b"b", b"c"):
+            np.testing.assert_allclose(av[inst], bv[inst], rtol=1e-6)
+
+
+class TestAtModifier:
+    """`@ <ts>` / `@ start()` / `@ end()` pin a selector's evaluation time;
+    the result is constant across the output grid (prom promql/engine.go)."""
+
+    def test_parse(self):
+        ast = parse("metric @ 1609746000")
+        assert ast.at_ns == 1_609_746_000 * S
+        assert parse("metric @ start()").at_ns == "start"
+        assert parse("rate(m[5m] @ end())").args[0].at_ns == "end"
+        assert parse("metric @ -5").at_ns == -5 * S
+        sub = parse("avg_over_time(m[10m:1m] @ 1609746000)").args[0]
+        assert isinstance(sub, promql.Subquery)
+        assert sub.at_ns == 1_609_746_000 * S
+        with pytest.raises(promql.ParseError):
+            parse("metric @ start() @ end()")
+        with pytest.raises(promql.ParseError):
+            parse("(a + b) @ 5")
+        with pytest.raises(promql.ParseError):
+            parse("metric @ bogus()")
+
+    def test_instant_at_is_constant(self, engine):
+        got = run(engine, "http_requests_total{instance=\"a\"} @ 360")
+        # pinned at t=360s -> the 360/15=24th sample (value 240) everywhere
+        assert got.values.shape[1] == 9
+        assert np.allclose(got.values, 240.0)
+
+    def test_at_start_and_end(self, engine):
+        base = run(engine, "http_requests_total{instance=\"a\"}")
+        s_pin = run(engine, "http_requests_total{instance=\"a\"} @ start()")
+        e_pin = run(engine, "http_requests_total{instance=\"a\"} @ end()")
+        assert np.allclose(s_pin.values, base.values[0][0])
+        assert np.allclose(e_pin.values, base.values[0][-1])
+
+    def test_range_func_at(self, engine):
+        pinned = run(engine, "increase(http_requests_total{instance=\"a\"}[2m] @ 480)")
+        plain = run(engine, "increase(http_requests_total{instance=\"a\"}[2m])",
+                    start=8 * MIN, end=8 * MIN, step=STEP)
+        assert np.allclose(pinned.values, plain.values[0][0], rtol=1e-6)
+
+    def test_at_with_offset(self, engine):
+        # offset applies relative to the pinned time
+        a = run(engine, "http_requests_total{instance=\"a\"} @ 480 offset 1m")
+        b = run(engine, "http_requests_total{instance=\"a\"} @ 420")
+        assert np.allclose(a.values, b.values)
+
+    def test_subquery_at(self, engine):
+        got = run(engine, "avg_over_time(memory_bytes[2m:30s] @ 480)")
+        assert np.allclose(got.values[got.series_tags.index(
+            next(t for t in got.series_tags if t.get(b"instance") == b"a"))],
+            100.0)
+
+    def test_sharded_fast_path_skips_at(self, engine):
+        # @ on the inner selector must not take the mesh fast path blindly;
+        # single-device engine: just assert correctness of the value.
+        got = run(engine, "sum(increase(http_requests_total[2m] @ 480))")
+        # all three counters: (10+5+2)/15s * 120s = 136
+        assert np.allclose(got.values, 17 / 15 * 120, rtol=1e-6)
+
+    def test_zero_range_and_resolution_rejected(self):
+        with pytest.raises(promql.ParseError):
+            parse("avg_over_time(x[5m:0s])")
+        with pytest.raises(promql.ParseError):
+            parse("avg_over_time(x[0s:1m])")
+        with pytest.raises(promql.ParseError):
+            parse("rate(x[0s])")
+        with pytest.raises(promql.ParseError):
+            parse("rate(m[5m] offset 0s offset 5m)")
+
+    def test_single_step_empty_window_is_nan_not_crash(self, engine):
+        # window (60s, 90s] holds no 1m-aligned timestamp: prometheus
+        # returns an empty matrix; here the series row is all-NaN.
+        blk = engine.execute_range("last_over_time(memory_bytes[30s:1m])",
+                                   90 * S, 90 * S, S)
+        assert blk.values.shape[1] == 1
+        assert np.all(np.isnan(blk.values))
