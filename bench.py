@@ -733,9 +733,14 @@ def main():
     # still a real re-probe (a tunnel that comes back IS picked up), but a
     # dead tunnel costs one probe timeout per config, not three.
     dead_streak = 0
+    force_cpu = bool(os.environ.get("BENCH_FORCE_CPU"))
     for name in selected:
         errors = []
-        attempts = _ATTEMPTS if dead_streak < _ATTEMPTS else 1
+        # Baseline-measurement mode goes straight to the CPU child — no
+        # point probing (and possibly hanging on) the accelerator it will
+        # not use.
+        attempts = 0 if force_cpu else (
+            _ATTEMPTS if dead_streak < _ATTEMPTS else 1)
         for attempt in range(attempts):
             if attempt:
                 time.sleep(_RETRY_SLEEP_S[min(attempt - 1,
@@ -789,6 +794,14 @@ def main():
         extra = r.setdefault("extra", {})
         extra["platform"] = r.pop("platform", None)
         extra["cpu_baseline_dps"] = base
+        # End-to-end ratio for the ingest config: device step INCLUDING
+        # per-block host prep vs the same path on CPU (the north star
+        # covers the whole shard ingest, not just the device launch).
+        e2e = extra.get("e2e_dps_with_host_prep")
+        e2e_base = baselines.get("m3tsz_encode_e2e")
+        if e2e and e2e_base:
+            extra["cpu_e2e_baseline_dps"] = e2e_base
+            extra["e2e_vs_cpu_e2e"] = round(e2e / e2e_base, 3)
         if errors:
             extra["retries"] = errors
         vs = (r["value"] / base) if (base and r["value"]) else None

@@ -20,9 +20,14 @@ from .model import Matcher, MatchType, METRIC_NAME
 
 # ---------------------------------------------------------------- tokens
 
+# ONE duration grammar, shared by the lexer's DURATION token, the
+# duration-value parser (_DUR_PART) and the subquery-resolution validator
+# (Parser._RESOLUTION_RE) — one edit changes all three.
+_DUR_ATOM = r"[0-9]+(?:\.[0-9]+)?(?:ms|[smhdwy])"
+
 _TOKEN_RE = re.compile(r"""
     (?P<WS>\s+)
-  | (?P<DURATION>[0-9]+(?:\.[0-9]+)?(?:ms|[smhdwy])(?:[0-9]+(?:\.[0-9]+)?(?:ms|[smhdwy]))*)
+  | (?P<DURATION>@DUR@(?:@DUR@)*)
   | (?P<NUMBER>(?:0x[0-9a-fA-F]+)|(?:[0-9]*\.[0-9]+(?:[eE][+-]?[0-9]+)?)|(?:[0-9]+(?:[eE][+-]?[0-9]+)?)|[iI][nN][fF]|[nN][aA][nN])
   | (?P<IDENT>[a-zA-Z_:][a-zA-Z0-9_:.]*)
   | (?P<STRING>"(?:\\.|[^"\\])*"|'(?:\\.|[^'\\])*')
@@ -31,11 +36,11 @@ _TOKEN_RE = re.compile(r"""
   | (?P<LBRACE>\{)|(?P<RBRACE>\})
   | (?P<LBRACKET>\[)|(?P<RBRACKET>\])
   | (?P<COMMA>,)
-""", re.VERBOSE)
+""".replace("@DUR@", _DUR_ATOM), re.VERBOSE)
 
 _UNITS_NS = {"ms": 10**6, "s": 10**9, "m": 60 * 10**9, "h": 3600 * 10**9,
              "d": 86400 * 10**9, "w": 7 * 86400 * 10**9, "y": 365 * 86400 * 10**9}
-_DUR_PART = re.compile(r"([0-9]+(?:\.[0-9]+)?)(ms|[smhdwy])")
+_DUR_PART = re.compile(r"([0-9]+(?:\.[0-9]+)?)(ms|[smhdwy])")  # groups of _DUR_ATOM
 
 
 def parse_duration_ns(s: str) -> int:
@@ -272,7 +277,11 @@ class Parser:
                 if res is not None:
                     node = Subquery(node, rng, res)
                     offset_seen = False  # the subquery is a new modifier target
-                elif isinstance(node, VectorSelector) and not node.range_ns:
+                elif (isinstance(node, VectorSelector) and not node.range_ns
+                        and not offset_seen):
+                    # offset_seen guard: prom requires the range BEFORE any
+                    # offset (`c offset 5m [5m]` is a parse error upstream;
+                    # silently reordering would mask the user's mistake).
                     node = dataclasses.replace(node, range_ns=rng)
                 else:
                     raise ParseError("range selector on non-selector expression")
@@ -316,8 +325,7 @@ class Parser:
         raise ParseError(f"expected timestamp, start() or end() after @ "
                          f"at {t.pos}")
 
-    _RESOLUTION_RE = re.compile(
-        r"(?:[0-9]+(?:\.[0-9]+)?(?:ms|[smhdwy]))+\Z")
+    _RESOLUTION_RE = re.compile(rf"(?:{_DUR_ATOM})+\Z")
 
     def _accept_subquery_resolution(self) -> Optional[int]:
         """After the range duration inside brackets: ':' or ':<dur>' marks a

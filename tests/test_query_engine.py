@@ -613,3 +613,10 @@ class TestAtModifier:
                                    90 * S, 90 * S, S)
         assert blk.values.shape[1] == 1
         assert np.all(np.isnan(blk.values))
+
+    def test_offset_before_range_rejected(self):
+        # prom requires the range selector before any offset modifier
+        with pytest.raises(promql.ParseError):
+            parse("rate(c offset 5m [5m])")
+        # ...but a subquery OF an offset selector stays legal
+        parse("avg_over_time(x offset 5m [1h:])")
