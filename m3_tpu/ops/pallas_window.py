@@ -1,0 +1,115 @@
+"""Pallas TPU kernel for strided sliding-window moments — the
+*_over_time hot loop (reference: src/query/functions/temporal/
+aggregation.go walks a per-series iterator per step; the XLA path in
+ops/temporal.py reduces EVERY window with reduce_window and strides the
+result AFTER, paying W work per grid cell even when the query step only
+needs every stride-th window).
+
+This kernel computes exactly the strided windows: one grid program per
+8-row tile keeps its [8, K] slice of the residual grid in VMEM and loops
+the T_out output steps, each reducing its [8, W] window slice on the VPU
+and storing one output lane. Work drops from O(S*K*W) to
+O(S*T_out*W) = O(S*K*W/stride), and the stat+count pair comes out of one
+launch (the XLA path builds a separate masked volume per moment).
+
+Semantics are IDENTICAL to temporal._window_stat (masked by finiteness,
+m2 in the two-pass mean-then-deviation form that survives f32): the
+parity tests run both over the same grids, NaN holes included.
+
+Opt-in wiring: temporal._window_stat_strided dispatches here when
+M3_TPU_PALLAS=1 (default off until proven on-chip; interpret mode backs
+the kernel on CPU so the tests and any CPU fallback stay correct).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+_F32 = jnp.float32
+
+# Row tile: f32 VMEM tiling is (8, 128); eight series rows per program
+# keeps the window slice a native sublane group.
+_BS = 8
+
+STATS = ("count", "sum", "min", "max", "last", "m2")
+
+
+def _kernel(x_ref, o_ref, c_ref, *, W: int, stride: int, T_out: int,
+            stat: str):
+    iota_w = jax.lax.broadcasted_iota(jnp.int32, (_BS, W), 1)
+
+    def body(i, _):
+        win = x_ref[:, pl.ds(i * stride, W)]            # [BS, W] VMEM
+        mask = jnp.isfinite(win)
+        cnt = jnp.sum(mask.astype(_F32), axis=1)
+        if stat == "count":
+            out = cnt
+        elif stat == "sum":
+            out = jnp.sum(jnp.where(mask, win, 0.0), axis=1)
+        elif stat == "min":
+            out = jnp.min(jnp.where(mask, win, jnp.inf), axis=1)
+        elif stat == "max":
+            out = jnp.max(jnp.where(mask, win, -jnp.inf), axis=1)
+        elif stat == "last":
+            last_i = jnp.max(jnp.where(mask, iota_w, -1), axis=1)
+            hit = iota_w == last_i[:, None]
+            out = jnp.sum(jnp.where(hit & mask, win, 0.0), axis=1)
+        elif stat == "m2":
+            s = jnp.sum(jnp.where(mask, win, 0.0), axis=1)
+            mu = s / jnp.maximum(cnt, 1.0)
+            dev = jnp.where(mask, win - mu[:, None], 0.0)
+            out = jnp.sum(dev * dev, axis=1)
+        else:  # pragma: no cover - guarded by caller
+            raise ValueError(stat)
+        o_ref[:, pl.ds(i, 1)] = out[:, None]
+        c_ref[:, pl.ds(i, 1)] = cnt[:, None]
+        return 0
+
+    jax.lax.fori_loop(0, T_out, body, 0)
+
+
+@functools.lru_cache(maxsize=256)
+def _build(S: int, K: int, W: int, stride: int, stat: str,
+           interpret: bool):
+    T_out = (K - W) // stride + 1
+    grid = ((S + _BS - 1) // _BS,)
+    kern = functools.partial(_kernel, W=W, stride=stride, T_out=T_out,
+                             stat=stat)
+    call = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[pl.BlockSpec((_BS, K), lambda i: (i, 0))],
+        out_specs=[pl.BlockSpec((_BS, T_out), lambda i: (i, 0)),
+                   pl.BlockSpec((_BS, T_out), lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((S, T_out), _F32),
+                   jax.ShapeDtypeStruct((S, T_out), _F32)],
+        interpret=interpret,
+    )
+    return jax.jit(call)
+
+
+def window_stat(resid, W: int, stride: int, stat: str):
+    """(stat [S, T_out] f32, count [S, T_out] f32) over the strided
+    windows of `resid` ([S, K] f32, NaN = missing sample); window t reads
+    columns [t*stride, t*stride+W).
+
+    Matches temporal._window_stat followed by [..., ::stride] at every
+    cell with count > 0 — which is the whole caller contract: both
+    finishes mask count==0 to NaN. Where count == 0 the raw planes may
+    differ ('last' yields 0.0 here vs the XLA gather's clipped-index
+    artifact), and a selected -0.0 comes back as +0.0 (the one-hot
+    sum); neither is observable through *_over_time.
+
+    Runs in interpret mode off-TPU — fine for tests, pathologically
+    slow in serving, which is why temporal._window_stat_strided only
+    dispatches here on a real tpu backend."""
+    if stat not in STATS:
+        raise ValueError(f"unknown pallas window stat {stat!r}")
+    S, K = resid.shape
+    interpret = jax.default_backend() != "tpu"
+    return _build(S, K, W, stride, stat, interpret)(resid)

@@ -487,6 +487,34 @@ def _window_stat(resid, W: int, stat: str):
     return out, cnt
 
 
+# Opt-in Pallas kernel for the strided window moments (M3_TPU_PALLAS=1):
+# computes ONLY every stride-th window in VMEM instead of reducing all of
+# them and striding after — O(W/stride) less work per grid cell. Off by
+# default until proven on-chip; parity-tested against the XLA path
+# (tests/test_temporal.py::TestPallasWindow).
+_PALLAS_ENABLED = os.environ.get("M3_TPU_PALLAS") == "1"
+
+
+def _use_pallas() -> bool:
+    """Pallas dispatch requires a REAL tpu backend: on anything else the
+    kernel would run in interpret mode (a per-op Python evaluator,
+    orders of magnitude slower than the XLA path) — a fleetwide
+    M3_TPU_PALLAS=1 must not become a silent cliff on CPU nodes.
+    (Tests monkeypatch this to exercise the dispatch off-TPU.)"""
+    return _PALLAS_ENABLED and jax.default_backend() == "tpu"
+
+
+def _window_stat_strided(resid, W: int, stat: str, stride: int):
+    """(stat, count) planes already consolidated to the output stride."""
+    if _use_pallas():
+        from . import pallas_window
+
+        if stat in pallas_window.STATS:
+            return pallas_window.window_stat(resid, W, stride, stat)
+    out, cnt = _window_stat(resid, W, stat)
+    return out[..., ::stride], cnt[..., ::stride]
+
+
 @functools.lru_cache(maxsize=256)
 def _over_time_fn(W: int, stat: str, stride: int = 1):
     """One masked window moment for *_over_time (temporal/aggregation.go):
@@ -496,10 +524,9 @@ def _over_time_fn(W: int, stat: str, stride: int = 1):
     and striding before the transfer are what keep this D2H-lean."""
 
     def fn(resid):
-        out, cnt = _window_stat(resid, W, stat)
+        out, cnt = _window_stat_strided(resid, W, stat, stride)
         cnt_dtype = jnp.uint16 if W <= 0xFFFF else jnp.int32
-        return (out.astype(_F32)[..., ::stride],
-                cnt.astype(cnt_dtype)[..., ::stride])
+        return out.astype(_F32), cnt.astype(cnt_dtype)
 
     return jax.jit(fn)
 
@@ -536,9 +563,9 @@ def _over_time_finish_fn(W: int, kind: str, stride: int = 1):
     stat_name = _OVER_TIME_STATS[kind]
 
     def fn(resid, base32):
-        stat, cnt = _window_stat(resid, W, stat_name)
+        stat, cnt = _window_stat_strided(resid, W, stat_name, stride)
         out = _finish_over_time(jnp, kind, stat, cnt, base32[:, None])
-        return jnp.where(cnt > 0, out, jnp.nan).astype(_F32)[..., ::stride]
+        return jnp.where(cnt > 0, out, jnp.nan).astype(_F32)
 
     return jax.jit(fn)
 

@@ -256,3 +256,76 @@ def test_rate_no_cancellation_on_huge_counter():
     expected = (W - 1) * 1.0 * (W / (W - 1))  # extrapolated to full range
     assert out[0, -1] == pytest.approx(expected, rel=1e-3)
     assert (out[0, -5:] > 0).all()  # counter increase can never go negative
+
+
+class TestPallasWindow:
+    """Parity of the opt-in Pallas strided-window kernel (M3_TPU_PALLAS=1)
+    against the XLA reduce_window path — same masked-by-finiteness
+    semantics, m2 in the same two-pass form, empty windows included."""
+
+    def test_kernel_parity_all_stats_strides(self):
+        import jax.numpy as jnp
+
+        from m3_tpu.ops import pallas_window as pw
+        from m3_tpu.ops import temporal
+
+        rng = np.random.default_rng(3)
+        S, K, W = 13, 67, 6
+        resid = rng.standard_normal((S, K)).astype(np.float32)
+        resid[rng.random((S, K)) < 0.2] = np.nan
+        resid[0] = np.nan  # one fully-empty series
+        for stride in (1, 2, 3):
+            for stat in pw.STATS:
+                got_s, got_c = pw.window_stat(jnp.asarray(resid), W, stride, stat)
+                ref_s, ref_c = temporal._window_stat(jnp.asarray(resid), W, stat)
+                got_s, got_c = np.asarray(got_s), np.asarray(got_c)
+                ref_s = np.asarray(ref_s)[:, ::stride]
+                ref_c = np.asarray(ref_c)[:, ::stride].astype(np.float32)
+                np.testing.assert_array_equal(got_c, ref_c)
+                # The contract covers populated windows only: both callers
+                # mask count==0 to NaN, and the raw empty-window planes
+                # legitimately differ ('last': 0.0 vs the XLA gather's
+                # clipped-index artifact).
+                pop = ref_c > 0
+                np.testing.assert_allclose(
+                    got_s[pop], ref_s[pop],
+                    rtol=1e-6, atol=1e-6, err_msg=f"{stat} stride={stride}")
+
+    def test_empty_window_counts_zero(self):
+        import jax.numpy as jnp
+
+        from m3_tpu.ops import pallas_window as pw
+
+        # crafted fully-NaN window inside a row whose column 0 is finite
+        # (the case the XLA raw plane renders differently)
+        resid = np.array([[5.0, 1.0, np.nan, np.nan, np.nan, 2.0, 3.0, 4.0]],
+                         np.float32)
+        got_s, got_c = pw.window_stat(jnp.asarray(resid), 3, 1, "last")
+        got_s, got_c = np.asarray(got_s), np.asarray(got_c)
+        assert got_c[0, 2] == 0.0
+        assert got_s[0, 2] == 0.0  # documented empty-window value
+
+    def test_over_time_dispatch(self, monkeypatch):
+        from m3_tpu.ops import temporal
+
+        rng = np.random.default_rng(5)
+        grid = np.cumsum(rng.poisson(3.0, (9, 50)), axis=1).astype(np.float64)
+        grid[rng.random((9, 50)) < 0.1] = np.nan
+        refs = {k: temporal.over_time(grid, 5, k, stride=2)
+                for k in ("sum", "avg", "min", "max", "count", "last",
+                          "stddev", "stdvar")}
+        monkeypatch.setattr(temporal, "_use_pallas", lambda: True)
+        temporal._over_time_fn.cache_clear()
+        temporal._over_time_finish_fn.cache_clear()
+        try:
+            for k, ref in refs.items():
+                got = temporal.over_time(grid, 5, k, stride=2)
+                np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-9,
+                                           equal_nan=True, err_msg=k)
+                got_dev = temporal.over_time(grid, 5, k, stride=2,
+                                             finish="device")
+                np.testing.assert_allclose(got_dev, ref, rtol=1e-5, atol=1e-5,
+                                           equal_nan=True, err_msg=k + " device")
+        finally:
+            temporal._over_time_fn.cache_clear()
+            temporal._over_time_finish_fn.cache_clear()
