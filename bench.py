@@ -163,7 +163,8 @@ def bench_encode_rollup():
         "extra": dict(
             base_extra,
             host_prep_ms=round(host_prep_s * 1000, 1),
-            prep="device-fused (ingest_step_raw); host = pair splits + f32 cast",
+            prep="device-fused (ingest_step_raw); host = zero-copy pair "
+                 "views + f32 cast",
             fused_step_dps=round(points / dt_raw, 1),
             e2e_dps_with_host_prep=round(e2e_dps, 1),
         ),

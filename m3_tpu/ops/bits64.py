@@ -20,6 +20,29 @@ def pair(hi, lo):
     return jnp.asarray(hi, U32), jnp.asarray(lo, U32)
 
 
+# Index of the HIGH u32 word within a native-order pair view of a 64-bit
+# buffer — THE one endianness decision, shared by the strided host split
+# (from_u64_np) and the zero-copy device-split path (ingest.make_raw_batch).
+import sys as _sys
+
+PAIR_HI = 0 if _sys.byteorder == "big" else 1
+
+
+def pair_view_np(x):
+    """Zero-copy interleaved u32 pair view of a 64-bit numpy buffer:
+    [..., 2] in native order (index PAIR_HI = high word). Narrow ints are
+    widened first (a raw view would pair adjacent elements into bogus
+    64-bit values); floats are viewed bitwise."""
+    import numpy as np
+
+    x = np.ascontiguousarray(x)
+    if x.dtype.kind in "iu" and x.dtype.itemsize < 8:
+        x = x.astype(np.uint64)
+    elif x.dtype.kind not in "iu" or x.dtype.itemsize != 8:
+        x = x.view(np.uint64)
+    return x.view(np.uint32).reshape(*x.shape, 2)
+
+
 def from_u64_np(x):
     """Host helper: split numpy uint64/int64 array into (hi, lo) u32 arrays.
 
@@ -28,18 +51,9 @@ def from_u64_np(x):
     over every datapoint of every sealed block on the ingest path)."""
     import numpy as np
 
-    x = np.ascontiguousarray(x)
-    if x.dtype.kind in "iu" and x.dtype.itemsize < 8:
-        x = x.astype(np.uint64)  # widen narrow ints; a raw view would pair
-        # adjacent elements into bogus 64-bit values
-    elif x.dtype.kind not in "iu" or x.dtype.itemsize != 8:
-        x = x.view(np.uint64)
-    import sys
-
-    pairs = x.view(np.uint32).reshape(*x.shape, 2)
-    if sys.byteorder == "big":  # pragma: no cover - LE everywhere
-        return np.ascontiguousarray(pairs[..., 0]), np.ascontiguousarray(pairs[..., 1])
-    return np.ascontiguousarray(pairs[..., 1]), np.ascontiguousarray(pairs[..., 0])
+    pairs = pair_view_np(x)
+    return (np.ascontiguousarray(pairs[..., PAIR_HI]),
+            np.ascontiguousarray(pairs[..., 1 - PAIR_HI]))
 
 
 def to_u64_np(hi, lo):

@@ -111,5 +111,9 @@ def window_stat(resid, W: int, stride: int, stat: str):
     if stat not in STATS:
         raise ValueError(f"unknown pallas window stat {stat!r}")
     S, K = resid.shape
+    if K < W:
+        raise ValueError(
+            f"grid has {K} columns < window {W}; callers fall back to the "
+            "XLA path for the empty result (temporal._window_stat_strided)")
     interpret = jax.default_backend() != "tpu"
     return _build(S, K, W, stride, stat, interpret)(resid)

@@ -506,7 +506,10 @@ def _use_pallas() -> bool:
 
 def _window_stat_strided(resid, W: int, stat: str, stride: int):
     """(stat, count) planes already consolidated to the output stride."""
-    if _use_pallas():
+    if _use_pallas() and resid.shape[-1] >= W:
+        # K < W falls through: the pallas grid would have zero (or
+        # negative) output columns where the XLA path returns the valid
+        # empty plane.
         from . import pallas_window
 
         if stat in pallas_window.STATS:

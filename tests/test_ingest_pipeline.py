@@ -44,8 +44,10 @@ def test_device_prep_matches_host_prep(rng):
     raw_vals[6, :] = 0.25           # decimal: host k=2, device float mode
     host = tsz.prepare_encode_inputs(raw_ts, raw_vals, npoints)
     raw = ingest.make_raw_batch(raw_ts, raw_vals, npoints)
+    hi, lo = ingest._HI, 1 - ingest._HI
     prep, ok = jax.jit(tsz.prepare_on_device_math)(
-        raw.ts_hi, raw.ts_lo, raw.vhi, raw.vlo, raw.npoints)
+        raw.ts_pairs[..., hi], raw.ts_pairs[..., lo],
+        raw.v_pairs[..., hi], raw.v_pairs[..., lo], raw.npoints)
     assert bool(ok)
     decimal = host["int_mode"] & (host["k"] > 0)
     assert decimal[6] and not bool(np.asarray(prep["int_mode"])[6])

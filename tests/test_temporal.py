@@ -329,3 +329,13 @@ class TestPallasWindow:
         finally:
             temporal._over_time_fn.cache_clear()
             temporal._over_time_finish_fn.cache_clear()
+
+    def test_narrow_grid_falls_back(self, monkeypatch):
+        # K < W: the dispatch must use the XLA empty plane, not a
+        # zero/negative-width pallas grid.
+        from m3_tpu.ops import temporal
+
+        monkeypatch.setattr(temporal, "_use_pallas", lambda: True)
+        resid = np.full((4, 3), 1.0, np.float32)
+        out, cnt = temporal._window_stat_strided(resid, 6, "sum", 1)
+        assert out.shape == (4, 0) and cnt.shape == (4, 0)
