@@ -140,8 +140,9 @@ def bench_encode_rollup():
         "extra": dict(base_extra, e2e="pending (fused-raw segment follows)"),
     }
     # End-to-end: the FUSED raw path (ingest_step_raw) moves delta/int-mode/
-    # mantissa prep into the same XLA program as encode+rollup; per-block
-    # host work shrinks to u32-pair view splits + one f32 cast.
+    # mantissa prep AND the f32 value derivation into the same XLA program
+    # as encode+rollup; per-block host work shrinks to two zero-copy pair
+    # views of the buffers the caller already holds.
     _phase("encode: fused raw path (device prep)")
     t_prep0 = time.perf_counter()
     rawb = ingest.make_raw_batch(raw_ts, raw_vals, npoints)
@@ -163,8 +164,8 @@ def bench_encode_rollup():
         "extra": dict(
             base_extra,
             host_prep_ms=round(host_prep_s * 1000, 1),
-            prep="device-fused (ingest_step_raw); host = zero-copy pair "
-                 "views + f32 cast",
+            prep="device-fused (ingest_step_raw); host = two zero-copy "
+                 "pair views (f32 derived on device, bits64.f64_bits_to_f32)",
             fused_step_dps=round(points / dt_raw, 1),
             e2e_dps_with_host_prep=round(e2e_dps, 1),
         ),

@@ -39,6 +39,11 @@ def pair_view_np(x):
     if x.dtype.kind in "iu" and x.dtype.itemsize < 8:
         x = x.astype(np.uint64)
     elif x.dtype.kind not in "iu" or x.dtype.itemsize != 8:
+        if x.dtype.itemsize != 8:
+            # a raw view of narrow floats would pair ADJACENT elements
+            # into bogus 64-bit values — fail loudly instead
+            raise TypeError(
+                f"pair_view_np needs a 64-bit buffer, got {x.dtype}")
         x = x.view(np.uint64)
     return x.view(np.uint32).reshape(*x.shape, 2)
 
@@ -193,3 +198,68 @@ def i32_to_pair(x):
 def pair_to_i32(a):
     """Truncate pair to int32 (caller guarantees it fits)."""
     return a[1].astype(jnp.int32)
+
+
+def f64_bits_to_f32(hi, lo):
+    """Exact float64 -> float32 conversion from raw bit pairs, entirely in
+    u32 integer math (round-to-nearest-even, matching numpy's astype):
+    lets the ingest path derive the f32 aggregation values ON DEVICE from
+    the same pair views it encodes, killing the last host prep pass and
+    48MB/block of H2D (parallel/ingest.py make_raw_batch).
+
+    Handles every IEEE case: normals, overflow->inf, underflow to f32
+    denormals and zero (with the double rounding avoided by sticky-bit
+    collection), inf passthrough, NaN -> quiet NaN, signed zeros."""
+    hi = jnp.asarray(hi, U32)
+    lo = jnp.asarray(lo, U32)
+    sign = hi & U32(0x80000000)
+    exp64 = (hi >> U32(20)) & U32(0x7FF)
+    mant_hi = hi & U32(0xFFFFF)
+    # 52-bit mantissa split: top 23 bits + 29 round/sticky bits.
+    m23 = (mant_hi << U32(3)) | (lo >> U32(29))
+    rest = lo & U32(0x1FFFFFFF)
+
+    e32 = exp64.astype(jnp.int32) - 1023 + 127
+
+    # -- normal path (1 <= e32 <= 254 before rounding) --------------------
+    half = U32(0x10000000)
+    round_up = (rest > half) | ((rest == half) & ((m23 & U32(1)) == U32(1)))
+    m23r = m23 + round_up.astype(U32)
+    carry = m23r >> U32(23)                 # mantissa overflow 2^23
+    m_norm = jnp.where(carry > 0, U32(0), m23r & U32(0x7FFFFF))
+    e_norm = e32 + carry.astype(jnp.int32)
+    norm_bits = sign | (jnp.clip(e_norm, 0, 255).astype(U32) << U32(23)) | m_norm
+    norm_bits = jnp.where(e_norm >= 255, sign | U32(0x7F800000), norm_bits)
+
+    # -- underflow path (e32 <= 0): shift the FULL 24-bit significand -----
+    # (implicit 1 + 23 mantissa bits) right by (1 - e32), collecting
+    # shifted-out bits as round/sticky so only ONE rounding happens.
+    shift = jnp.clip(1 - e32, 0, 32).astype(U32)      # >=25 -> zero anyway
+    sig24 = U32(0x800000) | m23                        # implicit one
+    kept = jnp.where(shift >= U32(24), U32(0), _shr32(sig24, shift))
+    # bits shifted out of sig24 (low `shift` bits), as a 32-bit field
+    dropped = jnp.where(shift >= U32(32), sig24,
+                        sig24 & (_shl32(U32(1), shift) - U32(1)))
+    # round position: the top dropped bit is the guard; sticky = lower
+    # dropped bits OR the original 29 rest bits.
+    guard_mask = jnp.where(shift == 0, U32(0), _shl32(U32(1), shift - U32(1)))
+    guard = (dropped & guard_mask) != 0
+    sticky = ((dropped & (guard_mask - U32(1))) != 0) | (rest != 0)
+    sub_up = guard & (sticky | ((kept & U32(1)) == U32(1)))
+    sub = kept + sub_up.astype(U32)
+    # sub may carry into the exponent (becomes smallest normal) — the bit
+    # layout handles that naturally: 0x800000 == exponent 1, mantissa 0.
+    sub_bits = sign | sub
+
+    # -- special exponents -------------------------------------------------
+    is_inf_nan = exp64 == U32(0x7FF)
+    is_nan = is_inf_nan & ((mant_hi | lo) != 0)
+    spec_bits = jnp.where(is_nan, sign | U32(0x7FC00000),
+                          sign | U32(0x7F800000))
+    # f64 denormals (exp64==0) are far below f32 denormal range -> 0.
+    is_zero64 = exp64 == U32(0)
+
+    bits = jnp.where(is_inf_nan, spec_bits,
+                     jnp.where(is_zero64, sign,
+                               jnp.where(e32 <= 0, sub_bits, norm_bits)))
+    return jax.lax.bitcast_convert_type(bits, jnp.float32)

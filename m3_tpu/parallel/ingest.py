@@ -90,18 +90,18 @@ def ingest_step(batch: IngestBatch, *, rollup_factor: int, max_words: int, quant
 
 class RawIngestBatch(NamedTuple):
     """Raw device inputs for the fused prep+encode ingest step:
-    INTERLEAVED u32-pair views of the int64 timestamps / f64 value bits
-    (the exact memory the host already holds — no de-interleave pass)
-    plus an f32 value copy for the aggregation kernels. Host cost to
-    build one: two zero-copy views and one f32 cast (make_raw_batch);
-    the hi/lo split happens on device as a strided slice fused into the
-    encode program (ingest_step_raw), which cut host prep from ~440ms to
-    ~33ms per 100k x 120 block."""
+    INTERLEAVED u32-pair views of the int64 timestamps / f64 value bits —
+    the exact memory the host already holds. Host cost to build one: two
+    zero-copy views (make_raw_batch, ~0ms); the hi/lo split is a strided
+    slice fused into the encode program and the f32 aggregation values
+    are derived on device by exact RNE bit conversion
+    (bits64.f64_bits_to_f32), so no host pass touches the data at all
+    (was ~440ms of splits + cast per 100k x 120 block) and the f32 plane
+    never crosses H2D."""
 
     ts_pairs: jax.Array  # u32 [N, W, 2] raw int64 bytes, native order
     v_pairs: jax.Array   # u32 [N, W, 2] raw f64 bytes, native order
     npoints: jax.Array   # int32 [N]
-    values: jax.Array    # f32 [N, W]
 
 
 # THE endianness decision lives in bits64 (shared with from_u64_np).
@@ -110,14 +110,13 @@ _HI = b64.PAIR_HI
 
 def make_raw_batch(ts: np.ndarray, values: np.ndarray,
                    npoints: np.ndarray) -> RawIngestBatch:
-    """Cheap host prep for ingest_step_raw: zero-copy pair views + one f32
-    cast — the hi/lo split and all delta/int-mode/mantissa work happens
-    on device."""
+    """Zero-cost host prep for ingest_step_raw: two zero-copy pair views —
+    the hi/lo split, the f32 value derivation, and all delta/int-mode/
+    mantissa work happens on device."""
     return RawIngestBatch(
         b64.pair_view_np(np.asarray(ts, np.int64)),
         b64.pair_view_np(np.asarray(values, np.float64)),
-        np.asarray(npoints, np.int32),
-        np.asarray(values, np.float32))
+        np.asarray(npoints, np.int32))
 
 
 def ingest_step_raw(raw: RawIngestBatch, *, rollup_factor: int,
@@ -128,15 +127,19 @@ def ingest_step_raw(raw: RawIngestBatch, *, rollup_factor: int,
     device twin of the host prep's int32 delta/DoD ValueErrors — callers
     must check it once per block)."""
     lo = 1 - _HI
+    vhi_raw, vlo_raw = raw.v_pairs[..., _HI], raw.v_pairs[..., lo]
     prep, range_ok = tsz.prepare_on_device_math(
         raw.ts_pairs[..., _HI], raw.ts_pairs[..., lo],
-        raw.v_pairs[..., _HI], raw.v_pairs[..., lo], raw.npoints)
+        vhi_raw, vlo_raw, raw.npoints)
+    # f32 aggregation values from the ORIGINAL f64 bits (prep rewrites
+    # vhi/vlo to extracted mantissas for int-mode series).
+    values32 = b64.f64_bits_to_f32(vhi_raw, vlo_raw)
     batch = IngestBatch(
         dt=prep["dt"], t0_hi=prep["t0"][0], t0_lo=prep["t0"][1],
         vhi=prep["vhi"], vlo=prep["vlo"], int_mode=prep["int_mode"],
         k=prep["k"], npoints=prep["npoints"],
         ts_regular=prep["ts_regular"], delta0=prep["delta0"],
-        values=raw.values)
+        values=values32)
     return (*ingest_step(batch, rollup_factor=rollup_factor,
                          max_words=max_words, quantile_qs=quantile_qs),
             range_ok)

@@ -241,3 +241,39 @@ class TestBatchedVsOracle:
         _, nbits = tsz.encode(ts, vals, np.full(n, w, dtype=np.int32))
         bpd = float(np.asarray(nbits).sum()) / 8.0 / (n * w)
         assert bpd < 2.0, f"bytes/datapoint {bpd:.3f} too high"
+
+
+class TestF64BitsToF32:
+    """Device RNE f64->f32 bit conversion (bits64.f64_bits_to_f32) must be
+    bit-identical to numpy's astype across every IEEE class — it replaces
+    the host f32 cast on the ingest path, so a rounding divergence would
+    silently change rollup aggregates."""
+
+    def test_bit_exact_vs_numpy(self):
+        import jax
+
+        from m3_tpu.ops import bits64 as b64
+
+        rng = np.random.default_rng(0)
+        parts = [
+            rng.standard_normal(50000) * 10.0 ** rng.integers(-40, 40, 50000),
+            rng.integers(-2**53, 2**53, 20000).astype(np.float64),
+            np.array([0.0, -0.0, np.inf, -np.inf, np.nan,
+                      1e308, 3.4028235e38, 3.4028236e38, 1e39,
+                      2.0**-126, 2.0**-149, 2.0**-150, 2.0**-151,
+                      1.4e-45, 7e-46, 1e-300]),
+            2.0 ** rng.uniform(-160, -120, 50000) * rng.choice([-1, 1], 50000),
+            # random raw bit patterns incl. ties at the 29-bit boundary
+            ((rng.integers(0, 2, 50000).astype(np.uint64) << np.uint64(63))
+             | (rng.integers(1, 2046, 50000).astype(np.uint64) << np.uint64(52))
+             | rng.integers(0, 2**52, 50000).astype(np.uint64)).view(np.float64),
+        ]
+        with np.errstate(over="ignore"):
+            for vals in parts:
+                hi, lo = b64.from_u64_np(np.ascontiguousarray(vals).view(np.uint64))
+                got = np.asarray(jax.jit(b64.f64_bits_to_f32)(hi, lo))
+                want = vals.astype(np.float32)
+                nan = np.isnan(want)
+                np.testing.assert_array_equal(np.isnan(got), nan)
+                np.testing.assert_array_equal(
+                    got.view(np.uint32)[~nan], want.view(np.uint32)[~nan])
