@@ -154,6 +154,16 @@ def bench_encode_rollup():
     assert bool(out_raw[-1]), "range_ok must hold for the bench batch"
     assert np.array_equal(np.asarray(out_raw[0]), np.asarray(out[0])), (
         "fused raw path must produce the identical streams")
+    # ...and identical aggregates: the fused path derives its f32 values
+    # on device (bits64.f64_bits_to_f32); a backend-specific rounding
+    # regression there would skew every rollup silently if only the
+    # value-independent streams were compared.
+    for agg_i in (2, 3):
+        for k, v in out_raw[agg_i].items():
+            assert np.array_equal(np.asarray(v), np.asarray(out[agg_i][k])), (
+                f"fused aggregate {agg_i}.{k} diverged")
+    assert np.array_equal(np.asarray(out_raw[4]), np.asarray(out[4])), (
+        "fused quantiles diverged")
     dt_raw = _timed(raw_step, rawb, iters=iters)
     e2e_dps = points / (dt_raw + host_prep_s)
     _phase("encode: fused raw steady state done")

@@ -50,6 +50,8 @@ class HTTPApi:
             ("GET", r"/api/v1/search", self.complete_tags),
             ("POST", r"/api/v1/search", self.complete_tags),
             ("GET", r"/api/v1/openapi", self.openapi),
+            ("GET", r"/api/v1/status/buildinfo", self.buildinfo),
+            ("GET", r"/api/v1/metadata", self.metric_metadata),
             ("POST", r"/api/v1/json/write", self.json_write),
             ("POST", r"/api/v1/prom/remote/write", self.prom_remote_write),
             ("POST", r"/api/v1/prom/remote/read", self.prom_remote_read),
@@ -80,6 +82,25 @@ class HTTPApi:
 
     def health(self, req) -> dict:
         return {"ok": True, "uptime": "ok"}
+
+    def buildinfo(self, req) -> dict:
+        """Prometheus-compat /api/v1/status/buildinfo (beyond the
+        reference's router, which predates it): Grafana probes this to
+        pick API features, so serving it makes datasource setup
+        frictionless. Reports the prom API generation this surface
+        tracks plus the real backing build."""
+        return {"status": "success",
+                "data": {"version": "2.37.0",
+                         "application": "m3_tpu-coordinator",
+                         "features": {}}}
+
+    def metric_metadata(self, req) -> dict:
+        """Prometheus-compat /api/v1/metadata. Metric HELP/TYPE/UNIT
+        metadata is not persisted by the storage tier (same position as
+        the reference coordinator) — an empty map is the documented
+        valid response for unknown metadata and keeps Grafana's
+        metadata probes happy."""
+        return {"status": "success", "data": {}}
 
     def list_routes(self, req) -> dict:
         return {"routes": [f"{m} {p}" for m, p, _ in self.routes]}

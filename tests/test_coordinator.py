@@ -181,3 +181,13 @@ class TestAdmin:
         assert out["topic"]["num_shards"] == 4
         got = http("GET", f"{base}/api/v1/topic?name=aggregated_metrics")
         assert got["topic"]["consumer_services"][0]["service_id"] == "coordinator"
+
+
+def test_buildinfo_and_metadata_compat(coord):
+    """Grafana probes these prometheus-compat endpoints during datasource
+    setup; both must return the prom success envelope."""
+    c, _, _ = coord
+    r = http("GET", c.api.endpoint + "/api/v1/status/buildinfo")
+    assert r["status"] == "success" and "version" in r["data"]
+    r = http("GET", c.api.endpoint + "/api/v1/metadata")
+    assert r["status"] == "success" and r["data"] == {}

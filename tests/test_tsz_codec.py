@@ -245,7 +245,8 @@ class TestBatchedVsOracle:
 
 class TestF64BitsToF32:
     """Device RNE f64->f32 bit conversion (bits64.f64_bits_to_f32) must be
-    bit-identical to numpy's astype across every IEEE class — it replaces
+    bit-identical to numpy's astype across every IEEE class (modulo NaN
+    payloads, which canonicalize to quiet NaN) — it replaces
     the host f32 cast on the ingest path, so a rounding divergence would
     silently change rollup aggregates."""
 
