@@ -143,7 +143,17 @@ class HTTPApi:
     def query_instant(self, req) -> dict:
         q = req.param("query")
         t = _parse_time(req.param("time", str(time.time())))
-        block = self.engine.execute_instant(q, t)
+        # ONE parse serves both the type check and the evaluation.
+        ast = _parse_promql(q)
+        block = self.engine.execute_instant(ast, t)
+        if _is_scalar_node(ast):
+            # prom instant queries of scalar-typed expressions return
+            # resultType "scalar" (range queries still matrix-ize them)
+            v = block.values[0][-1] if block.n_series else float("nan")
+            return {"status": "success",
+                    "data": {"resultType": "scalar",
+                             "result": [block.meta.times()[-1] / S,
+                                        _prom_sample_value(v)]}}
         return _prom_vector(block)
 
     def _fetch_for_match(self, req):
@@ -517,12 +527,44 @@ def _parse_series_matchers(expr: str) -> Tuple[Matcher, ...]:
     return tuple(out)
 
 
+_SCALAR_FUNCS = {"scalar", "time", "pi"}
+
+
+def _parse_promql(q: str):
+    from ..query import promql as _pq
+
+    return _pq.parse(q)
+
+
+def _is_scalar_node(node) -> bool:
+    """Static promql typing of the ROOT expression: scalar literals,
+    scalar-returning functions, and arithmetic over scalars type as
+    scalar (promql/parser checkAST); anything touching a vector types
+    as vector."""
+    from ..query import promql as _pq
+
+    if isinstance(node, _pq.NumberLiteral):
+        return True
+    if isinstance(node, _pq.Unary):
+        return _is_scalar_node(node.expr)
+    if isinstance(node, _pq.Call):
+        return node.func in _SCALAR_FUNCS
+    if isinstance(node, _pq.BinaryOp):
+        return (node.op not in _pq.SET_OPS
+                and _is_scalar_node(node.lhs) and _is_scalar_node(node.rhs))
+    return False
+
+
 def _prom_sample_value(v: float) -> str:
     if math.isnan(v):
         return "NaN"
     if math.isinf(v):
         return "+Inf" if v > 0 else "-Inf"
-    return repr(float(v))
+    # Go strconv.FormatFloat(v, 'f', -1)-style: shortest POSITIONAL
+    # round-trip decimal — no trailing .0 on integers and no scientific
+    # notation at any magnitude ("100000000000000000000", "0.0000001") —
+    # what prometheus emits and strict clients byte-compare against.
+    return np.format_float_positional(float(v), unique=True, trim="-")
 
 
 def _metric_labels(tags) -> Dict[str, str]:

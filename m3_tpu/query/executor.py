@@ -127,13 +127,18 @@ class Engine:
 
         ROOT.counter("query.executed").inc()
         timer = ROOT.timer("query.latency_s")
-        with timer, span("query.execute_range", query=query):
+        with timer, span("query.execute_range", query=str(query)):
             return self._execute_range(query, start_ns, end_ns, step_ns)
 
-    def _execute_range(self, query: str, start_ns: int, end_ns: int,
+    def _execute_range(self, query, start_ns: int, end_ns: int,
                        step_ns: int) -> Block:
-        with span("query.parse"):
-            ast = promql.parse(query)
+        # `query` may be a pre-parsed AST (the HTTP layer parses once for
+        # its static type check and hands the node in) or a string.
+        if isinstance(query, promql.Node):
+            ast = query
+        else:
+            with span("query.parse"):
+                ast = promql.parse(query)
         params = QueryParams(start_ns, end_ns, step_ns)
         # @ start()/end() resolve against the OUTERMOST query range even
         # inside subqueries (prom promql/parser/ast.go StartOrEnd).

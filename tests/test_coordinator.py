@@ -191,3 +191,17 @@ def test_buildinfo_and_metadata_compat(coord):
     assert r["status"] == "success" and "version" in r["data"]
     r = http("GET", c.api.endpoint + "/api/v1/metadata")
     assert r["status"] == "success" and r["data"] == {}
+
+
+def test_instant_scalar_result_type(coord):
+    """prom API: instant queries of scalar-typed expressions return
+    resultType "scalar" with Go-style shortest number formatting ("2",
+    not "2.0"); vector-typed stay "vector"."""
+    c, _, _ = coord
+    base = c.endpoint
+    r = http("GET", base + "/api/v1/query?query=1%2B1&time=1700000000")
+    assert r["data"]["resultType"] == "scalar"
+    assert r["data"]["result"][1] == "2"
+    r = http("GET", base + "/api/v1/query?query=vector(42)&time=1700000000")
+    assert r["data"]["resultType"] == "vector"
+    assert r["data"]["result"][0]["value"][1] == "42"
