@@ -144,9 +144,9 @@ class HTTPApi:
         q = req.param("query")
         t = _parse_time(req.param("time", str(time.time())))
         # ONE parse serves both the type check and the evaluation.
-        ast = _parse_promql(q)
-        block = self.engine.execute_instant(ast, t)
-        if _is_scalar_node(ast):
+        ast = promql.parse(q)
+        block = self.engine.execute_instant(q, t, ast=ast)
+        if promql.is_scalar_node(ast):
             # prom instant queries of scalar-typed expressions return
             # resultType "scalar" (range queries still matrix-ize them)
             v = block.values[0][-1] if block.n_series else float("nan")
@@ -525,34 +525,6 @@ def _parse_series_matchers(expr: str) -> Tuple[Matcher, ...]:
                   "=~": MatchType.REGEXP, "!~": MatchType.NOT_REGEXP}[op]
             out.append(Matcher(mt, name.encode(), value.encode()))
     return tuple(out)
-
-
-_SCALAR_FUNCS = {"scalar", "time", "pi"}
-
-
-def _parse_promql(q: str):
-    from ..query import promql as _pq
-
-    return _pq.parse(q)
-
-
-def _is_scalar_node(node) -> bool:
-    """Static promql typing of the ROOT expression: scalar literals,
-    scalar-returning functions, and arithmetic over scalars type as
-    scalar (promql/parser checkAST); anything touching a vector types
-    as vector."""
-    from ..query import promql as _pq
-
-    if isinstance(node, _pq.NumberLiteral):
-        return True
-    if isinstance(node, _pq.Unary):
-        return _is_scalar_node(node.expr)
-    if isinstance(node, _pq.Call):
-        return node.func in _SCALAR_FUNCS
-    if isinstance(node, _pq.BinaryOp):
-        return (node.op not in _pq.SET_OPS
-                and _is_scalar_node(node.lhs) and _is_scalar_node(node.rhs))
-    return False
 
 
 def _prom_sample_value(v: float) -> str:

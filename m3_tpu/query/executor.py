@@ -122,21 +122,20 @@ class Engine:
         self._mesh = value
 
     def execute_range(self, query: str, start_ns: int, end_ns: int,
-                      step_ns: int) -> Block:
+                      step_ns: int, ast: Optional[Node] = None) -> Block:
         from ..utils.instrument import ROOT
 
         ROOT.counter("query.executed").inc()
         timer = ROOT.timer("query.latency_s")
-        with timer, span("query.execute_range", query=str(query)):
-            return self._execute_range(query, start_ns, end_ns, step_ns)
+        with timer, span("query.execute_range", query=query):
+            return self._execute_range(query, start_ns, end_ns, step_ns,
+                                       ast=ast)
 
-    def _execute_range(self, query, start_ns: int, end_ns: int,
-                       step_ns: int) -> Block:
-        # `query` may be a pre-parsed AST (the HTTP layer parses once for
-        # its static type check and hands the node in) or a string.
-        if isinstance(query, promql.Node):
-            ast = query
-        else:
+    def _execute_range(self, query: str, start_ns: int, end_ns: int,
+                       step_ns: int, ast: Optional[Node] = None) -> Block:
+        # The HTTP layer parses once for its static type check and hands
+        # the node in via `ast`; the query STRING still tags the spans.
+        if ast is None:
             with span("query.parse"):
                 ast = promql.parse(query)
         params = QueryParams(start_ns, end_ns, step_ns)
@@ -155,8 +154,9 @@ class Engine:
             val = self._eval(ast, params)
         return _to_block(val, params)
 
-    def execute_instant(self, query: str, t_ns: int) -> Block:
-        return self.execute_range(query, t_ns, t_ns, 1_000_000_000)
+    def execute_instant(self, query: str, t_ns: int,
+                        ast: Optional[Node] = None) -> Block:
+        return self.execute_range(query, t_ns, t_ns, 1_000_000_000, ast=ast)
 
     # -- evaluation --------------------------------------------------------
 

@@ -468,6 +468,29 @@ def parse(s: str) -> Node:
     return Parser(s).parse()
 
 
+# Functions whose CALL types as scalar (promql/parser functions.go return
+# types) — kept next to the AST so the engine and the HTTP layer share one
+# definition.
+SCALAR_FUNCS = frozenset({"scalar", "time", "pi"})
+
+
+def is_scalar_node(node: Node) -> bool:
+    """Static promql typing of the ROOT expression: scalar literals,
+    scalar-returning functions, and arithmetic over scalars type as
+    scalar (promql/parser checkAST); anything touching a vector types as
+    vector. The prom HTTP API shapes instant results by this."""
+    if isinstance(node, NumberLiteral):
+        return True
+    if isinstance(node, Unary):
+        return is_scalar_node(node.expr)
+    if isinstance(node, Call):
+        return node.func in SCALAR_FUNCS
+    if isinstance(node, BinaryOp):
+        return (node.op not in SET_OPS
+                and is_scalar_node(node.lhs) and is_scalar_node(node.rhs))
+    return False
+
+
 def selector_matchers(sel: VectorSelector) -> Tuple[Matcher, ...]:
     """Full matcher set including the metric name."""
     out = list(sel.matchers)

@@ -114,6 +114,33 @@ assert out['status'] == 'success', out
 print('promql function over HTTP OK')
 "
 
+# Modern promql surface against the real cluster: a subquery over an
+# @-pinned selector (max_over_time of 10s-resolution evals), and an
+# instant scalar-typed query returning resultType scalar.
+SUBQ="max_over_time(smoke_metric%5B30s:10s%5D%20@%20$NOW)"
+RESULT3=$(curl -fsS "$COORD/api/v1/query_range?query=$SUBQ&start=$((NOW-30))&end=$NOW&step=10")
+echo "$RESULT3" | python -c "
+import json, sys
+out = json.load(sys.stdin)
+assert out['status'] == 'success', out
+series = out['data']['result']
+assert len(series) == 6, [s['metric'] for s in series]
+for s in series:
+    vals = {float(v) for _, v in s['values']}
+    # @-pinned window => one constant value at every output step; the
+    # 10s-aligned eval times may cut one sample before NOW (13 or 14).
+    assert len(vals) == 1 and vals <= {13.0, 14.0}, (s['metric'], vals)
+print('subquery + @-modifier over HTTP OK (6 series, constant pinned max)')
+"
+RESULT4=$(curl -fsS "$COORD/api/v1/query?query=scalar(sum(smoke_metric))&time=$NOW")
+echo "$RESULT4" | python -c "
+import json, sys
+out = json.load(sys.stdin)
+assert out['data']['resultType'] == 'scalar', out
+assert out['data']['result'][1] == '84', out  # 6 series x 14, Go formatting
+print('instant scalar resultType + formatting OK (84)')
+"
+
 # --- 6. aggregators with placement watch ----------------------------------
 for a in a b; do
   cat > "$WORKDIR/agg$a.yml" <<EOF
@@ -261,7 +288,10 @@ for ep in sys.argv[1:3]:
 print("dual-wrote 5 windows to both HA aggregators")
 EOF
 
-for i in $(seq 1 40); do
+# Up to 60s: election + first flush normally lands in ~5-10s, but under
+# CPU contention (suite running alongside) heartbeat/election latency can
+# push past 20s — observed flaky once at 40x0.5s.
+for i in $(seq 1 120); do
   [ -s "$WORKDIR/ha-a.flush.log" ] && break
   sleep 0.5
 done
