@@ -24,6 +24,7 @@ from ..metrics.metric import MetricType
 from ..query import METRIC_NAME, Engine
 from ..query.block import Block
 from ..query.model import Matcher, MatchType
+from ..query import promql
 from ..query.promql import parse_duration_ns
 from .ingest import DownsamplerAndWriter
 
