@@ -48,7 +48,8 @@ class KVServer:
                             outer._serve_watch(self.request, req)
                             return  # connection is now a push stream
                         wire.write_frame(self.request, outer._handle(req))
-                except (ConnectionError, OSError, EOFError):
+                except (ConnectionError, OSError, EOFError, ValueError):
+                    # ValueError = malformed frame: stream desync, drop conn
                     pass
 
         class _Server(socketserver.ThreadingTCPServer):

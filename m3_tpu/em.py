@@ -196,7 +196,9 @@ class AgentServer:
                     while True:
                         req = wire.read_frame(self.request)
                         wire.write_frame(self.request, outer._handle(req))
-                except (ConnectionError, OSError, EOFError):
+                except (ConnectionError, OSError, EOFError, ValueError):
+                    # ValueError = malformed frame (wire.decode normalizes
+                    # every corrupt-buffer case): stream desync, drop conn
                     pass
 
         class _Server(socketserver.ThreadingTCPServer):

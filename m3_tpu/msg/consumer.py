@@ -57,7 +57,8 @@ class Consumer:
                         pending_acks.append(frame["id"])
                         if len(pending_acks) >= outer._ack_batch:
                             flush()
-                except (ConnectionError, OSError):
+                except (ConnectionError, OSError, ValueError):
+                    # ValueError = malformed frame: stream desync, drop conn
                     pass
 
         class _Server(socketserver.ThreadingTCPServer):

@@ -44,7 +44,8 @@ class RemoteStorageServer:
                         except Exception as e:  # noqa: BLE001
                             resp = {"err": str(e)}
                         wire.write_frame(self.request, resp)
-                except (ConnectionError, OSError):
+                except (ConnectionError, OSError, ValueError):
+                    # ValueError = malformed frame: stream desync, drop conn
                     pass
 
         class _Server(socketserver.ThreadingTCPServer):

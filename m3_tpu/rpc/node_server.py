@@ -260,6 +260,8 @@ class NodeServer:
                 try:
                     while True:
                         req = wire.read_frame(sock)
+                        if not isinstance(req, dict):
+                            return  # valid frame, wrong shape: drop conn
                         msg_id = req.get("id", 0)
                         try:
                             result = svc.dispatch(req["m"], req.get("a", {}))
@@ -268,7 +270,11 @@ class NodeServer:
                             wire.write_frame(
                                 sock, {"id": msg_id, "ok": False, "err": f"{type(e).__name__}: {e}"}
                             )
-                except (ConnectionError, OSError):
+                except (ConnectionError, OSError, ValueError):
+                    # ValueError = malformed/truncated frame (wire.decode
+                    # normalizes every corrupt-buffer case to it): the
+                    # stream is desynchronized, so drop the connection —
+                    # don't let the handler thread die with a traceback.
                     return
 
         class Server(socketserver.ThreadingTCPServer):

@@ -94,7 +94,16 @@ def encode(v: Any) -> bytes:
     return bytes(out)
 
 
-def _dec(buf: memoryview, pos: int):
+# Containers deeper than this are rejected: no legitimate RPC payload
+# nests past a handful of levels, and unbounded recursion would let a
+# ~10KB frame of nested list tags kill a handler thread with
+# RecursionError instead of the normalized ValueError.
+MAX_DEPTH = 32
+
+
+def _dec(buf: memoryview, pos: int, depth: int = 0):
+    if depth > MAX_DEPTH:
+        raise ValueError(f"wire: nesting deeper than {MAX_DEPTH}")
     tag = buf[pos]
     pos += 1
     if tag == _NIL:
@@ -135,7 +144,7 @@ def _dec(buf: memoryview, pos: int):
         pos += 4
         out = []
         for _ in range(n):
-            item, pos = _dec(buf, pos)
+            item, pos = _dec(buf, pos, depth + 1)
             out.append(item)
         return out, pos
     if tag == _DICT:
@@ -143,15 +152,21 @@ def _dec(buf: memoryview, pos: int):
         pos += 4
         d = {}
         for _ in range(n):
-            k, pos = _dec(buf, pos)
-            v, pos = _dec(buf, pos)
+            k, pos = _dec(buf, pos, depth + 1)
+            v, pos = _dec(buf, pos, depth + 1)
             d[k] = v
         return d, pos
     raise ValueError(f"wire: bad tag {tag}")
 
 
 def decode(buf: bytes) -> Any:
-    v, pos = _dec(memoryview(buf), 0)
+    try:
+        v, pos = _dec(memoryview(buf), 0)
+    except (struct.error, IndexError, TypeError) as e:
+        # truncated fixed-width field, out-of-range read, or garbage
+        # ndarray dtype string: surface the SAME error type as every
+        # other malformed-buffer case so callers catch one thing
+        raise ValueError(f"wire: malformed buffer ({e})")
     if pos != len(buf):
         raise ValueError(f"wire: trailing bytes ({len(buf) - pos})")
     return v
