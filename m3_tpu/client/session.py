@@ -55,7 +55,14 @@ class Connection:
     def call(self, method: str, args: dict):
         self._msg_id += 1
         wire.write_frame(self.sock, {"m": method, "id": self._msg_id, "a": args})
-        resp = wire.read_frame(self.sock)
+        try:
+            resp = wire.read_dict_frame(self.sock)
+        except ValueError as e:
+            # malformed reply = desync: this connection is unusable; close
+            # it and surface a CONNECTION error so quorum fanout treats
+            # the node as failed instead of retrying on a broken stream.
+            self.close()
+            raise ConnectionError(f"node reply desync: {e}")
         if not resp.get("ok"):
             raise RemoteError(resp.get("err", "unknown remote error"))
         return resp["r"]

@@ -43,7 +43,7 @@ class KVServer:
             def handle(self):
                 try:
                     while True:
-                        req = wire.read_frame(self.request)
+                        req = wire.read_dict_frame(self.request)
                         if req.get("op") == "watch":
                             outer._serve_watch(self.request, req)
                             return  # connection is now a push stream
@@ -159,7 +159,14 @@ class RemoteStore:
                     if self._sock is None:
                         self._sock = self._connect()
                     wire.write_frame(self._sock, req)
-                    resp = wire.read_frame(self._sock)
+                    try:
+                        resp = wire.read_dict_frame(self._sock)
+                    except ValueError as e:
+                        # malformed reply = stream desync: the pooled
+                        # socket is unusable; surface as a CONNECTION
+                        # error so it can never collide with the
+                        # CAS-mismatch ValueError contract below.
+                        raise ConnectionError(f"kv reply desync: {e}")
                     break
                 except (ConnectionError, OSError, EOFError):
                     if self._sock is not None:

@@ -194,7 +194,7 @@ class AgentServer:
             def handle(self):
                 try:
                     while True:
-                        req = wire.read_frame(self.request)
+                        req = wire.read_dict_frame(self.request)
                         wire.write_frame(self.request, outer._handle(req))
                 except (ConnectionError, OSError, EOFError, ValueError):
                     # ValueError = malformed frame (wire.decode normalizes
@@ -316,7 +316,10 @@ class RemoteOperator:
                     self._timeout + float(req.get("timeout_s", 0.0)))
                 wire.write_frame(self._sock, req)
                 wrote = True
-                resp = wire.read_frame(self._sock)
+                try:
+                    resp = wire.read_dict_frame(self._sock)
+                except ValueError as e:
+                    raise ConnectionError(f"agent reply desync: {e}")
                 break
             except (ConnectionError, OSError, EOFError):
                 self._close()

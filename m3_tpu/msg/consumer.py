@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import socket
 import socketserver
+import traceback
 import threading
 from typing import Callable, List, Optional
 
@@ -50,11 +51,25 @@ class Consumer:
                         if not ready:
                             flush()
                             continue
-                        frame = wire.read_frame(sock)
-                        if frame is None or frame.get("t") != "msg":
+                        frame = wire.read_dict_frame(sock)
+                        if frame.get("t") != "msg":
                             continue
-                        outer._handler(frame["shard"], frame["value"])
-                        pending_acks.append(frame["id"])
+                        shard = frame.get("shard")
+                        value = frame.get("value")
+                        mid = frame.get("id")
+                        if shard is None or value is None or mid is None:
+                            return  # protocol error, not an app error: drop
+                        try:
+                            outer._handler(shard, value)
+                        except Exception:  # noqa: BLE001 - app error, not desync
+                            # Handler failure is the APPLICATION's error:
+                            # log it, skip the ack, keep consuming — the
+                            # producer's retry-until-ack redelivers
+                            # (at-least-once), and the connection (whose
+                            # framing is intact) stays up.
+                            traceback.print_exc()
+                            continue
+                        pending_acks.append(mid)
                         if len(pending_acks) >= outer._ack_batch:
                             flush()
                 except (ConnectionError, OSError, ValueError):

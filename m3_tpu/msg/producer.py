@@ -58,6 +58,8 @@ class MessageWriter:
         self._send(msg)
 
     def _ensure_conn(self) -> bool:
+        if self._closed:
+            return False  # a late retry pass must not reconnect after close
         if self._sock is not None:
             return True
         try:
@@ -95,17 +97,25 @@ class MessageWriter:
         sock = self._sock
         try:
             while not self._closed and sock is self._sock:
-                frame = wire.read_frame(sock)
+                frame = wire.read_dict_frame(sock)
                 if frame.get("t") != "ack":
                     continue
+                ids = frame.get("ids") or ()
                 with self._lock:
-                    msgs = [self._queue.pop(i) for i in frame["ids"] if i in self._queue]
+                    msgs = [self._queue.pop(i) for i in ids if i in self._queue]
                 for m in msgs:
                     self.acked += 1
                     if self._on_ack is not None:
                         self._on_ack(m)
-        except (OSError, ConnectionError, Exception):
+        except Exception:  # noqa: BLE001 - reader exit = connection reset
             pass
+        finally:
+            # A dead ack reader MUST take the connection with it: leaving
+            # _sock set would let writes keep landing on a desynced stream
+            # whose acks are never read — with the background retry loop
+            # that becomes an infinite resend of every queued message.
+            if sock is self._sock:
+                self._drop_conn()
 
     def retry_unacked(self):
         """One retry pass (message_writer.go scanMessageQueue)."""
@@ -218,6 +228,7 @@ class Producer:
                  max_buffer_bytes: int = 64 * 1024 * 1024,
                  retry_delay_s: float = 0.2):
         self.topic = topic
+        self._retry_delay_s = retry_delay_s
         self._next_id = 0
         self._max_buffer_bytes = max_buffer_bytes
         self._buffered_bytes = 0
@@ -234,6 +245,16 @@ class Producer:
         for w in self._service_writers:
             w._on_ack = self._message_acked
         self.dropped_oldest = 0
+        # The reference's message writer scans its queue on a schedule
+        # (writer/message_writer.go scanMessageQueue loop) — without this
+        # thread, at-least-once only held if the CALLER remembered to pump
+        # retry_unacked(), and no service did: an unacked message (handler
+        # failure, dropped ack) was never redelivered. Found by driving a
+        # failing consumer handler live.
+        self._closed = False
+        self._retry_thread = threading.Thread(
+            target=self._retry_loop, name="producer-retry", daemon=True)
+        self._retry_thread.start()
 
     def publish(self, shard: int, value: bytes) -> int:
         """Publish one message to every consumer service; returns message id."""
@@ -280,6 +301,16 @@ class Producer:
             for w in self._service_writers:
                 w.forget(mid)
 
+    def _retry_loop(self):
+        while not self._closed:
+            time.sleep(self._retry_delay_s)
+            if self._closed:
+                return
+            try:
+                self.retry_unacked()
+            except Exception:  # noqa: BLE001 - the scan must outlive flaps
+                pass
+
     def retry_unacked(self):
         for w in self._service_writers:
             w.retry_unacked()
@@ -292,8 +323,11 @@ class Producer:
             return self._buffered_bytes
 
     def close(self):
+        self._closed = True
         for w in self._service_writers:
             w.close()
+        if self._retry_thread.is_alive():
+            self._retry_thread.join(timeout=2 * self._retry_delay_s + 1)
 
 
 def _default_connect(endpoint: str):

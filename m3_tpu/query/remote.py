@@ -38,7 +38,7 @@ class RemoteStorageServer:
             def handle(self):
                 try:
                     while True:
-                        req = wire.read_frame(self.request)
+                        req = wire.read_dict_frame(self.request)
                         try:
                             resp = outer._dispatch(req)
                         except Exception as e:  # noqa: BLE001
@@ -100,9 +100,10 @@ class RemoteStorage:
                 try:
                     sock = self._ensure_conn()
                     wire.write_frame(sock, req)
-                    resp = wire.read_frame(sock)
+                    resp = wire.read_dict_frame(sock)
                     break
-                except OSError:
+                except (OSError, ValueError):
+                    # ValueError = malformed reply (desync): same reset
                     self._drop_conn()
             else:
                 raise ConnectionError(f"remote storage {self._endpoint} unreachable")

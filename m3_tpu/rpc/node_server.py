@@ -259,9 +259,7 @@ class NodeServer:
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 try:
                     while True:
-                        req = wire.read_frame(sock)
-                        if not isinstance(req, dict):
-                            return  # valid frame, wrong shape: drop conn
+                        req = wire.read_dict_frame(sock)
                         msg_id = req.get("id", 0)
                         try:
                             result = svc.dispatch(req["m"], req.get("a", {}))

@@ -39,7 +39,9 @@ _F64S = struct.Struct("<d")
 MAX_FRAME = 1 << 31  # 2 GiB hard cap against corrupt length prefixes
 
 
-def _enc(out: bytearray, v: Any) -> None:
+def _enc(out: bytearray, v: Any, depth: int = 0) -> None:
+    if depth > MAX_DEPTH:
+        raise ValueError(f"wire: nesting deeper than {MAX_DEPTH}")
     if v is None:
         out += b"\x00"
     elif v is True:
@@ -77,13 +79,13 @@ def _enc(out: bytearray, v: Any) -> None:
         out += _U8.pack(_LIST)
         out += _U32.pack(len(v))
         for item in v:
-            _enc(out, item)
+            _enc(out, item, depth + 1)
     elif isinstance(v, dict):
         out += _U8.pack(_DICT)
         out += _U32.pack(len(v))
         for k, item in v.items():
-            _enc(out, k)
-            _enc(out, item)
+            _enc(out, k, depth + 1)
+            _enc(out, item, depth + 1)
     else:
         raise TypeError(f"wire: cannot encode {type(v)!r}")
 
@@ -94,11 +96,13 @@ def encode(v: Any) -> bytes:
     return bytes(out)
 
 
-# Containers deeper than this are rejected: no legitimate RPC payload
-# nests past a handful of levels, and unbounded recursion would let a
-# ~10KB frame of nested list tags kill a handler thread with
-# RecursionError instead of the normalized ValueError.
-MAX_DEPTH = 32
+# Containers deeper than this are rejected ON BOTH SIDES: encode fails
+# fast at the sender with a clear error instead of the receiver dropping
+# the connection as if the peer were malicious, and decode keeps a ~10KB
+# frame of nested list tags from killing a handler thread with
+# RecursionError. 64 is an order of magnitude above any real payload
+# (recursive query trees cost 2 levels per node).
+MAX_DEPTH = 64
 
 
 def _dec(buf: memoryview, pos: int, depth: int = 0):
@@ -196,6 +200,18 @@ def read_frame(sock: socket.socket) -> Any:
     if n > MAX_FRAME:
         raise ValueError(f"wire: frame too large ({n})")
     return decode(_read_exact(sock, n))
+
+
+def read_dict_frame(sock: socket.socket) -> dict:
+    """read_frame + top-level shape check: every server protocol in this
+    codebase frames dict messages, and a well-formed frame with the wrong
+    top type must surface as the SAME ValueError every handler loop
+    already treats as drop-the-connection (not an AttributeError
+    traceback at the first .get)."""
+    v = read_frame(sock)
+    if not isinstance(v, dict):
+        raise ValueError(f"wire: expected dict frame, got {type(v).__name__}")
+    return v
 
 
 # -------------------------------------------------- index query serialization

@@ -261,7 +261,8 @@ PIDS+=($HA_A_PID)
 await_log "$WORKDIR/ha-a.log" "m3_tpu aggregator listening on"
 sleep 1.5  # let ha-a win the election before the follower starts
 python -m m3_tpu.services aggregator -f "$WORKDIR/ha-b.yml" > "$WORKDIR/ha-b.log" 2>&1 &
-PIDS+=($!)
+HA_B_PID=$!
+PIDS+=($HA_B_PID)
 await_log "$WORKDIR/ha-b.log" "m3_tpu aggregator listening on"
 HA_A=$(grep "m3_tpu aggregator listening on" "$WORKDIR/ha-a.log" | awk '{print $NF}')
 HA_B=$(grep "m3_tpu aggregator listening on" "$WORKDIR/ha-b.log" | awk '{print $NF}')
@@ -288,21 +289,25 @@ for ep in sys.argv[1:3]:
 print("dual-wrote 5 windows to both HA aggregators")
 EOF
 
-# Up to 60s: election + first flush normally lands in ~5-10s, but under
-# CPU contention (suite running alongside) heartbeat/election latency can
-# push past 20s — observed flaky once at 40x0.5s.
+# The election may legitimately land on EITHER instance (observed: ha-b
+# wins ~half the time despite ha-a's head start) — detect the leader as
+# whichever flush log goes non-empty first. Up to 60s: election + first
+# flush normally lands in ~5-10s but CPU contention can stretch it.
+LEADER=""
 for i in $(seq 1 120); do
-  [ -s "$WORKDIR/ha-a.flush.log" ] && break
+  if [ -s "$WORKDIR/ha-a.flush.log" ]; then LEADER=ha-a; break; fi
+  if [ -s "$WORKDIR/ha-b.flush.log" ]; then LEADER=ha-b; break; fi
   sleep 0.5
 done
-[ -s "$WORKDIR/ha-a.flush.log" ] || { echo "leader never flushed"; cat "$WORKDIR/ha-a.log"; exit 1; }
+[ -n "$LEADER" ] || { echo "no leader ever flushed"; cat "$WORKDIR/ha-a.log" "$WORKDIR/ha-b.log"; exit 1; }
+if [ "$LEADER" = ha-a ]; then LEADER_PID=$HA_A_PID; else LEADER_PID=$HA_B_PID; fi
 # The flush loop emits (durable log line) THEN commits flush times to KV —
 # an at-least-once window of a few ms. Killing right on the observed line
 # could land inside it and legitimately double-flush; a 1s grace puts the
 # SIGKILL well past the commit (the next window is ~10s away).
 sleep 1
-kill -9 "$HA_A_PID"
-echo "leader ha-a SIGKILLed after $(wc -l < "$WORKDIR/ha-a.flush.log") flushed window(s)"
+kill -9 "$LEADER_PID"
+echo "leader $LEADER SIGKILLed after $(wc -l < "$WORKDIR/$LEADER.flush.log") flushed window(s)"
 
 # Wait until the promoted follower has drained every remaining window
 # (the last one only closes ~30s after the writes).

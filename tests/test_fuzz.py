@@ -192,3 +192,45 @@ class TestWireFuzz:
             sys.stderr = old
             srv.close()
         assert "Traceback" not in errbuf.getvalue()
+
+
+    def test_non_dict_frame_all_servers(self):
+        """The shared read_dict_frame guard covers every server loop: a
+        valid frame with a non-dict top value drops the connection on the
+        KV service too (was an AttributeError traceback)."""
+        import io
+        import socket
+        import struct
+        import sys
+
+        from m3_tpu.cluster.kv import MemStore
+        from m3_tpu.cluster.kv_service import KVServer
+        from m3_tpu.rpc import wire
+
+        srv = KVServer(MemStore()).start()
+        host, _, port = srv.endpoint.rpartition(":")
+        port = int(port)
+        errbuf = io.StringIO()
+        old = sys.stderr
+        sys.stderr = errbuf
+        try:
+            payload = wire.encode(123)
+            with socket.create_connection((host, port), timeout=5) as s:
+                s.sendall(struct.pack("<I", len(payload)) + payload)
+                s.settimeout(5)
+                with pytest.raises((ConnectionError, socket.timeout,
+                                    ValueError)):
+                    wire.read_frame(s)
+        finally:
+            sys.stderr = old
+            srv.close()
+        assert "Traceback" not in errbuf.getvalue()
+
+    def test_encode_depth_cap_fails_at_sender(self):
+        from m3_tpu.rpc import wire
+
+        v = None
+        for _ in range(80):
+            v = [v]
+        with pytest.raises(ValueError):
+            wire.encode(v)

@@ -132,7 +132,9 @@ class TestProducerConsumer:
                 if received:
                     break
                 time.sleep(0.02)
-            assert received == [b"early"]
+            # at-least-once: the background retry pass may legitimately
+            # resend before the first ack lands, so duplicates are valid
+            assert received and set(received) == {b"early"}
             assert _await(lambda: prod.unacked() == 0)
         finally:
             prod.close()
@@ -320,3 +322,37 @@ class TestCollectorEndToEnd:
         rollups = [m for m in cap.metrics if m.id.startswith(b"api_region_total")]
         assert len(rollups) == 1
         assert rollups[0].value == 12.0
+
+
+    def test_handler_failure_redelivered_not_fatal(self):
+        """A RAISING consumer handler is an application error, not stream
+        desync: the message goes unacked (redelivered by the producer's
+        own retry loop — no manual retry_unacked pumping), later messages
+        keep flowing, and the connection survives. Reference:
+        writer/message_writer.go scanMessageQueue's scheduled retry."""
+        import sys
+
+        seen = {}
+        lock = threading.Lock()
+
+        def handler(shard, value):
+            with lock:
+                seen[value] = seen.get(value, 0) + 1
+                n = seen[value]
+            if value == b"poison" and n == 1:
+                raise ValueError("injected handler failure")
+
+        consumer = Consumer(handler).start()
+        topic = Topic("t", 2, (ConsumerService("svc"),))
+        p = one_instance_placement(consumer.endpoint)
+        prod = Producer(topic, {"svc": lambda: p}, retry_delay_s=0.05)
+        try:
+            prod.publish(0, b"ok-1")
+            prod.publish(1, b"poison")
+            prod.publish(0, b"ok-2")
+            assert _await(lambda: seen.get(b"poison", 0) >= 2, timeout=10)
+            assert _await(lambda: prod.unacked() == 0, timeout=10)
+            assert seen.get(b"ok-1") and seen.get(b"ok-2")
+        finally:
+            prod.close()
+            consumer.close()
