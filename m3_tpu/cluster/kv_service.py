@@ -277,7 +277,7 @@ class RemoteStore:
                 wire.write_frame(s, {"op": "watch", "key": key,
                                      "from_version": last})
                 while not self._closed:
-                    ev = wire.read_frame(s)
+                    ev = wire.read_dict_frame(s)
                     if ev.get("heartbeat"):
                         continue
                     last = ev["version"]
@@ -308,7 +308,12 @@ class RemoteStore:
                                 fn(key, value)
                             except Exception:  # noqa: BLE001
                                 pass
-            except (ConnectionError, OSError, EOFError):
+            except (ConnectionError, OSError, EOFError, ValueError):
+                # ValueError = malformed/desynced push frame: the stream
+                # is unusable, but the WATCH must not die — reconnect
+                # from the last seen version like any broken connection
+                # (a dead watch thread would silently end placement/
+                # runtime-option delivery for every watcher of the key).
                 if self._closed:
                     return
                 threading.Event().wait(0.2)  # backoff, then reconnect

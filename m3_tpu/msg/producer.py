@@ -44,6 +44,12 @@ class MessageWriter:
         self._connect = connect
         self._retry_delay_s = retry_delay_s
         self._lock = threading.Lock()
+        # Serializes every socket write + connect/drop: publish() and the
+        # producer's background retry pass both call _send on this writer,
+        # and two interleaved sendall byte streams would desync the frame
+        # protocol at the consumer (and a connect race would leak a socket
+        # plus its ack-reader thread).
+        self._io_lock = threading.Lock()
         self._queue: Dict[int, _Message] = {}
         self._sock = None
         self._reader: Optional[threading.Thread] = None
@@ -72,20 +78,25 @@ class MessageWriter:
         return True
 
     def _send(self, msg: _Message) -> bool:
-        if not self._ensure_conn():
-            return False
-        try:
-            wire.write_frame(self._sock, {
-                "t": "msg", "shard": msg.shard, "id": msg.id,
-                "sent_at": time.monotonic_ns(), "value": msg.value,
-            })
-            msg.sent_at = time.monotonic_ns()
-            return True
-        except OSError:
-            self._drop_conn()
-            return False
+        with self._io_lock:
+            if not self._ensure_conn():
+                return False
+            try:
+                wire.write_frame(self._sock, {
+                    "t": "msg", "shard": msg.shard, "id": msg.id,
+                    "sent_at": time.monotonic_ns(), "value": msg.value,
+                })
+                msg.sent_at = time.monotonic_ns()
+                return True
+            except OSError:
+                self._drop_conn_locked()
+                return False
 
     def _drop_conn(self):
+        with self._io_lock:
+            self._drop_conn_locked()
+
+    def _drop_conn_locked(self):
         sock, self._sock = self._sock, None
         if sock is not None:
             try:
@@ -114,8 +125,11 @@ class MessageWriter:
             # _sock set would let writes keep landing on a desynced stream
             # whose acks are never read — with the background retry loop
             # that becomes an infinite resend of every queued message.
-            if sock is self._sock:
-                self._drop_conn()
+            # (Under the io lock so it can't close a freshly reconnected
+            # socket it compares against mid-swap.)
+            with self._io_lock:
+                if sock is self._sock:
+                    self._drop_conn_locked()
 
     def retry_unacked(self):
         """One retry pass (message_writer.go scanMessageQueue)."""
