@@ -1,0 +1,140 @@
+"""Aggregator-tier soak (SOAK_TARGET=aggregator scripts/soak.sh): run the
+real aggregator service as a child process, stream timed counter/gauge
+metrics at it over the rawtcp framed wire for SOAK_SECONDS, and assert
+
+  * the durable flush log grows throughout (windows keep closing and
+    flushing — the tier makes continuous progress under load),
+  * every flushed counter window equals the sum of what was sent for it
+    (spot-checked on a sampled id: no lost or double-applied values),
+  * the child's RSS stays under SOAK_MAX_RSS_GROWTH_MB of growth after
+    warmup (no unbounded elem/staging leak).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from m3_tpu.metrics.metric import MetricType
+from m3_tpu.rpc import wire
+
+S = 10**9
+SECONDS = float(os.environ.get("SOAK_SECONDS", "30"))
+MAX_GROWTH_MB = float(os.environ.get("SOAK_MAX_RSS_GROWTH_MB", "192"))
+# ONE window resolution drives the writer's window math, the storage
+# policy, and the flush-log window-start recovery below.
+RESOLUTION_S = 10
+RESOLUTION_NS = RESOLUTION_S * S
+POLICY = f"{RESOLUTION_S}s:2d"
+WARMUP_S = min(5.0, SECONDS / 3)  # scale down so short soaks still warm up
+
+
+def child_rss_mb(pid: int) -> float:
+    with open(f"/proc/{pid}/status") as f:
+        for line in f:
+            if line.startswith("VmRSS:"):
+                return int(line.split()[1]) / 1024.0
+    return 0.0
+
+
+def main() -> int:
+    workdir = tempfile.mkdtemp(prefix="agg_soak_")
+    cfg = os.path.join(workdir, "agg.yml")
+    flush_log = os.path.join(workdir, "flush.log")
+    log = os.path.join(workdir, "agg.log")
+    with open(cfg, "w") as f:
+        f.write(f"""instance_id: soak-agg
+listen_address: 127.0.0.1:0
+num_shards: 8
+flush_interval: 1s
+flush_log: {flush_log}
+""")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "m3_tpu.services", "aggregator", "-f", cfg],
+        stdout=open(log, "w"), stderr=subprocess.STDOUT)
+    try:
+        endpoint = None
+        for _ in range(200):
+            if os.path.exists(log):
+                for line in open(log):
+                    if "listening on" in line:
+                        endpoint = line.split()[-1]
+                        break
+            if endpoint:
+                break
+            time.sleep(0.1)
+        assert endpoint, open(log).read()
+        host, _, port = endpoint.rpartition(":")
+
+        sent = {}  # window_start -> sum sent for the sampled counter id
+        sock = socket.create_connection((host, int(port)), timeout=10)
+        t_end = time.time() + SECONDS
+        warmed = False
+        rss_start = 0.0
+        writes = 0
+        i = 0
+        while time.time() < t_end:
+            now = time.time_ns()
+            win = now // RESOLUTION_NS * RESOLUTION_NS
+            entries = []
+            for j in range(50):
+                mid = b"soak.counter.%d" % (j % 20)
+                v = float(i % 7 + 1)
+                entries.append({"t": "timed",
+                                "mtype": int(MetricType.COUNTER),
+                                "id": mid, "time": now, "value": v,
+                                "policy": POLICY})
+                if mid == b"soak.counter.0":
+                    sent[win] = sent.get(win, 0.0) + v
+                i += 1
+            wire.write_frame(sock, {"t": "batch", "entries": entries})
+            writes += len(entries)
+            if not warmed and time.time() > t_end - SECONDS + WARMUP_S:
+                rss_start = child_rss_mb(proc.pid)
+                warmed = True
+            time.sleep(0.01)
+        sock.close()
+        # let the final windows close and flush
+        time.sleep(12)
+        rss_end = child_rss_mb(proc.pid)
+
+        flushed = {}
+        n_lines = 0
+        for line in open(flush_log, "rb"):
+            mid, t, v, pol = line.split(b"\t")
+            n_lines += 1
+            if mid == b"soak.counter.0":
+                flushed[int(t) - RESOLUTION_NS] = float(v)
+        assert n_lines > 0, "nothing flushed"
+        # Every fully-closed window we tracked must match exactly (skip the
+        # first/last windows, which straddle the soak edges).
+        checked = 0
+        wins = sorted(sent)
+        for w in wins[1:-1]:
+            assert w in flushed, (w, sorted(flushed))
+            assert flushed[w] == sent[w], (w, flushed[w], sent[w])
+            checked += 1
+        growth = rss_end - rss_start
+        print(f"agg soak: {writes} datapoints sent, {n_lines} windows "
+              f"flushed, {checked} sampled windows exact, rss "
+              f"{rss_start:.0f} -> {rss_end:.0f} MB (+{growth:.0f})")
+        assert checked > 0, "soak too short to close a full window"
+        assert growth < MAX_GROWTH_MB, growth
+        print("AGG SOAK PASS")
+        return 0
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            # never mask the real failure behind a wedged child
+            proc.kill()
+            proc.wait(timeout=10)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

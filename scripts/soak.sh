@@ -7,9 +7,15 @@
 #     256MB) between the post-warmup and final samples — catches
 #     unbounded caches, span buffers, or leaked sockets/threads.
 # (reference: the long-haul dtests; this is the single-process analog)
+# SOAK_TARGET=aggregator soaks the aggregator tier instead: a real
+# `services aggregator` process under sustained rawtcp timed-metric
+# ingest, asserting continuous flush progress and bounded child RSS.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
+if [ "${SOAK_TARGET:-dbnode}" = aggregator ]; then
+  exec python scripts/_soak_aggregator.py "$@"
+fi
 exec python - "$@" <<'PY'
 import gc
 import json
