@@ -894,6 +894,15 @@ def _histogram_quantile(q: float, block: Block) -> Block:
         buckets.sort()
         ubs = np.array([b[0] for b in buckets])
         idxs = [b[1] for b in buckets]
+        if len(buckets) < 2 or not np.isinf(ubs[-1]):
+            # upstream requires a +Inf bucket AND at least two buckets:
+            # without them the total/interpolation is unknowable and the
+            # result is NaN (promql functions.go bucketQuantile), not a
+            # guess that treats the largest finite bucket as the total
+            # or collapses a lone +Inf bucket to 0.
+            tags_out.append(group_tags[key])
+            rows.append(np.full(block.meta.steps, np.nan))
+            continue
         counts = block.values[idxs]  # cumulative counts [B, T]
         total = counts[-1]
         out = np.full(block.meta.steps, np.nan)

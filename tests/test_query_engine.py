@@ -620,3 +620,64 @@ class TestAtModifier:
             parse("rate(c offset 5m [5m])")
         # ...but a subquery OF an offset selector stays legal
         parse("avg_over_time(x offset 5m [1h:])")
+
+
+class TestUpstreamSemanticEdges:
+    """Targeted upstream-conformance cases beyond the main suites."""
+
+    def test_rate_with_counter_reset_through_engine(self):
+        st = MemStorage()
+        t = np.arange(0, 20) * 15 * S
+        # counter climbs to 150, resets to 5, climbs again
+        v = np.concatenate([np.arange(10) * 15.0 + 10,
+                            np.arange(10) * 15.0 + 5])
+        st.add({"__name__": "c"}, t, v)
+        eng = Engine(st)
+        blk = eng.execute_range("increase(c[2m])", 3 * MIN, 4 * MIN, STEP)
+        vals = blk.values[0]
+        finite = vals[np.isfinite(vals)]
+        # every window spanning the reset must still be positive (the
+        # pre-reset value is added back, promql extrapolation applies)
+        assert (finite > 0).all(), vals
+
+    def test_histogram_quantile_missing_inf_bucket_is_nan(self):
+        # upstream: no le="+Inf" bucket -> NaN (total count unknowable)
+        st = MemStorage()
+        t = np.arange(0, 10) * 15 * S
+        for le, frac in ((b"0.1", 10.0), (b"1", 40.0), (b"10", 100.0)):
+            st.add({"__name__": "h_bucket", "le": le}, t, np.full(10, frac))
+        eng = Engine(st)
+        blk = eng.execute_range("histogram_quantile(0.5, h_bucket)",
+                                MIN, 2 * MIN, STEP)
+        assert np.all(np.isnan(blk.values)), blk.values
+
+    def test_histogram_quantile_with_inf_bucket(self):
+        st = MemStorage()
+        t = np.arange(0, 10) * 15 * S
+        for le, frac in ((b"0.1", 10.0), (b"1", 40.0), (b"10", 100.0),
+                         (b"+Inf", 100.0)):
+            st.add({"__name__": "h_bucket", "le": le}, t, np.full(10, frac))
+        eng = Engine(st)
+        blk = eng.execute_range("histogram_quantile(0.5, h_bucket)",
+                                MIN, 2 * MIN, STEP)
+        vals = blk.values[0][np.isfinite(blk.values[0])]
+        # rank 50 of 100 -> (1, 10] bucket, interpolated to 2.5
+        np.testing.assert_allclose(vals, 2.5)
+
+    def test_only_inf_bucket_is_nan(self):
+        # len(buckets) < 2: a lone +Inf bucket must be NaN, not 0.0
+        st = MemStorage()
+        t = np.arange(0, 10) * 15 * S
+        st.add({"__name__": "h_bucket", "le": "+Inf"}, t, np.full(10, 100.0))
+        eng = Engine(st)
+        blk = eng.execute_range("histogram_quantile(0.5, h_bucket)",
+                                MIN, 2 * MIN, STEP)
+        assert np.all(np.isnan(blk.values)), blk.values
+
+    def test_subquery_inside_aggregation(self, engine):
+        # sum over per-series subquery averages — composes through the
+        # aggregation path without touching the mesh fast path
+        blk = run(engine, "sum(avg_over_time(memory_bytes[2m:30s]))")
+        np.testing.assert_allclose(
+            blk.values[0][np.isfinite(blk.values[0])], 400.0)
+
