@@ -31,6 +31,7 @@ next query's host prep (double-buffering across a dashboard burst)."""
 from __future__ import annotations
 
 import collections
+import contextlib
 import functools
 import hashlib
 import os
@@ -42,6 +43,45 @@ import jax.numpy as jnp
 import numpy as np
 
 _F32 = jnp.float32
+
+# ------------------------------------------------------- query placement
+#
+# The engine may route a whole range-function evaluation to a specific
+# device — in practice the HOST cpu backend when the measured link says
+# shipping a full [series x steps] result plane off a tunneled accelerator
+# costs more than computing it locally (m3_tpu/query/placement.py). The
+# same jitted kernels run either way (XLA compiles per backend); inputs
+# committed to the placed device keep execution there. Thread-local
+# because one engine serves concurrent queries.
+
+_PLACEMENT = threading.local()
+
+
+@contextlib.contextmanager
+def placed_on(device):
+    """Run the enclosed kernel calls with inputs committed to `device`
+    (None = default backend). Cache entries are tagged per placement so a
+    host-placed and device-placed eval of the same grid never collide."""
+    prev = getattr(_PLACEMENT, "device", None)
+    _PLACEMENT.device = device
+    try:
+        yield
+    finally:
+        _PLACEMENT.device = prev
+
+
+def _place_device():
+    return getattr(_PLACEMENT, "device", None)
+
+
+def _place_tag():
+    dev = _place_device()
+    return None if dev is None else (dev.platform, dev.id)
+
+
+def _placed_put(arr):
+    dev = _place_device()
+    return jax.device_put(arr, dev) if dev is not None else jax.device_put(arr)
 
 # ------------------------------------------------------------ upload cache
 #
@@ -82,18 +122,45 @@ _DERIVED_CACHE_MAX_BYTES = int(os.environ.get(
 _derived_cache_bytes = 0
 
 
+# Identity fast path in front of the content hash: the executor's grid
+# cache returns the SAME consolidated grid object for a repeat selector
+# evaluation, and blake2b over a 10k-series f64 grid costs ~49ms (measured
+# ~700MB/s) — pure steady-state waste when the object is provably the one
+# already keyed. Entries hold a strong ref to the grid, so its id() cannot
+# be recycled while the entry lives; budget below bounds the pinned bytes.
+_DERIVED_ID_FAST: "collections.OrderedDict[tuple, tuple]" = collections.OrderedDict()
+_DERIVED_ID_FAST_MAX_BYTES = int(os.environ.get(
+    "M3_TPU_DERIVED_IDCACHE_BYTES", str(256 * 1024 * 1024)))
+_derived_id_fast_bytes = 0
+
+
 def _derived(grid: np.ndarray, kind: str, build):
-    """build(grid) -> (value tuple, charged bytes); cached by grid content
-    when a real accelerator is attached, rebuilt every call on host CPU."""
-    global _derived_cache_bytes
+    """build(grid) -> (value tuple, charged bytes); an id-keyed fast path
+    returns the cached derived tuple when the exact same grid object comes
+    back (repeat selector evals via the executor grid cache) — on EVERY
+    backend, since it costs two dict probes and no hash. The content-hash
+    tier below it runs only with a real accelerator attached (on host CPU
+    the 49ms blake2b costs more than the work it would save)."""
+    global _derived_cache_bytes, _derived_id_fast_bytes
+    fast_key = (id(grid), kind, _place_tag())
+    with _PUT_CACHE_LOCK:
+        fast = _DERIVED_ID_FAST.get(fast_key)
+        if fast is not None and fast[0] is grid:
+            _DERIVED_ID_FAST.move_to_end(fast_key)
+            return fast[1]
     if not _cache_enabled():
-        return build(grid)[0]
+        val, _ = build(grid)
+        with _PUT_CACHE_LOCK:
+            _id_fast_store(fast_key, grid, val)
+        return val
     g = np.ascontiguousarray(grid)
-    key = (hashlib.blake2b(g, digest_size=16).digest(), g.shape, kind)
+    key = (hashlib.blake2b(g, digest_size=16).digest(), g.shape, kind,
+           _place_tag())
     with _PUT_CACHE_LOCK:
         hit = _DERIVED_CACHE.get(key)
         if hit is not None:
             _DERIVED_CACHE.move_to_end(key)
+            _id_fast_store(fast_key, grid, hit[0])
             return hit[0]
     val, nbytes = build(g)
     with _PUT_CACHE_LOCK:
@@ -104,7 +171,27 @@ def _derived(grid: np.ndarray, kind: str, build):
                and len(_DERIVED_CACHE) > 1):
             _, (_, freed) = _DERIVED_CACHE.popitem(last=False)
             _derived_cache_bytes -= freed
+        _id_fast_store(fast_key, grid, val)
     return val
+
+
+def _id_fast_store(fast_key, grid, val):
+    """Store an id-keyed alias entry (caller holds _PUT_CACHE_LOCK).
+    Charged bytes cover BOTH the pinned grid and the derived value tuple —
+    on the pure-CPU path the tuple is host arrays no other budget sees."""
+    global _derived_id_fast_bytes
+    old = _DERIVED_ID_FAST.pop(fast_key, None)
+    if old is not None:
+        _derived_id_fast_bytes -= old[2]
+    cost = grid.nbytes + sum(
+        getattr(a, "nbytes", 0) for a in (val if isinstance(val, tuple)
+                                          else (val,)))
+    _DERIVED_ID_FAST[fast_key] = (grid, val, cost)
+    _derived_id_fast_bytes += cost
+    while (_derived_id_fast_bytes > _DERIVED_ID_FAST_MAX_BYTES
+           and len(_DERIVED_ID_FAST) > 1):
+        _, (_, _, freed) = _DERIVED_ID_FAST.popitem(last=False)
+        _derived_id_fast_bytes -= freed
 
 
 def _cached_put(arr: np.ndarray):
@@ -113,13 +200,13 @@ def _cached_put(arr: np.ndarray):
         return arr
     arr = np.ascontiguousarray(arr)
     key = (hashlib.blake2b(arr, digest_size=16).digest(),
-           arr.shape, arr.dtype.str)
+           arr.shape, arr.dtype.str, _place_tag())
     with _PUT_CACHE_LOCK:
         hit = _PUT_CACHE.get(key)
         if hit is not None:
             _PUT_CACHE.move_to_end(key)
             return hit[0]
-    dev = jax.device_put(arr)
+    dev = _placed_put(arr)
     with _PUT_CACHE_LOCK:
         if key not in _PUT_CACHE:
             # Charge the HOST size we measured; device_put may canonicalize
@@ -327,9 +414,9 @@ def _rate_args(grid: np.ndarray, is_counter: bool):
     def build(g):
         adj, finite, grid32 = rate_inputs(g, is_counter)
         arrs = (adj, finite) + ((grid32,) if is_counter else ())
-        if not _cache_enabled():
+        if not _cache_enabled() and _place_device() is None:
             return arrs, 0
-        return tuple(jax.device_put(a) for a in arrs), sum(
+        return tuple(_placed_put(a) for a in arrs), sum(
             a.nbytes for a in arrs)
 
     return _derived(grid, f"rate:{is_counter}", build)
@@ -587,9 +674,9 @@ def _resid_args(grid: np.ndarray):
     def build(g):
         resid, base = center(g)
         base32 = base.astype(np.float32)
-        if not _cache_enabled():
+        if not _cache_enabled() and _place_device() is None:
             return (resid, base, base32), 0
-        return ((jax.device_put(resid), base, jax.device_put(base32)),
+        return ((_placed_put(resid), base, _placed_put(base32)),
                 resid.nbytes + base32.nbytes)
 
     return _derived(grid, "resid", build)

@@ -14,6 +14,7 @@ from __future__ import annotations
 import dataclasses
 import math
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
@@ -82,6 +83,89 @@ _UNSET = object()
 _QUERY_MESH = _UNSET
 
 
+class _GridCache:
+    """Consolidated-grid cache for repeated selector evaluations.
+
+    A dashboard burst evaluates the same selector over the same immutable
+    sealed blocks every few seconds; re-consolidating a 10k-series fetch
+    onto the grid costs ~50ms per query (measured, consolidate_series on
+    a [10k x 447] grid) — pure waste when the data hasn't changed. The
+    reference leans on block/iterator caching for the same reason
+    (src/dbnode/storage/block/wired_list.go:77 WiredList).
+
+    Validity is OBJECT IDENTITY, not content: an entry stores strong
+    references to the fetched per-series entry dicts, and a lookup hits
+    only when the storage layer handed back the *same entry objects* (an
+    `is` check per series, ~1ms for 10k series). Unchanged-identity
+    arrays cannot have changed content anywhere in the query layer (fetch
+    results are treated as immutable throughout), so a hit is provably
+    equivalent to recomputation. Storages that rebuild entry dicts per
+    fetch simply never hit — correct, just slower. The strong refs pin
+    the fetched arrays while cached; the byte budget bounds that.
+    """
+
+    # A storage that rebuilds entry dicts per fetch can never hit; after
+    # this many consecutive identity misses with zero hits ever, puts are
+    # sampled 1-in-_PROBE_EVERY instead of pinning every fetch's arrays.
+    _MISS_DISABLE = 32
+    _PROBE_EVERY = 64
+
+    def __init__(self, max_bytes: int = 256 * 1024 * 1024):
+        import collections
+        self._lock = threading.Lock()
+        self._entries: "collections.OrderedDict[tuple, tuple]" = (
+            collections.OrderedDict())
+        self._bytes = 0
+        self._max_bytes = max_bytes
+        self._hits = 0
+        self._misses = 0
+        self._puts = 0
+
+    def get(self, key: tuple, series: dict):
+        with self._lock:
+            hit = self._entries.get(key)
+            if hit is None:
+                self._misses += 1
+                return None
+            stored_series, tags_list, values, _cost = hit
+            ok = len(stored_series) == len(series) and all(
+                stored_series.get(sid) is entry
+                for sid, entry in series.items())
+            if not ok:
+                # The stored entry can never hit again (identity moved on)
+                # — evict now so a rebuilding storage doesn't accumulate
+                # dead pinned arrays across selectors.
+                self._entries.pop(key, None)
+                self._bytes -= _cost
+                self._misses += 1
+                return None
+            self._hits += 1
+            self._entries.move_to_end(key)
+            return tags_list, values
+
+    def put(self, key: tuple, series: dict, tags_list, values) -> None:
+        cost = values.nbytes + sum(
+            e["t"].nbytes + e["v"].nbytes for e in series.values()
+            if hasattr(e.get("t"), "nbytes") and hasattr(e.get("v"), "nbytes"))
+        if cost > self._max_bytes:
+            return
+        with self._lock:
+            self._puts += 1
+            if (self._hits == 0 and self._misses >= self._MISS_DISABLE
+                    and self._puts % self._PROBE_EVERY):
+                # Rebuilding-storage regime: keep probing occasionally so a
+                # storage that starts returning stable entries is noticed.
+                return
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old[3]
+            self._entries[key] = (dict(series), tags_list, values, cost)
+            self._bytes += cost
+            while self._bytes > self._max_bytes and len(self._entries) > 1:
+                _, evicted = self._entries.popitem(last=False)
+                self._bytes -= evicted[3]
+
+
 class Engine:
     """executor/engine.go: compile -> plan -> execute. Storage is anything
     with fetch_raw(matchers, start_ns, end_ns) -> {id: {tags, t, v}}.
@@ -110,6 +194,9 @@ class Engine:
         # serves concurrent queries from the ThreadingHTTPServer and a
         # shared slot would charge one query's datapoints to another.
         self._local = threading.local()
+        self._grid_cache = _GridCache()
+        from .placement import QueryPlacement
+        self._placement = QueryPlacement()
 
     @property
     def mesh(self):
@@ -233,7 +320,8 @@ class Engine:
         series = self._fetch(sel, params.start_ns - self.lookback_ns - off,
                              params.end_ns - off + 1)
         shifted = BlockMeta(meta.start_ns - off, meta.step_ns, meta.steps)
-        tags_list, values = consolidate_series(series, shifted, self.lookback_ns)
+        tags_list, values = self._consolidate_cached(
+            sel, series, shifted, self.lookback_ns)
         return Block(meta, tags_list, values)
 
     def _eval_range_selector(self, sel: VectorSelector, params: QueryParams
@@ -253,8 +341,29 @@ class Engine:
         series = self._fetch(sel, ext_start - wgrid, meta.end_ns - off + 1)
         # Range selectors see raw samples (no lookback): a cell holds the
         # latest sample within its grid cell only.
-        tags_list, values = consolidate_series(series, ext_meta, wgrid)
+        tags_list, values = self._consolidate_cached(
+            sel, series, ext_meta, wgrid)
         return Block(ext_meta, tags_list, values), W, stride
+
+    def _consolidate_cached(self, sel: VectorSelector, series: dict,
+                            meta: BlockMeta, lookback_ns: int):
+        """consolidate_series behind the identity-verified grid cache: a
+        repeat evaluation of the same selector over the same (immutable)
+        fetched entries reuses the consolidated grid object, which also
+        re-arms every id-keyed device cache downstream (temporal's derived
+        cache skips its content hash when the same grid object returns)."""
+        from ..utils.instrument import ROOT
+
+        key = (promql.selector_matchers(sel),
+               meta.start_ns, meta.step_ns, meta.steps, lookback_ns)
+        hit = self._grid_cache.get(key, series)
+        if hit is not None:
+            ROOT.counter("query.grid_cache.hit").inc()
+            return hit
+        ROOT.counter("query.grid_cache.miss").inc()
+        tags_list, values = consolidate_series(series, meta, lookback_ns)
+        self._grid_cache.put(key, series, tags_list, values)
+        return tags_list, values
 
     def _eval_subquery_grid(self, sub: Subquery, params: QueryParams
                             ) -> Tuple[Block, int, int]:
@@ -346,8 +455,6 @@ class Engine:
         return self._eval_instant_func(node, params)
 
     def _eval_range_func(self, node: Call, params: QueryParams) -> Block:
-        from .block import LazyBlock
-
         range_args = [a for a in node.args
                       if isinstance(a, (VectorSelector, Subquery))]
         if not range_args or not (isinstance(range_args[-1], Subquery)
@@ -369,6 +476,29 @@ class Engine:
         # ever crosses the link. The hot dashboard shapes (rate-family and
         # *_over_time moments) additionally return fetch closures whose
         # async copy overlaps the next query's host prep (LazyBlock).
+        # WHERE the kernels run is a measured decision (placement.py):
+        # full-matrix results route to the host CPU backend when shipping
+        # them off a slow link would cost more than computing them there.
+        from ..utils.instrument import ROOT
+
+        cells = int(np.asarray(grid).size)
+        result_bytes = ext.n_series * params.meta().steps * 4
+        placed = self._placement.choose(cells, result_bytes)
+        ROOT.counter("query.placement.host" if placed is not None
+                     else "query.placement.device").inc()
+        t_dispatch = time.perf_counter()
+        with temporal.placed_on(placed):
+            return self._dispatch_range_func(
+                node, sel, params, ext, grid, W, stride, step_ns,
+                placed=placed, cells=cells, result_bytes=result_bytes,
+                t_dispatch=t_dispatch)
+
+    def _dispatch_range_func(self, node, sel, params, ext, grid, W, stride,
+                             step_ns, *, placed, cells, result_bytes,
+                             t_dispatch):
+        from .block import LazyBlock
+
+        f = node.func
         fetch = None
         if f == "rate":
             fetch = temporal.rate_async(grid, W, step_ns, sel.range_ns, stride)
@@ -416,7 +546,23 @@ class Engine:
         drop_name = f not in ("last_over_time",)
         tags = [_strip_name(t) if drop_name else t for t in ext.series_tags]
         if fetch is not None:
-            return LazyBlock(params.meta(), tags, fetch)
+            placement, inner = self._placement, fetch
+            # Observed cost = dispatch segment + materialization segment.
+            # The wall interval between them is EXCLUDED: LazyBlock exists
+            # so unrelated work (the next query's prep) interleaves there,
+            # and charging it to this eval would deflate the rate model.
+            dispatch_s = time.perf_counter() - t_dispatch
+
+            def observed_fetch():
+                t0 = time.perf_counter()
+                result = inner()
+                placement.observe(placed, cells, result_bytes,
+                                  dispatch_s + time.perf_counter() - t0)
+                return result
+
+            return LazyBlock(params.meta(), tags, observed_fetch)
+        self._placement.observe(placed, cells, result_bytes,
+                                time.perf_counter() - t_dispatch)
         return Block(params.meta(), tags, out)
 
     def _eval_instant_func(self, node: Call, params: QueryParams) -> Value:
@@ -571,11 +717,32 @@ class Engine:
                   "group"):
             # f64 host reduce keeps counter-sum exactness; the jitted f32
             # segment kernel (series_agg.grouped_reduce) is the fast path
-            # for large fan-in where 24-bit mantissas suffice.
+            # for large fan-in where 24-bit mantissas suffice. The large
+            # path places by the measured link: its input is a full
+            # [S, T] H2D upload, which a slow tunnel turns into the cost
+            # (the same economics as the range-func result transfer).
             kind = "count" if op == "group" else op
-            out = (series_agg.grouped_reduce_f64(vals, group_ids, G, kind)
-                   if vals.shape[0] < 4096 else
-                   series_agg.grouped_reduce(vals, group_ids, G, kind))
+            if vals.shape[0] < 4096:
+                out = series_agg.grouped_reduce_f64(vals, group_ids, G, kind)
+            else:
+                cells = int(np.asarray(vals).size)
+                # Transfer term = H2D upload of the f32 input + D2H of the
+                # grouped result; the SAME value feeds observe() so the
+                # model nets out what choose() charged (an inconsistent
+                # pair would fold the upload into "compute" and bias
+                # future choices).
+                xfer_bytes = cells * 4 + G * vals.shape[1] * 8
+                placed = self._placement.choose(cells, xfer_bytes)
+                arr = vals
+                if placed is not None:
+                    import jax
+
+                    arr = jax.device_put(
+                        np.asarray(vals, dtype=np.float32), placed)
+                t0 = time.perf_counter()
+                out = series_agg.grouped_reduce(arr, group_ids, G, kind)
+                self._placement.observe(placed, cells, xfer_bytes,
+                                        time.perf_counter() - t0)
             if op == "group":
                 # promql group(): 1 per group with any present series.
                 out = np.where(out > 0, 1.0, np.nan)

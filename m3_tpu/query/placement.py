@@ -1,0 +1,179 @@
+"""Transfer-aware query placement: device vs host, per evaluation.
+
+The TPU-first design principle (SURVEY.md §2.13): put the math where the
+data motion is cheapest. A range-function evaluation produces a
+[series x steps] f32 result plane that must reach the host to serve HTTP;
+on a locally-attached accelerator that D2H costs microseconds and the
+device wins outright, but over a slow tunnel (~10-80MB/s observed) a
+full-matrix result can cost more to SHIP than the host needs to COMPUTE.
+The engine therefore contains the host path as a subset — the same jitted
+XLA kernels compiled for the CPU backend — and routes each evaluation by
+a measured cost model:
+
+    host_cost  = cells / host_rate
+    accel_cost = rtt + result_bytes / d2h_bw + cells / accel_rate
+
+All four parameters are measured, not configured: d2h_bw and rtt from a
+periodic 1MB probe of the real link (refreshed every PROBE_REFRESH_S),
+host_rate / accel_rate as EWMAs of observed evaluations. Aggregated
+shapes (sum(rate(..)) over the mesh) never come through here — their
+result plane is tiny and the in-mesh scatter-gather path keeps them on
+device (m3_tpu/parallel/query.py).
+
+Reference analog: the coordinator's fanout storage picks local vs remote
+per query (/root/reference/src/query/storage/fanout/storage.go:1); here
+the "fanout" is across XLA backends with a measured link model.
+
+Env: M3_TPU_QUERY_PLACEMENT = auto (default) | device | host.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+PROBE_REFRESH_S = float(os.environ.get("M3_TPU_PLACEMENT_PROBE_S", "60"))
+_PROBE_BYTES = 1 << 20
+
+# Conservative priors, replaced by measurements after the first eval/probe:
+# host ~150M grid cells/s (measured: rate+sum_over_time pair over 2x4.47M
+# cells in ~60ms of XLA:CPU kernels), accel ~5G cells/s.
+_HOST_RATE_PRIOR = 150e6
+_ACCEL_RATE_PRIOR = 5e9
+
+
+def _ewma(old: Optional[float], new: float, alpha: float = 0.3) -> float:
+    return new if old is None else (1 - alpha) * old + alpha * new
+
+
+class QueryPlacement:
+    """Per-engine placement chooser + online cost model."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._mode = os.environ.get("M3_TPU_QUERY_PLACEMENT", "auto")
+        self._host_rate: Optional[float] = None
+        self._accel_rate: Optional[float] = None
+        self._d2h_bw: Optional[float] = None   # bytes/s
+        self._rtt: Optional[float] = None      # seconds
+        self._probed_at = 0.0
+        self._cpu_device = None
+        self._cpu_checked = False
+
+    # -- devices -----------------------------------------------------------
+
+    def _host_device(self):
+        """The CPU backend device, or None when unavailable / already the
+        default (JAX_PLATFORMS=cpu runs have nothing to place)."""
+        if not self._cpu_checked:
+            self._cpu_checked = True
+            import jax
+
+            try:
+                if jax.default_backend() != "cpu":
+                    self._cpu_device = jax.local_devices(backend="cpu")[0]
+            except Exception:  # no cpu platform registered
+                self._cpu_device = None
+        return self._cpu_device
+
+    # -- link probe --------------------------------------------------------
+
+    def _probe_link(self) -> None:
+        """Measure D2H bandwidth + dispatch RTT of the default accelerator
+        with a 1MB round trip. Serialized; refreshed every PROBE_REFRESH_S.
+        Runs on the accelerator the engine would use anyway, so a hung
+        tunnel costs no more here than the query itself would."""
+        import jax
+        import jax.numpy as jnp
+
+        now = time.monotonic()
+        with self._lock:
+            # Check-and-set under the lock: concurrent first queries must
+            # not each fire a 1MB probe and split the link N ways (each
+            # would measure ~bw/N and seed the EWMA low).
+            if now - self._probed_at < PROBE_REFRESH_S:
+                return
+            self._probed_at = now
+        try:
+            f = jax.jit(lambda x: x + 1)
+            tiny = jnp.arange(8)
+            t0 = time.perf_counter()
+            np.asarray(f(tiny))
+            rtt = time.perf_counter() - t0
+            buf = jax.device_put(
+                np.zeros(_PROBE_BYTES // 4, dtype=np.float32))
+            jax.block_until_ready(buf)
+            t0 = time.perf_counter()
+            np.asarray(buf)
+            dt = max(time.perf_counter() - t0, 1e-6)
+            with self._lock:
+                self._rtt = _ewma(self._rtt, rtt)
+                self._d2h_bw = _ewma(self._d2h_bw, _PROBE_BYTES / dt)
+        except Exception:
+            pass  # a failed probe leaves the prior model in place
+
+    # -- decision ----------------------------------------------------------
+
+    def choose(self, cells: int, result_bytes: int):
+        """Device to place this evaluation on: None = default accelerator,
+        or the CPU backend device for host evaluation."""
+        if self._mode == "device":
+            return None
+        host_dev = self._host_device()
+        if host_dev is None:
+            return None
+        if self._mode == "host":
+            return host_dev
+        self._probe_link()
+        with self._lock:
+            host_rate = self._host_rate or _HOST_RATE_PRIOR
+            accel_rate = self._accel_rate or _ACCEL_RATE_PRIOR
+            bw = self._d2h_bw
+            rtt = self._rtt or 0.003
+        if bw is None:
+            # No successful probe yet: assume the accelerator is healthy
+            # and locally attached until measured otherwise.
+            return None
+        host_cost = cells / host_rate
+        accel_cost = rtt + result_bytes / bw + cells / accel_rate
+        return host_dev if host_cost < accel_cost else None
+
+    # -- model updates -----------------------------------------------------
+
+    def observe(self, device, cells: int, result_bytes: int,
+                seconds: float) -> None:
+        """Fold an observed evaluation (dispatch -> result on host) back
+        into the rate model for the path that served it."""
+        if seconds <= 0 or cells <= 0:
+            return
+        with self._lock:
+            if device is not None:  # host-placed
+                self._host_rate = _ewma(self._host_rate, cells / seconds)
+            else:
+                bw = self._d2h_bw
+                transfer = (result_bytes / bw) if bw else 0.0
+                if transfer >= 0.8 * seconds:
+                    # Modeled transfer swallows (or exceeds) the whole
+                    # observation — the decomposition is unreliable (stale
+                    # bw after a link change would clamp compute to ~0 and
+                    # inject an absurd rate sample). Wait for the probe to
+                    # catch up instead.
+                    return
+                compute = max(seconds - transfer - (self._rtt or 0.0), 1e-5)
+                self._accel_rate = _ewma(self._accel_rate, cells / compute)
+
+    def snapshot(self) -> dict:
+        """Observability: /debug/vars + bench extra."""
+        with self._lock:
+            return {
+                "mode": self._mode,
+                "host_rate_cells_s": self._host_rate,
+                "accel_rate_cells_s": self._accel_rate,
+                "d2h_bw_mb_s": (self._d2h_bw / 2**20
+                                if self._d2h_bw else None),
+                "rtt_ms": (self._rtt * 1e3 if self._rtt else None),
+            }

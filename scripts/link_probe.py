@@ -1,0 +1,115 @@
+"""Tunnel link microbenchmark: split the promql pair cost into its floors.
+
+BASELINE config #3 loses to CPU only on tunneled accelerators; the bench
+artifact's phase_ms lumps "device_dispatch_and_transfer" into one number.
+This probe separates the two physical floors so the attribution (and the
+optimization target) is measured, not guessed:
+
+  - dispatch RTT: tiny jit call round-trips, median + p90
+  - D2H bandwidth: device->host fetch of 1/4/8/32MB f32 planes
+  - H2D bandwidth: host->device puts of the same planes
+
+Writes one JSON line to stdout; phase stamps to stderr. Exits 1 if the
+default backend is not a real accelerator (no point probing CPU memcpy).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    if dev.platform == "cpu":
+        print("link-probe: default backend is cpu, nothing to measure",
+              file=sys.stderr)
+        return 1
+
+    def timed(fn, n, warmup=2, setup=None):
+        """Median-friendly timings; `setup` (untimed) runs before every
+        rep and its return feeds fn — jax arrays cache their host copy
+        after the first np.asarray, so D2H reps must fetch a FRESH device
+        buffer each time or they time a memcpy, not the link."""
+        for _ in range(warmup):
+            fn(setup() if setup else None)
+        ts = []
+        for _ in range(n):
+            arg = setup() if setup else None
+            t0 = time.perf_counter()
+            fn(arg)
+            ts.append(time.perf_counter() - t0)
+        return ts
+
+    # Dispatch RTT: jit identity-ish op on 8 ints, force full round trip.
+    f = jax.jit(lambda x: x + 1)
+    x = jnp.arange(8)
+    rtts = timed(lambda _: np.asarray(f(x)), 20)
+    out = {
+        "platform": dev.platform,
+        "dispatch_rtt_ms": {
+            "median": round(float(np.median(rtts)) * 1e3, 2),
+            "p90": round(float(np.quantile(rtts, 0.9)) * 1e3, 2),
+        },
+    }
+    print(f"link-probe rtt median {out['dispatch_rtt_ms']['median']}ms",
+          file=sys.stderr, flush=True)
+
+    # Bandwidth planes. Every D2H rep fetches a FRESHLY-PUT device buffer
+    # (see timed's setup) so the cached-host-copy shortcut never fires.
+    d2h, h2d = {}, {}
+    for mb in (1, 4, 8, 32):
+        n_elem = mb * (1 << 20) // 4
+        host = np.random.default_rng(3).random(n_elem, dtype=np.float32)
+
+        def put_fresh():
+            arr = jax.device_put(host)
+            jax.block_until_ready(arr)
+            return arr
+
+        ts = timed(lambda arr: np.asarray(arr), 4, warmup=1,
+                   setup=put_fresh)
+        d2h[f"{mb}MB"] = round(mb / float(np.median(ts)), 1)
+        ts = timed(
+            lambda _: jax.block_until_ready(jax.device_put(host)), 4,
+            warmup=1)
+        h2d[f"{mb}MB"] = round(mb / float(np.median(ts)), 1)
+        print(f"link-probe {mb}MB d2h {d2h[f'{mb}MB']}MB/s "
+              f"h2d {h2d[f'{mb}MB']}MB/s", file=sys.stderr, flush=True)
+    out["d2h_mb_per_s"] = d2h
+    out["h2d_mb_per_s"] = h2d
+
+    # Overlap check: two async D2H copies vs sequential — does the tunnel
+    # pipeline concurrent fetches? Fresh device pairs per rep (above).
+    ha = np.random.default_rng(4).random(1 << 20, dtype=np.float32)
+    hb = np.random.default_rng(5).random(1 << 20, dtype=np.float32)
+
+    def put_pair():
+        pair = (jax.device_put(ha), jax.device_put(hb))
+        jax.block_until_ready(pair)
+        return pair
+
+    def seq(pair):
+        np.asarray(pair[0]), np.asarray(pair[1])
+
+    def overlapped(pair):
+        pair[0].copy_to_host_async()
+        pair[1].copy_to_host_async()
+        np.asarray(pair[0]), np.asarray(pair[1])
+
+    t_seq = float(np.median(timed(seq, 4, warmup=1, setup=put_pair)))
+    t_ovl = float(np.median(timed(overlapped, 4, warmup=1,
+                                  setup=put_pair)))
+    out["overlap_8mb_seq_ms"] = round(t_seq * 1e3, 1)
+    out["overlap_8mb_async_ms"] = round(t_ovl * 1e3, 1)
+
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

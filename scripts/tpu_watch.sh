@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# Tunnel watch: probe the accelerator every PROBE_INTERVAL seconds; the
+# moment it answers, capture (1) the link microbenchmark and (2) the
+# encode config's fused-e2e segment on-chip, then exit. Used mid-round to
+# re-arm on-chip proof runs across tunnel flaps without burning a
+# foreground session on polling.
+set -u
+cd "$(dirname "$0")/.."
+INTERVAL="${PROBE_INTERVAL:-120}"
+DEADLINE=$(( $(date +%s) + ${WATCH_MAX_S:-21600} ))
+STAMP=$(date -u +%Y%m%d_%H%M)
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  if timeout 95 python bench.py --probe 2>/dev/null | grep -q probe-ok; then
+    echo "tunnel up at $(date -u +%H:%M:%S)" >&2
+    python scripts/link_probe.py \
+      > "artifacts/link_probe_${STAMP}.json" \
+      2> "artifacts/link_probe_${STAMP}.err"
+    BENCH_ONLY=encode timeout 1200 python bench.py \
+      > "artifacts/bench_tpu_${STAMP}_encode_e2e.json" \
+      2> "artifacts/bench_tpu_${STAMP}_encode_e2e.phases.err"
+    exit 0
+  fi
+  sleep "$INTERVAL"
+done
+echo "tunnel never came back within the watch window" >&2
+exit 1

@@ -1,0 +1,92 @@
+"""QueryPlacement decision model: measured link + rate EWMAs drive the
+device-vs-host routing (m3_tpu/query/placement.py). The jax backends are
+not exercised here — the decision math is, with injected measurements."""
+
+import numpy as np
+
+from m3_tpu.query.placement import QueryPlacement, _ewma
+
+
+class _FakeDev:
+    platform = "cpu"
+    id = 0
+
+
+def _mk(mode="auto", bw=None, rtt=0.003, host_rate=None, accel_rate=None):
+    p = QueryPlacement()
+    p._mode = mode
+    p._cpu_checked = True
+    p._cpu_device = _FakeDev()
+    p._probed_at = float("inf")  # suppress the live probe
+    p._d2h_bw = bw
+    p._rtt = rtt
+    p._host_rate = host_rate
+    p._accel_rate = accel_rate
+    return p
+
+
+CELLS = 10_000 * 447          # the bench grid
+RESULT = 10_000 * 110 * 4     # one f32 result plane
+
+
+class TestChoose:
+    def test_slow_link_routes_host(self):
+        p = _mk(bw=15e6)  # ~15MB/s tunnel: 4.2MB result = ~290ms
+        assert p.choose(CELLS, RESULT) is p._cpu_device
+
+    def test_fast_link_routes_device(self):
+        p = _mk(bw=5e9)  # locally-attached: transfer ~1ms
+        assert p.choose(CELLS, RESULT) is None
+
+    def test_tiny_result_routes_device_even_on_slow_link(self):
+        # sum(rate(..)) shape: 110 floats. Host compute of 4.5M cells
+        # (~30ms) loses to rtt + ~0 transfer.
+        p = _mk(bw=15e6)
+        assert p.choose(CELLS, 110 * 4) is None
+
+    def test_mode_overrides(self):
+        assert _mk(mode="device", bw=1e3).choose(CELLS, RESULT) is None
+        p = _mk(mode="host", bw=1e12)
+        assert p.choose(CELLS, RESULT) is p._cpu_device
+
+    def test_no_probe_yet_prefers_device(self):
+        p = _mk(bw=None)
+        assert p.choose(CELLS, RESULT) is None
+
+    def test_no_cpu_backend_means_device(self):
+        p = _mk(bw=1e3)
+        p._cpu_device = None
+        assert p.choose(CELLS, RESULT) is None
+
+
+class TestObserve:
+    def test_host_observation_updates_host_rate(self):
+        p = _mk()
+        p.observe(_FakeDev(), cells=1_000_000, result_bytes=0, seconds=0.01)
+        assert p._host_rate == 1e8
+        # EWMA folds subsequent observations.
+        p.observe(_FakeDev(), cells=1_000_000, result_bytes=0, seconds=0.02)
+        assert 5e7 < p._host_rate < 1e8
+
+    def test_accel_observation_nets_out_transfer(self):
+        p = _mk(bw=100e6, rtt=0.0)
+        # 0.05s total with 0.04s of modeled transfer -> 0.01s compute.
+        p.observe(None, cells=1_000_000, result_bytes=4_000_000,
+                  seconds=0.05)
+        assert abs(p._accel_rate - 1e8) / 1e8 < 0.01
+
+    def test_bad_observations_ignored(self):
+        p = _mk()
+        p.observe(None, cells=0, result_bytes=0, seconds=0.0)
+        assert p._accel_rate is None
+
+    def test_snapshot_shape(self):
+        snap = _mk(bw=50e6, host_rate=1e8).snapshot()
+        assert snap["mode"] == "auto"
+        assert round(snap["d2h_bw_mb_s"], 1) == round(50e6 / 2**20, 1)
+        assert snap["host_rate_cells_s"] == 1e8
+
+
+def test_ewma():
+    assert _ewma(None, 10.0) == 10.0
+    assert np.isclose(_ewma(10.0, 20.0), 13.0)

@@ -1,0 +1,210 @@
+"""Grid/derived cache behavior: repeat selector evaluations must reuse the
+consolidated grid ONLY when the storage hands back identical entry objects
+(immutability by identity), and the temporal derived cache must skip its
+content hash when the exact same grid object returns.
+
+Reference analog: block/iterator caching on the read path
+(/root/reference/src/dbnode/storage/block/wired_list.go:77); the cache here
+lives at the query layer because consolidation (not disk) is the repeated
+host cost in this design.
+"""
+
+import numpy as np
+import pytest
+
+from m3_tpu.query import executor as executor_mod
+from m3_tpu.query.executor import Engine
+from m3_tpu.ops import temporal
+
+
+S_NS = 1_000_000_000
+
+
+def _mk_series(n=4, npts=60, reuse_grid=True):
+    t = 1_700_000_000 * S_NS + np.arange(npts, dtype=np.int64) * 10 * S_NS
+    rng = np.random.default_rng(5)
+    out = {}
+    for i in range(n):
+        sid = b"m{i=%d}" % i
+        out[sid] = {
+            "tags": {b"__name__": b"m", b"i": str(i).encode()},
+            "t": t if reuse_grid else t.copy(),
+            "v": np.cumsum(rng.poisson(3.0, npts)).astype(np.float64),
+        }
+    return out
+
+
+class _StaticStorage:
+    """Returns the SAME entry dicts every fetch (sealed-block serving)."""
+
+    def __init__(self, series):
+        self.series = series
+        self.fetches = 0
+
+    def fetch_raw(self, matchers, start_ns, end_ns):
+        self.fetches += 1
+        return dict(self.series)  # new outer dict, same entries
+
+
+class _RebuildingStorage(_StaticStorage):
+    """Rebuilds entry dicts per fetch (mutable head serving) — the cache
+    must treat every fetch as new data."""
+
+    def fetch_raw(self, matchers, start_ns, end_ns):
+        self.fetches += 1
+        return {
+            sid: dict(e, t=np.array(e["t"]), v=np.array(e["v"]))
+            for sid, e in self.series.items()
+        }
+
+
+def _range_args(series):
+    any_t = next(iter(series.values()))["t"]
+    start = int(any_t[30])
+    end = int(any_t[-1])
+    return start, end, 30 * S_NS
+
+
+def _count_consolidations(monkeypatch):
+    calls = []
+    real = executor_mod.consolidate_series
+
+    def counting(*a, **k):
+        calls.append(1)
+        return real(*a, **k)
+
+    monkeypatch.setattr(executor_mod, "consolidate_series", counting)
+    return calls
+
+
+class TestGridCache:
+    def test_identical_entries_hit(self, monkeypatch):
+        series = _mk_series()
+        st = _StaticStorage(series)
+        eng = Engine(st, mesh=None)
+        calls = _count_consolidations(monkeypatch)
+        start, end, step = _range_args(series)
+        b1 = eng.execute_range("rate(m[5m])", start, end, step)
+        n1 = len(calls)
+        b2 = eng.execute_range("rate(m[5m])", start, end, step)
+        assert len(calls) == n1  # zero new consolidations on the repeat
+        np.testing.assert_array_equal(b1.values, b2.values)
+
+    def test_rebuilt_entries_miss(self, monkeypatch):
+        series = _mk_series(reuse_grid=False)
+        st = _RebuildingStorage(series)
+        eng = Engine(st, mesh=None)
+        calls = _count_consolidations(monkeypatch)
+        start, end, step = _range_args(series)
+        b1 = eng.execute_range("rate(m[5m])", start, end, step)
+        n1 = len(calls)
+        b2 = eng.execute_range("rate(m[5m])", start, end, step)
+        assert len(calls) == 2 * n1  # every consolidation redone
+        np.testing.assert_array_equal(b1.values, b2.values)
+
+    def test_changed_series_set_misses_and_serves_new_data(self):
+        series = _mk_series()
+        st = _StaticStorage(series)
+        eng = Engine(st, mesh=None)
+        start, end, step = _range_args(series)
+        b1 = eng.execute_range("sum_over_time(m[5m])", start, end, step)
+        # A new series arrives (same objects for the old ones).
+        extra_entry = dict(next(iter(_mk_series(n=5).values())),
+                           tags={b"__name__": b"m", b"i": b"9"})
+        st.series = dict(series)
+        st.series[b"m{i=9}"] = extra_entry
+        b2 = eng.execute_range("sum_over_time(m[5m])", start, end, step)
+        assert b2.n_series == b1.n_series + 1
+
+    def test_different_selectors_do_not_collide(self):
+        series = _mk_series()
+        st = _StaticStorage(series)
+        eng = Engine(st, mesh=None)
+        start, end, step = _range_args(series)
+        b_rate = eng.execute_range("rate(m[5m])", start, end, step)
+        b_sum = eng.execute_range("sum_over_time(m[5m])", start, end, step)
+        # Same grid params, different function: both use the same cached
+        # grid but different kernels — results must differ.
+        assert not np.array_equal(
+            np.nan_to_num(b_rate.values), np.nan_to_num(b_sum.values))
+
+    def test_instant_selector_cached(self, monkeypatch):
+        series = _mk_series()
+        st = _StaticStorage(series)
+        eng = Engine(st, mesh=None)
+        calls = _count_consolidations(monkeypatch)
+        start, end, step = _range_args(series)
+        v1 = eng.execute_range("m", start, end, step)
+        n1 = len(calls)
+        v2 = eng.execute_range("m", start, end, step)
+        assert len(calls) == n1
+        np.testing.assert_array_equal(v1.values, v2.values)
+
+    def test_byte_budget_bounds_entries(self):
+        cache = executor_mod._GridCache(max_bytes=1)
+        series = _mk_series()
+        vals = np.zeros((4, 10))
+        cache.put(("k",), series, [], vals)
+        # Entry larger than the budget is simply not stored.
+        assert cache.get(("k",), series) is None
+
+
+class TestDerivedIdFastPath:
+    @pytest.fixture()
+    def force_cache(self, monkeypatch):
+        monkeypatch.setattr(temporal, "_cache_enabled", lambda: True)
+        # Isolate this test's entries.
+        monkeypatch.setattr(temporal, "_DERIVED_CACHE",
+                            type(temporal._DERIVED_CACHE)())
+        monkeypatch.setattr(temporal, "_DERIVED_ID_FAST",
+                            type(temporal._DERIVED_ID_FAST)())
+        monkeypatch.setattr(temporal, "_derived_cache_bytes", 0)
+        monkeypatch.setattr(temporal, "_derived_id_fast_bytes", 0)
+        monkeypatch.setattr(temporal, "_PUT_CACHE",
+                            type(temporal._PUT_CACHE)())
+        monkeypatch.setattr(temporal, "_put_cache_bytes", 0)
+
+    def _count_hashes(self, monkeypatch):
+        import hashlib as real_hashlib
+        calls = []
+
+        class _H:
+            def __getattr__(self, name):
+                return getattr(real_hashlib, name)
+
+            @staticmethod
+            def blake2b(*a, **k):
+                calls.append(1)
+                return real_hashlib.blake2b(*a, **k)
+
+        monkeypatch.setattr(temporal, "hashlib", _H())
+        return calls
+
+    def test_same_object_skips_hash(self, monkeypatch, force_cache):
+        calls = self._count_hashes(monkeypatch)
+        grid = np.random.default_rng(0).random((16, 50))
+        r1 = temporal.rate(grid, 6, 10 * S_NS, 60 * S_NS, 3)
+        n1 = len(calls)
+        assert n1 > 0
+        r2 = temporal.rate(grid, 6, 10 * S_NS, 60 * S_NS, 3)
+        assert len(calls) == n1  # no new hashes for the same object
+        np.testing.assert_array_equal(np.asarray(r1), np.asarray(r2))
+
+    def test_equal_content_new_object_hits_content_path(
+            self, monkeypatch, force_cache):
+        calls = self._count_hashes(monkeypatch)
+        grid = np.random.default_rng(0).random((16, 50))
+        r1 = temporal.over_time(grid, 6, "sum", 3)
+        n1 = len(calls)
+        r2 = temporal.over_time(grid.copy(), 6, "sum", 3)
+        # Content path re-hashes but reuses the derived device arrays.
+        assert len(calls) > n1
+        np.testing.assert_array_equal(np.asarray(r1), np.asarray(r2))
+
+    def test_id_fast_budget(self, force_cache, monkeypatch):
+        monkeypatch.setattr(temporal, "_DERIVED_ID_FAST_MAX_BYTES", 1)
+        g1 = np.random.default_rng(1).random((8, 30))
+        g2 = np.random.default_rng(2).random((8, 30))
+        temporal.over_time(g1, 3, "sum", 2)
+        temporal.over_time(g2, 3, "sum", 2)
+        assert len(temporal._DERIVED_ID_FAST) <= 1
