@@ -60,7 +60,8 @@ class QueryPlacement:
         self._accel_rate: Optional[float] = None
         self._d2h_bw: Optional[float] = None   # bytes/s
         self._rtt: Optional[float] = None      # seconds
-        self._probed_at = 0.0
+        self._probed_at: Optional[float] = None
+        self._probe_fn = None
         self._cpu_device = None
         self._cpu_checked = False
 
@@ -82,6 +83,20 @@ class QueryPlacement:
 
     # -- link probe --------------------------------------------------------
 
+    def _claim_probe(self, now: float) -> bool:
+        """Freshness guard, check-and-set under the lock: concurrent first
+        queries must not each fire a 1MB probe and split the link N ways
+        (each would measure ~bw/N and seed the EWMA low). None (never
+        probed) always probes — a 0.0 sentinel would compare against raw
+        monotonic time and skip every probe for the first PROBE_REFRESH_S
+        after boot (CLOCK_MONOTONIC is uptime on Linux)."""
+        with self._lock:
+            if (self._probed_at is not None
+                    and now - self._probed_at < PROBE_REFRESH_S):
+                return False
+            self._probed_at = now
+            return True
+
     def _probe_link(self) -> None:
         """Measure D2H bandwidth + dispatch RTT of the default accelerator
         with a 1MB round trip. Serialized; refreshed every PROBE_REFRESH_S.
@@ -91,16 +106,21 @@ class QueryPlacement:
         import jax.numpy as jnp
 
         now = time.monotonic()
-        with self._lock:
-            # Check-and-set under the lock: concurrent first queries must
-            # not each fire a 1MB probe and split the link N ways (each
-            # would measure ~bw/N and seed the EWMA low).
-            if now - self._probed_at < PROBE_REFRESH_S:
-                return
-            self._probed_at = now
+        if not self._claim_probe(now):
+            return
         try:
-            f = jax.jit(lambda x: x + 1)
+            if self._probe_fn is None:
+                # Jitted once per instance: a fresh lambda each probe
+                # would re-pay the XLA compile every refresh (jit caches
+                # by function identity).
+                self._probe_fn = jax.jit(lambda x: x + 1)
+            f = self._probe_fn
             tiny = jnp.arange(8)
+            # Warm dispatch first: the initial call pays XLA compile +
+            # backend warmup (observed 0.5-54s on a cold axon tunnel) and
+            # would poison the RTT EWMA for the whole refresh horizon —
+            # time the SECOND round trip, which is pure dispatch + D2H.
+            np.asarray(f(tiny))
             t0 = time.perf_counter()
             np.asarray(f(tiny))
             rtt = time.perf_counter() - t0

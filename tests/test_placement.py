@@ -1,8 +1,10 @@
 """QueryPlacement decision model: measured link + rate EWMAs drive the
-device-vs-host routing (m3_tpu/query/placement.py). The jax backends are
-not exercised here — the decision math is, with injected measurements."""
+device-vs-host routing (m3_tpu/query/placement.py). The decision math
+runs on injected measurements; the final test drives the LIVE link probe
+against this process's default jax backend (compile + a 1MB transfer)."""
 
 import numpy as np
+import pytest
 
 from m3_tpu.query.placement import QueryPlacement, _ewma
 
@@ -90,3 +92,57 @@ class TestObserve:
 def test_ewma():
     assert _ewma(None, 10.0) == 10.0
     assert np.isclose(_ewma(10.0, 20.0), 13.0)
+
+
+def test_live_probe_rtt_excludes_compile():
+    """The probe times the SECOND tiny dispatch: the first pays XLA
+    compile + backend warmup (observed 0.5-54s on a cold tunnel) and
+    must not seed the RTT EWMA. Discriminating bound: measure this
+    backend's actual compile+first-dispatch cost of an equivalent fresh
+    jit in-test; the recorded rtt must undercut it (a compile-polluted
+    rtt would be >= it by construction)."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    # What a compile-polluted rtt would be on THIS backend, right now:
+    # fresh function identity forces a real compile.
+    t0 = time.perf_counter()
+    np.asarray(jax.jit(lambda x: x + 2)(jnp.arange(8)))
+    first_dispatch = time.perf_counter() - t0
+
+    # Min of three probes: the timed warm dispatch is sub-ms, so one
+    # scheduler preemption could push a single sample past the floor.
+    p = QueryPlacement()
+    rtts = []
+    for _ in range(3):
+        p._probed_at = None  # re-arm the freshness guard
+        p._rtt = None        # fresh sample, not an EWMA blend
+        p._probe_link()
+        assert p._rtt is not None and p._d2h_bw is not None
+        rtts.append(p._rtt)
+    rtt = min(rtts)
+    if first_dispatch < 4 * rtt:
+        # The 'fresh compile' hit a warm persistent compilation cache
+        # (JAX_COMPILATION_CACHE_DIR on TPU VMs) — first_dispatch is just
+        # a dispatch, the pollution premise is void, and the bound would
+        # fail spuriously on high-RTT tunneled backends. The probe fields
+        # populating (asserted above) is all this environment can check.
+        pytest.skip("no real compile observed; bound not discriminating")
+    assert rtt < max(0.5 * first_dispatch, 0.005), (
+        f"rtt {rtt * 1e3:.2f}ms vs compile+first-dispatch "
+        f"{first_dispatch * 1e3:.2f}ms: compile-polluted")
+
+
+def test_probe_guard_fresh_instance_even_early_in_uptime():
+    """_probed_at starts as None, not 0.0: with a 0.0 sentinel the claim
+    guard `now - 0.0 < PROBE_REFRESH_S` would skip every probe for the
+    first PROBE_REFRESH_S of MONOTONIC time — i.e. the first minute
+    after boot on Linux, where CLOCK_MONOTONIC is uptime. Hermetic: the
+    guard method takes `now` explicitly, no backend or clock patching."""
+    p = QueryPlacement()
+    assert p._claim_probe(1.0)           # "just booted": must probe
+    assert p._probed_at == 1.0           # stamped
+    assert not p._claim_probe(2.0)       # fresh: within the refresh window
+    assert p._claim_probe(1.0 + 3600.0)  # stale: re-probes
