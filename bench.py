@@ -313,7 +313,7 @@ def bench_promql():
     dt = timed_pairs(eng, iters)
     _phase("promql: done")
     dps = 2 * n * npts / dt
-    placement = eng._placement.snapshot()
+    placement = eng.placement_snapshot()
     # Attribution on accelerator platforms: the adaptive engine routes by
     # the measured link (the headline above IS the product behavior); the
     # forced pairs record what each path costs on this hardware, and the

@@ -108,10 +108,12 @@ class HTTPApi:
 
     def debug_vars(self, req) -> dict:
         """Process metrics snapshot (the reference exposes pprof + tally;
-        dbnode/server/server.go:575 debug listener)."""
+        dbnode/server/server.go:575 debug listener), plus the query
+        engine's live device-vs-host placement cost model."""
         from ..utils.instrument import ROOT
 
-        return {"metrics": ROOT.snapshot()}
+        return {"metrics": ROOT.snapshot(),
+                "query_placement": self.engine.placement_snapshot()}
 
     def debug_traces(self, req) -> dict:
         """Recent finished span trees (opentracing-analog)."""

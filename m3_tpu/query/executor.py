@@ -198,6 +198,12 @@ class Engine:
         from .placement import QueryPlacement
         self._placement = QueryPlacement()
 
+    def placement_snapshot(self) -> dict:
+        """Live device-vs-host cost model state (mode, measured link
+        bandwidth/RTT, per-path rate EWMAs) for /debug/vars and the bench
+        extra."""
+        return self._placement.snapshot()
+
     @property
     def mesh(self):
         if isinstance(self._mesh, str):  # "auto"

@@ -129,6 +129,17 @@ class TestHTTPReadWrite:
         assert any("query_range" in r for r in
                    http("GET", f"{c.endpoint}/routes")["routes"])
 
+    def test_debug_vars_exposes_placement_model(self, coord):
+        """Operators watching /debug/vars see the live device-vs-host
+        query placement cost model next to the process counters."""
+        c, _, _ = coord
+        v = http("GET", f"{c.endpoint}/debug/vars")
+        assert "metrics" in v
+        qp = v["query_placement"]
+        assert qp["mode"] in ("auto", "device", "host")
+        assert set(qp) >= {"host_rate_cells_s", "accel_rate_cells_s",
+                           "d2h_bw_mb_s", "rtt_ms"}
+
 
 class TestDownsampler:
     def test_rule_matched_writes_aggregate_back(self, coord):
