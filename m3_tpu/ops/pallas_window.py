@@ -12,13 +12,27 @@ and storing one output lane. Work drops from O(S*K*W) to
 O(S*T_out*W) = O(S*K*W/stride), and the stat+count pair comes out of one
 launch (the XLA path builds a separate masked volume per moment).
 
-Semantics are IDENTICAL to temporal._window_stat (masked by finiteness,
-m2 in the two-pass mean-then-deviation form that survives f32): the
-parity tests run both over the same grids, NaN holes included.
+Semantics match temporal._window_stat (masked by finiteness, m2 in the
+two-pass mean-then-deviation form that survives f32): the parity tests
+run both over the same grids, NaN holes included. On real hardware the
+accumulated stats (sum/m2) differ from the XLA path by reduction-order
+ULPs only (measured max abs 8e-6 on N(0,1) windows of 30).
+
+ON-CHIP STATUS (v5e, 2026-07-31, 10k x 438 grid, W=30, stride=3 — the
+bench's promql shape): compiles and matches, but LOSES to the XLA path —
+count 8.9ms vs 5.7, sum 13.3 vs 6.5, m2 33.4 vs 8.3. The theoretical
+O(W/stride) work saving never materializes: each output step reduces an
+[8, W] tile that fills 30 of 128 VPU lanes and pays a relayout for its
+unaligned static offset, while XLA's fused reduce_window streams full
+[8, 128] tiles. The kernel stays opt-in (M3_TPU_PALLAS=1) as an
+honestly-measured negative result — the pallas playbook's "don't
+hand-schedule what the compiler already schedules well" conclusion,
+kept because its structure (VMEM tiling, static-unroll constraint) is
+the template for kernels XLA does NOT already fuse.
 
 Opt-in wiring: temporal._window_stat_strided dispatches here when
-M3_TPU_PALLAS=1 (default off until proven on-chip; interpret mode backs
-the kernel on CPU so the tests and any CPU fallback stay correct).
+M3_TPU_PALLAS=1 (interpret mode backs the kernel on CPU so the tests
+and any CPU fallback stay correct).
 """
 
 from __future__ import annotations
@@ -38,13 +52,29 @@ _BS = 8
 
 STATS = ("count", "sum", "min", "max", "last", "m2")
 
+# The kernel statically unrolls its output-step loop (Mosaic alignment,
+# see _kernel); callers must not dispatch shapes whose unroll would blow
+# up trace/compile time — an unstrided 10k-column grid would unroll ~10k
+# window reductions into one program. Past this bound the XLA
+# reduce_window path (constant program size) is the right tool anyway.
+MAX_UNROLL_STEPS = 512
+
 
 def _kernel(x_ref, o_ref, c_ref, *, W: int, stride: int, T_out: int,
             stat: str):
+    # STATIC unroll over the output steps: Mosaic requires dynamic lane
+    # slices to start at provable multiples of 128, and a window start of
+    # i*stride from a fori_loop counter is not — the dynamic-slice form
+    # fails TPU compilation outright ("cannot statically prove that index
+    # in dimension 1 is a multiple of 128", found by the on-chip proof
+    # run; interpret mode on CPU never sees the constraint). Constant
+    # offsets lower fine (Mosaic inserts the relayouts), and T_out is a
+    # query's output step count (~100s), so the unrolled loop stays a
+    # modest program.
+    x = x_ref[:, :]
     iota_w = jax.lax.broadcasted_iota(jnp.int32, (_BS, W), 1)
-
-    def body(i, _):
-        win = x_ref[:, pl.ds(i * stride, W)]            # [BS, W] VMEM
+    for i in range(T_out):
+        win = x[:, i * stride: i * stride + W]          # [BS, W], static
         mask = jnp.isfinite(win)
         cnt = jnp.sum(mask.astype(_F32), axis=1)
         if stat == "count":
@@ -66,11 +96,8 @@ def _kernel(x_ref, o_ref, c_ref, *, W: int, stride: int, T_out: int,
             out = jnp.sum(dev * dev, axis=1)
         else:  # pragma: no cover - guarded by caller
             raise ValueError(stat)
-        o_ref[:, pl.ds(i, 1)] = out[:, None]
-        c_ref[:, pl.ds(i, 1)] = cnt[:, None]
-        return 0
-
-    jax.lax.fori_loop(0, T_out, body, 0)
+        o_ref[:, i] = out
+        c_ref[:, i] = cnt
 
 
 @functools.lru_cache(maxsize=256)
@@ -115,5 +142,11 @@ def window_stat(resid, W: int, stride: int, stat: str):
         raise ValueError(
             f"grid has {K} columns < window {W}; callers fall back to the "
             "XLA path for the empty result (temporal._window_stat_strided)")
+    t_out = (K - W) // stride + 1
+    if t_out > MAX_UNROLL_STEPS:
+        raise ValueError(
+            f"{t_out} output steps would unroll past MAX_UNROLL_STEPS="
+            f"{MAX_UNROLL_STEPS}; callers fall back to the XLA path "
+            "(temporal._window_stat_strided)")
     interpret = jax.default_backend() != "tpu"
     return _build(S, K, W, stride, stat, interpret)(resid)

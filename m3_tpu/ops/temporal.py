@@ -596,10 +596,14 @@ def _window_stat_strided(resid, W: int, stat: str, stride: int):
     if _use_pallas() and resid.shape[-1] >= W:
         # K < W falls through: the pallas grid would have zero (or
         # negative) output columns where the XLA path returns the valid
-        # empty plane.
+        # empty plane. Oversized unrolls fall through too — the kernel
+        # statically unrolls T_out window reductions (Mosaic alignment),
+        # so an unstrided wide grid would trace/compile pathologically.
         from . import pallas_window
 
-        if stat in pallas_window.STATS:
+        t_out = (resid.shape[-1] - W) // stride + 1
+        if (stat in pallas_window.STATS
+                and t_out <= pallas_window.MAX_UNROLL_STEPS):
             return pallas_window.window_stat(resid, W, stride, stat)
     out, cnt = _window_stat(resid, W, stat)
     return out[..., ::stride], cnt[..., ::stride]

@@ -339,3 +339,19 @@ class TestPallasWindow:
         resid = np.full((4, 3), 1.0, np.float32)
         out, cnt = temporal._window_stat_strided(resid, 6, "sum", 1)
         assert out.shape == (4, 0) and cnt.shape == (4, 0)
+
+    def test_oversized_unroll_falls_back(self, monkeypatch):
+        # The kernel statically unrolls T_out window reductions (Mosaic
+        # alignment constraint); past MAX_UNROLL_STEPS the dispatch must
+        # take the constant-program-size XLA path instead of tracing a
+        # pathological kernel — and window_stat itself must refuse.
+        from m3_tpu.ops import pallas_window as pw
+
+        monkeypatch.setattr(temporal, "_use_pallas", lambda: True)
+        K = pw.MAX_UNROLL_STEPS + 40  # stride 1, W 6 -> T_out > cap
+        resid = np.ones((4, K), np.float32)
+        out, cnt = temporal._window_stat_strided(resid, 6, "sum", 1)
+        assert out.shape == (4, K - 5)  # XLA path served it
+        assert float(np.asarray(out)[0, 0]) == 6.0
+        with pytest.raises(ValueError, match="MAX_UNROLL_STEPS"):
+            pw.window_stat(resid, 6, 1, "sum")
