@@ -18,7 +18,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 from ..metrics.metadata import ForwardMetadata, StagedMetadata
 from ..metrics.metric import MetricType, MetricUnion
 from ..metrics.policy import StoragePolicy
-from ..utils.hashing import murmur3_32
+from ..utils.hashing import murmur3_32_cached
 from .election import ElectionManager
 from .entry import MetricMap
 from .flush import FlushManager, FlushTimesManager
@@ -155,7 +155,7 @@ class Aggregator:
 
     def shard_for(self, metric_id: bytes) -> int:
         """aggregator/sharding/hash.go:89 — murmur3 % num_shards."""
-        return murmur3_32(metric_id) % self.num_shards
+        return murmur3_32_cached(metric_id) % self.num_shards
 
     def _shard(self, metric_id: bytes) -> Optional[AggregatorShard]:
         sid = self.shard_for(metric_id)

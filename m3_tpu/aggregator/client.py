@@ -15,7 +15,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 from ..cluster.placement import Placement, ShardState
 from ..metrics.metadata import StagedMetadata
 from ..metrics.metric import MetricUnion
-from ..utils.hashing import murmur3_32
+from ..utils.hashing import murmur3_32_cached
 
 
 class AggregatorClient:
@@ -30,7 +30,7 @@ class AggregatorClient:
         self.dropped = 0
 
     def shard_for(self, metric_id: bytes) -> int:
-        return murmur3_32(metric_id) % self.num_shards
+        return murmur3_32_cached(metric_id) % self.num_shards
 
     def _instances_for(self, shard: int) -> List[str]:
         p = self._placement()

@@ -115,12 +115,12 @@ class ProducerHandler(Handler):
 
     def __init__(self, producer, num_shards: int):
         from ..rpc import wire
-        from ..utils.hashing import murmur3_32
+        from ..utils.hashing import murmur3_32_cached
 
         self._producer = producer
         self._num_shards = num_shards
         self._encode = wire.encode
-        self._hash = murmur3_32
+        self._hash = murmur3_32_cached
 
     def handle(self, metric: AggregatedMetric):
         payload = self._encode({

@@ -16,6 +16,18 @@ Wire frames:
    reference: src/aggregator/server/rawtcp handling of forwarded metric
    unions + forwarded_writer.go)
 A batch frame {"t": "batch", "entries": [...]} carries many at once.
+
+A COLUMNAR timed batch amortizes the per-entry codec and parse cost —
+the dominant share of the per-connection ingest ceiling once dispatch
+itself is memoized (policy parse + shard hash). One frame carries one
+(mtype, policy, agg_id) group:
+  {"t": "tbatch", "mtype": i64, "policy": str, "agg_id": i64,
+   "ids": [bytes, ...], "times": ndarray i64, "values": ndarray f64}
+The codec writes the two numeric columns as raw ndarray buffers (no
+per-element marshalling) and the six key strings once per frame instead
+of once per datapoint; the server parses policy/type once and loops
+add_timed. This is the wire shape of the reference's protobuf
+WriteTimedBatch (src/aggregator/client/client.go WriteTimed batching).
 """
 
 from __future__ import annotations
@@ -153,10 +165,12 @@ class RawTCPServer:
                             with outer._stats_lock:
                                 outer.errors += 1
                             break
-                        for e in entries:
-                            outer._handle(e)
+                        # frames counts successfully ingested RECORDS (a
+                        # columnar tbatch carries one per id); a failed
+                        # dispatch contributes errors, not phantom frames.
+                        n_rec = sum(outer._handle(e) for e in entries)
                         with outer._stats_lock:
-                            outer.frames += len(entries)
+                            outer.frames += n_rec
                 except (ConnectionError, OSError):
                     pass
 
@@ -166,12 +180,25 @@ class RawTCPServer:
 
         self._server = _Server((host, port), _Handler)
 
-    def _handle(self, e: dict):
+    def _handle(self, e: dict) -> int:
+        """Dispatch one entry; returns the record count it ingested
+        (len(ids) for a columnar tbatch, else 1), 0 on failure. Both
+        counters are in RECORDS: a failed tbatch charges its id count to
+        `errors` (tbatch dispatch validates before the first add, so a
+        failure means the whole frame was rejected — nothing partial)."""
+        def _records() -> int:
+            if e.get("t") != "tbatch":
+                return 1
+            ids = e.get("ids")
+            return len(ids) if isinstance(ids, (list, tuple)) else 1
+
         try:
             dispatch_entry(self.aggregator, e)
         except Exception:  # noqa: BLE001 - bad frame must not kill the conn
             with self._stats_lock:
-                self.errors += 1
+                self.errors += _records()
+            return 0
+        return _records()
 
     @property
     def endpoint(self) -> str:
@@ -197,11 +224,41 @@ def dispatch_entry(agg: Aggregator, e: dict):
         agg.add_timed(
             MetricType(e["mtype"]), e["id"], e["time"], e["value"],
             StoragePolicy.parse(e["policy"]), e.get("agg_id", 0))
+    elif e["t"] == "tbatch":
+        dispatch_timed_batch(agg, e)
     elif e["t"] == "forwarded":
         mt, mid, t_nanos, value, meta = forwarded_from_wire(e)
         agg.add_forwarded(mt, mid, t_nanos, value, meta)
     else:
         raise ValueError(f"unknown entry type {e.get('t')!r}")
+
+
+def dispatch_timed_batch(agg: Aggregator, e: dict):
+    """Columnar timed batch: type/policy parsed once, numeric columns
+    converted in one C pass (tolist), then the tight add_timed loop. A
+    length mismatch between the columns is a malformed frame (ValueError
+    -> the caller's per-entry error accounting)."""
+    ids = e["ids"]
+    times = e["times"]
+    values = e["values"]
+    if not (len(ids) == len(times) == len(values)):
+        raise ValueError(
+            f"tbatch column length mismatch: {len(ids)} ids, "
+            f"{len(times)} times, {len(values)} values")
+    # Validate EVERYTHING that could raise before the first add: the
+    # frame must ingest all-or-nothing, or a mid-loop failure would leave
+    # a prefix aggregated while the stats report the whole frame failed
+    # (and a sender retry would double-count that prefix).
+    if not all(isinstance(m, (bytes, bytearray)) for m in ids):
+        raise ValueError("tbatch ids must all be bytes")
+    mt = MetricType(e["mtype"])
+    pol = StoragePolicy.parse(e["policy"])
+    agg_id = e.get("agg_id", 0)
+    times = times.tolist() if hasattr(times, "tolist") else times
+    values = values.tolist() if hasattr(values, "tolist") else values
+    add = agg.add_timed
+    for mid, t, v in zip(ids, times, values):
+        add(mt, mid, t, v, pol, agg_id)
 
 
 class HTTPAdminServer:
@@ -393,6 +450,23 @@ class TCPTransport(_BatchingTransport):
     def _encode(self, mu: MetricUnion, metadatas: Sequence[StagedMetadata]) -> dict:
         return union_to_wire(mu, metadatas)
 
+    def send_timed_batch(self, metric_type: MetricType, policy,
+                         ids: Sequence[bytes], times, values,
+                         agg_id: int = 0) -> bool:
+        """Ship one (type, policy) group of timed datapoints as a single
+        columnar tbatch frame — the codec writes the numeric columns as
+        raw buffers and the keys once, so the per-datapoint wire cost is
+        ~the raw bytes. This is the client half of the reference's timed
+        batching (client.go WriteTimed + queue buffering)."""
+        import numpy as _np
+
+        return self._send_frame({
+            "t": "tbatch", "mtype": int(metric_type), "policy": str(policy),
+            "agg_id": agg_id, "ids": list(ids),
+            "times": _np.asarray(times, _np.int64),
+            "values": _np.asarray(values, _np.float64),
+        })
+
     def send_forwarded(self, metric_type: MetricType, metric_id: bytes,
                        t_nanos: int, value: float,
                        meta: ForwardMetadata) -> bool:
@@ -408,8 +482,12 @@ class TCPTransport(_BatchingTransport):
         return self._send_batch(batch)
 
     def _send_batch(self, batch: List[dict]) -> bool:
-        frame = {"t": "batch", "entries": batch}
-        for _ in range(2):  # one reconnect attempt
+        return self._send_frame({"t": "batch", "entries": batch})
+
+    def _send_frame(self, frame: dict) -> bool:
+        """Write one frame with one reconnect attempt — the shared send
+        loop behind batch and tbatch shipping."""
+        for _ in range(2):
             try:
                 sock = self._ensure_conn()
                 wire.write_frame(sock, frame)

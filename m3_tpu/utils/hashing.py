@@ -7,6 +7,7 @@ over many IDs with numpy for bulk shard routing of write batches."""
 
 from __future__ import annotations
 
+import functools
 from typing import Sequence
 
 import numpy as np
@@ -52,6 +53,23 @@ def murmur3_32(data: bytes, seed: int = 0) -> int:
     h = (h * 0xC2B2AE35) & _M32
     h ^= h >> 16
     return h
+
+
+# Bounded memo for hot-ID shard routing: the aggregator's timed wire
+# hashes the same metric IDs once per datapoint (every window), and the
+# pure-Python block mixer was ~13% of per-entry dispatch. Bounded in
+# BOTH dimensions — entry count (lru) and key size (oversize IDs skip
+# the cache entirely), because the wire calls this on client-supplied
+# ids before any validation and 64k pinned multi-MB keys would be an
+# unbounded-memory hazard, not a cache. 64k x <=256B is <= ~16MB.
+_MURMUR_CACHE_MAX_KEY = 256
+_murmur3_32_lru = functools.lru_cache(maxsize=65536)(murmur3_32)
+
+
+def murmur3_32_cached(data: bytes, seed: int = 0) -> int:
+    if len(data) > _MURMUR_CACHE_MAX_KEY:
+        return murmur3_32(data, seed)
+    return _murmur3_32_lru(data, seed)
 
 
 def hash_batch(ids: Sequence[bytes], seed: int = 0) -> np.ndarray:

@@ -80,19 +80,39 @@ flush_log: {flush_log}
         while time.time() < t_end:
             now = time.time_ns()
             win = now // RESOLUTION_NS * RESOLUTION_NS
+            # Alternate the two batch wire shapes so the soak exercises
+            # BOTH sustained-ingest paths: per-entry "batch" frames and
+            # the columnar "tbatch" (one frame per policy group, numeric
+            # columns as raw buffers).
+            ids, values = [], []
             entries = []
+            use_tbatch = (i // 50) % 2 == 0
             for j in range(50):
                 mid = b"soak.counter.%d" % (j % 20)
                 v = float(i % 7 + 1)
-                entries.append({"t": "timed",
-                                "mtype": int(MetricType.COUNTER),
-                                "id": mid, "time": now, "value": v,
-                                "policy": POLICY})
+                if use_tbatch:
+                    ids.append(mid)
+                    values.append(v)
+                else:
+                    entries.append({"t": "timed",
+                                    "mtype": int(MetricType.COUNTER),
+                                    "id": mid, "time": now, "value": v,
+                                    "policy": POLICY})
                 if mid == b"soak.counter.0":
                     sent[win] = sent.get(win, 0.0) + v
                 i += 1
-            wire.write_frame(sock, {"t": "batch", "entries": entries})
-            writes += len(entries)
+            if use_tbatch:
+                import numpy as np
+
+                wire.write_frame(sock, {
+                    "t": "tbatch", "mtype": int(MetricType.COUNTER),
+                    "policy": POLICY, "agg_id": 0, "ids": ids,
+                    "times": np.full(len(ids), now, np.int64),
+                    "values": np.asarray(values, np.float64)})
+                writes += len(ids)
+            else:
+                wire.write_frame(sock, {"t": "batch", "entries": entries})
+                writes += len(entries)
             if not warmed and time.time() > t_end - SECONDS + WARMUP_S:
                 rss_start = child_rss_mb(proc.pid)
                 warmed = True

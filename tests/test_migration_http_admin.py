@@ -273,3 +273,115 @@ def test_admin_sidecar_via_service_assembly():
             assert json.loads(r.read()) == {"state": "OK"}
     finally:
         handle.close()
+
+
+def test_columnar_timed_batch_roundtrip():
+    """A tbatch frame (columnar timed batch) lands every datapoint in the
+    right windows — conservation against per-entry timed frames carrying
+    the same data — and the server counts one RECORD per id."""
+    import numpy as np
+
+    clock = SettableClock(1_700_000_000 * S)
+    cap = CaptureHandler()
+    agg = Aggregator(num_shards=8, clock=clock, flush_handler=cap)
+    srv = RawTCPServer(agg).start()
+    try:
+        t0 = 1_700_000_000 * S
+        n = 300
+        ids = [b"tb.%d" % (i % 50) for i in range(n)]
+        times = np.array([t0 + (i % 3) * 10 * S for i in range(n)], np.int64)
+        values = np.arange(n, dtype=np.float64)
+
+        host, _, port = srv.endpoint.rpartition(":")
+        sock = socket.create_connection((host, int(port)), timeout=5)
+        wire.write_frame(sock, {
+            "t": "tbatch", "mtype": int(MetricType.COUNTER),
+            "policy": "10s:2d", "agg_id": 0,
+            "ids": ids, "times": times, "values": values})
+        assert _await(lambda: srv.frames >= n)  # records, not frames
+        assert srv.errors == 0
+        clock.advance(40 * S)
+        agg.flush()
+        # Conservation: per-(id, window) sums match a host reference.
+        want = {}
+        for mid, t, v in zip(ids, times.tolist(), values.tolist()):
+            want[(mid, t // (10 * S))] = want.get((mid, t // (10 * S)), 0.0) + v
+        got = {}
+        for m in cap.metrics:
+            key = (m.id, m.time_nanos // (10 * S) - 1)  # window end stamp
+            got[key] = got.get(key, 0.0) + m.value
+        assert sum(got.values()) == sum(want.values()) == values.sum()
+        assert len(got) == len(want)
+        sock.close()
+    finally:
+        srv.close()
+
+
+def test_columnar_timed_batch_via_transport():
+    """TCPTransport.send_timed_batch ships the frame the server accepts."""
+    import numpy as np
+
+    clock = SettableClock(1_700_000_000 * S)
+    cap = CaptureHandler()
+    agg = Aggregator(num_shards=8, clock=clock, flush_handler=cap)
+    srv = RawTCPServer(agg).start()
+    tr = TCPTransport(srv.endpoint)
+    try:
+        t0 = 1_700_000_000 * S
+        assert tr.send_timed_batch(
+            MetricType.GAUGE, TEN_S, [b"tg.1", b"tg.2"],
+            [t0, t0], [4.5, 6.5])
+        assert _await(lambda: srv.frames >= 2)
+        clock.advance(10 * S)
+        agg.flush()
+        assert cap.by_id(b"tg.1")[0].value == 4.5
+        assert cap.by_id(b"tg.2")[0].value == 6.5
+    finally:
+        tr.close()
+        srv.close()
+
+
+def test_columnar_timed_batch_length_mismatch_counts_error():
+    """Malformed tbatch (ragged columns) is an application error: counted,
+    connection stays up, later frames still ingest."""
+    import numpy as np
+
+    clock = SettableClock(1_700_000_000 * S)
+    cap = CaptureHandler()
+    agg = Aggregator(num_shards=8, clock=clock, flush_handler=cap)
+    srv = RawTCPServer(agg).start()
+    try:
+        t0 = 1_700_000_000 * S
+        host, _, port = srv.endpoint.rpartition(":")
+        sock = socket.create_connection((host, int(port)), timeout=5)
+        wire.write_frame(sock, {
+            "t": "tbatch", "mtype": int(MetricType.COUNTER),
+            "policy": "10s:2d", "agg_id": 0,
+            "ids": [b"ragged.1", b"ragged.2"],
+            "times": np.array([t0], np.int64),          # ragged!
+            "values": np.array([1.0, 2.0], np.float64)})
+        # non-bytes ids must reject the WHOLE frame before any add
+        # (all-or-nothing: no partial prefix may aggregate)
+        wire.write_frame(sock, {
+            "t": "tbatch", "mtype": int(MetricType.COUNTER),
+            "policy": "10s:2d", "agg_id": 0,
+            "ids": [b"typed.ok", "typed.bad-str"],
+            "times": np.array([t0, t0], np.int64),
+            "values": np.array([1.0, 2.0], np.float64)})
+        wire.write_frame(sock, {
+            "t": "timed", "mtype": int(MetricType.COUNTER),
+            "id": b"after.ragged", "time": t0, "value": 7.0,
+            "policy": "10s:2d"})
+        # errors count RECORDS, same unit as frames: 2 per failed tbatch
+        assert _await(lambda: srv.errors >= 4)
+        assert _await(lambda: srv.frames >= 1)
+        clock.advance(10 * S)
+        agg.flush()
+        assert cap.by_id(b"after.ragged")[0].value == 7.0
+        # nothing from either rejected tbatch aggregated — incl. the
+        # well-typed first row of the mixed-type frame
+        assert not cap.by_id(b"typed.ok")
+        assert not cap.by_id(b"ragged.1")
+        sock.close()
+    finally:
+        srv.close()

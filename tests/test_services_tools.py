@@ -92,9 +92,10 @@ class TestAggregatorNetworkPath:
             for i in range(8):
                 assert transport(MetricUnion.counter(b"net_metric", 1), md)
             transport.flush()
-            # Await all 8 frames (server bumps .frames only after handling a
-            # whole batch) — awaiting just num_entries()==1 raced the flush
-            # against writes 2..8 still being ingested.
+            # Await all 8 records (server counts .frames in successfully
+            # ingested RECORDS, bumped after handling a whole batch) —
+            # awaiting just num_entries()==1 raced the flush against
+            # writes 2..8 still being ingested.
             assert _await(lambda: srv.frames >= 8)
             assert agg.num_entries() == 1
             clock.advance(10 * S)
