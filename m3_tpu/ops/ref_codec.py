@@ -156,13 +156,16 @@ def detect_int_mode(values: np.ndarray) -> tuple[bool, int]:
         # mode round-trips the raw sign bit, so force it to keep the exact
         # float64 roundtrip invariant.
         return False, 0
-    for k in range(MAX_DECIMAL_EXP + 1):
-        scale = np.float64(10.0**k)
-        m = np.rint(v * scale)
-        if np.abs(m).max(initial=0.0) >= 2.0**53:
-            continue
-        if np.array_equal(m / scale, v):
-            return True, k
+    # Overflow in v*scale is an expected classification signal for huge
+    # magnitudes (inf -> >= 2^53 -> not int-representable), not an error.
+    with np.errstate(over="ignore"):
+        for k in range(MAX_DECIMAL_EXP + 1):
+            scale = np.float64(10.0**k)
+            m = np.rint(v * scale)
+            if np.abs(m).max(initial=0.0) >= 2.0**53:
+                continue
+            if np.array_equal(m / scale, v):
+                return True, k
     return False, 0
 
 

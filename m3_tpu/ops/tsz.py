@@ -675,7 +675,9 @@ def detect_int_mode_batch(values: np.ndarray, npoints: np.ndarray):
         if rows.size == 0:
             break
         vr = v[rows]
-        with np.errstate(invalid="ignore"):
+        # over: huge magnitudes overflow vr*scale to inf, which correctly
+        # fails the < 2^53 bound — an expected classification signal.
+        with np.errstate(invalid="ignore", over="ignore"):
             if k == 0:
                 m = np.rint(vr)
                 ok = (np.abs(m) < 2.0**53) & (m == vr)
