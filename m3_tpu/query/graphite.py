@@ -108,6 +108,12 @@ def parse_target(s: str) -> _Expr:
             return Literal(text[1:-1])
         if kind == "PATH":
             nxt()
+            # bare boolean literals (compiler.go: true/false args, e.g.
+            # summarize(..., alignToFrom))
+            if text in ("true", "True"):
+                return Literal(True)
+            if text in ("false", "False"):
+                return Literal(False)
             return PathExpr(text)
         raise GraphiteParseError(f"unexpected {text!r}")
 
@@ -338,21 +344,11 @@ def _non_negative_derivative(eng, args, params):
 
 @_register("movingAverage")
 def _moving_average(eng, args, params):
-    w = args[1].value
-    if isinstance(w, str):
-        from .promql import parse_duration_ns
-
-        W = max(1, parse_duration_ns(w) // params.step_ns)
-    else:
-        W = max(1, int(w))
-    # Shift the fetch window back W-1 steps so the first output point has a
-    # full window of history (graphite-web movingAverage semantics), then
-    # reduce every window via the batched temporal kernel (device path).
-    ext = QueryParams(params.start_ns - (W - 1) * params.step_ns,
-                      params.end_ns, params.step_ns)
-    block = eng._eval(args[0], ext)
-    out = temporal.over_time(block.values, W, "avg")
-    return Block(params.meta(), block.series_tags, out)
+    # Shares _moving's lookback-exclusive window (the reference's
+    # movingAverage walks the W points BEFORE each output step,
+    # builtin_functions.go:620-666), reduced via the batched temporal
+    # kernel.
+    return _moving(eng, args, params, "avg")
 
 
 @_register("keepLastValue")
@@ -455,21 +451,58 @@ def _group_by_node(eng, args, params):
 
 @_register("summarize")
 def _summarize(eng, args, params):
+    """Reference semantics (native/summarize.go): by default buckets are
+    aligned to EPOCH multiples of the interval — the output grid starts
+    at floor(start, interval) and covers through floor(end, interval) +
+    interval — and each point lands in the bucket floor(ts, interval).
+    With alignToFrom=true buckets count from the series start instead.
+    Empty buckets emit NaN."""
     from .promql import parse_duration_ns
 
     block = eng._eval(args[0], params)
     bucket_ns = parse_duration_ns(args[1].value)
-    agg = args[2].value if len(args) > 2 else "sum"
-    factor = max(1, bucket_ns // params.step_ns)
-    steps = block.meta.steps // factor
-    if steps == 0:
-        return block
-    v = block.values[:, : steps * factor].reshape(block.n_series, steps, factor)
+    agg = (args[2].value or "sum") if len(args) > 2 else "sum"
+    align_to_from = _bool_arg(args[3].value) if len(args) > 3 else False
+    if bucket_ns <= 0:
+        raise GraphiteParseError(f"invalid summarize interval {args[1].value!r}")
     reducers = {"sum": np.nansum, "avg": np.nanmean, "max": np.nanmax,
-                "min": np.nanmin, "last": lambda a, axis: a[..., -1]}
+                "min": np.nanmin, "last": None}  # last: per-row gather below
+    if agg not in reducers:
+        raise GraphiteParseError(f"invalid summarize func {agg!r}")
+    reduce = reducers[agg]
+    times = block.meta.times()
+    start = block.meta.start_ns
+    if align_to_from:
+        new_start = start
+        bucket_of = (times - start) // bucket_ns
+    else:
+        new_start = start - start % bucket_ns
+        bucket_of = (times - new_start) // bucket_ns
+    last_ts = int(times[-1]) if times.size else start
+    steps = int((last_ts - new_start) // bucket_ns) + 1
+    out = np.full((block.n_series, steps), np.nan)
+    # The time grid is regular, so each bucket's columns are one
+    # CONTIGUOUS slice: one searchsorted gives every boundary, and each
+    # bucket reduces as a whole [n_series, width] tile (no per-series
+    # Python loop — the batched shape every other transform here keeps).
+    bounds = np.searchsorted(bucket_of, np.arange(steps + 1))
     with np.errstate(invalid="ignore"):
-        out = reducers[agg](v, axis=2)
-    meta = BlockMeta(block.meta.start_ns, bucket_ns, steps)
+        for b in range(steps):
+            lo, hi = bounds[b], bounds[b + 1]
+            if lo == hi:
+                continue
+            seg = block.values[:, lo:hi]
+            finite = np.isfinite(seg)
+            have = finite.any(axis=1)
+            if agg == "last":
+                idx = np.where(finite, np.arange(hi - lo), -1).max(axis=1)
+                vals = seg[np.arange(seg.shape[0]), np.maximum(idx, 0)]
+                out[:, b] = np.where(have, vals, np.nan)
+            else:
+                # reduce only the rows with data: the nan-reducers warn
+                # on all-NaN rows, and `have` masks them anyway
+                out[have, b] = reduce(seg[have], axis=1)
+    meta = BlockMeta(int(new_start), bucket_ns, steps)
     return Block(meta, block.series_tags, out)
 
 
@@ -623,7 +656,9 @@ def _time_slice(eng, args, params):
     t1 = (_parse_graphite_time(args[2].value, params.end_ns)
           if len(args) > 2 else params.end_ns)
     times = block.meta.times()
-    keep = ((times >= t0) & (times < t1))[None, :]
+    # end-INCLUSIVE per graphite-web timeSlice (points outside
+    # [start, end] become None; the boundary point survives)
+    keep = ((times >= t0) & (times <= t1))[None, :]
     return block.with_values(np.where(keep, block.values, np.nan))
 
 
@@ -670,12 +705,46 @@ def _remove_below_value(eng, args, params):
     return block.with_values(v)
 
 
-def _row_percentile(v: np.ndarray, n: float) -> np.ndarray:
+def _get_percentile(finite: np.ndarray, p: float,
+                    interpolate: bool = False) -> float:
+    """The reference's rank-based percentile, NOT numpy's linear default
+    (common/percentiles.go:75 GetPercentile): rank = ceil(p/100 * n),
+    value = sorted[rank-1]; with interpolate, blend with sorted[rank-2]
+    by the fractional rank. NB: the reference's formula multiplies by
+    len(series) — not graphite-web's (len+1) — and interpolates BACKWARD
+    (percentiles.go:82-97); M3 is the conformance target, verbatim."""
+    s = np.sort(finite)
+    n = s.size
+    if n == 0:
+        return np.nan
+    frac = (p / 100.0) * n
+    rank = int(np.ceil(frac))
+    if rank <= 1:
+        return float(s[0])
+    rank = min(rank, n)
+    out = float(s[rank - 1])
+    if interpolate:
+        prev = float(s[rank - 2])
+        out = prev + (frac - (rank - 1)) * (out - prev)
+    return out
+
+
+def _bool_arg(v) -> bool:
+    """Boolean function argument: bare true/false parse as literals, but
+    real clients also send the QUOTED strings "true"/"false" — Python
+    truthiness would read "false" as True and silently flip the option."""
+    if isinstance(v, str):
+        return v.strip().lower() == "true"
+    return bool(v)
+
+
+def _row_percentile(v: np.ndarray, n: float,
+                    interpolate: bool = False) -> np.ndarray:
     out = np.full(v.shape[0], np.nan)
     for i in range(v.shape[0]):
         finite = v[i][np.isfinite(v[i])]
         if finite.size:
-            out[i] = np.percentile(finite, n)
+            out[i] = _get_percentile(finite, n, interpolate)
     return out
 
 
@@ -838,17 +907,25 @@ def _n_percentile(eng, args, params):
 def _percentile_of_series(eng, args, params):
     block = eng._eval(args[0], params)
     n = args[1].value
+    interpolate = _bool_arg(args[2].value) if len(args) > 2 else False
     out = np.full(block.meta.steps, np.nan)
     v = block.values
     for j in range(v.shape[1]):
         finite = v[:, j][np.isfinite(v[:, j])]
         if finite.size:
-            out[j] = np.percentile(finite, n)
+            out[j] = _get_percentile(finite, n, interpolate)
     tags = Tags.of({b"__alias__": b"percentileOfSeries"})
     return Block(block.meta, [tags], out[None, :])
 
 
 def _moving(eng, args, params, kind):
+    """moving* window semantics per the reference: output step i reduces
+    the W points STRICTLY BEFORE it (builtin_functions.go:620-666
+    movingAverage walks bootstrap[i+offset-W .. i+offset-1], i.e. the
+    lookback window EXCLUDES the current point; movingMedian likewise).
+    So the selector extends W steps back and the trailing-inclusive
+    window reduce drops its last column (the window ending AT the
+    current step)."""
     w = args[1].value
     if isinstance(w, str):
         from .promql import parse_duration_ns
@@ -856,14 +933,14 @@ def _moving(eng, args, params, kind):
         W = max(1, parse_duration_ns(w) // params.step_ns)
     else:
         W = max(1, int(w))
-    ext = QueryParams(params.start_ns - (W - 1) * params.step_ns,
+    ext = QueryParams(params.start_ns - W * params.step_ns,
                       params.end_ns, params.step_ns)
     block = eng._eval(args[0], ext)
     if kind == "median":
         out = temporal.quantile_over_time(block.values, W, 0.5)
     else:
         out = temporal.over_time(block.values, W, kind)
-    return Block(params.meta(), block.series_tags, out)
+    return Block(params.meta(), block.series_tags, out[:, :-1])
 
 
 @_register("movingMax")
@@ -888,7 +965,30 @@ def _moving_median(eng, args, params):
 
 @_register("stdev", "stddev")
 def _stdev(eng, args, params):
-    return _moving(eng, args, params, "stddev")
+    """Unlike the moving* family, the reference's stdev window INCLUDES
+    the current point (common/transform.go:222-248 folds ValueAt(index)
+    in before emitting index) and gates output on windowTolerance: emit
+    when validPoints/points >= tolerance — transform.go:250's exact
+    condition, which is a MINIMUM valid fraction (default 0.1), not
+    graphite-web's maximum-missing fraction."""
+    w = args[1].value
+    if isinstance(w, str):
+        from .promql import parse_duration_ns
+
+        W = max(1, parse_duration_ns(w) // params.step_ns)
+    else:
+        W = max(1, int(w))
+    tolerance = float(args[2].value) if len(args) > 2 else 0.1
+    ext = QueryParams(params.start_ns - (W - 1) * params.step_ns,
+                      params.end_ns, params.step_ns)
+    block = eng._eval(args[0], ext)
+    # both window passes dispatch before either result is fetched
+    fetch_out = temporal.over_time_async(block.values, W, "stddev")
+    fetch_cnt = temporal.over_time_async(block.values, W, "count")
+    out, cnt = fetch_out(), fetch_cnt()
+    with np.errstate(invalid="ignore"):
+        out = np.where(cnt / W >= tolerance, out, np.nan)
+    return Block(params.meta(), block.series_tags, out)
 
 
 @_register("diffSeries")
@@ -1053,8 +1153,8 @@ def _average_outside_percentile(eng, args, params):
     finite = means[np.isfinite(means)]
     if not finite.size:
         return block
-    hi = np.percentile(finite, n)
-    lo = np.percentile(finite, 100 - n)
+    hi = _get_percentile(finite, n)
+    lo = _get_percentile(finite, 100 - n)
     # graphite-web keeps anything NOT strictly inside (lo, hi), so the
     # boundary series (including n=100/n=0) survive.
     with np.errstate(invalid="ignore"):

@@ -130,7 +130,10 @@ class TestGraphiteEngine:
         np.testing.assert_allclose(blk.values[0][1:], 0.1)  # +1 per 10s
         blk = eng.render("movingAverage(counters.reqs, 3)", T0 + 30 * S,
                          T0 + 80 * S, 10 * S)
-        np.testing.assert_allclose(blk.values[0][0], (1 + 2 + 3) / 3)
+        # the reference's moving window EXCLUDES the current point
+        # (builtin_functions.go:620-666): at T0+30 (value 3) it averages
+        # the three points before it — values 0, 1, 2.
+        np.testing.assert_allclose(blk.values[0][0], (0 + 1 + 2) / 3)
 
 
 class TestCarbonServerEndToEnd:
@@ -452,3 +455,135 @@ class TestRound4Builtins:
         expected[over] = (plain - upper)[over]
         expected[under] = (plain - lower)[under]
         np.testing.assert_allclose(ab.values[0], expected)
+
+
+class TestBuiltinConformance:
+    """Exact-value sweep over the builtins no other test exercises
+    (reference semantics: src/query/graphite/native/builtin_functions.go).
+    Window: T0+30..T0+60 @10s over t.a=[13..16], t.b=[23..26], t.c=[8..11]."""
+
+    @pytest.fixture
+    def teng(self, genv):
+        c, db, now = genv
+        ingest_paths(c, now, [(b"t.a", 10.0), (b"t.b", 20.0), (b"t.c", 5.0)])
+        eng = GraphiteEngine(c.engine.storage)
+        render = lambda target: eng.render(  # noqa: E731
+            target, T0 + 30 * S, T0 + 60 * S, 10 * S)
+        return render
+
+    A = np.array([13.0, 14.0, 15.0, 16.0])
+    B = np.array([23.0, 24.0, 25.0, 26.0])
+    C = np.array([8.0, 9.0, 10.0, 11.0])
+
+    def _one(self, blk):
+        assert blk.n_series == 1
+        return blk.values[0]
+
+    def test_combiners(self, teng):
+        np.testing.assert_allclose(
+            self._one(teng("averageSeries(t.*)")), (self.A + self.B + self.C) / 3)
+        np.testing.assert_allclose(self._one(teng("maxSeries(t.*)")), self.B)
+        np.testing.assert_allclose(self._one(teng("minSeries(t.*)")), self.C)
+        np.testing.assert_allclose(
+            self._one(teng("multiplySeries(t.*)")), self.A * self.B * self.C)
+        np.testing.assert_allclose(
+            self._one(teng("stddevSeries(t.*)")),
+            np.std([self.A, self.B, self.C], axis=0))
+
+    def test_pointwise(self, teng):
+        np.testing.assert_allclose(
+            self._one(teng("absolute(scale(t.a, -1))")), self.A)
+        d = self._one(teng("derivative(t.a)"))
+        assert np.isnan(d[0])
+        np.testing.assert_allclose(d[1:], 1.0)
+        nn = self._one(teng("nonNegativeDerivative(scale(t.a, -1))"))
+        assert np.isnan(nn).all()  # strictly decreasing -> all masked
+        cb = self._one(teng('consolidateBy(t.a, "max")'))
+        np.testing.assert_allclose(cb, self.A)  # annotation only
+
+    def test_time_slice_and_keep_last(self, teng):
+        # graphite-web timeSlice is end-INCLUSIVE: the point at exactly
+        # endSliceAt (T0+50, value 15) survives.
+        t0s = (T0 + 30 * S) // S
+        sliced = self._one(teng(f"timeSlice(t.a, {t0s}, {t0s + 20})"))
+        np.testing.assert_allclose(sliced[:3], [13.0, 14.0, 15.0])
+        assert np.isnan(sliced[3:]).all()
+        kept = self._one(teng(f"keepLastValue(timeSlice(t.a, {t0s}, {t0s + 20}))"))
+        np.testing.assert_allclose(kept, [13.0, 14.0, 15.0, 15.0])
+
+    def test_filters_by_stat(self, teng):
+        assert teng("averageAbove(t.*, 12)").n_series == 2     # a, b
+        np.testing.assert_allclose(
+            self._one(teng("averageBelow(t.*, 12)")), self.C)
+        assert teng("minimumAbove(t.*, 10)").n_series == 2     # a, b
+        np.testing.assert_allclose(
+            self._one(teng("minimumBelow(t.*, 10)")), self.C)
+        assert teng("maximumBelow(t.*, 20)").n_series == 2     # a, c
+        np.testing.assert_allclose(
+            self._one(teng("currentAbove(t.*, 20)")), self.B)
+
+    def test_select_and_sort(self, teng):
+        np.testing.assert_allclose(
+            self._one(teng("highestCurrent(t.*, 1)")), self.B)
+        np.testing.assert_allclose(
+            self._one(teng("lowestAverage(t.*, 1)")), self.C)
+        np.testing.assert_allclose(
+            self._one(teng("highestMax(t.*, 1)")), self.B)
+        srt = teng("sortByMaxima(t.*)")
+        np.testing.assert_allclose(srt.values[0], self.B)
+        np.testing.assert_allclose(srt.values[-1], self.C)
+        assert teng("limit(t.*, 2)").n_series == 2
+
+    def test_name_filters(self, teng):
+        assert teng('exclude(t.*, "b")').n_series == 2
+        np.testing.assert_allclose(self._one(teng('grep(t.*, "b")')), self.B)
+
+    def test_percentile_filters(self, teng):
+        # rank-based percentile (common/percentiles.go GetPercentile):
+        # p50 of [13..16] -> rank ceil(0.5*4)=2 -> sorted[1] = 14.
+        above = self._one(teng("removeAbovePercentile(t.a, 50)"))
+        np.testing.assert_allclose(above[:2], [13.0, 14.0])
+        assert np.isnan(above[2:]).all()
+        # removeBelow keeps values >= the percentile: 14 survives.
+        below = self._one(teng("removeBelowPercentile(t.a, 50)"))
+        assert np.isnan(below[0])
+        np.testing.assert_allclose(below[1:], [14.0, 15.0, 16.0])
+        # means 14.5/24.5/9.5; rank-based p90=24.5, p10=9.5; the filter
+        # keeps anything NOT strictly inside (lo, hi) -> b and c survive
+        out = teng("averageOutsidePercentile(t.*, 90)")
+        assert out.n_series == 2
+        assert {v[0] for v in out.values} == {23.0, 8.0}
+
+    def test_moving_and_summarize(self, teng):
+        # moving* windows EXCLUDE the current point (the W points before
+        # it): at T0+30 movingMax over scale(t.a,-1) sees -11, -12.
+        np.testing.assert_allclose(
+            self._one(teng("movingMax(scale(t.a, -1), 2)")),
+            [-11.0, -12.0, -13.0, -14.0])
+        np.testing.assert_allclose(
+            self._one(teng("movingMin(t.a, 2)")), [11.0, 12.0, 13.0, 14.0])
+        # stdev's window INCLUDES the current point (common/transform.go)
+        # and is the POPULATION stddev: two consecutive ints -> 0.5.
+        np.testing.assert_allclose(
+            self._one(teng("stdev(t.a, 2)")), 0.5, rtol=1e-6)
+        # summarize default aligns buckets to EPOCH multiples of the
+        # interval (summarize.go): the grid starts at floor(T0+30, 20s) =
+        # T0+20, so buckets hold {13}, {14,15}, {16}.
+        summ = teng('summarize(t.a, "20s", "sum")')
+        np.testing.assert_allclose(self._one(summ), [13.0, 29.0, 16.0])
+        assert summ.meta.step_ns == 20 * S
+        assert summ.meta.start_ns == T0 + 20 * S
+        # alignToFrom=true counts buckets from the series start instead
+        summ2 = teng('summarize(t.a, "20s", "sum", true)')
+        np.testing.assert_allclose(self._one(summ2), [27.0, 31.0])
+        # QUOTED "false" must mean false (Python truthiness would flip it)
+        summ3 = teng('summarize(t.a, "20s", "sum", "false")')
+        np.testing.assert_allclose(self._one(summ3), [13.0, 29.0, 16.0])
+        # last: per-bucket final finite value
+        summ4 = teng('summarize(t.a, "20s", "last")')
+        np.testing.assert_allclose(self._one(summ4), [13.0, 15.0, 16.0])
+
+    def test_wildcards_grouping(self, teng):
+        blk = teng("averageSeriesWithWildcards(t.*, 1)")
+        np.testing.assert_allclose(
+            self._one(blk), (self.A + self.B + self.C) / 3)
