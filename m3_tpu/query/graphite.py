@@ -459,7 +459,8 @@ def _summarize(eng, args, params):
     Empty buckets emit NaN."""
     from .promql import parse_duration_ns
 
-    block = eng._eval(args[0], params)
+    # Argument validation FIRST: an invalid interval/func must reject
+    # before paying the (potentially wide) series fetch.
     bucket_ns = parse_duration_ns(args[1].value)
     agg = (args[2].value or "sum") if len(args) > 2 else "sum"
     align_to_from = _bool_arg(args[3].value) if len(args) > 3 else False
@@ -470,6 +471,7 @@ def _summarize(eng, args, params):
     if agg not in reducers:
         raise GraphiteParseError(f"invalid summarize func {agg!r}")
     reduce = reducers[agg]
+    block = eng._eval(args[0], params)
     times = block.meta.times()
     start = block.meta.start_ns
     if align_to_from:
@@ -480,11 +482,41 @@ def _summarize(eng, args, params):
         bucket_of = (times - new_start) // bucket_ns
     last_ts = int(times[-1]) if times.size else start
     steps = int((last_ts - new_start) // bucket_ns) + 1
+    # Dashboard-typical fast path: the interval divides the step grid
+    # and the epoch-aligned start lands ON the grid, so every bucket has
+    # the same width — one reshape + one masked reduce, no Python loop.
+    # (bucket_ns > 0 was enforced above, so divisibility implies
+    # factor >= 1.)
+    factor = bucket_ns // block.meta.step_ns
+    if (agg != "last" and bucket_ns % block.meta.step_ns == 0
+            and (start - new_start) % bucket_ns == 0
+            and times.size == steps * factor):
+        v = block.values.reshape(block.n_series, steps, factor)
+        # NaN is the ONLY missing marker — inf is a real sample and must
+        # propagate through every aggregate exactly as in the general
+        # path (graphite None vs a value).
+        present = ~np.isnan(v)
+        have = present.any(axis=2)
+        # Identity-filled reduces (never the warning-prone all-NaN
+        # nan-reducers): sum/avg from masked sums, min/max from
+        # +/-inf fills; `have` masks empty buckets to NaN either way.
+        if agg == "sum":
+            red = np.where(present, v, 0.0).sum(axis=2)
+        elif agg == "avg":
+            red = (np.where(present, v, 0.0).sum(axis=2)
+                   / np.maximum(present.sum(axis=2), 1))
+        elif agg == "max":
+            red = np.where(present, v, -np.inf).max(axis=2)
+        else:  # min
+            red = np.where(present, v, np.inf).min(axis=2)
+        out = np.where(have, red, np.nan)
+        return Block(BlockMeta(int(new_start), bucket_ns, steps),
+                     block.series_tags, out)
     out = np.full((block.n_series, steps), np.nan)
-    # The time grid is regular, so each bucket's columns are one
-    # CONTIGUOUS slice: one searchsorted gives every boundary, and each
-    # bucket reduces as a whole [n_series, width] tile (no per-series
-    # Python loop — the batched shape every other transform here keeps).
+    # General path: the time grid is regular, so each bucket's columns
+    # are one CONTIGUOUS slice: one searchsorted gives every boundary,
+    # and each bucket reduces as a whole [n_series, width] tile (no
+    # per-series Python loop).
     bounds = np.searchsorted(bucket_of, np.arange(steps + 1))
     with np.errstate(invalid="ignore"):
         for b in range(steps):
@@ -492,10 +524,10 @@ def _summarize(eng, args, params):
             if lo == hi:
                 continue
             seg = block.values[:, lo:hi]
-            finite = np.isfinite(seg)
-            have = finite.any(axis=1)
+            present = ~np.isnan(seg)  # inf is a real sample, NaN missing
+            have = present.any(axis=1)
             if agg == "last":
-                idx = np.where(finite, np.arange(hi - lo), -1).max(axis=1)
+                idx = np.where(present, np.arange(hi - lo), -1).max(axis=1)
                 vals = seg[np.arange(seg.shape[0]), np.maximum(idx, 0)]
                 out[:, b] = np.where(have, vals, np.nan)
             else:
@@ -732,9 +764,15 @@ def _get_percentile(finite: np.ndarray, p: float,
 def _bool_arg(v) -> bool:
     """Boolean function argument: bare true/false parse as literals, but
     real clients also send the QUOTED strings "true"/"false" — Python
-    truthiness would read "false" as True and silently flip the option."""
+    truthiness would read "false" as True and silently flip the option.
+    Anything else ("1", a typo) is a hard error, not a silent False."""
     if isinstance(v, str):
-        return v.strip().lower() == "true"
+        s = v.strip().lower()
+        if s == "true":
+            return True
+        if s == "false":
+            return False
+        raise GraphiteParseError(f"invalid boolean argument {v!r}")
     return bool(v)
 
 
@@ -918,6 +956,16 @@ def _percentile_of_series(eng, args, params):
     return Block(block.meta, [tags], out[None, :])
 
 
+def _window_steps(w, params) -> int:
+    """Window argument (duration string or point count) -> grid steps;
+    shared by the moving* family and stdev."""
+    if isinstance(w, str):
+        from .promql import parse_duration_ns
+
+        return max(1, parse_duration_ns(w) // params.step_ns)
+    return max(1, int(w))
+
+
 def _moving(eng, args, params, kind):
     """moving* window semantics per the reference: output step i reduces
     the W points STRICTLY BEFORE it (builtin_functions.go:620-666
@@ -926,13 +974,7 @@ def _moving(eng, args, params, kind):
     So the selector extends W steps back and the trailing-inclusive
     window reduce drops its last column (the window ending AT the
     current step)."""
-    w = args[1].value
-    if isinstance(w, str):
-        from .promql import parse_duration_ns
-
-        W = max(1, parse_duration_ns(w) // params.step_ns)
-    else:
-        W = max(1, int(w))
+    W = _window_steps(args[1].value, params)
     ext = QueryParams(params.start_ns - W * params.step_ns,
                       params.end_ns, params.step_ns)
     block = eng._eval(args[0], ext)
@@ -971,13 +1013,7 @@ def _stdev(eng, args, params):
     when validPoints/points >= tolerance — transform.go:250's exact
     condition, which is a MINIMUM valid fraction (default 0.1), not
     graphite-web's maximum-missing fraction."""
-    w = args[1].value
-    if isinstance(w, str):
-        from .promql import parse_duration_ns
-
-        W = max(1, parse_duration_ns(w) // params.step_ns)
-    else:
-        W = max(1, int(w))
+    W = _window_steps(args[1].value, params)
     tolerance = float(args[2].value) if len(args) > 2 else 0.1
     ext = QueryParams(params.start_ns - (W - 1) * params.step_ns,
                       params.end_ns, params.step_ns)

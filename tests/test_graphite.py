@@ -583,6 +583,18 @@ class TestBuiltinConformance:
         summ4 = teng('summarize(t.a, "20s", "last")')
         np.testing.assert_allclose(self._one(summ4), [13.0, 15.0, 16.0])
 
+    def test_summarize_aligned_fast_path(self, genv):
+        # An epoch-aligned query window with uniform buckets takes the
+        # reshape fast path; values must match the general path's
+        # semantics: T0+40..T0+70 @10s = [14,15,16,17] -> 20s sums.
+        c, db, now = genv
+        ingest_paths(c, now, [(b"t.a", 10.0)])
+        eng = GraphiteEngine(c.engine.storage)
+        blk = eng.render('summarize(t.a, "20s", "sum")',
+                         T0 + 40 * S, T0 + 70 * S, 10 * S)
+        np.testing.assert_allclose(blk.values[0], [29.0, 33.0])
+        assert blk.meta.start_ns == T0 + 40 * S
+
     def test_wildcards_grouping(self, teng):
         blk = teng("averageSeriesWithWildcards(t.*, 1)")
         np.testing.assert_allclose(

@@ -681,3 +681,56 @@ class TestUpstreamSemanticEdges:
         np.testing.assert_allclose(
             blk.values[0][np.isfinite(blk.values[0])], 400.0)
 
+
+
+class TestRemainingFunctionConformance:
+    """Exact-value coverage for the functions no other test touches
+    (upstream promql/functions.go semantics)."""
+
+    def test_hyperbolic_and_log2_sgn(self, engine):
+        base = run(engine, "http_requests_total")
+        for name, fn in [("cosh", np.cosh), ("acosh", np.arccosh),
+                         ("atanh", np.arctanh), ("log2", np.log2),
+                         ("sgn", np.sign)]:
+            with np.errstate(invalid="ignore", divide="ignore"):
+                want = fn(base.values)
+            blk = run(engine, f"{name}(http_requests_total)")
+            np.testing.assert_allclose(blk.values, want, rtol=1e-9,
+                                       equal_nan=True, err_msg=name)
+
+    def test_clamp_min_max(self, engine):
+        blk = run(engine, "clamp_min(memory_bytes, 150)")
+        assert sorted(v[0] for v in blk.values) == [150.0, 300.0]
+        blk = run(engine, "clamp_max(memory_bytes, 150)")
+        assert sorted(v[0] for v in blk.values) == [100.0, 150.0]
+
+    def test_sort_desc(self, engine):
+        # instant-query ordering by value, descending (functions.go sortDesc)
+        blk = run(engine, "sort_desc(memory_bytes)")
+        vals = [v[0] for v in blk.values]
+        assert vals == sorted(vals, reverse=True) == [300.0, 100.0]
+
+    def test_present_and_stdvar_over_time(self, engine):
+        blk = run(engine, "present_over_time(memory_bytes[2m])")
+        np.testing.assert_allclose(blk.values, 1.0)
+        # constant series: population variance over any window is 0
+        blk = run(engine, "stdvar_over_time(memory_bytes[2m])")
+        np.testing.assert_allclose(blk.values, 0.0, atol=1e-9)
+        # Linear counter 10/15s. The engine grids the selector at
+        # gcd(step=30s, range=1m)=30s with latest-sample-per-cell
+        # consolidation (DIVERGENCES.md "Range selectors grid raw
+        # samples"): the 1m window holds k=2 cells with gap g=20, and
+        # stdvar of k evenly spaced points is g^2*(k^2-1)/12 = 100.
+        blk = run(engine, "stdvar_over_time(http_requests_total[1m])")
+        k, g = 2, 20.0
+        want = g * g * (k * k - 1) / 12.0
+        filled = blk.values[0][np.isfinite(blk.values[0])]
+        np.testing.assert_allclose(filled[2:], want, rtol=1e-6)
+        # At a step that divides the cadence the window sees every raw
+        # sample (upstream-exact regime): 15s step, [1m] -> k=4, gap 10.
+        fine = engine.execute_range(
+            "stdvar_over_time(http_requests_total[1m])",
+            5 * MIN, 8 * MIN, 15 * S)
+        want4 = 10.0 * 10.0 * (4 * 4 - 1) / 12.0
+        vals = fine.values[0][np.isfinite(fine.values[0])]
+        np.testing.assert_allclose(vals[3:], want4, rtol=1e-6)
