@@ -234,3 +234,66 @@ class TestWireFuzz:
             v = [v]
         with pytest.raises(ValueError):
             wire.encode(v)
+
+
+class TestTbatchDispatchFuzz:
+    """Malformed columnar timed-batch frames through dispatch_entry: every
+    outcome is a typed error counted by the server's per-entry handler or
+    a clean (possibly partial-free) ingest — never a crash that kills the
+    connection thread, and NEVER a partial ingest on a frame that errors
+    (all-or-nothing contract, server.py dispatch_timed_batch)."""
+
+    def _agg(self):
+        from m3_tpu.aggregator import Aggregator, CaptureHandler
+
+        S = 1_000_000_000
+        return Aggregator(num_shards=4, clock=lambda: 1_700_000_000 * S,
+                          flush_handler=CaptureHandler())
+
+    def test_fuzzed_tbatch_frames(self):
+        from m3_tpu.aggregator.server import dispatch_entry
+
+        S = 1_000_000_000
+        t0 = 1_700_000_000 * S
+        rng = np.random.default_rng(29)
+        mutations = [
+            lambda f: f.pop("ids"),
+            lambda f: f.pop("times"),
+            lambda f: f.pop("values"),
+            lambda f: f.update(ids=f["ids"][:-1]),          # ragged
+            lambda f: f.update(times=f["times"][:-1]),      # ragged
+            lambda f: f.update(mtype=99),                   # bad type
+            lambda f: f.update(policy="nonsense"),          # bad policy
+            lambda f: f.update(policy=123),                 # wrong type
+            lambda f: f.update(ids=[*f["ids"][:-1], "str"]),  # non-bytes id
+            lambda f: f.update(times="not-an-array"),
+            lambda f: f.update(values=None),
+        ]
+        for i in range(len(mutations) * 3):
+            agg = self._agg()
+            n = int(rng.integers(1, 8))
+            frame = {"t": "tbatch", "mtype": 1, "policy": "10s:2d",
+                     "agg_id": 0,
+                     "ids": [b"fz.%d" % j for j in range(n)],
+                     "times": np.full(n, t0, np.int64),
+                     "values": np.arange(n, dtype=np.float64)}
+            mutations[i % len(mutations)](frame)
+            try:
+                dispatch_entry(agg, frame)
+            except Exception:  # noqa: BLE001 - typed by the server handler
+                # the all-or-nothing contract: an erroring frame must not
+                # have staged ANY entries
+                assert agg.num_entries() == 0, (
+                    f"partial ingest from mutation {i % len(mutations)}")
+
+    def test_valid_tbatch_through_dispatch(self):
+        from m3_tpu.aggregator.server import dispatch_entry
+
+        S = 1_000_000_000
+        t0 = 1_700_000_000 * S
+        agg = self._agg()
+        dispatch_entry(agg, {
+            "t": "tbatch", "mtype": 1, "policy": "10s:2d", "agg_id": 0,
+            "ids": [b"ok.1", b"ok.2"], "times": np.full(2, t0, np.int64),
+            "values": np.array([1.0, 2.0])})
+        assert agg.num_entries() == 2
