@@ -1,0 +1,162 @@
+"""Randomized inverted-index campaign — the fuzz tier for m3_tpu/index.
+
+Each round builds a random document set (wider alphabets, optional
+missing fields, duplicate tag shapes) and checks EVERY path that serves
+a boolean query against a brute-force evaluator over the raw tags:
+
+  1. MutableSegment search (the live write path);
+  2. ImmutableSegment.from_mutable (the sealed read path);
+  3. ImmutableSegment.merge of a random split of the docs (compaction);
+  4. persist write_segment -> read_segment roundtrip (the fileset path).
+
+Duplicate-id shapes are exercised for real: every mutable segment
+re-inserts a sample of its docs (insert's dedup early-return), and the
+merge split OVERLAPS so the same document reaches merge from both parts.
+
+Queries are random trees of term/regexp/conjunction/disjunction/negation
+up to depth 3 — the same grammar the reference property-tests in
+src/m3ninx/search/proptest, at campaign scale.
+
+Usage: python scripts/fuzz_index.py --rounds 300
+(pure numpy — no jax backend is touched)
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from m3_tpu.index import query as iq  # noqa: E402
+from m3_tpu.index.segment import (Document, ImmutableSegment,  # noqa: E402
+                                  MutableSegment, execute)
+from m3_tpu.index import persist as ipersist  # noqa: E402
+
+FIELDS = [b"a", b"b", b"c", b"host", b"__name__"]
+VALUES = [b"x", b"y", b"z", b"xx", b"web-1", b"web-2", b"", b"cpu.total"]
+PATTERNS = [b"x|y", b"[yz]", b".*", b"web-.*", b"x+", b"(?:xx|z)", b"cpu\\..*"]
+
+
+def rand_docs(rng, n):
+    docs = []
+    for i in range(n):
+        tags = {}
+        for f in FIELDS:
+            if rng.random() < 0.6:
+                tags[f] = VALUES[rng.integers(len(VALUES))]
+        docs.append((b"doc-%d" % i, tags))
+    return docs
+
+
+def rand_query(rng, depth=0):
+    kinds = (["term", "term", "regexp", "conj", "disj", "neg", "all"]
+             if depth < 3 else ["term", "regexp"])
+    kind = kinds[rng.integers(len(kinds))]
+    if kind == "all":
+        return iq.AllQuery()
+    if kind == "term":
+        return iq.new_term(FIELDS[rng.integers(len(FIELDS))],
+                           VALUES[rng.integers(len(VALUES))])
+    if kind == "regexp":
+        return iq.new_regexp(FIELDS[rng.integers(len(FIELDS))],
+                             PATTERNS[rng.integers(len(PATTERNS))])
+    if kind == "neg":
+        return iq.new_negation(rand_query(rng, depth + 1))
+    parts = [rand_query(rng, depth + 1)
+             for _ in range(int(rng.integers(1, 4)))]
+    return (iq.new_conjunction(*parts) if kind == "conj"
+            else iq.new_disjunction(*parts))
+
+
+def brute(q, tags) -> bool:
+    if isinstance(q, iq.AllQuery):
+        return True
+    if isinstance(q, iq.TermQuery):
+        return tags.get(q.field) == q.value
+    if isinstance(q, iq.RegexpQuery):
+        v = tags.get(q.field)
+        return v is not None and re.fullmatch(q.pattern, v) is not None
+    if isinstance(q, iq.ConjunctionQuery):
+        return all(brute(p, tags) for p in q.queries)
+    if isinstance(q, iq.DisjunctionQuery):
+        return any(brute(p, tags) for p in q.queries)
+    if isinstance(q, iq.NegationQuery):
+        return not brute(q.query, tags)
+    raise AssertionError(q)
+
+
+def run_round(rng, root, queries_per_round=12):
+    n = int(rng.integers(1, 400))
+    docs = rand_docs(rng, n)
+    mseg = MutableSegment()
+    for sid, tags in docs:
+        mseg.insert(Document(sid, tuple(sorted(tags.items()))))
+    # duplicate-id inserts must dedup (segment.py insert early-return)
+    for sid, tags in docs[: max(1, n // 10)]:
+        mseg.insert(Document(sid, tuple(sorted(tags.items()))))
+    assert len(mseg) == n, "duplicate insert changed the doc count"
+    iseg = ImmutableSegment.from_mutable(mseg)
+    # random OVERLAPPING split merge (compaction path with the same doc
+    # arriving from both parts)
+    cut = int(rng.integers(0, n + 1))
+    overlap = int(rng.integers(0, min(8, n) + 1))
+    parts = []
+    for chunk in (docs[: min(n, cut + overlap)], docs[cut:]):
+        ms = MutableSegment()
+        for sid, tags in chunk:
+            ms.insert(Document(sid, tuple(sorted(tags.items()))))
+        if len(ms):
+            parts.append(ImmutableSegment.from_mutable(ms))
+    merged = (ImmutableSegment.merge(parts) if parts
+              else ImmutableSegment.from_mutable(MutableSegment()))
+    # persist roundtrip
+    block = int(rng.integers(0, 1 << 40))
+    ipersist.write_segment(root, b"fuzz", block, iseg)
+    rseg = ipersist.read_segment(root, b"fuzz", block)
+
+    for _ in range(queries_per_round):
+        q = rand_query(rng)
+        want = {sid for sid, tags in docs if brute(q, tags)}
+        for name, seg in (("mutable", mseg), ("immutable", iseg),
+                          ("merged", merged), ("persisted", rseg)):
+            got = {seg.doc(p).id for p in execute(seg, q)}
+            assert got == want, (
+                f"{name} segment diverged from bruteforce on {q!r}: "
+                f"extra={sorted(got - want)[:3]} "
+                f"missing={sorted(want - got)[:3]}")
+    return n * queries_per_round * 4
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=100)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    rng = np.random.default_rng(args.seed)
+    t0 = time.time()
+    total = 0
+    root = tempfile.mkdtemp(prefix="fuzz_index_")
+    try:
+        for r in range(args.rounds):
+            total += run_round(rng, root)
+            if (r + 1) % 25 == 0:
+                print(f"  round {r + 1}/{args.rounds} "
+                      f"({total} doc-query checks, {time.time() - t0:.0f}s)",
+                      flush=True)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    print(f"INDEX FUZZ PASS: {args.rounds} rounds, {total} doc-query "
+          f"checks, seed {args.seed}, {time.time() - t0:.0f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
