@@ -345,7 +345,7 @@ class Session:
               ) -> Tuple[np.ndarray, np.ndarray]:
         """Fetch decoded + replica-merged datapoints for one series."""
         m = self._map()
-        hosts = m.route_shard(self._shards().lookup(id))
+        hosts = m.route_shard_readable(self._shards().lookup(id))
         required = min(required_reads(self.opts.read_consistency, m.replica_factor),
                        len(hosts)) or 1
         results, errs = [], []
@@ -381,9 +381,11 @@ class Session:
 
         def coverage_met(ok_ids):
             # Per-shard accumulation (fetch_tagged_results_accumulator.go):
-            # every owned shard needs >= required responders among its owners.
+            # every owned shard needs >= required responders among its
+            # READABLE owners — an initializing owner has no data and
+            # must neither count toward nor be awaited for coverage.
             for shard in range(m.num_shards):
-                owners = m.route_shard(shard)
+                owners = m.route_shard_readable(shard)
                 if not owners:
                     continue
                 got = sum(1 for h in owners if h.id in ok_ids)
@@ -482,7 +484,9 @@ class Session:
         replica of a shard -> {host_id: {series_id: {tags, blocks}}}."""
         m = self._map()
         out: Dict[str, Dict[bytes, dict]] = {}
-        for h in m.route_shard(shard):
+        # Peer streaming reads block data: only readable owners hold any
+        # (an initializing peer is itself still bootstrapping).
+        for h in m.route_shard_readable(shard):
             if h.id == exclude_host:
                 continue
             series: Dict[bytes, dict] = {}

@@ -64,16 +64,39 @@ class TopologyMap:
         self.hosts = {
             iid: Host(iid, inst.endpoint) for iid, inst in placement.instances.items()
         }
+        # WRITE targets include INITIALIZING owners (they must receive new
+        # points while bootstrapping); READABLE owners are only those whose
+        # shard holds data — AVAILABLE and LEAVING. An INITIALIZING owner
+        # has not bootstrapped yet, and a consistency-ONE read accepting
+        # its empty response would silently lose every point the real
+        # replicas hold (reference: src/dbnode/topology shard-state
+        # semantics — session reads check IsAvailable/Leaving).
         self._shard_hosts: Dict[int, List[Host]] = {}
+        self._shard_hosts_readable: Dict[int, List[Host]] = {}
         for iid, inst in placement.instances.items():
             for a in inst.shards.values():
-                if a.state in (ShardState.AVAILABLE, ShardState.INITIALIZING, ShardState.LEAVING):
-                    self._shard_hosts.setdefault(a.shard, []).append(self.hosts[iid])
-        for hosts in self._shard_hosts.values():
-            hosts.sort(key=lambda h: h.id)
+                if a.state in (ShardState.AVAILABLE, ShardState.INITIALIZING,
+                               ShardState.LEAVING):
+                    self._shard_hosts.setdefault(a.shard, []).append(
+                        self.hosts[iid])
+                if a.state in (ShardState.AVAILABLE, ShardState.LEAVING):
+                    self._shard_hosts_readable.setdefault(a.shard, []).append(
+                        self.hosts[iid])
+        for m in (self._shard_hosts, self._shard_hosts_readable):
+            for hosts in m.values():
+                hosts.sort(key=lambda h: h.id)
 
     def route_shard(self, shard: int) -> List[Host]:
+        """All owners that accept WRITES (incl. initializing)."""
         return self._shard_hosts.get(shard, [])
+
+    def route_shard_readable(self, shard: int) -> List[Host]:
+        """Owners that can serve READS (available/leaving). Falls back to
+        the full owner set when nothing is readable yet (a cluster mid
+        initial claim) — a degraded read beats no read, matching the
+        unstrict consistency spirit."""
+        return (self._shard_hosts_readable.get(shard)
+                or self._shard_hosts.get(shard, []))
 
     def majority_replicas(self) -> int:
         return majority(self.replica_factor)

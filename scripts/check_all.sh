@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# One-command validation of every robustness tier, in cost order:
+#   unit/property/integration suite -> multichip dryrun -> fuzz
+#   campaigns -> multi-process smoke (incl. leader failover) -> soaks.
+# Roughly 20 minutes on one core. Any failing tier stops the run.
+# Usage: bash scripts/check_all.sh [--quick]   (--quick trims campaign
+# rounds and soak seconds for a ~6-minute pass)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+QUICK=${1:-}
+ROUNDS=200; IROUNDS=500; DROUNDS=200; export SOAK_SECONDS=${SOAK_SECONDS:-30}
+if [ "$QUICK" = "--quick" ]; then
+  # campaigns trim, but the soak floor stays 30s: the aggregator soak
+  # needs enough wall time to close whole windows (it asserts so)
+  ROUNDS=40; IROUNDS=100; DROUNDS=40
+fi
+
+echo "== test suite =="
+python -m pytest tests/ -x -q
+
+echo "== multichip dryrun (virtual 8-device mesh) =="
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun OK')"
+
+echo "== fuzz campaigns =="
+JAX_PLATFORMS=cpu python scripts/fuzz_codec.py --rounds "$ROUNDS" --seed 7
+python scripts/fuzz_index.py --rounds "$IROUNDS" --seed 7
+python scripts/fuzz_durability.py --rounds "$DROUNDS" --seed 7
+
+echo "== multi-process smoke =="
+bash scripts/integration_smoke.sh
+
+echo "== soaks =="
+bash scripts/soak.sh
+SOAK_TARGET=aggregator bash scripts/soak.sh
+
+echo "ALL TIERS PASS"

@@ -8,9 +8,11 @@ slice) and replay. Invariants:
   * replay never raises — corruption is a clean stop, not a crash;
   * every replayed record is bit-identical to a written one (entries are
     globally unique, so any fabricated/corrupt record is caught);
-  * each file replays a PREFIX of what was written to it, and every
-    file OTHER than the corrupted one replays in full (adler32-chunked
-    format: damage is contained to its file's tail).
+  * every file OTHER than the corrupted one replays in full, and the
+    corrupted file yields at most an in-order SUBSEQUENCE of its
+    records (usually a truncated tail; a delete of exactly
+    chunk-aligned bytes legitimately realigns the stream and leaves a
+    mid-file gap) — damage never leaks across files.
 
 FILESET (m3_tpu/persist/fs.py): write a complete fileset, xor-flip one
 random byte in one random file. Invariant: the corruption is DETECTED —

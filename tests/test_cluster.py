@@ -158,6 +158,34 @@ def test_topology_map_and_consistency():
     assert required_acks(ConsistencyLevel.ALL, 3) == 3
 
 
+def test_topology_readable_excludes_initializing():
+    """Reads must not route to INITIALIZING owners: they have not
+    bootstrapped, and a consistency-ONE read accepting their empty
+    response silently loses the data the real replicas hold (the
+    remove_up_node flake this pins). Writes still include them."""
+    from m3_tpu.cluster.placement import ShardAssignment, ShardState
+
+    p = initial_placement(insts(3), num_shards=4, replica_factor=2)
+    # force shard 0's owner on the first instance into INITIALIZING
+    first = sorted(p.instances)[0]
+    inst = p.instances[first]
+    owned = sorted(inst.shards)
+    s0 = owned[0]
+    inst.shards[s0] = ShardAssignment(s0, ShardState.INITIALIZING)
+    tm = TopologyMap(p)
+    writers = {h.id for h in tm.route_shard(s0)}
+    readers = {h.id for h in tm.route_shard_readable(s0)}
+    assert first in writers  # writes reach the bootstrapping owner
+    assert first not in readers  # reads never see it
+    assert readers  # the available replica still serves
+    # all-initializing shard: degraded fallback serves the full set
+    for iid, i in p.instances.items():
+        for s, a in list(i.shards.items()):
+            i.shards[s] = ShardAssignment(s, ShardState.INITIALIZING)
+    tm2 = TopologyMap(p)
+    assert tm2.route_shard_readable(s0) == tm2.route_shard(s0)
+
+
 def test_dynamic_topology_reacts_to_placement_change():
     store = MemStore()
     svc = PlacementService(store)

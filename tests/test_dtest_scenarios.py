@@ -94,7 +94,13 @@ class TestRemoveUpNode:
             _seed_and_seal(h, session)
             h.remove_node("node2")
             session2 = Session(h.topology, SessionOptions(timeout_s=10))
-            _verify_all(session2, h)
+            # Repeatedly: the leaver's shards now have an INITIALIZING
+            # (empty, unbootstrapped) new owner; a read racing it must
+            # NEVER accept its empty response over the data-holding
+            # replicas (route_shard_readable excludes it — the flake
+            # this loop would reproduce under owner-inclusive routing).
+            for _ in range(10):
+                _verify_all(session2, h)
             session.close()
             session2.close()
         finally:
