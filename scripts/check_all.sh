@@ -9,11 +9,12 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 QUICK=${1:-}
-ROUNDS=200; IROUNDS=500; DROUNDS=200; export SOAK_SECONDS=${SOAK_SECONDS:-30}
+ROUNDS=200; IROUNDS=500; DROUNDS=200; CROUNDS=3
+export SOAK_SECONDS=${SOAK_SECONDS:-30}
 if [ "$QUICK" = "--quick" ]; then
   # campaigns trim, but the soak floor stays 30s: the aggregator soak
   # needs enough wall time to close whole windows (it asserts so)
-  ROUNDS=40; IROUNDS=100; DROUNDS=40
+  ROUNDS=40; IROUNDS=100; DROUNDS=40; CROUNDS=1
 fi
 
 echo "== test suite =="
@@ -27,6 +28,7 @@ echo "== fuzz campaigns =="
 JAX_PLATFORMS=cpu python scripts/fuzz_codec.py --rounds "$ROUNDS" --seed 7
 python scripts/fuzz_index.py --rounds "$IROUNDS" --seed 7
 python scripts/fuzz_durability.py --rounds "$DROUNDS" --seed 7
+python scripts/fuzz_cluster.py --rounds "$CROUNDS" --ops 10 --seed 7
 
 echo "== multi-process smoke =="
 bash scripts/integration_smoke.sh
