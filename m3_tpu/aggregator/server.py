@@ -36,6 +36,8 @@ import socketserver
 import threading
 from typing import List, Optional, Sequence
 
+import numpy as np
+
 from ..metrics.metadata import (ForwardMetadata, Metadata, PipelineMetadata,
                                 StagedMetadata)
 from ..metrics.matcher import pipeline_from_json, pipeline_to_json
@@ -249,13 +251,27 @@ def dispatch_timed_batch(agg: Aggregator, e: dict):
     # frame must ingest all-or-nothing, or a mid-loop failure would leave
     # a prefix aggregated while the stats report the whole frame failed
     # (and a sender retry would double-count that prefix).
-    if not all(isinstance(m, (bytes, bytearray)) for m in ids):
+    if not all(isinstance(m, (bytes, bytearray, memoryview)) for m in ids):
         raise ValueError("tbatch ids must all be bytes")
+    # Normalize ids to bytes AFTER the isinstance gate: add_timed ->
+    # shard_for memoizes on the id, and a bytearray/memoryview that
+    # passed validation would raise (unhashable) on the Nth add.
+    ids = [m if type(m) is bytes else bytes(m) for m in ids]
     mt = MetricType(e["mtype"])
     pol = StoragePolicy.parse(e["policy"])
     agg_id = e.get("agg_id", 0)
-    times = times.tolist() if hasattr(times, "tolist") else times
-    values = values.tolist() if hasattr(values, "tolist") else values
+    # One C-pass conversion doubling as element validation: a list with a
+    # non-numeric mid-array element coerces to a non-numeric dtype and is
+    # rejected HERE, never mid-loop (np.asarray also raises ValueError on
+    # ragged input).
+    times = np.asarray(times)
+    values = np.asarray(values)
+    if times.dtype.kind not in "iuf" or values.dtype.kind not in "iuf":
+        raise ValueError("tbatch times/values must be numeric columns")
+    if times.ndim != 1 or values.ndim != 1:
+        raise ValueError("tbatch times/values must be one-dimensional")
+    times = times.tolist()
+    values = values.tolist()
     add = agg.add_timed
     for mid, t, v in zip(ids, times, values):
         add(mt, mid, t, v, pol, agg_id)

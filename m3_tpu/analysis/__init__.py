@@ -1,0 +1,14 @@
+"""m3lint: repo-native static analysis (cache-key safety, JAX trace
+purity, lock discipline, batch-loop exception safety).
+
+Run `python -m m3_tpu.analysis m3_tpu/` — the tier-1 gate in
+tests/test_static_analysis.py keeps the tree at zero non-suppressed
+findings. See m3_tpu/analysis/README.md for the rule catalog and the
+`# m3lint: disable=<rule>` suppression syntax.
+"""
+
+from .core import (Finding, Module, Rule, all_rules, run_module,  # noqa: F401
+                   run_paths)
+
+__all__ = ["Finding", "Module", "Rule", "all_rules", "run_module",
+           "run_paths"]

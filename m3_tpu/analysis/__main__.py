@@ -1,0 +1,40 @@
+"""CLI: python -m m3_tpu.analysis [paths...]
+
+Exit status 0 only when every analyzed file is clean (no non-suppressed
+findings); 1 otherwise. `--list-rules` prints the rule catalog."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .core import all_rules, run_paths
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m m3_tpu.analysis",
+        description="m3lint: repo-native static analysis")
+    ap.add_argument("paths", nargs="*", default=["m3_tpu"],
+                    help="files or directories to analyze (default: m3_tpu)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    rules = all_rules()
+    if args.list_rules:
+        for r in rules:
+            doc = ((r.__doc__ or "").strip().splitlines() or [""])[0]
+            print(f"{r.id:28s} [{r.severity}] {doc}")
+        return 0
+
+    findings, suppressed, nmods = run_paths(args.paths or ["m3_tpu"], rules)
+    for f in findings:
+        print(f.render())
+    print(f"m3lint: {len(findings)} finding(s), {suppressed} suppressed, "
+          f"{nmods} file(s) analyzed")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

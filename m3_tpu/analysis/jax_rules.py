@@ -1,0 +1,442 @@
+"""JAX trace purity for the kernel modules (everything importing jax).
+
+Traced functions are discovered structurally: `@jax.jit` decorations
+(including `functools.partial(jax.jit, static_argnames=...)`), and
+`jax.jit(fn)` / `jax.jit(functools.partial(fn, **static))` call sites —
+the repo's lru_cache-builder idiom. Within a traced function a tiny
+forward taint pass marks values derived from traced (non-static)
+parameters; taint propagates into same-module helpers called with
+tainted arguments, so `_wsum`-style helpers are checked with exactly
+the parameters that carry tracers.
+
+Rules:
+  jax-traced-branch    Python `if`/`while` on a traced value (concretizes
+                       the tracer; jax raises TracerBoolConversionError).
+                       `x is None` tests and static attribute reads
+                       (.shape/.ndim/.dtype/.size, len()) don't count.
+  jax-numpy-in-jit     numpy called on a traced value inside a traced
+                       function (np.asarray & friends force a host
+                       materialization mid-trace).
+  jax-host-sync        float()/int()/bool()/.item()/.tolist() on a traced
+                       value inside a traced function.
+  jax-nonstatic-jit-cache  lru_cache'd jit-builder whose cache key
+                       includes an unhashable-annotated parameter or a
+                       mutable default.
+  jax-item-in-loop     .item()/.block_until_ready() inside a Python
+                       for/while loop in a jax module — a per-element
+                       device sync in what should be one batched
+                       transfer. (warning)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from .core import (Finding, Module, Rule, annotation_names, func_params,
+                   index_functions, is_cache_decorator, qualname)
+
+_NUMPY_ALIASES = {"np", "numpy"}
+# static metadata on tracers: reading these is trace-time constant
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "weak_type",
+                 "sharding"}
+_SYNC_BUILTINS = {"float", "int", "bool", "complex"}
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_UNHASHABLE_ANNOT = {"list", "List", "dict", "Dict", "set", "Set",
+                     "ndarray", "Array", "ArrayLike", "Sequence",
+                     "MutableSequence", "bytearray"}
+
+
+def _static_argnames(call: ast.Call) -> Set[str]:
+    """static_argnames=... from a jax.jit / partial(jax.jit, ...) call."""
+    out: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg != "static_argnames":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            out.add(v.value)
+        elif isinstance(v, (ast.Tuple, ast.List)):
+            for el in v.elts:
+                if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                    out.add(el.value)
+    return out
+
+
+_JIT_NAMES = ("jax.jit", "jit", "jax.pjit", "pjit")
+_TRANSFORM_NAMES = _JIT_NAMES + (
+    "jax.shard_map", "shard_map", "jax.vmap", "vmap", "jax.pmap", "pmap",
+    "jax.experimental.shard_map.shard_map")
+
+
+def _is_jax_jit(node: ast.AST) -> bool:
+    return qualname(node) in _JIT_NAMES
+
+
+def _is_jax_transform(node: ast.AST) -> bool:
+    """Any jax transform that traces its function argument."""
+    return qualname(node) in _TRANSFORM_NAMES
+
+
+def _partial_of(call: ast.Call) -> Optional[ast.AST]:
+    """For functools.partial(X, ...) return X, else None."""
+    if qualname(call.func) in ("functools.partial", "partial") and call.args:
+        return call.args[0]
+    return None
+
+
+def _index_all_functions(mod: Module) -> Dict[str, List[ast.FunctionDef]]:
+    """EVERY function def per bare name, in source order — the repo's
+    builder idiom defines many distinct nested `fn`s, and resolution
+    must not collapse them onto one."""
+    out: Dict[str, List[ast.FunctionDef]] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.setdefault(node.name, []).append(node)
+    for defs in out.values():
+        defs.sort(key=lambda f: f.lineno)
+    return out
+
+
+def _resolve(name: str, use_line: int,
+             by_name: Dict[str, List[ast.FunctionDef]],
+             ) -> Optional[ast.FunctionDef]:
+    """The def a name at `use_line` refers to: the nearest PRECEDING def
+    with that name (Python binding order in the builder idiom), falling
+    back to the first def when all follow the use site."""
+    defs = by_name.get(name)
+    if not defs:
+        return None
+    best = None
+    for fn in defs:
+        if fn.lineno <= use_line:
+            best = fn
+        else:
+            break
+    return best or defs[0]
+
+
+def find_traced(mod: Module) -> Dict[int, Tuple[ast.FunctionDef, Set[str]]]:
+    """id(funcdef) -> (funcdef, static param names) for every function
+    the module hands to jax.jit one way or another."""
+    by_name = _index_all_functions(mod)
+    traced: Dict[int, Tuple[ast.FunctionDef, Set[str]]] = {}
+
+    def mark(fn: ast.FunctionDef, static: Set[str]):
+        prev = traced.get(id(fn))
+        if prev is not None:
+            static = prev[1] & static  # keep the most conservative view
+        traced[id(fn)] = (fn, static)
+
+    for defs in by_name.values():
+        for fn in defs:
+            for dec in fn.decorator_list:
+                if _is_jax_transform(dec):
+                    mark(fn, set())
+                elif isinstance(dec, ast.Call):
+                    if _is_jax_transform(dec.func):
+                        mark(fn, _static_argnames(dec))
+                    else:
+                        inner = _partial_of(dec)
+                        if inner is not None and _is_jax_transform(inner):
+                            mark(fn, _static_argnames(dec))
+
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Call) and _is_jax_transform(node.func)
+                and node.args):
+            continue
+        static = _static_argnames(node)
+        target = node.args[0]
+        if isinstance(target, ast.Name):
+            fn = _resolve(target.id, node.lineno, by_name)
+            if fn is not None:
+                mark(fn, static)
+        elif isinstance(target, ast.Call):
+            inner = _partial_of(target)
+            if isinstance(inner, ast.Name):
+                fn = _resolve(inner.id, node.lineno, by_name)
+                if fn is not None:
+                    # partial-bound keywords are trace-time constants
+                    bound = {kw.arg for kw in target.keywords if kw.arg}
+                    mark(fn, static | bound)
+    return traced
+
+
+class _TaintVisitor:
+    """One pass over a traced function body: tracks names holding traced
+    values, records purity violations, and collects same-module calls
+    that receive tainted arguments (for interprocedural propagation)."""
+
+    def __init__(self, mod: Module, fn: ast.FunctionDef, tainted: Set[str],
+                 local_funcs: Dict[str, ast.FunctionDef]):
+        self.mod = mod
+        self.fn = fn
+        self.tainted = set(tainted)
+        self.local_funcs = local_funcs
+        self.violations: List[Tuple[str, ast.AST, str]] = []
+        self.calls_out: List[Tuple[str, Set[str]]] = []
+
+    # -- taint queries ----------------------------------------------------
+
+    def expr_tainted(self, node: ast.AST) -> bool:
+        return any(self._tainted_names(node))
+
+    def _tainted_names(self, node: ast.AST) -> Iterator[str]:
+        """Tainted Names reachable in an expression without crossing a
+        static boundary (.shape et al, len(), isinstance())."""
+        if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+            return
+        if isinstance(node, ast.Call):
+            q = qualname(node.func)
+            if q in ("len", "isinstance", "type", "id"):
+                return
+        if isinstance(node, ast.Name):
+            if node.id in self.tainted:
+                yield node.id
+            return
+        for child in ast.iter_child_nodes(node):
+            yield from self._tainted_names(child)
+
+    def _test_tainted(self, test: ast.AST) -> bool:
+        """Tainted-ness of a branch condition; `x is (not) None` legs are
+        trace-time constants and don't count."""
+        if isinstance(test, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+            return False
+        if isinstance(test, ast.BoolOp):
+            return any(self._test_tainted(v) for v in test.values)
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            return self._test_tainted(test.operand)
+        return self.expr_tainted(test)
+
+    # -- walking ----------------------------------------------------------
+
+    def run(self):
+        # two passes: loop-carried assignments taint their earlier uses
+        for _ in range(2):
+            self.violations.clear()
+            self.calls_out.clear()
+            for stmt in self.fn.body:
+                self._stmt(stmt)
+
+    def _assign_target(self, target: ast.AST, taint: bool):
+        if isinstance(target, ast.Name):
+            if taint:
+                self.tainted.add(target.id)
+            else:
+                self.tainted.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._assign_target(el, taint)
+        # attribute/subscript stores don't create new tracked names
+
+    def _stmt(self, stmt: ast.AST):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # nested defs trace on their own call sites
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = stmt.value
+            if value is not None:
+                self._expr(value)
+                taint = self.expr_tainted(value)
+                targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                           else [stmt.target])
+                for t in targets:
+                    if isinstance(stmt, ast.AugAssign):
+                        if taint and isinstance(t, ast.Name):
+                            self.tainted.add(t.id)
+                    else:
+                        self._assign_target(t, taint)
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            if self._test_tainted(stmt.test):
+                kind = "while" if isinstance(stmt, ast.While) else "if"
+                self.violations.append((
+                    "jax-traced-branch", stmt,
+                    f"Python `{kind}` on a traced value inside jitted "
+                    f"{self.fn.name!r} — the tracer cannot be concretized; "
+                    "use jnp.where/lax.cond/lax.select, or mark the "
+                    "argument static"))
+            self._expr(stmt.test)
+            for s in [*stmt.body, *stmt.orelse]:
+                self._stmt(s)
+            return
+        if isinstance(stmt, ast.For):
+            self._expr(stmt.iter)
+            self._assign_target(stmt.target, self.expr_tainted(stmt.iter))
+            for s in [*stmt.body, *stmt.orelse]:
+                self._stmt(s)
+            return
+        if isinstance(stmt, (ast.With,)):
+            for s in stmt.body:
+                self._stmt(s)
+            return
+        if isinstance(stmt, ast.Try):
+            for s in [*stmt.body, *stmt.orelse, *stmt.finalbody]:
+                self._stmt(s)
+            for h in stmt.handlers:
+                for s in h.body:
+                    self._stmt(s)
+            return
+        if isinstance(stmt, ast.Return) and stmt.value is not None:
+            self._expr(stmt.value)
+            return
+        if isinstance(stmt, ast.Expr):
+            self._expr(stmt.value)
+            return
+        # everything else (pass/raise/assert/...): still scan expressions
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._expr(child)
+
+    def _expr(self, node: ast.AST):
+        for call in [n for n in ast.walk(node) if isinstance(n, ast.Call)]:
+            self._call(call)
+        for ifexp in [n for n in ast.walk(node) if isinstance(n, ast.IfExp)]:
+            if self._test_tainted(ifexp.test):
+                self.violations.append((
+                    "jax-traced-branch", ifexp,
+                    f"conditional expression on a traced value inside "
+                    f"jitted {self.fn.name!r} — use jnp.where/lax.select"))
+
+    def _call(self, call: ast.Call):
+        q = qualname(call.func)
+        args_tainted = [self.expr_tainted(a) for a in call.args]
+        kw_tainted = {kw.arg: self.expr_tainted(kw.value)
+                      for kw in call.keywords if kw.arg}
+        any_tainted = any(args_tainted) or any(kw_tainted.values())
+
+        if q and any_tainted:
+            root = q.split(".")[0]
+            if root in _NUMPY_ALIASES and "." in q:
+                self.violations.append((
+                    "jax-numpy-in-jit", call,
+                    f"{q}() on a traced value inside jitted "
+                    f"{self.fn.name!r} — host numpy forces materialization "
+                    "mid-trace; use jnp/lax"))
+            elif q in _SYNC_BUILTINS:
+                self.violations.append((
+                    "jax-host-sync", call,
+                    f"{q}() concretizes a traced value inside jitted "
+                    f"{self.fn.name!r} (TracerError at trace time); keep "
+                    "the value symbolic or mark it static"))
+        if (isinstance(call.func, ast.Attribute)
+                and call.func.attr in _SYNC_METHODS
+                and self.expr_tainted(call.func.value)):
+            self.violations.append((
+                "jax-host-sync", call,
+                f".{call.func.attr}() on a traced value inside jitted "
+                f"{self.fn.name!r} forces a device sync mid-trace"))
+        # propagate taint into same-module helpers
+        if (q and "." not in q and q in self.local_funcs and any_tainted):
+            callee = self.local_funcs[q]
+            names = [a.arg for a in func_params(callee)]
+            hit: Set[str] = set()
+            for i, t in enumerate(args_tainted):
+                if t and i < len(names):
+                    hit.add(names[i])
+            for k, t in kw_tainted.items():
+                if t and k in names:
+                    hit.add(k)
+            if hit:
+                self.calls_out.append((q, hit))
+
+
+class JaxPurityRule(Rule):
+    """jax-traced-branch / jax-numpy-in-jit / jax-host-sync over every
+    traced function (direct and taint-transitive)."""
+
+    id = "jax-purity"  # umbrella; findings carry their specific ids
+    severity = "error"
+    requires_import = "jax"
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        funcs = index_functions(mod)
+        traced = find_traced(mod)
+        # worklist of (funcdef, tainted param set), seen keyed by node
+        # identity — distinct same-named nested builders analyze apart
+        seen: Dict[int, Set[str]] = {}
+        work: List[Tuple[ast.FunctionDef, Set[str]]] = []
+        for fn, static in traced.values():
+            params = {a.arg for a in func_params(fn)}
+            work.append((fn, params - static))
+        emitted: Set[Tuple[str, int, str]] = set()
+        while work:
+            fn, tainted = work.pop()
+            prev = seen.get(id(fn))
+            if prev is not None and tainted <= prev:
+                continue
+            seen[id(fn)] = (prev or set()) | tainted
+            v = _TaintVisitor(mod, fn, tainted, funcs)
+            v.run()
+            for rule_id, node, msg in v.violations:
+                line = getattr(node, "lineno", fn.lineno)
+                key = (rule_id, line, msg)
+                if key in emitted:
+                    continue  # re-analysis with a wider taint set
+                emitted.add(key)
+                yield Finding(rule_id, mod.relpath, line, msg, self.severity)
+            for callee, hit in v.calls_out:
+                if funcs[callee] is not fn:
+                    work.append((funcs[callee], hit))
+
+
+class NonStaticJitCacheRule(Rule):
+    """jax-nonstatic-jit-cache: lru_cache'd builder returning a jitted
+    callable whose cache key includes an unhashable parameter."""
+
+    id = "jax-nonstatic-jit-cache"
+    severity = "error"
+    requires_import = "jax"
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        for fn in index_functions(mod).values():
+            if not any(is_cache_decorator(d) for d in fn.decorator_list):
+                continue
+            if not any(_is_jax_jit(n) or (isinstance(n, ast.Call)
+                                          and _is_jax_jit(n.func))
+                       for n in ast.walk(fn)):
+                continue
+            for arg in func_params(fn):
+                bad = annotation_names(arg.annotation) & _UNHASHABLE_ANNOT
+                if bad:
+                    yield self.finding(
+                        mod, fn,
+                        f"jit-builder {fn.name!r} is lru_cache'd but "
+                        f"parameter {arg.arg!r} is annotated "
+                        f"{'|'.join(sorted(bad))} — unhashable cache key "
+                        "(TypeError) or object-identity keying; take "
+                        "hashable scalars/tuples instead")
+            defaults = [*fn.args.defaults, *fn.args.kw_defaults]
+            for d in defaults:
+                if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                    yield self.finding(
+                        mod, d,
+                        f"jit-builder {fn.name!r} is lru_cache'd with a "
+                        "mutable default — shared across every cache entry")
+
+
+class ItemInLoopRule(Rule):
+    """jax-item-in-loop: per-element device syncs in Python loops."""
+
+    id = "jax-item-in-loop"
+    severity = "warning"
+    requires_import = "jax"
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        for loop in ast.walk(mod.tree):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            for node in ast.walk(loop):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in ("item", "block_until_ready")):
+                    yield self.finding(
+                        mod, node,
+                        f".{node.func.attr}() inside a Python loop — one "
+                        "device sync per element; batch the transfer "
+                        "(np.asarray once) outside the loop")
+
+
+RULES: List[Rule] = [JaxPurityRule(), NonStaticJitCacheRule(),
+                     ItemInLoopRule()]

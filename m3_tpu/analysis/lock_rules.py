@@ -1,0 +1,344 @@
+"""Lock discipline across the concurrent tiers (storage/, cluster/,
+msg/, aggregator/) — the Python analog of what the reference leans on
+Go's race detector for.
+
+Per module, the rules build a lock model:
+
+  * lock objects: attributes/names assigned threading.Lock / RLock /
+    Condition (plus a `*_lock`/`*_cond` name heuristic for locks that
+    arrive via parameters), and queue.Queue attributes.
+  * per method: which locks it acquires (`with self._x:`), what it
+    acquires WHILE holding one (directly nested `with`, or via a self
+    method call whose transitive closure acquires locks), and which
+    blocking operations run under a held lock.
+
+Rules:
+  lock-order-inversion   two code paths in one module acquire the same
+                         pair of locks in opposite orders (ABBA), or a
+                         non-reentrant Lock is re-acquired on a path
+                         that already holds it (self-deadlock).
+  lock-held-blocking-call  socket/sleep/subprocess/queue-get style
+                         blocking operations while holding a lock —
+                         every other thread needing that lock stalls on
+                         peer I/O. `with cond:` bodies are exempt
+                         (Condition.wait IS the blocking-under-lock
+                         pattern, it releases while waiting).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, Module, Rule, index_functions, qualname
+
+_LOCK_CTORS = {
+    "threading.Lock": "lock", "threading.RLock": "rlock",
+    "threading.Condition": "cond", "Lock": "lock", "RLock": "rlock",
+    "Condition": "cond",
+}
+_QUEUE_CTORS = {"queue.Queue", "Queue", "queue.SimpleQueue", "SimpleQueue",
+                "queue.LifoQueue", "queue.PriorityQueue"}
+
+# blocking by qualified call name
+_BLOCKING_CALLS = {
+    "time.sleep", "socket.create_connection", "select.select",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "subprocess.Popen",
+    "urllib.request.urlopen",
+    # repo-specific: framed socket I/O helpers (m3_tpu.rpc.wire)
+    "wire.read_frame", "wire.write_frame", "wire.read_dict_frame",
+}
+# blocking by method name on any receiver (socket objects)
+_BLOCKING_METHODS = {"recv", "recv_into", "accept", "makefile", "sendall"}
+# blocking only on queue-typed receivers
+_QUEUE_BLOCKING_METHODS = {"get", "put", "join"}
+
+
+def _attr_key(node: ast.AST) -> Optional[str]:
+    """Identity of a lock expression: 'self._lock' / 'outer._stats_lock'
+    / bare name. None for anything that isn't a plain chain."""
+    return qualname(node)
+
+
+class _LockModel:
+    def __init__(self, mod: Module):
+        self.mod = mod
+        # lock identity (attr name) -> kind ('lock'|'rlock'|'cond')
+        self.kinds: Dict[str, str] = {}
+        self.queues: Set[str] = set()
+        self._scan_ctors()
+
+    def _scan_ctors(self):
+        for node in ast.walk(self.mod.tree):
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+                value = node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets = [node.target]
+                value = node.value
+            else:
+                continue
+            if not isinstance(value, ast.Call):
+                continue
+            ctor = qualname(value.func)
+            for target in targets:
+                key = _attr_key(target)
+                if key is None:
+                    continue
+                name = key.split(".")[-1]
+                if ctor in _LOCK_CTORS:
+                    self.kinds[name] = _LOCK_CTORS[ctor]
+                elif ctor in _QUEUE_CTORS:
+                    self.queues.add(name)
+
+    def lock_kind(self, expr: ast.AST) -> Optional[str]:
+        """Kind if `expr` is a with-context we should treat as a lock."""
+        key = _attr_key(expr)
+        if key is None:
+            return None
+        name = key.split(".")[-1]
+        if name in self.kinds:
+            return self.kinds[name]
+        low = name.lower()
+        if low.endswith("lock") or low == "lock":
+            return "lock"
+        if low.endswith("cond") or low.endswith("condition"):
+            return "cond"
+        return None
+
+    def is_queue(self, expr: ast.AST) -> bool:
+        key = _attr_key(expr)
+        if key is None:
+            return False
+        name = key.split(".")[-1]
+        return name in self.queues or "queue" in name.lower()
+
+
+def _self_call_name(call: ast.Call) -> Optional[str]:
+    """'m' for self.m(...) / cls.m(...), else None."""
+    f = call.func
+    if (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+            and f.value.id in ("self", "cls")):
+        return f.attr
+    return None
+
+
+def _blocking_reason(model: _LockModel, call: ast.Call) -> Optional[str]:
+    q = qualname(call.func)
+    if q:
+        if q in _BLOCKING_CALLS:
+            return f"{q}()"
+        tail = ".".join(q.split(".")[-2:])
+        if tail in _BLOCKING_CALLS:
+            return f"{tail}()"
+    if isinstance(call.func, ast.Attribute):
+        attr = call.func.attr
+        if attr in _BLOCKING_METHODS:
+            return f".{attr}()"
+        if attr == "wait":
+            # Condition.wait on a DIFFERENT lock's condition object; bare
+            # event.wait too — blocking either way
+            return ".wait()"
+        if (attr in _QUEUE_BLOCKING_METHODS
+                and model.is_queue(call.func.value)):
+            return f"queue .{attr}()"
+    return None
+
+
+class _MethodFacts:
+    """What one function acquires and does: direct lock set, (held ->
+    acquired) edges, (held -> self-call) deferred edges, (held ->
+    blocking op) sites, and bare self-calls outside any lock (for the
+    transitive acquire closure)."""
+
+    def __init__(self, fn: ast.FunctionDef, model: _LockModel):
+        self.fn = fn
+        self.model = model
+        self.acquires: Dict[str, int] = {}
+        self.edges: List[Tuple[str, str, int]] = []
+        self.calls_under: List[Tuple[str, str, int]] = []
+        self.blocking_under: List[Tuple[str, str, int]] = []
+        self.plain_calls: Set[str] = set()
+        self._walk(fn.body, held=[])
+
+    def _walk(self, stmts: Sequence[ast.stmt], held: List[Tuple[str, str]]):
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # nested scopes analyzed separately
+            if isinstance(stmt, ast.With):
+                newly: List[Tuple[str, str]] = []
+                for item in stmt.items:
+                    for node in ast.walk(item.context_expr):
+                        if isinstance(node, ast.Call):
+                            self._note_call(node, held)
+                    kind = self.model.lock_kind(item.context_expr)
+                    if kind is None:
+                        continue
+                    key = _attr_key(item.context_expr)
+                    name = key.split(".")[-1]
+                    self.acquires.setdefault(name, stmt.lineno)
+                    for h, _hk in held:
+                        self.edges.append((h, name, stmt.lineno))
+                    newly.append((name, kind))
+                self._walk(stmt.body, held + newly)
+                continue
+            # this statement's OWN expressions (nested statement lists are
+            # recursed below with their correct held set)
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    for node in ast.walk(child):
+                        if isinstance(node, ast.Call):
+                            self._note_call(node, held)
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, attr, None)
+                if sub:
+                    self._walk(sub, held)
+            for h in getattr(stmt, "handlers", []) or []:
+                self._walk(h.body, held)
+
+    def _note_call(self, call: ast.Call, held: List[Tuple[str, str]]):
+        m = _self_call_name(call)
+        if m is not None:
+            if held:
+                # attribute to the innermost non-condition held lock
+                for h, hk in reversed(held):
+                    if hk != "cond":
+                        self.calls_under.append((h, m, call.lineno))
+                        break
+            self.plain_calls.add(m)
+        if not held:
+            return
+        # condition bodies are the sanctioned blocking-under-lock shape
+        if all(hk == "cond" for _h, hk in held):
+            return
+        reason = _blocking_reason(self.model, call)
+        if reason is not None:
+            for h, hk in reversed(held):
+                if hk != "cond":
+                    self.blocking_under.append((h, reason, call.lineno))
+                    break
+
+
+def _transitive_acquires(facts: Dict[str, _MethodFacts]) -> Dict[str, Set[str]]:
+    """method -> every lock its call closure can acquire."""
+    out: Dict[str, Set[str]] = {
+        name: set(f.acquires) for name, f in facts.items()}
+    changed = True
+    while changed:
+        changed = False
+        for name, f in facts.items():
+            for callee in f.plain_calls:
+                more = out.get(callee)
+                if more and not more <= out[name]:
+                    out[name] |= more
+                    changed = True
+    return out
+
+
+def _transitive_blocking(facts: Dict[str, _MethodFacts],
+                         ) -> Dict[str, List[Tuple[str, int]]]:
+    """method -> blocking ops reachable through its call closure (one
+    level deep is enough for this codebase's helper style; deeper chains
+    converge through the closure loop)."""
+    out: Dict[str, List[Tuple[str, int]]] = {}
+    for name, f in facts.items():
+        seen: Set[str] = set()
+        ops: List[Tuple[str, int]] = []
+
+        def visit(n: str, depth: int):
+            if n in seen or depth > 4 or n not in facts:
+                return
+            seen.add(n)
+            fx = facts[n]
+            for node in ast.walk(fx.fn):
+                if isinstance(node, ast.Call):
+                    r = _blocking_reason(fx.model, node)
+                    if r is not None:
+                        ops.append((r, node.lineno))
+            for callee in fx.plain_calls:
+                visit(callee, depth + 1)
+
+        # include the method's OWN blocking ops: a caller holding a lock
+        # across `self.m()` blocks on everything m does, lock or not
+        visit(name, 0)
+        out[name] = ops
+    return out
+
+
+class LockDisciplineRule(Rule):
+    """lock-order-inversion + lock-held-blocking-call over one module's
+    lock graph."""
+
+    id = "lock-discipline"  # umbrella; findings carry specific ids
+    severity = "error"
+    dirs = ("storage", "cluster", "msg", "aggregator", "persist")
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        model = _LockModel(mod)
+        # bare-name method index (methods don't collide meaningfully
+        # within the modules this rule scopes to)
+        methods = index_functions(mod)
+        facts = {name: _MethodFacts(fn, model)
+                 for name, fn in methods.items()}
+        closure = _transitive_acquires(facts)
+
+        # direct + call-mediated (held -> acquired) edges
+        edges: Dict[Tuple[str, str], int] = {}
+        for name, f in facts.items():
+            for a, b, line in f.edges:
+                edges.setdefault((a, b), line)
+            for held, callee, line in f.calls_under:
+                for b in closure.get(callee, ()):
+                    edges.setdefault((held, b), line)
+
+        reported: Set[Tuple[str, str]] = set()
+        for (a, b), line in sorted(edges.items(), key=lambda kv: kv[1]):
+            if a == b:
+                if model.kinds.get(a, "lock") == "lock":
+                    yield Finding(
+                        "lock-order-inversion", mod.relpath, line,
+                        f"non-reentrant lock {a!r} re-acquired on a path "
+                        "that already holds it (self-deadlock); use an "
+                        "RLock or split the locked helper",
+                        self.severity)
+                continue
+            if (b, a) in edges and (b, a) not in reported:
+                reported.add((a, b))
+                yield Finding(
+                    "lock-order-inversion", mod.relpath, line,
+                    f"lock order inversion: {a!r} -> {b!r} here but "
+                    f"{b!r} -> {a!r} at line {edges[(b, a)]}; two threads "
+                    "taking opposite orders deadlock — pick one order",
+                    self.severity)
+
+        # blocking ops while holding a lock (direct + one call level)
+        emitted: Set[Tuple[int, str]] = set()
+        for name, f in facts.items():
+            for held, reason, line in f.blocking_under:
+                if (line, reason) not in emitted:
+                    emitted.add((line, reason))
+                    yield Finding(
+                        "lock-held-blocking-call", mod.relpath, line,
+                        f"blocking {reason} while holding {held!r} — "
+                        "every thread contending on that lock stalls "
+                        "behind this I/O; move it outside the critical "
+                        "section or snapshot state first",
+                        self.severity)
+        blocking_closure = _transitive_blocking(facts)
+        for name, f in facts.items():
+            for held, callee, line in f.calls_under:
+                for reason, bline in blocking_closure.get(callee, ())[:1]:
+                    if (line, reason) in emitted:
+                        continue
+                    emitted.add((line, reason))
+                    yield Finding(
+                        "lock-held-blocking-call", mod.relpath, line,
+                        f"call to {callee!r} while holding {held!r} "
+                        f"reaches blocking {reason} (line {bline}); move "
+                        "the call outside the critical section",
+                        self.severity)
+
+
+RULES: List[Rule] = [LockDisciplineRule()]

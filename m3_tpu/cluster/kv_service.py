@@ -157,10 +157,17 @@ class RemoteStore:
             for attempt in range(2):  # one reconnect attempt
                 try:
                     if self._sock is None:
-                        self._sock = self._connect()
-                    wire.write_frame(self._sock, req)
+                        # reconnect inside the same serialized exchange
+                        # (see I/O note below); bounded by the same timeout
+                        self._sock = self._connect()  # m3lint: disable=lock-held-blocking-call
+                    # DELIBERATE I/O under _lock: this lock exists to
+                    # serialize whole request/response exchanges on the
+                    # single pooled socket — interleaved frames from two
+                    # threads would desync the stream. Latency is bounded
+                    # by the connect/read timeout set in _connect.
+                    wire.write_frame(self._sock, req)  # m3lint: disable=lock-held-blocking-call
                     try:
-                        resp = wire.read_dict_frame(self._sock)
+                        resp = wire.read_dict_frame(self._sock)  # m3lint: disable=lock-held-blocking-call
                     except ValueError as e:
                         # malformed reply = stream desync: the pooled
                         # socket is unusable; surface as a CONNECTION

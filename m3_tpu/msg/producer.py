@@ -82,7 +82,11 @@ class MessageWriter:
             if not self._ensure_conn():
                 return False
             try:
-                wire.write_frame(self._sock, {
+                # DELIBERATE I/O under _io_lock: the lock's entire job is
+                # serializing frame writes on the shared connection so two
+                # writers can't interleave a frame; queue state uses the
+                # separate _lock, which is never held here.
+                wire.write_frame(self._sock, {  # m3lint: disable=lock-held-blocking-call
                     "t": "msg", "shard": msg.shard, "id": msg.id,
                     "sent_at": time.monotonic_ns(), "value": msg.value,
                 })

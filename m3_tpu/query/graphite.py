@@ -453,10 +453,13 @@ def _group_by_node(eng, args, params):
 def _summarize(eng, args, params):
     """Reference semantics (native/summarize.go): by default buckets are
     aligned to EPOCH multiples of the interval — the output grid starts
-    at floor(start, interval) and covers through floor(end, interval) +
-    interval — and each point lands in the bucket floor(ts, interval).
-    With alignToFrom=true buckets count from the series start instead.
-    Empty buckets emit NaN."""
+    at floor(start, interval) and runs through newEnd = floor(end,
+    interval) + interval, where end is the series' EXCLUSIVE end time
+    (summarizeTimeSeries sizes NumSteps from newEnd, so an end already
+    on an interval boundary gains one trailing empty bucket) — and each
+    point lands in the bucket floor(ts, interval). With alignToFrom=true
+    buckets count from the series start and NumSteps is
+    ceil((end-start)/interval). Empty buckets emit NaN."""
     from .promql import parse_duration_ns
 
     # Argument validation FIRST: an invalid interval/func must reject
@@ -480,18 +483,31 @@ def _summarize(eng, args, params):
     else:
         new_start = start - start % bucket_ns
         bucket_of = (times - new_start) // bucket_ns
-    last_ts = int(times[-1]) if times.size else start
-    steps = int((last_ts - new_start) // bucket_ns) + 1
+    # Grid sizing from the block's EXCLUSIVE end (start + steps*step),
+    # matching summarize.go's newEnd/NumSteps — never from the last data
+    # timestamp, which silently drops the reference's trailing bucket
+    # whenever the query end extends past the last gridded point.
+    end = start + block.meta.steps * block.meta.step_ns
+    if align_to_from:
+        steps = max(1, int(-(-(end - new_start) // bucket_ns)))  # ceil
+    else:
+        steps = int(((end // bucket_ns) * bucket_ns + bucket_ns
+                     - new_start) // bucket_ns)
     # Dashboard-typical fast path: the interval divides the step grid
     # and the epoch-aligned start lands ON the grid, so every bucket has
     # the same width — one reshape + one masked reduce, no Python loop.
     # (bucket_ns > 0 was enforced above, so divisibility implies
-    # factor >= 1.)
+    # factor >= 1.) `data_steps` buckets hold data; the epoch-aligned
+    # path then carries `steps - data_steps` (0 or 1) trailing NaN
+    # buckets from the newEnd sizing above.
     factor = bucket_ns // block.meta.step_ns
+    data_steps = times.size // factor if factor else 0
     if (agg != "last" and bucket_ns % block.meta.step_ns == 0
             and (start - new_start) % bucket_ns == 0
-            and times.size == steps * factor):
-        v = block.values.reshape(block.n_series, steps, factor)
+            and times.size == data_steps * factor
+            and times.size > 0
+            and steps in (data_steps, data_steps + 1)):
+        v = block.values.reshape(block.n_series, data_steps, factor)
         # NaN is the ONLY missing marker — inf is a real sample and must
         # propagate through every aggregate exactly as in the general
         # path (graphite None vs a value).
@@ -510,6 +526,10 @@ def _summarize(eng, args, params):
         else:  # min
             red = np.where(present, v, np.inf).min(axis=2)
         out = np.where(have, red, np.nan)
+        if steps > data_steps:
+            out = np.concatenate(
+                [out, np.full((block.n_series, steps - data_steps), np.nan)],
+                axis=1)
         return Block(BlockMeta(int(new_start), bucket_ns, steps),
                      block.series_tags, out)
     out = np.full((block.n_series, steps), np.nan)

@@ -63,10 +63,17 @@ def murmur3_32(data: bytes, seed: int = 0) -> int:
 # ids before any validation and 64k pinned multi-MB keys would be an
 # unbounded-memory hazard, not a cache. 64k x <=256B is <= ~16MB.
 _MURMUR_CACHE_MAX_KEY = 256
-_murmur3_32_lru = functools.lru_cache(maxsize=65536)(murmur3_32)
+# the public wrapper below normalizes every non-bytes buffer before this
+# memo sees it, so the unhashable/mutable-key hazard cannot reach it
+_murmur3_32_lru = functools.lru_cache(maxsize=65536)(murmur3_32)  # m3lint: disable=cache-key-buffer
 
 
 def murmur3_32_cached(data: bytes, seed: int = 0) -> int:
+    if type(data) is not bytes:
+        # bytearray/memoryview hash the same bytes but are unhashable (or
+        # mutable — a cache key that can change underneath the memo), so
+        # normalize before the cached path; mirrors the oversize bypass.
+        data = bytes(data)
     if len(data) > _MURMUR_CACHE_MAX_KEY:
         return murmur3_32(data, seed)
     return _murmur3_32_lru(data, seed)

@@ -17,6 +17,12 @@ if [ "$QUICK" = "--quick" ]; then
   ROUNDS=40; IROUNDS=100; DROUNDS=40; CROUNDS=1
 fi
 
+echo "== static analysis =="
+# m3lint (m3_tpu/analysis): cache-key safety, JAX trace purity, lock
+# discipline, batch-loop exception safety. Zero non-suppressed findings
+# is the contract (also gated in-tree by tests/test_static_analysis.py).
+python -m m3_tpu.analysis m3_tpu/
+
 echo "== test suite =="
 python -m pytest tests/ -x -q
 

@@ -268,6 +268,12 @@ class TestTbatchDispatchFuzz:
             lambda f: f.update(ids=[*f["ids"][:-1], "str"]),  # non-bytes id
             lambda f: f.update(times="not-an-array"),
             lambda f: f.update(values=None),
+            # element-level shapes the pre-round-6 validator admitted and
+            # then crashed on (or silently mis-ingested) MID-LOOP:
+            lambda f: f.update(times=[*map(int, f["times"][:-1]), "x"]),
+            lambda f: f.update(values=[*map(float, f["values"][:-1]), None]),
+            lambda f: f.update(times=[*map(int, f["times"][:-1]), [1, 2]]),
+            lambda f: f.update(values=object()),            # no len/iter
         ]
         for i in range(len(mutations) * 3):
             agg = self._agg()
@@ -297,3 +303,51 @@ class TestTbatchDispatchFuzz:
             "ids": [b"ok.1", b"ok.2"], "times": np.full(2, t0, np.int64),
             "values": np.array([1.0, 2.0])})
         assert agg.num_entries() == 2
+
+    def test_mixed_buffer_ids_ingest_fully(self):
+        """bytearray/memoryview metric IDs are valid wire buffers: a
+        mixed-type id column must ingest EVERY row (normalized to bytes
+        during validation), not crash on the first non-bytes id after a
+        prefix was aggregated (the round-5 partial-ingest hazard)."""
+        from m3_tpu.aggregator.server import dispatch_entry
+
+        S = 1_000_000_000
+        t0 = 1_700_000_000 * S
+        agg = self._agg()
+        dispatch_entry(agg, {
+            "t": "tbatch", "mtype": 1, "policy": "10s:2d", "agg_id": 0,
+            "ids": [b"mix.a", bytearray(b"mix.b"), memoryview(b"mix.c")],
+            "times": np.full(3, t0, np.int64),
+            "values": np.array([1.0, 2.0, 3.0])})
+        assert agg.num_entries() == 3
+        # same id through different buffer types lands on ONE entry
+        agg2 = self._agg()
+        dispatch_entry(agg2, {
+            "t": "tbatch", "mtype": 1, "policy": "10s:2d", "agg_id": 0,
+            "ids": [b"mix.same", bytearray(b"mix.same")],
+            "times": np.full(2, t0, np.int64),
+            "values": np.array([1.0, 2.0])})
+        assert agg2.num_entries() == 1
+
+    def test_non_numeric_mid_array_rejected_whole(self):
+        """List-typed columns with a bad element PAST the first position
+        must reject with zero entries staged — the length check alone
+        used to admit them and raise mid-loop."""
+        import pytest as _pytest
+
+        from m3_tpu.aggregator.server import dispatch_entry
+
+        S = 1_000_000_000
+        t0 = 1_700_000_000 * S
+        for col, bad in (("times", [t0, "x", t0]),
+                         ("values", [0.5, None, 1.5]),
+                         ("values", [0.5, [1.0], 1.5])):
+            agg = self._agg()
+            frame = {"t": "tbatch", "mtype": 1, "policy": "10s:2d",
+                     "agg_id": 0,
+                     "ids": [b"nn.1", b"nn.2", b"nn.3"],
+                     "times": [t0, t0, t0], "values": [1.0, 2.0, 3.0]}
+            frame[col] = bad
+            with _pytest.raises(ValueError):
+                dispatch_entry(agg, frame)
+            assert agg.num_entries() == 0

@@ -586,14 +586,18 @@ class TestBuiltinConformance:
     def test_summarize_aligned_fast_path(self, genv):
         # An epoch-aligned query window with uniform buckets takes the
         # reshape fast path; values must match the general path's
-        # semantics: T0+40..T0+70 @10s = [14,15,16,17] -> 20s sums.
+        # semantics: T0+40..T0+70 @10s = [14,15,16,17] -> 20s sums. The
+        # block's exclusive end (T0+80) lands ON the interval grid, so
+        # summarize.go's newEnd = floor(end, interval) + interval sizing
+        # emits one trailing empty (NaN) bucket at T0+80.
         c, db, now = genv
         ingest_paths(c, now, [(b"t.a", 10.0)])
         eng = GraphiteEngine(c.engine.storage)
         blk = eng.render('summarize(t.a, "20s", "sum")',
                          T0 + 40 * S, T0 + 70 * S, 10 * S)
-        np.testing.assert_allclose(blk.values[0], [29.0, 33.0])
+        np.testing.assert_allclose(blk.values[0], [29.0, 33.0, np.nan])
         assert blk.meta.start_ns == T0 + 40 * S
+        assert blk.meta.steps == 3
 
     def test_wildcards_grouping(self, teng):
         blk = teng("averageSeriesWithWildcards(t.*, 1)")
