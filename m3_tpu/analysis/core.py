@@ -242,13 +242,14 @@ def index_functions(mod: Module) -> Dict[str, ast.FunctionDef]:
 
 
 def _registry() -> List[Rule]:
-    from . import batch_rules, cache_rules, jax_rules, lock_rules
+    from . import batch_rules, cache_rules, jax_rules, lock_rules, retry_rules
 
     return [
         *cache_rules.RULES,
         *jax_rules.RULES,
         *lock_rules.RULES,
         *batch_rules.RULES,
+        *retry_rules.RULES,
     ]
 
 

@@ -1,0 +1,152 @@
+"""Retry-discipline rules: every networked layer must route retries and
+transport-error handling through the shared resilience primitives
+(m3_tpu/utils/retry.py) instead of ad-hoc shapes.
+
+Rules:
+  raw-sleep-retry        a `time.sleep` inside a loop that also contains
+                         a try/except — the hand-rolled fixed-delay retry
+                         loop. Fixed delays either hammer a dead endpoint
+                         (too short) or stall recovery (too long); the
+                         Retrier's jittered exponential backoff (or at
+                         least its backoff_for schedule) replaces both.
+  broad-except-wire-io   `except Exception` / bare `except` around direct
+                         wire.read_frame / write_frame / read_dict_frame
+                         calls. Framed I/O fails in exactly three typed
+                         ways (ConnectionError incl. WireTruncated,
+                         OSError, ValueError) and retriers/breakers
+                         classify on those types — a broad handler eats
+                         the classification and turns desyncs into
+                         silent retries.
+
+Both rules exempt m3_tpu/utils/retry.py itself (the primitives' own
+internals) — everything else needs a justified suppression.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set, Tuple
+
+from .core import Finding, Module, Rule, qualname
+
+_WIRE_IO = {"read_frame", "write_frame", "read_dict_frame"}
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_exempt(mod: Module) -> bool:
+    return mod.scope_parts[-2:] == ("utils", "retry.py")
+
+
+def _walk_no_nested_scopes(nodes) -> Iterator[ast.AST]:
+    """Descendants of the given statements, not entering nested function
+    or class scopes (their loops/handlers are analyzed on their own)."""
+    stack = list(nodes)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue  # yielded for visibility, but never descended into
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class RawSleepRetryRule(Rule):
+    """raw-sleep-retry: time.sleep in a loop that also try/excepts —
+    the hand-rolled fixed-delay retry loop; use utils.retry backoff."""
+
+    id = "raw-sleep-retry"
+    severity = "error"
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        if _is_exempt(mod):
+            return
+        seen: Set[int] = set()
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.While, ast.For)):
+                continue
+            body = list(node.body) + list(node.orelse)
+            sleeps: List[ast.Call] = []
+            has_try = False
+            for sub in _walk_no_nested_scopes(body):
+                if isinstance(sub, ast.Try):
+                    has_try = True
+                elif isinstance(sub, ast.Call) and \
+                        qualname(sub.func) == "time.sleep":
+                    sleeps.append(sub)
+            if not has_try:
+                continue
+            for call in sleeps:
+                if call.lineno in seen:
+                    continue
+                seen.add(call.lineno)
+                yield self.finding(
+                    mod, call,
+                    "raw time.sleep retry loop: fixed delays hammer dead "
+                    "endpoints or stall recovery — drive the wait from "
+                    "utils.retry (Retrier.attempt, or backoff_for for "
+                    "scheduled scans) and gate reconnects with a Breaker")
+
+
+class BroadExceptWireIORule(Rule):
+    """broad-except-wire-io: `except Exception`/bare except around direct
+    framed-wire I/O calls outside the retrier."""
+
+    id = "broad-except-wire-io"
+    severity = "error"
+
+    def _is_broad(self, handler: ast.ExceptHandler) -> bool:
+        t = handler.type
+        if t is None:
+            return True  # bare except
+        names = []
+        if isinstance(t, ast.Tuple):
+            names = [qualname(e) for e in t.elts]
+        else:
+            names = [qualname(t)]
+        return any(n is not None and n.split(".")[-1] in _BROAD
+                   for n in names)
+
+    def _wire_calls(self, try_node: ast.Try) -> List[Tuple[str, int]]:
+        out: List[Tuple[str, int]] = []
+        stack = list(try_node.body)
+        while stack:
+            sub = stack.pop()
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef, ast.Try)):
+                # nested scopes analyze separately; an inner try with its
+                # own (possibly typed) handlers owns its wire calls
+                continue
+            if isinstance(sub, ast.Call):
+                q = qualname(sub.func)
+                if q is not None:
+                    parts = q.split(".")
+                    if parts[-1] in _WIRE_IO and \
+                            (len(parts) == 1 or parts[-2] == "wire"):
+                        out.append((parts[-1], sub.lineno))
+            stack.extend(ast.iter_child_nodes(sub))
+        return out
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        if _is_exempt(mod):
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            calls = self._wire_calls(node)
+            if not calls:
+                continue
+            for handler in node.handlers:
+                if not self._is_broad(handler):
+                    continue
+                fn, line = calls[0]
+                yield Finding(
+                    self.id, mod.relpath, handler.lineno,
+                    f"broad except around wire.{fn} (line {line}): framed "
+                    "I/O fails typed (ConnectionError/WireTruncated, "
+                    "OSError, ValueError) and the retry/breaker layer "
+                    "classifies on those — catch the typed set or route "
+                    "through utils.retry",
+                    self.severity)
+
+
+RULES: List[Rule] = [RawSleepRetryRule(), BroadExceptWireIORule()]

@@ -29,15 +29,21 @@ from ..cluster.topology import (
 )
 from ..parallel.sharding import ShardSet
 from ..rpc import wire
+from ..utils.retry import (
+    Breaker,
+    BreakerOpen,
+    BreakerOptions,
+    Deadline,
+    DeadlineExceeded,
+    HostHealth,
+    Retrier,
+    RetryOptions,
+)
 from .decode import ConflictStrategy, merge_replica_points, series_points
 
 
 class ConsistencyError(Exception):
     """Not enough replica acks/responses to satisfy the consistency level."""
-
-
-class ConnectionError_(ConnectionError):
-    pass
 
 
 # ------------------------------------------------------------------ transport
@@ -46,17 +52,39 @@ class ConnectionError_(ConnectionError):
 class Connection:
     """One framed TCP connection (connection_pool.go conn)."""
 
-    def __init__(self, endpoint: str, timeout: float = 10.0):
+    def __init__(self, endpoint: str, connect_timeout: float = 10.0,
+                 request_timeout: float = 10.0):
         host, port = endpoint.rsplit(":", 1)
-        self.sock = socket.create_connection((host, int(port)), timeout=timeout)
+        self.sock = socket.create_connection((host, int(port)),
+                                             timeout=connect_timeout)
+        self.sock.settimeout(request_timeout)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.request_timeout = request_timeout
         self._msg_id = 0
 
-    def call(self, method: str, args: dict):
+    def call(self, method: str, args: dict,
+             deadline: Optional[Deadline] = None):
         self._msg_id += 1
-        wire.write_frame(self.sock, {"m": method, "id": self._msg_id, "a": args})
+        req = {"m": method, "id": self._msg_id, "a": args}
+        if deadline is not None:
+            deadline.check(method)
+            req[wire.DEADLINE_KEY] = deadline.to_wire()
+            # The read must give up when the BUDGET does, not at the
+            # connection's default request timeout past it.
+            self.sock.settimeout(deadline.min_timeout(self.request_timeout))
+        else:
+            self.sock.settimeout(self.request_timeout)
+        wire.write_frame(self.sock, req)
         try:
             resp = wire.read_dict_frame(self.sock)
+        except socket.timeout:
+            # The response may still land later: this stream is desynced
+            # for any further request/response pairing — drop it.
+            self.close()
+            if deadline is not None and deadline.expired:
+                raise DeadlineExceeded(f"{method}: deadline exceeded "
+                                       "waiting for reply")
+            raise
         except ValueError as e:
             # malformed reply = desync: this connection is unusable; close
             # it and surface a CONNECTION error so quorum fanout treats
@@ -64,6 +92,8 @@ class Connection:
             self.close()
             raise ConnectionError(f"node reply desync: {e}")
         if not resp.get("ok"):
+            if resp.get("kind") == "deadline":
+                raise DeadlineExceeded(resp.get("err", "deadline exceeded"))
             raise RemoteError(resp.get("err", "unknown remote error"))
         return resp["r"]
 
@@ -79,33 +109,126 @@ class RemoteError(Exception):
 
 
 class HostClient:
-    """Connection pool for one host (client/connection_pool.go)."""
+    """Connection pool for one host (client/connection_pool.go) fronted
+    by a circuit breaker and a retrier: transport failures retry with
+    backoff, repeated failures trip the breaker so a dead host is shed
+    instead of hammered, and a half-open probe restores it."""
 
-    def __init__(self, endpoint: str, pool_size: int = 4, timeout: float = 10.0):
+    def __init__(self, endpoint: str, pool_size: int = 4, timeout: float = 10.0,
+                 connect_timeout: Optional[float] = None,
+                 retry_opts: RetryOptions = RetryOptions(),
+                 breaker: Optional[Breaker] = None,
+                 on_outcome: Optional[Callable[[bool], None]] = None):
         self.endpoint = endpoint
         self.timeout = timeout
+        self.connect_timeout = timeout if connect_timeout is None else connect_timeout
+        self.breaker = breaker if breaker is not None else Breaker(name=endpoint)
+        self._on_outcome = on_outcome  # e.g. HostHealth.count
+        self.retrier = Retrier(retry_opts)
         self._free: List[Connection] = []
         self._lock = threading.Lock()
         self._sema = threading.Semaphore(pool_size)
 
-    def call(self, method: str, **args):
+    def _record(self, ok: bool):
+        if ok:
+            self.breaker.record_success()
+        else:
+            self.breaker.record_failure()
+        if self._on_outcome is not None:
+            self._on_outcome(ok)
+
+    def call(self, method: str, _deadline: Optional[Deadline] = None, **args):
+        return self.retrier.attempt(self._call_once, method, args,
+                                    _deadline, deadline=_deadline)
+
+    def _call_once(self, method: str, args: dict, deadline: Optional[Deadline]):
+        if self.breaker.state == Breaker.OPEN:
+            # fast shed: no pool-slot wait, no grant claimed
+            raise BreakerOpen(f"host {self.endpoint} shed by open breaker")
         with self._sema:
-            with self._lock:
-                conn = self._free.pop() if self._free else None
-            if conn is None:
-                conn = Connection(self.endpoint, self.timeout)
+            # Claim the breaker grant only once a pool slot is held: a
+            # half-open probe stuck waiting behind a busy pool would
+            # otherwise hold the ONLY probe slot while doing no probe
+            # I/O, shedding every other caller for the whole wait.
+            if not self.breaker.allow():
+                raise BreakerOpen(f"host {self.endpoint} shed by open breaker")
+            # Past allow(), EVERY exit must settle the grant exactly
+            # once — an unsettled exit leaks the half-open probe slot
+            # and wedges the breaker half-open forever (allow()'s
+            # contract).
+            recorded = [False]
+
+            def record(ok: bool):
+                if not recorded[0]:
+                    recorded[0] = True
+                    self._record(ok)
+
             try:
-                result = conn.call(method, args)
-            except RemoteError:
-                with self._lock:
-                    self._free.append(conn)
+                return self._call_on_conn(method, args, deadline, record)
+            except DeadlineExceeded as e:
+                if getattr(e, "pre_io", False) and not recorded[0]:
+                    # budget died in CLIENT-side queueing (retry backoff,
+                    # connect gate) before any bytes reached the host:
+                    # release the grant without blaming the endpoint
+                    recorded[0] = True
+                    self.breaker.cancel()
+                else:
+                    record(False)
                 raise
-            except Exception:
-                conn.close()
+            except BaseException:
+                record(False)  # safety net for paths the branches miss
                 raise
+
+    def _call_on_conn(self, method: str, args: dict,
+                      deadline: Optional[Deadline], record):
+        """One attempt on a pooled connection (pool semaphore + breaker
+        grant both held by _call_once)."""
+        with self._lock:
+            conn = self._free.pop() if self._free else None
+        if conn is None:
+            # the connect phase consumes deadline budget too: a
+            # blackholed host (SYN drop) must not stall a 100ms-budget
+            # call for the full connect timeout
+            ct = self.connect_timeout
+            if deadline is not None:
+                deadline.check(method)
+                ct = deadline.min_timeout(ct)
+            try:
+                conn = Connection(self.endpoint, ct, self.timeout)
+            except (OSError, ConnectionError):
+                record(False)
+                raise
+        try:
+            result = conn.call(method, args, deadline)
+        except RemoteError:
+            # The HOST is healthy — it parsed, ran, and answered; the
+            # application errored. Keep the connection and the breaker
+            # must not trip on it.
             with self._lock:
                 self._free.append(conn)
-            return result
+            record(True)
+            raise
+        except DeadlineExceeded as e:
+            # conn.call already dropped a desynced stream (reply never
+            # read); a server-relayed deadline frame leaves the stream
+            # synced and poolable. The breaker records a failure when
+            # the HOST burned the budget; a pre-I/O expiry (tagged by
+            # Deadline.check — budget died before any bytes went out)
+            # falls through for _call_once to cancel the grant.
+            if conn.sock.fileno() != -1:
+                with self._lock:
+                    self._free.append(conn)
+            if not getattr(e, "pre_io", False):
+                record(False)
+            raise
+        except Exception:
+            conn.close()
+            record(False)
+            raise
+        with self._lock:
+            self._free.append(conn)
+        record(True)
+        return result
 
     def health(self) -> bool:
         try:
@@ -192,7 +315,7 @@ class HostQueue:
     def enqueue(self, op: _WriteOp):
         with self._cond:
             if self._closed:
-                raise ConnectionError_("host queue closed")
+                raise ConnectionError("host queue closed")
             self._ops.append(op)
             self._cond.notify()
 
@@ -245,6 +368,25 @@ class SessionOptions:
     timeout_s: float = 30.0
     pool_size: int = 4
     max_batch: int = 256
+    # resilience knobs (no more hard-coded connect timeout): per-host
+    # transport retries, breaker trip/recovery, and connection timeouts.
+    # None = inherit timeout_s, preserving the pre-xresil behavior where
+    # the per-RPC socket timeout WAS the session timeout — a user setting
+    # only timeout_s must not be silently capped by a tighter default.
+    connect_timeout_s: Optional[float] = None
+    request_timeout_s: Optional[float] = None
+    retry: RetryOptions = RetryOptions(max_attempts=3, initial_backoff_s=0.05)
+    breaker: BreakerOptions = BreakerOptions()
+
+    @property
+    def effective_request_timeout_s(self) -> float:
+        return self.timeout_s if self.request_timeout_s is None \
+            else self.request_timeout_s
+
+    @property
+    def effective_connect_timeout_s(self) -> float:
+        return self.effective_request_timeout_s if self.connect_timeout_s \
+            is None else self.connect_timeout_s
 
 
 class Session:
@@ -253,6 +395,7 @@ class Session:
     def __init__(self, topology, opts: SessionOptions = SessionOptions()):
         self.topology = topology
         self.opts = opts
+        self.health = HostHealth(opts.breaker)  # per-endpoint breakers/stats
         self._clients: Dict[str, HostClient] = {}
         self._queues: Dict[str, HostQueue] = {}
         self._lock = threading.RLock()  # _queue -> _client nest on this lock
@@ -266,7 +409,7 @@ class Session:
     def _map(self):
         m = self.topology.get()
         if m is None:
-            raise ConnectionError_("no topology available")
+            raise ConnectionError("no topology available")
         return m
 
     def _shards(self) -> ShardSet:
@@ -281,7 +424,14 @@ class Session:
             if c is None or c.endpoint != host.endpoint:
                 if c is not None:
                     c.close()  # endpoint moved: release the old socket pool
-                c = HostClient(host.endpoint, self.opts.pool_size, self.opts.timeout_s)
+                ep = host.endpoint
+                c = HostClient(ep, self.opts.pool_size,
+                               self.opts.effective_request_timeout_s,
+                               connect_timeout=self.opts.effective_connect_timeout_s,
+                               retry_opts=self.opts.retry,
+                               breaker=self.health.breaker(ep),
+                               on_outcome=lambda ok, _ep=ep:
+                                   self.health.count(_ep, ok))
                 self._clients[host.id] = c
             return c
 
@@ -349,14 +499,18 @@ class Session:
         required = min(required_reads(self.opts.read_consistency, m.replica_factor),
                        len(hosts)) or 1
         results, errs = [], []
-        pending = {self._pool.submit(self._client(h).call, "fetch", ns=ns, id=id,
+        # One deadline bounds the whole quorum read and rides every RPC
+        # frame: a faulted/slow replica returns DeadlineExceeded instead
+        # of stalling past the caller's budget.
+        dl = Deadline.after(self.opts.timeout_s)
+        pending = {self._pool.submit(self._client(h).call, "fetch", _deadline=dl,
+                                     ns=ns, id=id,
                                      start_ns=start_ns, end_ns=end_ns) for h in hosts}
-        deadline = time.monotonic() + self.opts.timeout_s
         # Return as soon as the read consistency level is satisfied — a dead
         # replica must not stall a quorum-satisfiable read.
         while pending and len(results) < required:
             done, pending = futures_wait(
-                pending, timeout=max(0.0, deadline - time.monotonic()),
+                pending, timeout=max(0.0, dl.remaining()),
                 return_when=FIRST_COMPLETED)
             if not done:
                 break
@@ -395,13 +549,14 @@ class Session:
 
         results, errs = [], []
         ok_ids = set()
-        pending = {self._pool.submit(self._client(h).call, "fetch_tagged", ns=ns,
+        dl = Deadline.after(self.opts.timeout_s)
+        pending = {self._pool.submit(self._client(h).call, "fetch_tagged",
+                                     _deadline=dl, ns=ns,
                                      query=q, start_ns=start_ns, end_ns=end_ns,
                                      limit=limit): h for h in hosts}
-        deadline = time.monotonic() + self.opts.timeout_s
         while pending and not coverage_met(ok_ids):
             done, _ = futures_wait(
-                set(pending), timeout=max(0.0, deadline - time.monotonic()),
+                set(pending), timeout=max(0.0, dl.remaining()),
                 return_when=FIRST_COMPLETED)
             if not done:
                 break
@@ -555,7 +710,7 @@ class Session:
         m = self._map()
         host = m.hosts.get(host_id)
         if host is None:
-            raise ConnectionError_(f"unknown host {host_id}")
+            raise ConnectionError(f"unknown host {host_id}")
         return self._client(host).call("fetch_blocks", ns=ns, shard=shard,
                                        requests=requests)
 

@@ -28,6 +28,7 @@ import threading
 from typing import Callable, Dict, List, Optional
 
 from ..rpc import wire
+from ..utils.retry import Deadline, DeadlineExceeded, Retrier, RetryOptions
 from . import kv as cluster_kv
 
 
@@ -47,6 +48,15 @@ class KVServer:
                         if req.get("op") == "watch":
                             outer._serve_watch(self.request, req)
                             return  # connection is now a push stream
+                        # Per-request deadline: an expired budget answers
+                        # with a typed error instead of doing the work the
+                        # caller already stopped waiting for.
+                        deadline = wire.deadline_from_frame(req)
+                        if deadline is not None and deadline.expired:
+                            wire.write_frame(self.request, {
+                                "ok": False, "kind": "deadline",
+                                "err": f"kv {req.get('op')}: deadline exceeded"})
+                            continue
                         wire.write_frame(self.request, outer._handle(req))
                 except (ConnectionError, OSError, EOFError, ValueError):
                     # ValueError = malformed frame: stream desync, drop conn
@@ -131,9 +141,16 @@ class KVServer:
 class RemoteStore:
     """Client to a KVServer; drop-in for MemStore across processes."""
 
-    def __init__(self, endpoint: str, timeout: float = 10.0):
+    def __init__(self, endpoint: str, timeout: float = 10.0,
+                 retry_opts: Optional[RetryOptions] = None):
         self._endpoint = endpoint
         self._timeout = timeout
+        # READ retries only: get/keys are side-effect free, so the retrier
+        # may re-send them across reconnects with backoff. Mutations stay
+        # strictly at-most-once (see _request).
+        self._read_retrier = Retrier(retry_opts if retry_opts is not None
+                                     else RetryOptions(max_attempts=3,
+                                                       initial_backoff_s=0.05))
         self._lock = threading.Lock()     # guards the request connection
         self._sock: Optional[socket.socket] = None
         self._watch_lock = threading.Lock()
@@ -145,65 +162,88 @@ class RemoteStore:
 
     # -- request/response --------------------------------------------------
 
-    def _connect(self) -> socket.socket:
+    def _connect(self, timeout: Optional[float] = None) -> socket.socket:
         host, _, port = self._endpoint.rpartition(":")
-        s = socket.create_connection((host, int(port)), timeout=self._timeout)
+        s = socket.create_connection(
+            (host, int(port)),
+            timeout=self._timeout if timeout is None else timeout)
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         return s
 
-    def _request(self, req: dict) -> dict:
+    def _request(self, req: dict, deadline: Optional[Deadline] = None) -> dict:
         read_only = req.get("op") in ("get", "keys")
-        with self._lock:
-            for attempt in range(2):  # one reconnect attempt
-                try:
-                    if self._sock is None:
-                        # reconnect inside the same serialized exchange
-                        # (see I/O note below); bounded by the same timeout
-                        self._sock = self._connect()  # m3lint: disable=lock-held-blocking-call
-                    # DELIBERATE I/O under _lock: this lock exists to
-                    # serialize whole request/response exchanges on the
-                    # single pooled socket — interleaved frames from two
-                    # threads would desync the stream. Latency is bounded
-                    # by the connect/read timeout set in _connect.
-                    wire.write_frame(self._sock, req)  # m3lint: disable=lock-held-blocking-call
-                    try:
-                        resp = wire.read_dict_frame(self._sock)  # m3lint: disable=lock-held-blocking-call
-                    except ValueError as e:
-                        # malformed reply = stream desync: the pooled
-                        # socket is unusable; surface as a CONNECTION
-                        # error so it can never collide with the
-                        # CAS-mismatch ValueError contract below.
-                        raise ConnectionError(f"kv reply desync: {e}")
-                    break
-                except (ConnectionError, OSError, EOFError):
-                    if self._sock is not None:
-                        try:
-                            self._sock.close()
-                        except OSError:
-                            pass
-                        self._sock = None
-                    # Only reads retry. A failed mutation is never re-sent:
-                    # whether the failure hit a stale pooled socket or ate
-                    # the reply mid-request is indistinguishable without
-                    # request IDs, and in the latter case the server already
-                    # applied it — a blind re-send double-applies a set or
-                    # fails a CAS that in fact won. Surface the error; the
-                    # caller re-reads state to recover (at-most-once, as
-                    # with etcd client errors).
-                    if attempt == 1 or not read_only:
-                        raise
+        if read_only:
+            # Reads ride the retrier: reconnect + backoff per attempt,
+            # bounded by max_attempts and the optional deadline.
+            resp = self._read_retrier.attempt(self._exchange, req, deadline,
+                                              deadline=deadline)
+        else:
+            # A failed mutation is never re-sent: whether the failure hit
+            # a stale pooled socket or ate the reply mid-request is
+            # indistinguishable without request IDs, and in the latter
+            # case the server already applied it — a blind re-send
+            # double-applies a set or fails a CAS that in fact won.
+            # Surface the error; the caller re-reads state to recover
+            # (at-most-once, as with etcd client errors).
+            resp = self._exchange(req, deadline)
         if resp.get("ok"):
             return resp
+        if resp.get("kind") == "deadline":
+            raise DeadlineExceeded(resp.get("err", "kv deadline exceeded"))
         if resp.get("kind") == "exists":
             raise KeyError(resp.get("err", "exists"))
         if resp.get("kind") == "cas":
             raise ValueError(resp.get("err", "version mismatch"))
         raise RuntimeError(resp.get("err", "kv protocol error"))
 
+    def _exchange(self, req: dict, deadline: Optional[Deadline] = None) -> dict:
+        """One serialized request/response exchange on the pooled socket."""
+        with self._lock:
+            try:
+                if deadline is not None:
+                    deadline.check(f"kv {req.get('op')}")
+                if self._sock is None:
+                    # reconnect inside the same serialized exchange (see
+                    # I/O note below); the CONNECT phase is capped by the
+                    # remaining budget too, not just the reads
+                    self._sock = self._connect(  # m3lint: disable=lock-held-blocking-call
+                        None if deadline is None
+                        else deadline.min_timeout(self._timeout))
+                if deadline is not None:
+                    req = dict(req)
+                    req[wire.DEADLINE_KEY] = deadline.to_wire()
+                    self._sock.settimeout(deadline.min_timeout(self._timeout))
+                # DELIBERATE I/O under _lock: this lock exists to
+                # serialize whole request/response exchanges on the
+                # single pooled socket — interleaved frames from two
+                # threads would desync the stream. Latency is bounded
+                # by the connect/read timeout set in _connect.
+                wire.write_frame(self._sock, req)  # m3lint: disable=lock-held-blocking-call
+                try:
+                    return wire.read_dict_frame(self._sock)  # m3lint: disable=lock-held-blocking-call
+                except ValueError as e:
+                    # malformed reply = stream desync: the pooled
+                    # socket is unusable; surface as a CONNECTION
+                    # error so it can never collide with the
+                    # CAS-mismatch ValueError contract in _request.
+                    raise ConnectionError(f"kv reply desync: {e}")
+            except (ConnectionError, OSError, EOFError):
+                if self._sock is not None:
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    self._sock = None
+                raise
+            finally:
+                if deadline is not None and self._sock is not None:
+                    self._sock.settimeout(self._timeout)
+
     # -- MemStore surface --------------------------------------------------
 
-    def get(self, key: str) -> Optional[cluster_kv.Value]:
-        r = self._request({"op": "get", "key": key})
+    def get(self, key: str,
+            deadline: Optional[Deadline] = None) -> Optional[cluster_kv.Value]:
+        r = self._request({"op": "get", "key": key}, deadline)
         if r["version"] == 0 and r["data"] is None:
             return None
         return cluster_kv.Value(r["data"], r["version"])
@@ -224,8 +264,9 @@ class RemoteStore:
             return None
         return cluster_kv.Value(r["data"], r["version"])
 
-    def keys(self, prefix: str = "") -> List[str]:
-        return self._request({"op": "keys", "prefix": prefix})["keys"]
+    def keys(self, prefix: str = "",
+             deadline: Optional[Deadline] = None) -> List[str]:
+        return self._request({"op": "keys", "prefix": prefix}, deadline)["keys"]
 
     # -- watches -----------------------------------------------------------
 
@@ -275,6 +316,11 @@ class RemoteStore:
         version so missed intermediate versions collapse into one event
         (same coalescing etcd watches exhibit under reconnect)."""
         last = 0
+        # Reconnect backoff schedule (was a flat 0.2s): consecutive
+        # failures back off exponentially, any successful frame resets.
+        backoff = Retrier(RetryOptions(initial_backoff_s=0.1,
+                                       backoff_factor=2.0, max_backoff_s=2.0))
+        failures = 0
         while not self._closed:
             try:
                 s = self._connect()
@@ -285,6 +331,7 @@ class RemoteStore:
                                      "from_version": last})
                 while not self._closed:
                     ev = wire.read_dict_frame(s)
+                    failures = 0  # live stream: reset the reconnect backoff
                     if ev.get("heartbeat"):
                         continue
                     last = ev["version"]
@@ -323,7 +370,8 @@ class RemoteStore:
                 # runtime-option delivery for every watcher of the key).
                 if self._closed:
                     return
-                threading.Event().wait(0.2)  # backoff, then reconnect
+                failures += 1
+                threading.Event().wait(backoff.backoff_for(failures))
 
     def close(self):
         self._closed = True

@@ -3,7 +3,8 @@ src/msg/consumer/{consumer,handlers}.go — proto-framed Message/Ack exchange,
 the handler acks after processing so redelivery stops).
 
 Wire messages ride the shared framed codec (m3_tpu.rpc.wire):
-  {"t": "msg", "shard": i64, "id": i64, "sent_at": i64, "value": bytes}
+  {"t": "msg", "shard": i64, "id": i64, "sent_at": i64, "value": bytes,
+   "src": i64?}                     ("src" = producer identity, optional)
   {"t": "ack", "ids": [i64, ...]}   (consumer -> producer, batched)
 """
 
@@ -13,6 +14,7 @@ import socket
 import socketserver
 import traceback
 import threading
+from collections import deque
 from typing import Callable, List, Optional
 
 from ..rpc import wire
@@ -24,10 +26,55 @@ class Consumer:
 
     def __init__(self, handler: Callable[[int, bytes], None],
                  host: str = "127.0.0.1", port: int = 0,
-                 ack_batch: int = 1):
+                 ack_batch: int = 1, dedup_window: int = 4096):
         self._handler = handler
         self._ack_batch = ack_batch
+        # Recently ACKED message ids (bounded FIFO shared across producer
+        # connections): a duplicated wire delivery — faultnet duplicate
+        # injection, or a producer retry racing an in-flight ack — is
+        # re-ACKED without re-invoking the handler, so redelivery cannot
+        # double-count in the aggregator. Ids whose handler FAILED were
+        # never recorded here, so genuine at-least-once redelivery still
+        # reprocesses them. The IN-FLIGHT set closes the race where a
+        # redelivery (new connection) arrives while the first handler
+        # invocation is still running: the copy is dropped UNACKED — if
+        # the running handler succeeds its own ack covers the id, if it
+        # fails the producer redelivers later, so at-least-once holds.
+        # Keys are (producer src, message id): src is the random identity
+        # each producer stamps on its frames, so a RESTARTED producer
+        # reusing ids 0..N can never collide into a silent drop; frames
+        # without src fall back to a per-connection token (dedup then
+        # covers same-connection wire duplicates only).
+        self._dedup_lock = threading.Lock()
+        self._acked_ids = set()
+        self._acked_fifo: "deque" = deque(maxlen=max(1, dedup_window))
+        self._inflight_ids = set()
+        self._conn_counter = [0]
+        self.duplicates_dropped = 0
         outer = self
+
+        # begin -> "acked" (re-ack, skip handler) | "inflight" (drop,
+        # no ack) | "new" (claimed: run the handler, then settle)
+        def _begin(key) -> str:
+            with outer._dedup_lock:
+                if key in outer._acked_ids:
+                    outer.duplicates_dropped += 1
+                    return "acked"
+                if key in outer._inflight_ids:
+                    outer.duplicates_dropped += 1
+                    return "inflight"
+                outer._inflight_ids.add(key)
+                return "new"
+
+        def _settle(key, ok: bool):
+            with outer._dedup_lock:
+                outer._inflight_ids.discard(key)
+                if not ok:
+                    return
+                if len(outer._acked_fifo) == outer._acked_fifo.maxlen:
+                    outer._acked_ids.discard(outer._acked_fifo[0])
+                outer._acked_fifo.append(key)
+                outer._acked_ids.add(key)
 
         class _Handler(socketserver.BaseRequestHandler):
             def handle(self):
@@ -35,6 +82,9 @@ class Consumer:
 
                 sock = self.request
                 pending_acks: List[int] = []
+                with outer._dedup_lock:
+                    outer._conn_counter[0] += 1
+                    conn_token = ("conn", outer._conn_counter[0])
 
                 def flush():
                     nonlocal pending_acks
@@ -59,6 +109,22 @@ class Consumer:
                         mid = frame.get("id")
                         if shard is None or value is None or mid is None:
                             return  # protocol error, not an app error: drop
+                        src = frame.get("src")
+                        key = (src if src is not None else conn_token, mid)
+                        state = _begin(key)
+                        if state == "inflight":
+                            # another connection's handler is mid-run for
+                            # this id: drop this copy UNACKED (its peer's
+                            # outcome decides; redelivery covers failure)
+                            continue
+                        if state == "acked":
+                            # duplicate delivery of a processed message:
+                            # re-ack (the producer may have lost the first
+                            # ack) but DO NOT re-run the handler.
+                            pending_acks.append(mid)
+                            if len(pending_acks) >= outer._ack_batch:
+                                flush()
+                            continue
                         try:
                             outer._handler(shard, value)
                         except Exception:  # noqa: BLE001 - app error, not desync
@@ -67,8 +133,15 @@ class Consumer:
                             # producer's retry-until-ack redelivers
                             # (at-least-once), and the connection (whose
                             # framing is intact) stays up.
+                            _settle(key, ok=False)
                             traceback.print_exc()
                             continue
+                        except BaseException:
+                            # dying thread: release the in-flight claim or
+                            # the id's redeliveries are dropped forever
+                            _settle(key, ok=False)
+                            raise
+                        _settle(key, ok=True)
                         pending_acks.append(mid)
                         if len(pending_acks) >= outer._ack_batch:
                             flush()

@@ -13,17 +13,19 @@ the same tradeoff the reference makes.
 
 from __future__ import annotations
 
+import random as _random
 import threading
 import time
 from typing import Callable, Dict, List, Optional
 
 from ..cluster.placement import Placement, ShardState
 from ..rpc import wire
+from ..utils.retry import Breaker, BreakerOptions, Retrier, RetryOptions
 from .topic import ConsumptionType, Topic
 
 
 class _Message:
-    __slots__ = ("id", "shard", "value", "refs", "size", "sent_at")
+    __slots__ = ("id", "shard", "value", "refs", "size")
 
     def __init__(self, mid: int, shard: int, value: bytes, refs: int):
         self.id = mid
@@ -31,18 +33,53 @@ class _Message:
         self.value = value
         self.refs = refs
         self.size = len(value)
-        self.sent_at = 0
+
+
+class _Tracked:
+    """Per-WRITER send state for one message. The _Message itself is
+    shared across every consumer service's writer (ref-counted), so
+    redelivery state must live here: writer A's successful send must not
+    push writer B's first delivery down B's backoff schedule."""
+
+    __slots__ = ("msg", "due_at", "attempts")
+
+    def __init__(self, msg: _Message):
+        self.msg = msg
+        self.due_at = 0    # monotonic ns when the next resend is due
+        self.attempts = 0  # this writer's frame writes; drives its backoff
+
+
+def _writer_breaker_opts(retry_delay_s: float) -> BreakerOptions:
+    """Breaker tuned to the writer's retry cadence: trips after a burst
+    of connect/send failures, probes again after a few retry ticks."""
+    return BreakerOptions(window=8, failure_ratio=0.5, min_samples=4,
+                          cooldown_s=max(0.25, 2.0 * retry_delay_s))
 
 
 class MessageWriter:
     """Per-connection write loop with ack tracking (writer/message_writer.go):
-    messages stay queued until acked; a retry pass rewrites everything unacked
-    older than the retry delay."""
+    messages stay queued until acked; the retry pass resends each message
+    on its OWN exponential-backoff schedule (attempt n redelivers after
+    backoff(n), not a flat cutoff), and a breaker stops the pass from
+    hammering a dead consumer endpoint with reconnects."""
 
     def __init__(self, connect: Callable[[], "wire.socket.socket"],
-                 retry_delay_s: float = 0.2):
+                 retry_delay_s: float = 0.2,
+                 retry_opts: Optional[RetryOptions] = None,
+                 breaker_opts: Optional[BreakerOptions] = None,
+                 src: Optional[int] = None):
         self._connect = connect
         self._retry_delay_s = retry_delay_s
+        self._src = src  # producer identity riding each frame (dedup key)
+        # backoff_for() only — the scheduled scan IS the retry loop, so
+        # the Retrier here is the schedule, not the driver.
+        self._backoff = Retrier(retry_opts if retry_opts is not None
+                                else RetryOptions(
+                                    initial_backoff_s=retry_delay_s,
+                                    backoff_factor=2.0,
+                                    max_backoff_s=32.0 * retry_delay_s))
+        self._breaker = Breaker(breaker_opts if breaker_opts is not None
+                                else _writer_breaker_opts(retry_delay_s))
         self._lock = threading.Lock()
         # Serializes every socket write + connect/drop: publish() and the
         # producer's background retry pass both call _send on this writer,
@@ -50,7 +87,7 @@ class MessageWriter:
         # protocol at the consumer (and a connect race would leak a socket
         # plus its ack-reader thread).
         self._io_lock = threading.Lock()
-        self._queue: Dict[int, _Message] = {}
+        self._queue: Dict[int, _Tracked] = {}
         self._sock = None
         self._reader: Optional[threading.Thread] = None
         self._closed = False
@@ -60,24 +97,37 @@ class MessageWriter:
 
     def write(self, msg: _Message):
         with self._lock:
-            self._queue[msg.id] = msg
-        self._send(msg)
+            # dict.setdefault (not .get) also keeps m3lint's queue-get
+            # heuristic from reading this dict named _queue as a Queue
+            t = self._queue.setdefault(msg.id, _Tracked(msg))
+        self._send(t)
 
     def _ensure_conn(self) -> bool:
         if self._closed:
             return False  # a late retry pass must not reconnect after close
         if self._sock is not None:
             return True
+        # Breaker gate on RECONNECT only (an established connection keeps
+        # sending): once the endpoint has eaten its failure budget, retry
+        # passes stop paying for refused connects until the cooldown probe.
+        if not self._breaker.allow():
+            return False
         try:
             self._sock = self._connect()
-        except OSError:
+        except Exception:  # noqa: BLE001 — user-supplied connect callable
+            # ANY connect failure must record the outcome: allow() may
+            # have granted the single half-open probe slot, and an
+            # unrecorded exit would wedge the breaker half-open.
             self._sock = None
+            self._breaker.record_failure()
             return False
+        self._breaker.record_success()
         self._reader = threading.Thread(target=self._read_acks, daemon=True)
         self._reader.start()
         return True
 
-    def _send(self, msg: _Message) -> bool:
+    def _send(self, t: _Tracked) -> bool:
+        msg = t.msg
         with self._io_lock:
             if not self._ensure_conn():
                 return False
@@ -86,13 +136,25 @@ class MessageWriter:
                 # serializing frame writes on the shared connection so two
                 # writers can't interleave a frame; queue state uses the
                 # separate _lock, which is never held here.
-                wire.write_frame(self._sock, {  # m3lint: disable=lock-held-blocking-call
+                frame = {
                     "t": "msg", "shard": msg.shard, "id": msg.id,
                     "sent_at": time.monotonic_ns(), "value": msg.value,
-                })
-                msg.sent_at = time.monotonic_ns()
+                }
+                if self._src is not None:
+                    # producer identity: consumers key duplicate-delivery
+                    # dedup on (src, id) so a RESTARTED producer reusing
+                    # ids 0..N can never collide into a silent drop
+                    frame["src"] = self._src
+                wire.write_frame(self._sock, frame)  # m3lint: disable=lock-held-blocking-call
+                t.attempts += 1
+                # The due time is rolled ONCE per send (jitter included):
+                # the scan below is then one integer compare per message,
+                # and a re-rolled jitter can't fire a resend early.
+                t.due_at = time.monotonic_ns() + int(
+                    self._backoff.backoff_for(t.attempts) * 1e9)
                 return True
             except OSError:
+                self._breaker.record_failure()
                 self._drop_conn_locked()
                 return False
 
@@ -117,12 +179,17 @@ class MessageWriter:
                     continue
                 ids = frame.get("ids") or ()
                 with self._lock:
-                    msgs = [self._queue.pop(i) for i in ids if i in self._queue]
-                for m in msgs:
+                    acked = [self._queue.pop(i) for i in ids
+                             if i in self._queue]
+                for t in acked:
                     self.acked += 1
                     if self._on_ack is not None:
-                        self._on_ack(m)
-        except Exception:  # noqa: BLE001 - reader exit = connection reset
+                        self._on_ack(t.msg)
+        except (ConnectionError, OSError, ValueError):
+            # the typed transport set: reset/truncation, socket errors,
+            # malformed ack frame (desync) — all mean this stream is done.
+            # Anything ELSE is a real bug in ack handling and should
+            # surface loudly, not be eaten as a fake connection reset.
             pass
         finally:
             # A dead ack reader MUST take the connection with it: leaving
@@ -136,14 +203,22 @@ class MessageWriter:
                     self._drop_conn_locked()
 
     def retry_unacked(self):
-        """One retry pass (message_writer.go scanMessageQueue)."""
-        cutoff = time.monotonic_ns() - int(self._retry_delay_s * 1e9)
+        """One retry pass (message_writer.go scanMessageQueue). A message
+        is due when its per-message backoff has elapsed: attempt n waits
+        backoff(n) after the n-th send (due_at, stamped at send time), so
+        a hot-looping pump cannot flat-resend the whole queue every tick
+        and the scan stays one integer compare per queued message."""
+        now = time.monotonic_ns()
         with self._lock:
-            stale = [m for m in self._queue.values() if m.sent_at <= cutoff]
-        for m in stale:
+            stale = [t for t in self._queue.values() if now >= t.due_at]
+        for t in stale:
             self.retried += 1
-            if not self._send(m):
+            if not self._send(t):
                 break
+
+    @property
+    def breaker(self) -> Breaker:
+        return self._breaker
 
     def unacked(self) -> int:
         with self._lock:
@@ -151,11 +226,12 @@ class MessageWriter:
 
     def unacked_messages(self) -> List[_Message]:
         with self._lock:
-            return list(self._queue.values())
+            return [t.msg for t in self._queue.values()]
 
     def forget(self, mid: int) -> Optional[_Message]:
         with self._lock:
-            return self._queue.pop(mid, None)
+            t = self._queue.pop(mid, None)
+            return t.msg if t is not None else None
 
     def close(self):
         self._closed = True
@@ -170,11 +246,17 @@ class ConsumerServiceWriter:
     def __init__(self, service_id: str,
                  placement_getter: Callable[[], Optional[Placement]],
                  connect: Callable[[str], "wire.socket.socket"],
-                 retry_delay_s: float = 0.2):
+                 retry_delay_s: float = 0.2,
+                 retry_opts: Optional[RetryOptions] = None,
+                 breaker_opts: Optional[BreakerOptions] = None,
+                 src: Optional[int] = None):
         self.service_id = service_id
         self._placement = placement_getter
         self._connect = connect
         self._retry_delay_s = retry_delay_s
+        self._retry_opts = retry_opts
+        self._breaker_opts = breaker_opts
+        self._src = src
         self._writers: Dict[str, MessageWriter] = {}
         self._on_ack: Optional[Callable[[_Message], None]] = None
         # Messages with no routable instance yet (placement missing or shard
@@ -187,7 +269,11 @@ class ConsumerServiceWriter:
     def _writer_for(self, endpoint: str) -> MessageWriter:
         w = self._writers.get(endpoint)
         if w is None:
-            w = MessageWriter(lambda: self._connect(endpoint), self._retry_delay_s)
+            w = MessageWriter(lambda: self._connect(endpoint),
+                              self._retry_delay_s,
+                              retry_opts=self._retry_opts,
+                              breaker_opts=self._breaker_opts,
+                              src=self._src)
             w._on_ack = self._on_ack
             self._writers[endpoint] = w
         return w
@@ -244,7 +330,9 @@ class Producer:
                  service_placements: Dict[str, Callable[[], Optional[Placement]]],
                  connect: Callable[[str], "wire.socket.socket"] = None,
                  max_buffer_bytes: int = 64 * 1024 * 1024,
-                 retry_delay_s: float = 0.2):
+                 retry_delay_s: float = 0.2,
+                 retry_opts: Optional[RetryOptions] = None,
+                 breaker_opts: Optional[BreakerOptions] = None):
         self.topic = topic
         self._retry_delay_s = retry_delay_s
         self._next_id = 0
@@ -255,9 +343,16 @@ class Producer:
         # drop-oldest pops the front and acks remove in O(1).
         self._order: Dict[int, _Message] = {}
         connect = connect or _default_connect
+        # Random producer identity (63-bit): rides every frame so the
+        # consumer's duplicate-delivery dedup can never confuse THIS
+        # producer's id space with a restarted/parallel producer's.
+        self._src = _random.getrandbits(63)
         self._service_writers = [
             ConsumerServiceWriter(cs.service_id, service_placements[cs.service_id],
-                                  connect, retry_delay_s)
+                                  connect, retry_delay_s,
+                                  retry_opts=retry_opts,
+                                  breaker_opts=breaker_opts,
+                                  src=self._src)
             for cs in topic.consumer_services
         ]
         for w in self._service_writers:
@@ -321,7 +416,12 @@ class Producer:
 
     def _retry_loop(self):
         while not self._closed:
-            time.sleep(self._retry_delay_s)
+            # DELIBERATE fixed cadence: this is the SCAN SCHEDULER, not
+            # the retry policy — each message's due time comes from its
+            # own exponential backoff schedule in retry_unacked, and the
+            # writers' breakers gate reconnects. (message_writer.go's
+            # scanMessageQueue ticks the same way.)
+            time.sleep(self._retry_delay_s)  # m3lint: disable=raw-sleep-retry
             if self._closed:
                 return
             try:

@@ -16,6 +16,15 @@ from typing import Dict, Optional, Sequence
 import numpy as np
 
 from ..rpc import wire
+from ..utils.retry import (
+    Breaker,
+    BreakerOpen,
+    Deadline,
+    DeadlineExceeded,
+    Retrier,
+    RetryOptions,
+    default_is_retryable,
+)
 from .model import Matcher, MatchType
 
 
@@ -40,7 +49,15 @@ class RemoteStorageServer:
                     while True:
                         req = wire.read_dict_frame(self.request)
                         try:
+                            # Per-request deadline: a federated fetch whose
+                            # caller stopped waiting must not run to
+                            # completion against local storage.
+                            deadline = wire.deadline_from_frame(req)
+                            if deadline is not None:
+                                deadline.check(str(req.get("method")))
                             resp = outer._dispatch(req)
+                        except DeadlineExceeded as e:
+                            resp = {"err": str(e), "kind": "deadline"}
                         except Exception as e:  # noqa: BLE001
                             resp = {"err": str(e)}
                         wire.write_frame(self.request, resp)
@@ -88,50 +105,109 @@ class RemoteStorage:
     coordinator (tsdb/remote/client.go); drop it into FanoutStorage next
     to local stores for cross-cluster reads."""
 
-    def __init__(self, endpoint: str, timeout_s: float = 10.0):
+    def __init__(self, endpoint: str, timeout_s: float = 10.0,
+                 retry_opts: Optional[RetryOptions] = None,
+                 breaker: Optional[Breaker] = None):
         self._endpoint = endpoint
         self._timeout_s = timeout_s
         self._lock = threading.Lock()
         self._sock = None
+        # Desync (ValueError) IS retryable here — unlike mid-stream
+        # protocol users — because _exchange drops the connection first,
+        # so the re-attempt runs on a fresh stream; this storage's writes
+        # are idempotent, so re-sending a maybe-applied request is safe.
+        self._retrier = Retrier(
+            retry_opts if retry_opts is not None
+            else RetryOptions(max_attempts=2, initial_backoff_s=0.05),
+            is_retryable=lambda e: (isinstance(e, ValueError)
+                                    or default_is_retryable(e)))
+        self._breaker = breaker if breaker is not None else Breaker(
+            name=endpoint)
 
-    def _call(self, req: dict) -> dict:
-        with self._lock:
-            for _ in range(2):
-                try:
-                    sock = self._ensure_conn()
-                    wire.write_frame(sock, req)
-                    resp = wire.read_dict_frame(sock)
-                    break
-                except (OSError, ValueError):
-                    # ValueError = malformed reply (desync): same reset
-                    self._drop_conn()
-            else:
-                raise ConnectionError(f"remote storage {self._endpoint} unreachable")
+    def _call(self, req: dict, deadline: Optional[Deadline] = None) -> dict:
+        resp = self._retrier.attempt(self._exchange, req, deadline,
+                                     deadline=deadline)
         if "err" in resp:
+            if resp.get("kind") == "deadline":
+                raise DeadlineExceeded(resp["err"])
             raise RuntimeError(f"remote storage error: {resp['err']}")
         return resp
 
+    def _exchange(self, req: dict, deadline: Optional[Deadline]) -> dict:
+        """One serialized request/response exchange; transport errors are
+        surfaced typed so the retrier classifies them (a malformed reply
+        stays a ValueError — desync, NOT retryable on this stream, but the
+        connection is dropped so the next attempt starts clean)."""
+        if not self._breaker.allow():
+            raise BreakerOpen(f"remote storage {self._endpoint} shed")
+        # From here EVERY exit must settle the allow() grant, or a granted
+        # half-open probe slot leaks and the breaker wedges half-open.
+        try:
+            resp = self._exchange_locked(req, deadline)
+        except DeadlineExceeded:
+            # Always pre-I/O here (the budget died waiting on the LOCAL
+            # serialized-exchange lock — endpoint-side expiry surfaces as
+            # a socket timeout/OSError instead): release the grant but
+            # don't blame a host we never reached.
+            self._breaker.cancel()
+            raise
+        except BaseException:
+            self._breaker.record_failure()
+            raise
+        self._breaker.record_success()
+        return resp
+
+    def _exchange_locked(self, req: dict, deadline: Optional[Deadline]) -> dict:
+        with self._lock:
+            try:
+                if deadline is not None:
+                    deadline.check("remote storage")
+                # connect phase capped by the remaining budget as well
+                sock = self._ensure_conn(
+                    None if deadline is None
+                    else deadline.min_timeout(self._timeout_s))
+                if deadline is not None:
+                    req = dict(req)
+                    req[wire.DEADLINE_KEY] = deadline.to_wire()
+                    sock.settimeout(deadline.min_timeout(self._timeout_s))
+                else:
+                    sock.settimeout(self._timeout_s)
+                wire.write_frame(sock, req)  # m3lint: disable=lock-held-blocking-call
+                return wire.read_dict_frame(sock)  # m3lint: disable=lock-held-blocking-call
+            except (OSError, ValueError, ConnectionError):
+                # OSError covers socket.timeout; either way the stream may
+                # carry a late reply — unusable for the next exchange.
+                self._drop_conn()
+                raise
+
     def fetch_raw(self, matchers: Sequence[Matcher], start_ns: int,
-                  end_ns: int) -> Dict[bytes, dict]:
+                  end_ns: int, deadline: Optional[Deadline] = None
+                  ) -> Dict[bytes, dict]:
         resp = self._call({"method": "fetch_raw",
                            "matchers": _matchers_to_wire(matchers),
-                           "start": start_ns, "end": end_ns})
+                           "start": start_ns, "end": end_ns}, deadline)
         return {
             e["id"]: {"tags": e["tags"], "t": e["times"], "v": e["values"]}
             for e in resp["series"]
         }
 
-    def write(self, series_id: bytes, tags, t_ns: int, value: float):
+    def write(self, series_id: bytes, tags, t_ns: int, value: float,
+              deadline: Optional[Deadline] = None):
+        """Datapoint writes are idempotent (replica merge dedups on
+        timestamp), so the retrier may safely re-send one that failed
+        mid-exchange — unlike the KV store's mutations."""
         self._call({"method": "write", "id": series_id, "tags": dict(tags),
-                    "time": t_ns, "value": value})
+                    "time": t_ns, "value": value}, deadline)
 
-    def _ensure_conn(self):
+    def _ensure_conn(self, connect_timeout: Optional[float] = None):
         if self._sock is None:
             import socket as _socket
 
             host, _, port = self._endpoint.rpartition(":")
             self._sock = _socket.create_connection(
-                (host, int(port)), timeout=self._timeout_s)
+                (host, int(port)),
+                timeout=self._timeout_s if connect_timeout is None
+                else connect_timeout)
             self._sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
         return self._sock
 

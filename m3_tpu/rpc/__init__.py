@@ -5,7 +5,9 @@ the thrift `Node` service IDL (src/dbnode/generated/thrift/rpc.thrift)."""
 
 from .node_server import NodeServer, NodeService, RPCError
 from .wire import (
+    WireTruncated,
     decode,
+    deadline_from_frame,
     encode,
     query_from_wire,
     query_to_wire,
@@ -17,6 +19,8 @@ __all__ = [
     "NodeServer",
     "NodeService",
     "RPCError",
+    "WireTruncated",
+    "deadline_from_frame",
     "decode",
     "encode",
     "query_from_wire",

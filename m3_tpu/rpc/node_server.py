@@ -22,6 +22,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..storage.database import Database
+from ..utils.retry import Deadline, DeadlineExceeded
 from . import wire
 
 
@@ -35,14 +36,33 @@ class NodeService:
     def __init__(self, db: Database):
         self.db = db
         self.start_ns = time.time_ns()
+        # Per-request deadline, thread-local because the ThreadingTCPServer
+        # dispatches each connection on its own thread: rpc_* methods read
+        # it to bail out of long loops once the caller's budget is gone.
+        self._local = threading.local()
 
     # --------------------------------------------------------------- dispatch
 
-    def dispatch(self, method: str, args: dict):
+    def dispatch(self, method: str, args: dict,
+                 deadline: Optional[Deadline] = None):
         fn = getattr(self, "rpc_" + method, None)
         if fn is None:
             raise RPCError(f"unknown method {method!r}")
-        return fn(**args)
+        # Check BEFORE the work: a request whose budget is already spent
+        # in queueing/transit must not run an expensive fetch whose result
+        # the caller stopped waiting for.
+        if deadline is not None:
+            deadline.check(method)
+        self._local.deadline = deadline
+        try:
+            return fn(**args)
+        finally:
+            self._local.deadline = None
+
+    def _check_deadline(self, what: str):
+        dl = getattr(self._local, "deadline", None)
+        if dl is not None:
+            dl.check(what)
 
     # ----------------------------------------------------------------- health
 
@@ -108,6 +128,10 @@ class NodeService:
             ids = ids[:limit]
         out = []
         for sid in ids:
+            # Mid-loop budget check: fetch_tagged is the expensive fan-in
+            # (per-series segment snapshots); a dead caller's request must
+            # stop here, not run the whole result set to completion.
+            self._check_deadline("fetch_tagged")
             shard_id = self.db.shard_set.lookup(sid)
             shard = nsobj.shards.get(shard_id)
             if shard is None:
@@ -261,10 +285,28 @@ class NodeServer:
                     while True:
                         req = wire.read_dict_frame(sock)
                         msg_id = req.get("id", 0)
+                        # Optional deadline budget (ns remaining at send
+                        # time) rides the request frame as "d"; re-anchored
+                        # on this host's monotonic clock.
+                        deadline = wire.deadline_from_frame(req)
                         try:
-                            result = svc.dispatch(req["m"], req.get("a", {}))
+                            result = svc.dispatch(req["m"], req.get("a", {}),
+                                                  deadline=deadline)
                             wire.write_frame(sock, {"id": msg_id, "ok": True, "r": result})
-                        except Exception as e:  # noqa: BLE001 — carried to caller
+                        except DeadlineExceeded as e:
+                            # Typed error frame: the caller distinguishes
+                            # "server killed it for MY deadline" (stop
+                            # waiting, don't retry) from app errors.
+                            wire.write_frame(sock, {"id": msg_id, "ok": False,
+                                                    "kind": "deadline",
+                                                    "err": str(e)})
+                        # DELIBERATE broad except: the dispatch contract is
+                        # to relay ANY server-side application error to the
+                        # caller as a typed error frame — the wire write in
+                        # the try is the success path, and its own failures
+                        # hit the outer typed handler when the error frame
+                        # write below also fails.
+                        except Exception as e:  # noqa: BLE001  # m3lint: disable=broad-except-wire-io
                             wire.write_frame(
                                 sock, {"id": msg_id, "ok": False, "err": f"{type(e).__name__}: {e}"}
                             )

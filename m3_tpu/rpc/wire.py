@@ -39,6 +39,16 @@ _F64S = struct.Struct("<d")
 MAX_FRAME = 1 << 31  # 2 GiB hard cap against corrupt length prefixes
 
 
+class WireTruncated(ConnectionError):
+    """The peer died MID-FRAME: EOF inside the length prefix or body, so
+    some bytes of a frame arrived and the rest never will. One typed
+    error (instead of struct.error / short-read garbage) so retriers can
+    classify it as a retryable transport failure, distinct from both a
+    clean between-frames close (plain ConnectionError) and a malformed
+    but complete frame (ValueError — NOT retryable: the stream is
+    desynced and a re-send lands on garbage)."""
+
+
 def _enc(out: bytearray, v: Any, depth: int = 0) -> None:
     if depth > MAX_DEPTH:
         raise ValueError(f"wire: nesting deeper than {MAX_DEPTH}")
@@ -184,11 +194,19 @@ def write_frame(sock: socket.socket, value: Any) -> None:
     sock.sendall(_U32.pack(len(body)) + body)
 
 
-def _read_exact(sock: socket.socket, n: int) -> bytes:
+def _read_exact(sock: socket.socket, n: int, mid_frame: bool = False) -> bytes:
+    """Read exactly n bytes. EOF before the first byte is a clean close
+    (plain ConnectionError) unless `mid_frame` — a frame header already
+    committed the peer to a body — and EOF after a partial read is always
+    WireTruncated: the peer died inside a frame."""
+    want = n
     parts = []
     while n:
         chunk = sock.recv(min(n, 1 << 20))
         if not chunk:
+            if mid_frame or n != want:
+                raise WireTruncated(
+                    f"wire: peer closed mid-frame ({want - n}/{want} bytes)")
             raise ConnectionError("wire: peer closed")
         parts.append(chunk)
         n -= len(chunk)
@@ -199,7 +217,7 @@ def read_frame(sock: socket.socket) -> Any:
     (n,) = _U32.unpack(_read_exact(sock, 4))
     if n > MAX_FRAME:
         raise ValueError(f"wire: frame too large ({n})")
-    return decode(_read_exact(sock, n))
+    return decode(_read_exact(sock, n, mid_frame=True))
 
 
 def read_dict_frame(sock: socket.socket) -> dict:
@@ -212,6 +230,27 @@ def read_dict_frame(sock: socket.socket) -> dict:
     if not isinstance(v, dict):
         raise ValueError(f"wire: expected dict frame, got {type(v).__name__}")
     return v
+
+
+# ------------------------------------------------------ deadline propagation
+
+# Optional request-frame key carrying the caller's REMAINING time budget
+# in nanoseconds (a relative budget, not an absolute timestamp: monotonic
+# clocks don't compare across hosts and wall clocks skew). Every server
+# loop re-anchors it against its own clock on receipt.
+DEADLINE_KEY = "d"
+
+
+def deadline_from_frame(req: dict):
+    """Deadline from a request frame's budget field, or None. A malformed
+    budget (wrong type, negative) is treated as absent: deadline metadata
+    must never be the thing that kills an otherwise-valid request."""
+    from ..utils.retry import Deadline
+
+    budget = req.get(DEADLINE_KEY)
+    if not isinstance(budget, int) or isinstance(budget, bool) or budget < 0:
+        return None
+    return Deadline.from_wire(budget)
 
 
 # -------------------------------------------------- index query serialization

@@ -1,6 +1,8 @@
 """In-process multi-node test infrastructure (reference:
 src/dbnode/integration/setup.go newTestSetup + fake cluster services)."""
 
-from .cluster import ClusterHarness, ClusterNode
+from .cluster import ClusterHarness, ClusterNode, make_node_server
+from .faultnet import FaultPlan, FaultProxy
 
-__all__ = ["ClusterHarness", "ClusterNode"]
+__all__ = ["ClusterHarness", "ClusterNode", "FaultPlan", "FaultProxy",
+           "make_node_server"]

@@ -37,6 +37,15 @@ class SettableClock:
         self.now_ns += delta_ns
 
 
+def make_node_server(num_shards: int = 2, port: int = 0) -> NodeServer:
+    """One bootstrapped in-memory dbnode server — the shared fixture for
+    the chaos suites (tests/test_resilience.py, scripts/chaos_smoke.py),
+    so both gates drive the SAME server shape and can't drift apart."""
+    db = Database(ShardSet(num_shards), clock=lambda: 0)
+    db.mark_bootstrapped()
+    return NodeServer(NodeService(db), port=port).start()
+
+
 class ClusterNode:
     def __init__(self, host_id: str, db: Database, server: NodeServer,
                  persist: PersistManager, data_dir: str):

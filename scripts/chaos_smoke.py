@@ -1,0 +1,242 @@
+#!/usr/bin/env python
+"""Fast seeded chaos smoke: one faultnet scenario per networked layer,
+< 30s total, exits nonzero on the first violated invariant. Tier-1's
+quick answer to "did someone break the resilience layer" — the full
+matrix lives in tests/test_resilience.py.
+
+Usage: python scripts/chaos_smoke.py [--seed N]
+"""
+
+import argparse
+import socket
+import sys
+import threading
+import time
+
+sys.path.insert(0, ".")
+
+from m3_tpu.rpc import wire  # noqa: E402
+from m3_tpu.rpc.wire import WireTruncated  # noqa: E402
+from m3_tpu.testing.faultnet import FaultPlan, FaultProxy  # noqa: E402
+from m3_tpu.utils.retry import (  # noqa: E402
+    Breaker,
+    BreakerOptions,
+    Deadline,
+    DeadlineExceeded,
+    RetryOptions,
+)
+
+PASS = "ok"
+
+
+def _node_server(port: int = 0):
+    from m3_tpu.testing.cluster import make_node_server
+
+    return make_node_server(port=port)
+
+
+def scenario_schedule_determinism(seed):
+    """faultnet: identical seeds must produce identical fault schedules."""
+    kw = dict(reset=0.1, truncate=0.1, delay=0.2, duplicate=0.2)
+    a, b = FaultPlan(seed=seed, **kw), FaultPlan(seed=seed, **kw)
+    for conn in range(3):
+        for d in ("c2s", "s2c"):
+            assert a.schedule(conn, d, 300) == b.schedule(conn, d, 300), \
+                f"schedule diverged for conn={conn} dir={d}"
+    assert a.schedule(0, "c2s", 300) != \
+        FaultPlan(seed=seed + 1, **kw).schedule(0, "c2s", 300), \
+        "different seeds produced the same schedule"
+    return PASS
+
+
+def scenario_rpc_truncation_bounded(seed):
+    """node RPC: truncated replies -> typed WireTruncated after exactly
+    max_attempts tries, never a hang or struct.error."""
+    from m3_tpu.client.session import HostClient
+
+    srv = _node_server()
+    proxy = FaultProxy(srv.endpoint,
+                       FaultPlan(seed=seed, truncate=1.0,
+                                 directions=("s2c",))).start()
+    try:
+        hc = HostClient(proxy.endpoint, timeout=5,
+                        retry_opts=RetryOptions(max_attempts=3,
+                                                initial_backoff_s=0.01,
+                                                seed=seed))
+        try:
+            hc.call("health")
+            raise AssertionError("truncated replies should not succeed")
+        except WireTruncated:
+            pass
+        assert hc.retrier.attempts == 3, hc.retrier.attempts
+        hc.close()
+    finally:
+        proxy.close()
+        srv.close()
+    return PASS
+
+
+def scenario_rpc_deadline_bounded(seed):
+    """node RPC: 100ms budget against 600ms injected delay ->
+    DeadlineExceeded in bounded time."""
+    from m3_tpu.client.session import HostClient
+
+    srv = _node_server()
+    proxy = FaultProxy(srv.endpoint,
+                       FaultPlan(seed=seed, delay=1.0, delay_s=0.6,
+                                 directions=("s2c",))).start()
+    try:
+        hc = HostClient(proxy.endpoint, timeout=5,
+                        retry_opts=RetryOptions(max_attempts=3,
+                                                initial_backoff_s=0.01,
+                                                seed=seed))
+        t0 = time.monotonic()
+        try:
+            hc.call("health", _deadline=Deadline.after(0.1))
+            raise AssertionError("deadline should have fired")
+        except DeadlineExceeded:
+            pass
+        elapsed = time.monotonic() - t0
+        assert elapsed < 0.5, f"deadline unbounded: {elapsed:.2f}s"
+        hc.close()
+    finally:
+        proxy.close()
+        srv.close()
+    return PASS
+
+
+def scenario_breaker_trip_recover(seed):
+    """client breaker: connect storms trip it open (shedding), the
+    half-open probe closes it once the endpoint returns."""
+    from m3_tpu.client.session import HostClient
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    hc = HostClient(
+        f"127.0.0.1:{port}", timeout=5, connect_timeout=0.5,
+        retry_opts=RetryOptions(max_attempts=2, initial_backoff_s=0.01,
+                                seed=seed),
+        breaker=Breaker(BreakerOptions(window=8, failure_ratio=0.5,
+                                       min_samples=4, cooldown_s=0.25)))
+    try:
+        for _ in range(4):
+            try:
+                hc.call("health")
+            except (ConnectionError, OSError):
+                pass
+        assert hc.breaker.state == Breaker.OPEN, hc.breaker.state
+        srv = _node_server(port=port)
+        try:
+            time.sleep(0.3)
+            assert hc.call("health")["ok"]
+            assert hc.breaker.state == Breaker.CLOSED
+        finally:
+            srv.close()
+    finally:
+        hc.close()
+    return PASS
+
+
+def scenario_kv_reads_survive_resets(seed):
+    """kv: seeded reset storm — read retries converge, values intact."""
+    from m3_tpu.cluster.kv import MemStore
+    from m3_tpu.cluster.kv_service import KVServer, RemoteStore
+
+    srv = KVServer(MemStore()).start()
+    srv.store.set("k", b"v1")
+    proxy = FaultProxy(srv.endpoint, FaultPlan(seed=seed, reset=0.3)).start()
+    store = RemoteStore(proxy.endpoint,
+                        retry_opts=RetryOptions(max_attempts=6,
+                                                initial_backoff_s=0.01,
+                                                seed=seed))
+    try:
+        for _ in range(5):
+            v = store.get("k")
+            assert v is not None and v.data == b"v1"
+    finally:
+        store.close()
+        proxy.close()
+        srv.close()
+    return PASS
+
+
+def scenario_msg_duplicate_no_double_count(seed):
+    """msg: every producer frame duplicated — each message processed
+    exactly once (consumer acked-id dedup), queue drains."""
+    from m3_tpu.cluster.placement import Instance, initial_placement
+    from m3_tpu.msg import Consumer, ConsumerService, Producer, Topic
+
+    counts = {}
+    lock = threading.Lock()
+
+    def handler(shard, value):
+        with lock:
+            counts[value] = counts.get(value, 0) + 1
+
+    consumer = Consumer(handler).start()
+    proxy = FaultProxy(consumer.endpoint,
+                       FaultPlan(seed=seed, duplicate=1.0,
+                                 directions=("c2s",))).start()
+    placement = initial_placement(
+        [Instance(id="c0", endpoint=proxy.endpoint)], num_shards=2,
+        replica_factor=1)
+    prod = Producer(Topic("t", 2, (ConsumerService("svc"),)),
+                    {"svc": lambda: placement}, retry_delay_s=0.5)
+    try:
+        n = 8
+        for i in range(n):
+            prod.publish(i % 2, b"m-%d" % i)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            with lock:
+                done = len(counts) == n
+            if done and prod.unacked() == 0:
+                break
+            time.sleep(0.02)
+        assert prod.unacked() == 0, f"unacked: {prod.unacked()}"
+        time.sleep(0.2)  # let any late duplicate (wrongly) re-process
+        with lock:
+            bad = {k: c for k, c in counts.items() if c != 1}
+        assert not bad, f"double-counted: {bad}"
+        assert consumer.duplicates_dropped > 0
+    finally:
+        prod.close()
+        proxy.close()
+        consumer.close()
+    return PASS
+
+
+SCENARIOS = [
+    ("faultnet schedule determinism", scenario_schedule_determinism),
+    ("rpc truncation bounded retries", scenario_rpc_truncation_bounded),
+    ("rpc deadline bounded latency", scenario_rpc_deadline_bounded),
+    ("breaker trip + probe recovery", scenario_breaker_trip_recover),
+    ("kv reads survive reset storm", scenario_kv_reads_survive_resets),
+    ("msg duplicates not double-counted", scenario_msg_duplicate_no_double_count),
+]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="seeded chaos smoke")
+    ap.add_argument("--seed", type=int, default=7)
+    args = ap.parse_args(argv)
+    t_start = time.monotonic()
+    failed = 0
+    for name, fn in SCENARIOS:
+        t0 = time.monotonic()
+        try:
+            fn(args.seed)
+            print(f"  {name:40s} ok   ({time.monotonic() - t0:.2f}s)")
+        except Exception as e:  # noqa: BLE001 — report and fail the run
+            failed += 1
+            print(f"  {name:40s} FAIL ({type(e).__name__}: {e})")
+    total = time.monotonic() - t_start
+    print(f"chaos smoke: {len(SCENARIOS) - failed}/{len(SCENARIOS)} "
+          f"scenarios in {total:.1f}s (seed {args.seed})")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,6 +23,11 @@ echo "== static analysis =="
 # is the contract (also gated in-tree by tests/test_static_analysis.py).
 python -m m3_tpu.analysis m3_tpu/
 
+echo "== chaos smoke (seeded faultnet, one scenario per layer) =="
+# Resilience regressions (retry/breaker/deadline/dedup) fail HERE in
+# seconds, not twenty minutes in; the full matrix is tests/test_resilience.py.
+JAX_PLATFORMS=cpu python scripts/chaos_smoke.py --seed 7
+
 echo "== test suite =="
 python -m pytest tests/ -x -q
 

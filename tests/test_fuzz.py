@@ -235,6 +235,88 @@ class TestWireFuzz:
         with pytest.raises(ValueError):
             wire.encode(v)
 
+    def test_truncated_frames_raise_wire_truncated(self):
+        """A peer dying at ANY byte offset inside a frame (header or
+        body) surfaces as the single typed WireTruncated — never a
+        struct.error or short-read garbage — so retriers classify it as
+        a retryable transport failure."""
+        import socket
+        import struct
+
+        from m3_tpu.rpc import wire
+        from m3_tpu.rpc.wire import WireTruncated
+
+        body = wire.encode({"k": [1, 2.5, b"x" * 20, "s"],
+                            "arr": np.arange(4, dtype=np.int64)})
+        frame = struct.pack("<I", len(body)) + body
+        rng = np.random.default_rng(23)
+        cuts = {1, 2, 3, 4, len(frame) - 1} | {
+            int(c) for c in rng.integers(1, len(frame), 30)}
+        for cut in sorted(cuts):
+            a, b = socket.socketpair()
+            a.sendall(frame[:cut])
+            a.close()
+            b.settimeout(5)
+            with pytest.raises(WireTruncated):
+                wire.read_frame(b)
+            b.close()
+
+    def test_oversized_frame_length_rejected(self):
+        """A corrupt length prefix past MAX_FRAME is a typed ValueError
+        BEFORE any allocation or read of the announced body."""
+        import socket
+        import struct
+
+        from m3_tpu.rpc import wire
+
+        for n in (wire.MAX_FRAME + 1, 0xFFFFFFFF):
+            a, b = socket.socketpair()
+            a.sendall(struct.pack("<I", n))
+            b.settimeout(5)
+            with pytest.raises(ValueError):
+                wire.read_frame(b)
+            a.close()
+            b.close()
+
+    def test_frame_mutations_only_typed_errors(self):
+        """Random frame mutations (bit flips, length corruption, tail
+        truncation) through a real socket: read_frame yields a decoded
+        value, ValueError, or ConnectionError — nothing else, ever."""
+        import socket
+        import struct
+
+        from m3_tpu.rpc import wire
+
+        rng = np.random.default_rng(31)
+        base = wire.encode({"m": "w", "a": {"ids": [b"a", b"b"],
+                                            "vals": [1.0, 2.0]}})
+        outcomes = {"ok": 0, "value": 0, "conn": 0}
+        for _ in range(120):
+            blob = bytearray(struct.pack("<I", len(base)) + base)
+            mode = int(rng.integers(0, 3))
+            if mode == 0:    # flip a byte anywhere
+                i = int(rng.integers(0, len(blob)))
+                blob[i] ^= int(rng.integers(1, 256))
+            elif mode == 1:  # corrupt the length prefix
+                blob[int(rng.integers(0, 4))] ^= int(rng.integers(1, 256))
+            else:            # truncate the tail
+                blob = blob[: int(rng.integers(1, len(blob)))]
+            a, b = socket.socketpair()
+            a.sendall(bytes(blob))
+            a.close()
+            b.settimeout(5)
+            try:
+                wire.read_frame(b)
+                outcomes["ok"] += 1
+            except ConnectionError:
+                outcomes["conn"] += 1
+            except ValueError:
+                outcomes["value"] += 1
+            finally:
+                b.close()
+        assert sum(outcomes.values()) == 120
+        assert outcomes["conn"] > 0 and outcomes["value"] > 0
+
 
 class TestTbatchDispatchFuzz:
     """Malformed columnar timed-batch frames through dispatch_entry: every

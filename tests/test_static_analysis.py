@@ -15,6 +15,8 @@ from m3_tpu.analysis.cache_rules import CacheKeyBufferRule
 from m3_tpu.analysis.jax_rules import (ItemInLoopRule, JaxPurityRule,
                                        NonStaticJitCacheRule)
 from m3_tpu.analysis.lock_rules import LockDisciplineRule
+from m3_tpu.analysis.retry_rules import (BroadExceptWireIORule,
+                                         RawSleepRetryRule)
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
 
@@ -643,6 +645,161 @@ class TestSuppressionAndRunner:
         f.write_text("def f(:\n")
         findings, _, _ = run_paths([str(f)])
         assert rule_ids(findings) == ["parse-error"]
+
+
+class TestRetryRules:
+    def test_flags_fixed_delay_retry_loop(self):
+        src = """
+            import time
+
+            def pump(connect):
+                while True:
+                    try:
+                        connect()
+                        return
+                    except OSError:
+                        pass
+                    time.sleep(0.2)
+        """
+        found = lint(src, RawSleepRetryRule(), "m3_tpu/msg/mod.py")
+        assert rule_ids(found) == ["raw-sleep-retry"]
+
+    def test_sleep_in_handler_also_flags(self):
+        src = """
+            import time
+
+            def fetch(call):
+                for _ in range(5):
+                    try:
+                        return call()
+                    except ConnectionError:
+                        time.sleep(1.0)
+        """
+        assert rule_ids(lint(src, RawSleepRetryRule())) == ["raw-sleep-retry"]
+
+    def test_poll_loop_without_try_is_fine(self):
+        src = """
+            import time
+
+            def watch(poll):
+                while True:
+                    poll()
+                    time.sleep(5)
+        """
+        assert lint(src, RawSleepRetryRule()) == []
+
+    def test_retrier_module_is_exempt(self):
+        src = """
+            import time
+
+            def attempt(fn):
+                while True:
+                    try:
+                        return fn()
+                    except OSError:
+                        time.sleep(0.1)
+        """
+        assert lint(src, RawSleepRetryRule(), "m3_tpu/utils/retry.py") == []
+        # ...but the same shape anywhere else is not
+        assert lint(src, RawSleepRetryRule(), "m3_tpu/cluster/mod.py")
+
+    def test_nested_function_sleep_not_attributed_to_loop(self):
+        src = """
+            import time
+
+            def outer(items):
+                while items:
+                    try:
+                        items.pop()
+                    except IndexError:
+                        pass
+
+                    def helper():
+                        time.sleep(1)
+        """
+        assert lint(src, RawSleepRetryRule()) == []
+
+    def test_flags_broad_except_around_wire_io(self):
+        src = """
+            from ..rpc import wire
+
+            def serve(sock):
+                try:
+                    return wire.read_frame(sock)
+                except Exception:
+                    return None
+        """
+        found = lint(src, BroadExceptWireIORule(), "m3_tpu/query/mod.py")
+        assert rule_ids(found) == ["broad-except-wire-io"]
+        assert "read_frame" in found[0].message
+
+    def test_bare_except_and_write_frame_flag(self):
+        src = """
+            from ..rpc import wire
+
+            def push(sock, v):
+                try:
+                    wire.write_frame(sock, v)
+                except:
+                    pass
+        """
+        assert rule_ids(lint(src, BroadExceptWireIORule())) == \
+            ["broad-except-wire-io"]
+
+    def test_typed_except_set_is_fine(self):
+        src = """
+            from ..rpc import wire
+
+            def serve(sock):
+                try:
+                    while True:
+                        wire.write_frame(sock, wire.read_dict_frame(sock))
+                except (ConnectionError, OSError, ValueError):
+                    pass
+        """
+        assert lint(src, BroadExceptWireIORule()) == []
+
+    def test_inner_typed_try_owns_its_wire_calls(self):
+        # the node_server shape: a broad handler for DISPATCH errors is
+        # fine when the wire I/O has its own typed containment
+        src = """
+            from ..rpc import wire
+
+            def handle(sock, dispatch):
+                try:
+                    while True:
+                        try:
+                            req = wire.read_dict_frame(sock)
+                        except (ConnectionError, ValueError):
+                            return
+                        dispatch(req)
+                except Exception:
+                    pass
+        """
+        assert lint(src, BroadExceptWireIORule()) == []
+
+    def test_broad_except_without_wire_io_is_out_of_scope(self):
+        src = """
+            def run(fn):
+                try:
+                    return fn()
+                except Exception:
+                    return None
+        """
+        assert lint(src, BroadExceptWireIORule()) == []
+
+    def test_suppression_silences_with_justification(self):
+        src = """
+            from ..rpc import wire
+
+            def relay(sock, work):
+                try:
+                    wire.write_frame(sock, work())
+                # DELIBERATE: error-relay contract
+                except Exception:  # m3lint: disable=broad-except-wire-io
+                    pass
+        """
+        assert lint(src, BroadExceptWireIORule()) == []
 
 
 class TestTreeGate:
