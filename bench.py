@@ -628,12 +628,117 @@ def bench_flush_merge():
     }
 
 
+def bench_index_fetch_tagged():
+    """Config #6: reverse-index fetch_tagged query mix (queries/sec).
+
+    100k tagged documents in one sealed index block — the id-resolution
+    path every promql selector and the node RPC's FetchTagged runs before
+    any datapoint moves (db.query_ids -> NamespaceIndex.query -> segment
+    execute). The mix mirrors selector traffic: exact terms, multi-term
+    conjunctions with negation, literal-prefix regexps, a broad regexp,
+    and a disjunction. Pure host work by design (the index is the one
+    BASELINE surface that is pointer-chasing, not math), so the number is
+    platform-independent; the regexp-heavy share dominates the pre-change
+    pure-Python cost (pattern.fullmatch over every term in the field).
+
+    Steady state runs the mix against a warm index (repeat queries hit
+    the postings-list cache when present); extra.cold_qps records the
+    first cache-cold pass separately so both populate the artifact."""
+    from m3_tpu.index import query as iq
+    from m3_tpu.index.namespace_index import NamespaceIndex
+    from m3_tpu.utils import xtime
+
+    n = int(os.environ.get("BENCH_INDEX_DOCS", "100000"))
+    iters = int(os.environ.get("BENCH_INDEX_ITERS", "5"))
+    rng = np.random.default_rng(31)
+    t0 = 1_700_000_000 * 1_000_000_000
+
+    n_hosts = max(n // 10, 1)
+    names = [b"svc_%03d_latency" % i for i in range(100)]
+    dcs = [b"dc_%d" % i for i in range(4)]
+    roles = [b"role_%d" % i for i in range(8)]
+    _phase(f"index: building {n} docs")
+    items = []
+    for i in range(n):
+        sid = b"series-%07d" % i
+        tags = {
+            b"__name__": names[int(rng.integers(len(names)))],
+            b"host": b"host-%05d" % int(rng.integers(n_hosts)),
+            b"dc": dcs[int(rng.integers(len(dcs)))],
+            b"role": roles[int(rng.integers(len(roles)))],
+            b"pod": b"pod-%07d" % i,
+        }
+        items.append((sid, tags))
+    nsi = NamespaceIndex(block_size_ns=4 * xtime.HOUR)
+    nsi.insert_batch(items, t0)
+    # Seal: queries run against the compacted immutable segment, the
+    # shape the RPC serves once a block ages out of the write window.
+    nsi.tick(t0 + 5 * xtime.HOUR, retention_ns=30 * xtime.DAY)
+    _phase("index: sealed; building query mix")
+
+    queries = []
+    for i in range(8):  # exact terms
+        queries.append(iq.new_term(b"host", b"host-%05d" % (i * 997 % n_hosts)))
+    for i in range(6):  # conjunction + negation (the alert-rule shape)
+        queries.append(iq.new_conjunction(
+            iq.new_term(b"role", roles[i % len(roles)]),
+            iq.new_term(b"dc", dcs[i % len(dcs)]),
+            iq.new_negation(iq.new_term(b"__name__", names[i]))))
+    for i in range(6):  # literal-prefix regexps (fst prefix-range idiom)
+        queries.append(iq.new_regexp(b"host", b"host-00%02d.*" % i))
+        queries.append(iq.new_regexp(b"__name__", b"svc_0[0-4]%d_.*" % i))
+    queries.append(iq.new_regexp(b"pod", b".*-0000[0-9]{3}"))  # no prefix: full scan
+    queries.append(iq.new_disjunction(
+        iq.new_term(b"dc", dcs[0]), iq.new_term(b"dc", dcs[1])))
+    queries.append(iq.new_conjunction(  # negation-only conjunction
+        iq.new_negation(iq.new_term(b"dc", dcs[0])),
+        iq.new_negation(iq.new_term(b"role", roles[0]))))
+
+    def run_mix():
+        total = 0
+        for q in queries:
+            total += len(nsi.query(q))
+        return total
+
+    _phase(f"index: cold pass ({len(queries)} queries)")
+    t_cold0 = time.perf_counter()
+    n_ids = run_mix()
+    cold_s = time.perf_counter() - t_cold0
+    assert n_ids > 0
+    _phase(f"index: warm timing ({n_ids} ids/pass)")
+    dts = []
+    for _ in range(iters):
+        t1 = time.perf_counter()
+        got = run_mix()
+        dts.append(time.perf_counter() - t1)
+        assert got == n_ids
+    dt = min(dts)
+    _phase("index: done")
+    extra = {
+        "docs": n, "queries_per_pass": len(queries),
+        "ids_per_pass": n_ids,
+        "cold_qps": round(len(queries) / cold_s, 1),
+        "mix": {"term": 8, "conjunction_negation": 6, "regexp_prefix": 12,
+                "regexp_full_scan": 1, "disjunction": 1, "negation_only": 1},
+    }
+    stats_fn = getattr(nsi, "postings_cache_stats", None)
+    if stats_fn is not None:
+        extra["postings_cache"] = stats_fn()
+    return {
+        "metric": "index_fetch_tagged",
+        "value": round(len(queries) / dt, 1),
+        "unit": "queries/sec",
+        "extra": extra,
+    }
+
+
 _BENCHES = [
     ("m3tsz_encode_1m_rollup", bench_encode_rollup),
     ("counter_gauge_rollup", bench_counter_gauge),
     ("promql_rate_sum_over_time_1h", bench_promql),
     ("timer_quantile_rollup", bench_timer_quantiles),
     ("shard_flush_merge", bench_flush_merge),
+    ("index_fetch_tagged", bench_index_fetch_tagged),
 ]
 
 

@@ -129,4 +129,100 @@ class CacheKeyBufferRule(Rule):
                 yield self._report(mod, site, fn, [], inferred_line=line)
 
 
-RULES: List[Rule] = [CacheKeyBufferRule()]
+class CacheMethodBufferKeyRule(Rule):
+    """cache-buffer-key-method: hand-rolled cache classes must normalize
+    buffer-typed parameters to bytes before they become (part of) a key.
+
+    The functools rule above can't see custom caches (dict/OrderedDict
+    wrapped in a class, like the index PostingsListCache); same
+    regression class though: wire paths hand bytes/bytearray/memoryview
+    interchangeably, and a mutable buffer flowing into a key tuple or a
+    map subscript either raises (bytearray/memoryview aren't hashable)
+    or keys on content that can change under the cache.
+
+    Scope: classes whose name contains "Cache", methods whose name is a
+    cache-boundary verb (get/put/set/add/insert/lookup/invalidate/_key),
+    parameters annotated bytes/bytearray/memoryview. A param counts as
+    normalized once rebound via `p = bytes(p)`; inline `bytes(p)` at the
+    use site is fine. Raw uses flagged: inside a tuple literal, a
+    subscript index, or an argument to .get/.pop/.setdefault on a self
+    attribute."""
+
+    id = "cache-buffer-key-method"
+    severity = "error"
+    _METHODS = {"get", "put", "set", "add", "insert", "lookup",
+                "invalidate", "key", "_key"}
+    _MAP_CALLS = {"get", "pop", "setdefault"}
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.ClassDef) and "Cache" in node.name):
+                continue
+            for item in node.body:
+                if (isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef))
+                        and item.name in self._METHODS):
+                    yield from self._check_method(mod, node, item)
+
+    def _check_method(self, mod: Module, cls: ast.ClassDef,
+                      fn: ast.FunctionDef) -> Iterator[Finding]:
+        buffer_params = {name for name, _ in _buffer_params(fn)}
+        if not buffer_params:
+            return
+        normalized: Set[str] = set()
+        for stmt in fn.body:
+            use = self._raw_key_use(stmt, buffer_params - normalized)
+            if use is not None:
+                pname, site = use
+                yield self.finding(
+                    mod, site,
+                    f"{cls.name}.{fn.name}: buffer-typed parameter "
+                    f"{pname!r} reaches a cache key without bytes() "
+                    "normalization. bytearray/memoryview are unhashable "
+                    "and mutable buffers alias stale entries; rebind with "
+                    f"`{pname} = bytes({pname})` at the boundary (or wrap "
+                    "the use site in bytes(...)).")
+                return  # one finding per method keeps the signal readable
+            normalized |= self._normalized_in(stmt, buffer_params)
+
+    @staticmethod
+    def _normalized_in(stmt: ast.AST, params: Set[str]) -> Set[str]:
+        """Params rebound via `p = bytes(p)` in this statement."""
+        out: Set[str] = set()
+        for node in ast.walk(stmt):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+                continue
+            tgt, val = node.targets[0], node.value
+            if (isinstance(tgt, ast.Name) and tgt.id in params
+                    and isinstance(val, ast.Call)
+                    and qualname(val.func) == "bytes"
+                    and len(val.args) == 1
+                    and isinstance(val.args[0], ast.Name)
+                    and val.args[0].id == tgt.id):
+                out.add(tgt.id)
+        return out
+
+    def _raw_key_use(self, stmt: ast.AST, params: Set[str]):
+        """(param, node) for the first raw (un-wrapped) use of a buffer
+        param in a key position within this statement."""
+        if not params:
+            return None
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Tuple):
+                for elt in node.elts:
+                    if isinstance(elt, ast.Name) and elt.id in params:
+                        return elt.id, node
+            elif isinstance(node, ast.Subscript):
+                idx = node.slice
+                if isinstance(idx, ast.Name) and idx.id in params:
+                    return idx.id, node
+            elif (isinstance(node, ast.Call)
+                  and isinstance(node.func, ast.Attribute)
+                  and node.func.attr in self._MAP_CALLS
+                  and isinstance(node.func.value, ast.Attribute)):
+                for a in node.args:
+                    if isinstance(a, ast.Name) and a.id in params:
+                        return a.id, node
+        return None
+
+
+RULES: List[Rule] = [CacheKeyBufferRule(), CacheMethodBufferKeyRule()]

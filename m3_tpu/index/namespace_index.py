@@ -17,15 +17,19 @@ from typing import Dict, List, Optional, Set, Tuple
 import numpy as np
 
 from ..utils import xtime
+from .postings_cache import PostingsListCache
 from .query import Query
-from .segment import Document, ImmutableSegment, MutableSegment, execute
+from .segment import (Document, ImmutableSegment, MutableSegment,
+                      dedup_sorted_ids, execute)
 
 
 class IndexBlock:
     """index/block.go: one index block's segments."""
 
-    def __init__(self, block_start: int):
+    def __init__(self, block_start: int,
+                 plcache: Optional[PostingsListCache] = None):
         self.block_start = block_start
+        self.plcache = plcache
         self.mutable = MutableSegment()
         self.immutable: List[ImmutableSegment] = []
         self.sealed = False
@@ -64,25 +68,46 @@ class IndexBlock:
         """Under the index lock: publish a freeze built outside it (kept
         only if no newer snapshot landed first)."""
         if gen > self._snap_gen:
+            self._drop_segment(self._snap)
             self._snap = snap
             self._snap_gen = gen
+        else:
+            self._drop_segment(snap)
+
+    def _drop_segment(self, seg: Optional[ImmutableSegment]):
+        """A segment left the serving set: purge its cached postings."""
+        if seg is not None and self.plcache is not None:
+            self.plcache.invalidate_segment(seg.gen)
+
+    def drop_all(self):
+        """Block expired: purge every cached segment generation."""
+        self._drop_segment(self._snap)
+        for seg in self.immutable:
+            self._drop_segment(seg)
 
     def seal(self):
         """Mutable -> immutable compaction; merge accumulated immutables
-        (index/compaction/compactor.go plan: fewest, largest segments)."""
+        (index/compaction/compactor.go plan: fewest, largest segments).
+        Every segment this drops — the stale snapshot and the pre-merge
+        immutables — is invalidated in the postings cache."""
         if len(self.mutable):
             self.immutable.append(ImmutableSegment.from_mutable(self.mutable))
             self.mutable = MutableSegment()
+            self._drop_segment(self._snap)
             self._snap, self._snap_gen = None, -1
         if len(self.immutable) > 1:
-            self.immutable = [ImmutableSegment.merge(self.immutable)]
+            merged = ImmutableSegment.merge(self.immutable)
+            for seg in self.immutable:
+                self._drop_segment(seg)
+            self.immutable = [merged]
         self.sealed = True
 
     def query(self, q: Query) -> Set[bytes]:
         out: Set[bytes] = set()
         for seg in self.segments():
-            for pos in execute(seg, q):
-                out.add(seg.doc(int(pos)).id)
+            pos = execute(seg, q, cache=self.plcache)
+            if len(pos):
+                out.update(seg.ids_for(pos))
         return out
 
 
@@ -94,10 +119,14 @@ def tags_to_doc(series_id: bytes, tags: dict) -> Document:
 
 class NamespaceIndex:
     def __init__(self, block_size_ns: int = 4 * xtime.HOUR,
-                 clock=None):
+                 clock=None, postings_cache_capacity: int = 4096):
         self.block_size_ns = block_size_ns
         self.clock = clock
         self.blocks: Dict[int, IndexBlock] = {}
+        # Query-scoped postings resolution cache shared by every block
+        # (storage/index/postings_list_cache.go): keyed on segment
+        # generation, so seal/merge/expiry invalidate per segment.
+        self.postings_cache = PostingsListCache(postings_cache_capacity)
         self._known: Set[bytes] = set()
         # Inserts arrive concurrently from every shard's write path and
         # race queries and the mediator's tick/seal (the per-shard locks do
@@ -111,7 +140,7 @@ class NamespaceIndex:
         bs = xtime.truncate(t_ns, self.block_size_ns)
         blk = self.blocks.get(bs)
         if blk is None:
-            blk = self.blocks[bs] = IndexBlock(bs)
+            blk = self.blocks[bs] = IndexBlock(bs, plcache=self.postings_cache)
         return blk
 
     def insert(self, series_id: bytes, tags: dict, t_ns: Optional[int] = None):
@@ -160,13 +189,35 @@ class NamespaceIndex:
                 blk.store_snapshot(snap, gen)
         return segs
 
-    def query(self, q: Query, start_ns: int = 0, end_ns: int = 2**63 - 1) -> List[bytes]:
-        """nsIndex.Query: union across blocks overlapping [start, end)."""
-        out: Set[bytes] = set()
+    def query(self, q: Query, start_ns: int = 0, end_ns: int = 2**63 - 1,
+              limit: int = 0) -> List[bytes]:
+        """nsIndex.Query: union across blocks overlapping [start, end).
+
+        Results materialize via one id-array gather per segment (no
+        per-posting Python): each segment returns its matches already
+        lexicographically sorted through its precomputed rank arrays, so
+        the single-segment fast path never compares bytes at query time.
+        Leaf postings resolve through the shared postings-list cache.
+        `limit` truncates AFTER the sorted union so the prefix is
+        deterministic (the RPC's limit semantics)."""
+        parts = []
         for seg in self._snapshot_segments(start_ns, end_ns):
-            for pos in execute(seg, q):
-                out.add(seg.doc(int(pos)).id)
-        return sorted(out)
+            pos = execute(seg, q, cache=self.postings_cache)
+            if len(pos):
+                parts.append(seg.sorted_ids_for(pos))
+        if not parts:
+            return []
+        if len(parts) == 1:
+            ids = parts[0]
+        else:
+            ids = np.concatenate(parts)
+            ids.sort(kind="stable")
+            ids = dedup_sorted_ids(ids)
+        out = ids.tolist()
+        return out[:limit] if limit else out
+
+    def postings_cache_stats(self) -> dict:
+        return self.postings_cache.stats()
 
     def aggregate_terms(self, field: bytes, start_ns: int = 0, end_ns: int = 2**63 - 1) -> List[bytes]:
         """Distinct values for a tag (complete-tags / tag-values API)."""
@@ -196,4 +247,5 @@ class NamespaceIndex:
                 for seg in self.blocks[bs].segments():
                     for i in range(len(seg)):
                         self._known.discard(seg.doc(i).id)
+                self.blocks[bs].drop_all()
                 del self.blocks[bs]

@@ -41,7 +41,8 @@ def write_segment(root: str, namespace: bytes, block_start: int,
         for doc in seg._docs
     ]
     fields = {}
-    for name, (terms, offs, cat) in seg._fields.items():
+    for name in seg.fields():
+        terms, offs, cat = seg.field_raw(name)
         fields[name] = {
             "terms": list(terms),
             "offsets": np.asarray(offs, np.int64),
@@ -86,18 +87,18 @@ def read_segment(root: str, namespace: bytes, block_start: int,
                  tuple(sorted(tag_serialize.decode_tags(doc["tags"]).items())))
         for doc in obj["docs"]
     ]
-    fields: Dict[bytes, Tuple[List[bytes], List[np.ndarray]]] = {}
-    seg = ImmutableSegment.__new__(ImmutableSegment)
-    seg._docs = docs
-    seg._fields = {}
+    fields: Dict[bytes, Tuple[List[bytes], np.ndarray, np.ndarray]] = {}
     for name, fobj in obj["fields"].items():
         key = name if isinstance(name, bytes) else name.encode()
-        seg._fields[key] = (
+        fields[key] = (
             list(fobj["terms"]),
             np.asarray(fobj["offsets"], np.int64),
             np.asarray(fobj["postings"], np.int32),
         )
-    return seg
+    # Zero-parse into the array-native segment: the on-disk triples ARE
+    # the serving structure (TermDict wraps the terms, postings load as
+    # the offset-indexed spans).
+    return ImmutableSegment.from_raw(docs, fields)
 
 
 def list_segments(root: str, namespace: bytes) -> List[int]:

@@ -7,6 +7,34 @@ import dataclasses
 import re
 from typing import Tuple
 
+# Bytes that start regex syntax; a literal prefix scan stops at the first
+# one (mirrors regexp/syntax LiteralPrefix consumed by fst/regexp's
+# prefix-range prune).
+_META = frozenset(b".^$*+?{}[]\\|()")
+_QUANT = frozenset(b"*?{")
+
+
+def literal_prefix(pattern: bytes) -> bytes:
+    """Longest guaranteed literal prefix of a regexp over bytes.
+
+    Conservative by construction: a too-SHORT prefix only widens the term
+    range that gets automaton-matched afterwards, never the results.
+    Rules: an alternation ANYWHERE voids the prefix (a top-level `|`
+    lets a match start down the other branch, and telling top-level from
+    grouped needs a full parse — give up the prune instead); otherwise
+    stop at the first metacharacter, and `*`/`?`/`{` quantify the
+    previous literal, so it is dropped from the prefix."""
+    if 0x7C in pattern:  # "|"
+        return b""
+    out = bytearray()
+    for c in pattern:
+        if c in _META:
+            if c in _QUANT and out:
+                out.pop()
+            break
+        out.append(c)
+    return bytes(out)
+
 
 class Query:
     pass
@@ -28,8 +56,13 @@ class RegexpQuery(Query):
     field: bytes
     pattern: bytes
 
+    def __post_init__(self):
+        # Compile ONCE at construction (idx.NewRegexpQuery compiles the
+        # automaton up front); every per-segment execution reuses it.
+        object.__setattr__(self, "_compiled", re.compile(self.pattern))
+
     def compiled(self):
-        return re.compile(self.pattern)
+        return self._compiled
 
 
 @dataclasses.dataclass(frozen=True)
@@ -52,8 +85,7 @@ def new_term(field: bytes, value: bytes) -> TermQuery:
 
 
 def new_regexp(field: bytes, pattern: bytes) -> RegexpQuery:
-    re.compile(pattern)  # validate eagerly like idx.NewRegexpQuery
-    return RegexpQuery(field, pattern)
+    return RegexpQuery(field, pattern)  # constructor compiles eagerly
 
 
 def new_conjunction(*queries: Query) -> Query:

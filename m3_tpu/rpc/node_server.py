@@ -123,9 +123,7 @@ class NodeService:
                          fetch_data: bool = True, limit: int = 0):
         q = wire.query_from_wire(query)
         nsobj = self.db.namespace(ns)
-        ids = self.db.query_ids(ns, q, start_ns, end_ns)
-        if limit:
-            ids = ids[:limit]
+        ids = self.db.query_ids(ns, q, start_ns, end_ns, limit=limit)
         out = []
         for sid in ids:
             # Mid-loop budget check: fetch_tagged is the expensive fan-in

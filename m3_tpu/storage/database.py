@@ -132,12 +132,15 @@ class Database:
         ns = self.namespace(namespace)
         return ns.read(self.shard_set.lookup(series_id), series_id, start_ns, end_ns)
 
-    def query_ids(self, namespace: bytes, query, start_ns: int = 0, end_ns: int = 2**63 - 1):
-        """database.go:724 QueryIDs -> reverse index query."""
+    def query_ids(self, namespace: bytes, query, start_ns: int = 0, end_ns: int = 2**63 - 1,
+                  limit: int = 0):
+        """database.go:724 QueryIDs -> reverse index query. `limit`
+        pushes the RPC's series cap down to the index (sorted-prefix
+        semantics preserved: the index truncates after the sorted union)."""
         ns = self.namespace(namespace)
         if ns.index is None:
             raise RuntimeError(f"namespace {namespace!r} has no index")
-        return ns.index.query(query, start_ns, end_ns)
+        return ns.index.query(query, start_ns, end_ns, limit=limit)
 
     def aggregate_tags(self, namespace: bytes, query, start_ns: int, end_ns: int,
                        name_only: bool = False,

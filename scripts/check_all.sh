@@ -23,6 +23,12 @@ echo "== static analysis =="
 # is the contract (also gated in-tree by tests/test_static_analysis.py).
 python -m m3_tpu.analysis m3_tpu/
 
+echo "== index microbench smoke (<5s; bitmap-vs-ref + cache hit-rate asserted) =="
+# Array-native inverted index: bitmap kernels must agree with the
+# set-algebra reference and the postings cache must serve the warm pass
+# (full matrix: tests/test_index_property.py; bench: index_fetch_tagged).
+python scripts/index_smoke.py
+
 echo "== chaos smoke (seeded faultnet, one scenario per layer) =="
 # Resilience regressions (retry/breaker/deadline/dedup) fail HERE in
 # seconds, not twenty minutes in; the full matrix is tests/test_resilience.py.

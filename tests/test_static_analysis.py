@@ -11,7 +11,8 @@ import textwrap
 
 from m3_tpu.analysis import Module, all_rules, run_module, run_paths
 from m3_tpu.analysis.batch_rules import BatchPartialIngestRule
-from m3_tpu.analysis.cache_rules import CacheKeyBufferRule
+from m3_tpu.analysis.cache_rules import (CacheKeyBufferRule,
+                                         CacheMethodBufferKeyRule)
 from m3_tpu.analysis.jax_rules import (ItemInLoopRule, JaxPurityRule,
                                        NonStaticJitCacheRule)
 from m3_tpu.analysis.lock_rules import LockDisciplineRule
@@ -115,6 +116,103 @@ class TestCacheKeyBuffer:
             g = functools.lru_cache(maxsize=8)(f)  # m3lint: disable=cache-key-buffer
         """
         assert lint(src, CacheKeyBufferRule()) == []
+
+
+class TestCacheMethodBufferKey:
+    """Custom-cache boundary: buffer params must be bytes-normalized
+    before they reach a key (the PostingsListCache contract)."""
+
+    def test_flags_raw_buffer_in_key_tuple(self):
+        src = """
+            class PostingsCache:
+                def get(self, gen: int, field: bytes, key: bytes):
+                    return self._lru.get((gen, field, key))
+        """
+        found = lint(src, CacheMethodBufferKeyRule())
+        assert rule_ids(found) == ["cache-buffer-key-method"]
+        assert "'field'" in found[0].message
+
+    def test_flags_raw_subscript_and_memoryview(self):
+        src = """
+            class SegCache:
+                def put(self, key: memoryview, value):
+                    self._map[key] = value
+        """
+        assert rule_ids(lint(src, CacheMethodBufferKeyRule())) == [
+            "cache-buffer-key-method"]
+
+    def test_flags_map_get_arg(self):
+        src = """
+            class RouteCache:
+                def lookup(self, key: bytearray):
+                    return self._entries.get(key)
+        """
+        assert rule_ids(lint(src, CacheMethodBufferKeyRule())) == [
+            "cache-buffer-key-method"]
+
+    def test_rebind_normalization_passes(self):
+        src = """
+            class PostingsCache:
+                def get(self, gen: int, field: bytes, key: bytes):
+                    field = bytes(field)
+                    key = bytes(key)
+                    return self._lru.get((gen, field, key))
+        """
+        assert lint(src, CacheMethodBufferKeyRule()) == []
+
+    def test_inline_bytes_wrap_passes(self):
+        src = """
+            class PostingsCache:
+                @staticmethod
+                def _key(gen: int, field: bytes, key: bytes):
+                    return (gen, bytes(field), "term", bytes(key))
+        """
+        assert lint(src, CacheMethodBufferKeyRule()) == []
+
+    def test_use_before_normalization_still_flagged(self):
+        src = """
+            class LateCache:
+                def put(self, key: bytes, v):
+                    self._map[key] = v
+                    key = bytes(key)
+        """
+        assert rule_ids(lint(src, CacheMethodBufferKeyRule())) == [
+            "cache-buffer-key-method"]
+
+    def test_non_cache_class_and_scalar_params_ignored(self):
+        src = """
+            class Registry:
+                def get(self, key: bytes):
+                    return self._map.get(key)
+
+            class WidthCache:
+                def get(self, width: int, name: str):
+                    return self._map.get((width, name))
+
+                def helper(self, data: bytes):
+                    return len(data)
+        """
+        assert lint(src, CacheMethodBufferKeyRule()) == []
+
+    def test_delegating_to_normalizing_key_builder_passes(self):
+        src = """
+            class PostingsCache:
+                @staticmethod
+                def _key(field: bytes, key: bytes):
+                    return (bytes(field), bytes(key))
+
+                def get(self, field: bytes, key: bytes):
+                    return self._lru.get(self._key(field, key))
+        """
+        assert lint(src, CacheMethodBufferKeyRule()) == []
+
+    def test_suppression_silences(self):
+        src = """
+            class PinCache:
+                def get(self, key: bytes):
+                    return self._map.get(key)  # m3lint: disable=cache-buffer-key-method
+        """
+        assert lint(src, CacheMethodBufferKeyRule()) == []
 
 
 class TestJaxPurity:
