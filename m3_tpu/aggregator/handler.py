@@ -12,6 +12,7 @@ import logging
 from typing import Callable, List, NamedTuple, Sequence
 
 from ..metrics.policy import StoragePolicy
+from ..utils.limits import Backpressure
 
 
 class AggregatedMetric(NamedTuple):
@@ -121,6 +122,7 @@ class ProducerHandler(Handler):
         self._num_shards = num_shards
         self._encode = wire.encode
         self._hash = murmur3_32_cached
+        self.dropped_backpressure = 0
 
     def handle(self, metric: AggregatedMetric):
         payload = self._encode({
@@ -129,7 +131,16 @@ class ProducerHandler(Handler):
             "v": metric.value,
             "sp": str(metric.storage_policy),
         })
-        self._producer.publish(self._hash(metric.id) % self._num_shards, payload)
+        try:
+            self._producer.publish(
+                self._hash(metric.id) % self._num_shards, payload)
+        except Backpressure:
+            # The producer buffer is past its watermark: the flush must
+            # finish (a wedged flush loses EVERY window, not one metric),
+            # so this datapoint is counted as dropped — the same outcome
+            # drop-oldest would have forced, surfaced explicitly and
+            # earlier, while the buffer still holds undropped history.
+            self.dropped_backpressure += 1
 
 
 def decode_aggregated(payload: bytes) -> AggregatedMetric:

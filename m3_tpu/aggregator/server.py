@@ -44,6 +44,8 @@ from ..metrics.matcher import pipeline_from_json, pipeline_to_json
 from ..metrics.metric import MetricType, MetricUnion
 from ..metrics.policy import StoragePolicy
 from ..rpc import wire
+from ..utils.health import AdmissionGate, Priority
+from ..utils.limits import Backpressure
 from .aggregator import Aggregator
 
 
@@ -133,13 +135,26 @@ def union_from_wire(frame: dict):
 
 class RawTCPServer:
     """Accepts connections from aggregator clients; every frame feeds the
-    local Aggregator (rawtcp/server.go handleConnection)."""
+    local Aggregator (rawtcp/server.go handleConnection).
+
+    Ingest admission: in-flight records are bounded by an AdmissionGate.
+    The raw-TCP protocol is fire-and-forget (no per-record ack channel),
+    so shed records are DROPPED and counted (`shed`) — collectors see
+    loss in the counters, while producers speaking the acked msg path
+    get real backpressure at the consumer. `forwarded` frames (partial
+    aggregates between pipeline stages — already-accepted work whose
+    loss corrupts downstream rollups) are CRITICAL and never shed; a
+    frame may self-mark `"pri": "bulk"` (backfill replay) to shed
+    first at the high watermark."""
 
     def __init__(self, aggregator: Aggregator, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, gate: Optional[AdmissionGate] = None):
         self.aggregator = aggregator
+        self.gate = gate if gate is not None else AdmissionGate(
+            capacity=8192, name="aggregator.rawtcp")
         self.frames = 0
         self.errors = 0
+        self.shed = 0
         # Counters are bumped from per-connection handler threads; a plain
         # += is a non-atomic load/add/store that loses increments.
         self._stats_lock = threading.Lock()
@@ -194,13 +209,25 @@ class RawTCPServer:
             ids = e.get("ids")
             return len(ids) if isinstance(ids, (list, tuple)) else 1
 
+        n = _records()
+        pri = (Priority.CRITICAL if e.get("t") == "forwarded"
+               else Priority.BULK if e.get("pri") == "bulk"
+               else Priority.NORMAL)
         try:
-            dispatch_entry(self.aggregator, e)
+            with self.gate.held(n, priority=pri):
+                dispatch_entry(self.aggregator, e)
+        except Backpressure:
+            # fire-and-forget transport: shed = counted drop (the msg
+            # path's consumer converts the same condition into a skipped
+            # ack, i.e. real producer backpressure)
+            with self._stats_lock:
+                self.shed += n
+            return 0
         except Exception:  # noqa: BLE001 - bad frame must not kill the conn
             with self._stats_lock:
-                self.errors += _records()
+                self.errors += n
             return 0
-        return _records()
+        return n
 
     @property
     def endpoint(self) -> str:

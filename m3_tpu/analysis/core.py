@@ -242,7 +242,8 @@ def index_functions(mod: Module) -> Dict[str, ast.FunctionDef]:
 
 
 def _registry() -> List[Rule]:
-    from . import batch_rules, cache_rules, jax_rules, lock_rules, retry_rules
+    from . import (batch_rules, cache_rules, jax_rules, lock_rules,
+                   overload_rules, retry_rules)
 
     return [
         *cache_rules.RULES,
@@ -250,6 +251,7 @@ def _registry() -> List[Rule]:
         *lock_rules.RULES,
         *batch_rules.RULES,
         *retry_rules.RULES,
+        *overload_rules.RULES,
     ]
 
 

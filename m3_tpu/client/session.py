@@ -29,6 +29,7 @@ from ..cluster.topology import (
 )
 from ..parallel.sharding import ShardSet
 from ..rpc import wire
+from ..utils.limits import ResourceExhausted
 from ..utils.retry import (
     Breaker,
     BreakerOpen,
@@ -94,6 +95,13 @@ class Connection:
         if not resp.get("ok"):
             if resp.get("kind") == "deadline":
                 raise DeadlineExceeded(resp.get("err", "deadline exceeded"))
+            if resp.get("kind") == "resource_exhausted":
+                # Server shed this request (query limit / admission gate).
+                # ResourceExhausted is a RetryableError: the Retrier backs
+                # off and re-attempts, because the overload clears on its
+                # own — distinct from deadline, which stays non-retryable.
+                raise ResourceExhausted(
+                    resp.get("err", "server resource exhausted"))
             raise RemoteError(resp.get("err", "unknown remote error"))
         return resp["r"]
 
@@ -204,6 +212,16 @@ class HostClient:
             # The HOST is healthy — it parsed, ran, and answered; the
             # application errored. Keep the connection and the breaker
             # must not trip on it.
+            with self._lock:
+                self._free.append(conn)
+            record(True)
+            raise
+        except ResourceExhausted:
+            # Deliberate shed by a healthy host: the stream is synced and
+            # poolable, and the breaker must not trip (tripping it would
+            # turn a load-shedding node into a "dead" one and dogpile its
+            # replicas). The retrier above backs off and re-attempts —
+            # exactly the producer behavior shedding asks for.
             with self._lock:
                 self._free.append(conn)
             record(True)

@@ -26,6 +26,7 @@ from ..query.block import Block
 from ..query.model import Matcher, MatchType
 from ..query import promql
 from ..query.promql import parse_duration_ns
+from ..utils.limits import ResourceExhausted
 from .ingest import DownsamplerAndWriter
 
 S = 1_000_000_000
@@ -82,7 +83,18 @@ class HTTPApi:
     # ------------------------------------------------------------ handlers
 
     def health(self, req) -> dict:
-        return {"ok": True, "uptime": "ok"}
+        """Health now carries the degradation state machine's verdict
+        (utils.health: ok -> degraded -> shedding over gate depth and
+        limit-enforcer saturation): load balancers keep routing to a
+        degraded coordinator but should drain a shedding one, and
+        operators see WHICH source is saturated."""
+        from ..utils.health import SHEDDING, TRACKER
+
+        snap = TRACKER.snapshot()
+        return {"ok": snap["state"] != SHEDDING, "uptime": "ok",
+                "state": snap["state"],
+                "saturation": snap["saturation"],
+                "sources": snap["sources"]}
 
     def buildinfo(self, req) -> dict:
         """Prometheus-compat /api/v1/status/buildinfo (beyond the
@@ -408,6 +420,13 @@ class HTTPApi:
                             code = 200
                         except HTTPError as e:
                             out, code = {"status": "error", "error": e.msg}, e.code
+                        except ResourceExhausted as e:
+                            # Shed by a query limit or the ingest admission
+                            # gate: 429 with Retry-After so well-behaved
+                            # producers back off instead of retrying hot.
+                            out, code = {"status": "error",
+                                         "errorType": "resource_exhausted",
+                                         "error": str(e)}, 429
                         except Exception as e:  # noqa: BLE001
                             out, code = {"status": "error", "error": str(e)}, 400
                         if isinstance(out, RawResponse):
@@ -415,7 +434,8 @@ class HTTPApi:
                             extra = out.headers
                         else:
                             ctype, data = "application/json", json.dumps(out).encode()
-                            extra = {}
+                            # shed responses tell producers WHEN to retry
+                            extra = {"Retry-After": "1"} if code == 429 else {}
                         self.send_response(code)
                         self.send_header("Content-Type", ctype)
                         self.send_header("Content-Length", str(len(data)))

@@ -11,6 +11,7 @@ from typing import Callable, Dict, Optional, Sequence
 
 from ..aggregator.handler import decode_aggregated
 from ..metrics.metric import MetricType
+from ..utils.health import AdmissionGate, Priority
 from ..utils.instrument import ROOT
 from .downsample import Downsampler
 
@@ -18,17 +19,37 @@ _scope = ROOT.sub_scope("coordinator.ingest")
 
 
 class DownsamplerAndWriter:
-    def __init__(self, storage, downsampler: Optional[Downsampler] = None):
+    """Dual-path writer behind a bounded admission gate: in-flight write
+    work past the high watermark sheds bulk backfill first, past capacity
+    sheds normal producer traffic too (typed Backpressure — HTTP callers
+    get a retryable error, msg-path callers skip the ack so the producer
+    redelivers on its exponential backoff schedule), while the aggregated
+    pipeline's own output (M3MsgIngester) is never shed."""
+
+    def __init__(self, storage, downsampler: Optional[Downsampler] = None,
+                 gate: Optional[AdmissionGate] = None):
         """storage: query-storage-like .write(series_id, tags, t, v)."""
         self._storage = storage
         self._downsampler = downsampler
+        # Generous-but-finite default: ingest overload protection is on by
+        # default; services size it from config where it matters.
+        self.gate = gate if gate is not None else AdmissionGate(
+            capacity=4096, name="coordinator.ingest")
         self.written = 0
         self.downsampled = 0
 
     def write(self, tags: Dict[bytes, bytes], t_nanos: int, value: float,
               metric_type: MetricType = MetricType.GAUGE,
-              downsample: bool = True, write_unaggregated: bool = True):
-        """write.go WriteBatch dual path."""
+              downsample: bool = True, write_unaggregated: bool = True,
+              priority: Priority = Priority.NORMAL):
+        """write.go WriteBatch dual path. Raises Backpressure when the
+        admission gate sheds this priority class."""
+        with self.gate.held(priority=priority):
+            self._write_admitted(tags, t_nanos, value, metric_type,
+                                 downsample, write_unaggregated)
+
+    def _write_admitted(self, tags, t_nanos, value, metric_type,
+                        downsample, write_unaggregated):
         if downsample and self._downsampler is not None:
             if self._downsampler.write(tags, t_nanos, value, metric_type):
                 self.downsampled += 1
@@ -39,9 +60,22 @@ class DownsamplerAndWriter:
             self.written += 1
             _scope.counter("written").inc()
 
-    def write_batch(self, samples: Sequence[tuple], **kw):
-        for tags, t_nanos, value in samples:
-            self.write(tags, t_nanos, value, **kw)
+    def write_batch(self, samples: Sequence[tuple],
+                    priority: Priority = Priority.NORMAL, **kw):
+        """All-or-nothing admission: the whole batch is admitted ONCE up
+        front. Per-sample admission would let a mid-batch shed leave a
+        partially-written prefix that the 429-retrying producer then
+        re-writes, double-counting it — the same partial-prefix hazard
+        m3lint's batch-partial-ingest rule polices at the codec layer."""
+        samples = list(samples)
+        if not samples:
+            return
+        with self.gate.held(len(samples), priority=priority):
+            for tags, t_nanos, value in samples:
+                self._write_admitted(tags, t_nanos, value,
+                                     kw.get("metric_type", MetricType.GAUGE),
+                                     kw.get("downsample", True),
+                                     kw.get("write_unaggregated", True))
 
 
 class M3MsgIngester:
@@ -50,23 +84,37 @@ class M3MsgIngester:
     choosing the namespace for the sample's storage policy
     (ingest/m3msg/ingest.go -> storage write)."""
 
-    def __init__(self, storage_for_policy: Callable):
+    def __init__(self, storage_for_policy: Callable,
+                 gate: Optional[AdmissionGate] = None):
         """storage_for_policy(storage_policy) -> storage with .write(...)."""
         self._storage_for = storage_for_policy
+        self.gate = gate
         self.ingested = 0
 
     def __call__(self, shard: int, payload: bytes):
         from ..metrics import id as metric_id
 
-        m = decode_aggregated(payload)
-        storage = self._storage_for(m.storage_policy)
-        if storage is None:
-            return
-        name, tags = metric_id.decode(m.id)
-        if name:
-            tags = {b"__name__": name, **tags}
-        storage.write(m.id, tags, m.time_nanos, m.value)
-        self.ingested += 1
+        # CRITICAL priority: this is the aggregation pipeline's own
+        # output, already accepted and acked upstream — shedding it here
+        # would silently lose aggregated data the platform promised to
+        # keep. It is counted against the gate (the depth is honest) but
+        # never refused; raw producer traffic sheds first, upstream.
+        gate = self.gate
+        if gate is not None:
+            gate.admit(priority=Priority.CRITICAL)
+        try:
+            m = decode_aggregated(payload)
+            storage = self._storage_for(m.storage_policy)
+            if storage is None:
+                return
+            name, tags = metric_id.decode(m.id)
+            if name:
+                tags = {b"__name__": name, **tags}
+            storage.write(m.id, tags, m.time_nanos, m.value)
+            self.ingested += 1
+        finally:
+            if gate is not None:
+                gate.release()
 
 
 def _series_id(tags: Dict[bytes, bytes]) -> bytes:

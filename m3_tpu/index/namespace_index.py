@@ -16,6 +16,7 @@ from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
+from ..utils import limits as xlimits
 from ..utils import xtime
 from .postings_cache import PostingsListCache
 from .query import Query
@@ -199,11 +200,17 @@ class NamespaceIndex:
         the single-segment fast path never compares bytes at query time.
         Leaf postings resolve through the shared postings-list cache.
         `limit` truncates AFTER the sorted union so the prefix is
-        deterministic (the RPC's limit semantics)."""
+        deterministic (the RPC's limit semantics).
+
+        Every segment's matched postings are charged to the docs-matched
+        query limit BEFORE materialization (query_limits.go charges docs
+        at postings evaluation): a regexp matching the whole namespace is
+        rejected by ResourceExhausted before it gathers a single id."""
         parts = []
         for seg in self._snapshot_segments(start_ns, end_ns):
             pos = execute(seg, q, cache=self.postings_cache)
             if len(pos):
+                xlimits.charge("docs_matched", int(len(pos)))
                 parts.append(seg.sorted_ids_for(pos))
         if not parts:
             return []

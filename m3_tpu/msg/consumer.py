@@ -26,9 +26,17 @@ class Consumer:
 
     def __init__(self, handler: Callable[[int, bytes], None],
                  host: str = "127.0.0.1", port: int = 0,
-                 ack_batch: int = 1, dedup_window: int = 4096):
+                 ack_batch: int = 1, dedup_window: int = 4096,
+                 max_inflight: int = 1024):
         self._handler = handler
         self._ack_batch = ack_batch
+        # High watermark on concurrent handler invocations across ALL
+        # producer connections: past it, connection loops stop READING
+        # (the natural TCP backpressure — the producer's send blocks or
+        # its unacked queue fills, surfacing Backpressure at publish()),
+        # so a slow handler bounds in-flight memory instead of letting
+        # every connection pile work behind it.
+        self._max_inflight = max(1, max_inflight)
         # Recently ACKED message ids (bounded FIFO shared across producer
         # connections): a duplicated wire delivery — faultnet duplicate
         # injection, or a producer retry racing an in-flight ack — is
@@ -46,6 +54,9 @@ class Consumer:
         # without src fall back to a per-connection token (dedup then
         # covers same-connection wire duplicates only).
         self._dedup_lock = threading.Lock()
+        # Signals in-flight slots freeing up (wraps the dedup lock, so
+        # waiters atomically re-check the inflight set it guards).
+        self._inflight_free = threading.Condition(self._dedup_lock)
         self._acked_ids = set()
         self._acked_fifo: "deque" = deque(maxlen=max(1, dedup_window))
         self._inflight_ids = set()
@@ -54,21 +65,30 @@ class Consumer:
         outer = self
 
         # begin -> "acked" (re-ack, skip handler) | "inflight" (drop,
-        # no ack) | "new" (claimed: run the handler, then settle)
+        # no ack) | "new" (claimed: run the handler, then settle).
+        # Admission is INSIDE the same critical section as the claim:
+        # when the in-flight set is at the watermark, this connection
+        # waits HERE — it stops consuming frames, which is the natural
+        # TCP backpressure the framed protocol has — and the check and
+        # the claim can't race another connection past the bound.
         def _begin(key) -> str:
-            with outer._dedup_lock:
-                if key in outer._acked_ids:
-                    outer.duplicates_dropped += 1
-                    return "acked"
-                if key in outer._inflight_ids:
-                    outer.duplicates_dropped += 1
-                    return "inflight"
-                outer._inflight_ids.add(key)
-                return "new"
+            with outer._inflight_free:
+                while True:
+                    if key in outer._acked_ids:
+                        outer.duplicates_dropped += 1
+                        return "acked"
+                    if key in outer._inflight_ids:
+                        outer.duplicates_dropped += 1
+                        return "inflight"
+                    if len(outer._inflight_ids) < outer._max_inflight:
+                        outer._inflight_ids.add(key)
+                        return "new"
+                    outer._inflight_free.wait(timeout=0.05)
 
         def _settle(key, ok: bool):
             with outer._dedup_lock:
                 outer._inflight_ids.discard(key)
+                outer._inflight_free.notify_all()
                 if not ok:
                     return
                 if len(outer._acked_fifo) == outer._acked_fifo.maxlen:

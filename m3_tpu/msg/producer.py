@@ -20,6 +20,7 @@ from typing import Callable, Dict, List, Optional
 
 from ..cluster.placement import Placement, ShardState
 from ..rpc import wire
+from ..utils.limits import Backpressure
 from ..utils.retry import Breaker, BreakerOptions, Retrier, RetryOptions
 from .topic import ConsumptionType, Topic
 
@@ -67,9 +68,16 @@ class MessageWriter:
                  retry_delay_s: float = 0.2,
                  retry_opts: Optional[RetryOptions] = None,
                  breaker_opts: Optional[BreakerOptions] = None,
-                 src: Optional[int] = None):
+                 src: Optional[int] = None,
+                 max_unacked: int = 65536):
         self._connect = connect
         self._retry_delay_s = retry_delay_s
+        # Hard cap on the unacked/redelivery map: an unreachable consumer
+        # must not grow this without bound (the byte cap upstream bounds
+        # bytes; this bounds ENTRIES, which survive drop-oldest races and
+        # dominate memory for small payloads). At the cap, write()
+        # surfaces typed Backpressure so publish() callers back off.
+        self._max_unacked = max(1, max_unacked)
         self._src = src  # producer identity riding each frame (dedup key)
         # backoff_for() only — the scheduled scan IS the retry loop, so
         # the Retrier here is the schedule, not the driver.
@@ -97,6 +105,12 @@ class MessageWriter:
 
     def write(self, msg: _Message):
         with self._lock:
+            if msg.id not in self._queue and \
+                    len(self._queue) >= self._max_unacked:
+                raise Backpressure(
+                    f"message writer unacked queue full "
+                    f"({len(self._queue)}/{self._max_unacked}): "
+                    "consumer unreachable or slow — back off")
             # dict.setdefault (not .get) also keeps m3lint's queue-get
             # heuristic from reading this dict named _queue as a Queue
             t = self._queue.setdefault(msg.id, _Tracked(msg))
@@ -249,7 +263,8 @@ class ConsumerServiceWriter:
                  retry_delay_s: float = 0.2,
                  retry_opts: Optional[RetryOptions] = None,
                  breaker_opts: Optional[BreakerOptions] = None,
-                 src: Optional[int] = None):
+                 src: Optional[int] = None,
+                 max_unacked: int = 65536):
         self.service_id = service_id
         self._placement = placement_getter
         self._connect = connect
@@ -257,6 +272,7 @@ class ConsumerServiceWriter:
         self._retry_opts = retry_opts
         self._breaker_opts = breaker_opts
         self._src = src
+        self._max_unacked = max(1, max_unacked)
         self._writers: Dict[str, MessageWriter] = {}
         self._on_ack: Optional[Callable[[_Message], None]] = None
         # Messages with no routable instance yet (placement missing or shard
@@ -273,7 +289,8 @@ class ConsumerServiceWriter:
                               self._retry_delay_s,
                               retry_opts=self._retry_opts,
                               breaker_opts=self._breaker_opts,
-                              src=self._src)
+                              src=self._src,
+                              max_unacked=self._max_unacked)
             w._on_ack = self._on_ack
             self._writers[endpoint] = w
         return w
@@ -282,6 +299,15 @@ class ConsumerServiceWriter:
         if self._route(msg):
             return True
         with self._lock:
+            # The unrouted holding pen is bounded like the writer queues:
+            # a long placement gap must surface as backpressure, not as
+            # an unbounded map of every message published meanwhile.
+            if msg.id not in self._unrouted and \
+                    len(self._unrouted) >= self._max_unacked:
+                raise Backpressure(
+                    f"{self.service_id}: unrouted buffer full "
+                    f"({len(self._unrouted)}/{self._max_unacked}): "
+                    "no routable placement — back off")
             self._unrouted[msg.id] = msg
         return False
 
@@ -332,11 +358,22 @@ class Producer:
                  max_buffer_bytes: int = 64 * 1024 * 1024,
                  retry_delay_s: float = 0.2,
                  retry_opts: Optional[RetryOptions] = None,
-                 breaker_opts: Optional[BreakerOptions] = None):
+                 breaker_opts: Optional[BreakerOptions] = None,
+                 high_watermark: float = 0.8,
+                 max_unacked: int = 65536):
         self.topic = topic
         self._retry_delay_s = retry_delay_s
         self._next_id = 0
         self._max_buffer_bytes = max_buffer_bytes
+        # Backpressure BEFORE loss: past the high watermark publish()
+        # raises the typed Backpressure so producers back off while the
+        # retry pass drains; drop-oldest above remains the hard cap for
+        # what's already buffered (the reference's tradeoff), but a
+        # well-behaved publisher never reaches it. A watermark > 1.0
+        # disables the backpressure gate, restoring the reference's pure
+        # drop-oldest semantics for callers that prefer loss to refusal.
+        self._hwm_bytes = int(max_buffer_bytes * high_watermark)
+        self._max_unacked = max_unacked
         self._buffered_bytes = 0
         self._lock = threading.Lock()
         # id -> message, insertion-ordered (dicts preserve order) so
@@ -352,12 +389,14 @@ class Producer:
                                   connect, retry_delay_s,
                                   retry_opts=retry_opts,
                                   breaker_opts=breaker_opts,
-                                  src=self._src)
+                                  src=self._src,
+                                  max_unacked=max_unacked)
             for cs in topic.consumer_services
         ]
         for w in self._service_writers:
             w._on_ack = self._message_acked
         self.dropped_oldest = 0
+        self.backpressure_rejections = 0
         # The reference's message writer scans its queue on a schedule
         # (writer/message_writer.go scanMessageQueue loop) — without this
         # thread, at-least-once only held if the CALLER remembered to pump
@@ -370,15 +409,37 @@ class Producer:
         self._retry_thread.start()
 
     def publish(self, shard: int, value: bytes) -> int:
-        """Publish one message to every consumer service; returns message id."""
+        """Publish one message to every consumer service; returns message
+        id. Raises the typed Backpressure past the buffer's high
+        watermark (or a writer's unacked-entry cap): the producer is
+        outrunning its consumers and the caller must back off — retrying
+        hot would only push the buffer into drop-oldest data loss."""
         with self._lock:
+            if self._buffered_bytes + len(value) > self._hwm_bytes:
+                self.backpressure_rejections += 1
+                raise Backpressure(
+                    f"producer buffer past high watermark "
+                    f"({self._buffered_bytes + len(value)}/{self._hwm_bytes} "
+                    f"bytes buffered): consumers behind — back off")
             mid = self._next_id
             self._next_id += 1
             msg = _Message(mid, shard, value, refs=len(self._service_writers))
             self._order[mid] = msg
             self._buffered_bytes += msg.size
-        for w in self._service_writers:
-            w.write(msg)
+        try:
+            for w in self._service_writers:
+                w.write(msg)
+        except Backpressure:
+            # A writer-level cap fired mid-fanout: unwind this message
+            # everywhere (partial enqueue must not be retried-until-acked
+            # on some services while the caller thinks it failed).
+            with self._lock:
+                if self._order.pop(mid, None) is not None:
+                    self._buffered_bytes -= msg.size
+                self.backpressure_rejections += 1
+            for w in self._service_writers:
+                w.forget(mid)
+            raise
         # Enforce after the writes: if this (or any) message is evicted by
         # drop-oldest, _enforce_buffer forgets it from every writer queue as
         # well, so an over-cap message is not retried-until-acked and the

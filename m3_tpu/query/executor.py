@@ -21,6 +21,7 @@ import numpy as np
 
 from ..ops import series_agg, temporal
 from . import promql
+from ..utils import limits as xlimits
 from ..utils.tracing import span
 from .block import Block, BlockMeta, consolidate_series
 from .model import Matcher, MatchType, METRIC_NAME, Tags
@@ -177,8 +178,16 @@ class Engine:
     axis."""
 
     def __init__(self, storage, lookback_ns: int = DEFAULT_LOOKBACK_NS,
-                 cost_enforcer=None, per_query_cost_limit=None, mesh="auto"):
+                 cost_enforcer=None, per_query_cost_limit=None, mesh="auto",
+                 query_limits=None):
         self.storage = storage
+        # Overload-protection registry (utils.limits). None = resolve the
+        # process-global registry at query time, so a deployment that
+        # configures limits after engine construction still gets them.
+        # Each query runs inside a QueryScope: per-query child enforcers
+        # chained to the global concurrent budgets, installed thread-local
+        # so the storage/index charge sites below this query bill it.
+        self.query_limits = query_limits
         # "auto" resolves LAZILY on the first sharded-eligible query: the
         # resolution touches jax.devices(), i.e. backend init, and a server
         # must not block its startup on accelerator health (a downed tunnel
@@ -235,17 +244,20 @@ class Engine:
         # @ start()/end() resolve against the OUTERMOST query range even
         # inside subqueries (prom promql/parser/ast.go StartOrEnd).
         self._local.outer_params = params
-        if self.cost_enforcer is not None:
-            child = self.cost_enforcer.child(self.per_query_cost_limit)
-            self._local.enforcer = child
-            try:
+        ql = self.query_limits if self.query_limits is not None \
+            else xlimits.get_global()
+        with ql.scope("query"):
+            if self.cost_enforcer is not None:
+                child = self.cost_enforcer.child(self.per_query_cost_limit)
+                self._local.enforcer = child
+                try:
+                    val = self._eval(ast, params)
+                finally:
+                    self._local.enforcer = None
+                    child.release(child.current())
+            else:
                 val = self._eval(ast, params)
-            finally:
-                self._local.enforcer = None
-                child.release(child.current())
-        else:
-            val = self._eval(ast, params)
-        return _to_block(val, params)
+            return _to_block(val, params)
 
     def execute_instant(self, query: str, t_ns: int,
                         ast: Optional[Node] = None) -> Block:
@@ -283,9 +295,16 @@ class Engine:
             series = self.storage.fetch_raw(
                 promql.selector_matchers(sel), start_ns, end_ns)
             sp.set_tag("series", len(series))
+        points = sum(len(e["t"]) for e in series.values())
+        # Per-query datapoint budget: bills the QueryScope's child
+        # enforcer installed by _execute_range (utils.limits), so one
+        # runaway selector exhausts its own budget, not the process's.
+        # This is the single datapoint charge point on the query path —
+        # LocalStorage.fetch_raw reads shards directly, below database's
+        # charging wrapper.
+        xlimits.charge("datapoints_decoded", points)
         enforcer = getattr(self._local, "enforcer", None)
         if enforcer is not None:
-            points = sum(len(e["t"]) for e in series.values())
             enforcer.add(points)
         return series
 

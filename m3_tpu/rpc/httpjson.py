@@ -15,6 +15,7 @@ from typing import Any
 
 import numpy as np
 
+from ..utils.limits import ResourceExhausted
 from .node_server import NodeService
 
 
@@ -70,6 +71,11 @@ class HTTPJSONServer:
                     result = svc.dispatch(method, args)
                     out = {"ok": True, "r": _to_json(result)}
                     code = 200
+                except ResourceExhausted as e:
+                    # typed shed: 429 so HTTP producers back off (the
+                    # JSON mirror of the wire's resource_exhausted frame)
+                    out, code = {"ok": False, "err": str(e),
+                                 "kind": "resource_exhausted"}, 429
                 except Exception as e:  # noqa: BLE001
                     out, code = {"ok": False, "err": str(e)}, 400
                 data = json.dumps(out).encode()

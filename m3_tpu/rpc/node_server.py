@@ -22,6 +22,10 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..storage.database import Database
+from ..storage.series import charge_read
+from ..utils import limits as xlimits
+from ..utils.health import AdmissionGate, Priority
+from ..utils.limits import ResourceExhausted
 from ..utils.retry import Deadline, DeadlineExceeded
 from . import wire
 
@@ -30,12 +34,44 @@ class RPCError(Exception):
     """Server-side error carried back over the wire."""
 
 
-class NodeService:
-    """Dispatchable method table over a storage.Database."""
+# Priority classification for admission control: the traffic whose loss
+# turns an overload into an outage is CRITICAL and is never shed —
+# health/admin probes (operators must see INTO an overloaded node) and
+# replication/bootstrap streams (shedding them converts one overloaded
+# replica into an under-replicated shard). Everything else is NORMAL
+# serving traffic unless the request frame marks itself "bulk"
+# (backfill), which sheds first at the high watermark.
+_CRITICAL_METHODS = frozenset({
+    "health", "namespaces", "truncate",
+    "fetch_blocks", "fetch_blocks_metadata",
+})
 
-    def __init__(self, db: Database):
+
+def method_priority(method: str, hint: Optional[str] = None) -> Priority:
+    if method in _CRITICAL_METHODS:
+        return Priority.CRITICAL
+    if hint == "bulk":
+        return Priority.BULK
+    return Priority.NORMAL
+
+
+class NodeService:
+    """Dispatchable method table over a storage.Database, fronted by a
+    bounded admission gate: in-flight requests past the high watermark
+    shed bulk backfill, past capacity shed normal serving traffic too —
+    with typed Backpressure so producers back off — while health/admin
+    and replication always get through."""
+
+    def __init__(self, db: Database, gate: Optional[AdmissionGate] = None,
+                 limits: Optional[xlimits.QueryLimits] = None):
         self.db = db
         self.start_ns = time.time_ns()
+        # Default gate is generous (threaded server, sub-ms dispatches:
+        # 1024 in flight means the node is drowning) but FINITE — overload
+        # protection must be on by default, not a config opt-in.
+        self.gate = gate if gate is not None else AdmissionGate(
+            capacity=1024, name="rpc.node")
+        self._limits = limits
         # Per-request deadline, thread-local because the ThreadingTCPServer
         # dispatches each connection on its own thread: rpc_* methods read
         # it to bail out of long loops once the caller's budget is gone.
@@ -44,7 +80,8 @@ class NodeService:
     # --------------------------------------------------------------- dispatch
 
     def dispatch(self, method: str, args: dict,
-                 deadline: Optional[Deadline] = None):
+                 deadline: Optional[Deadline] = None,
+                 priority_hint: Optional[str] = None):
         fn = getattr(self, "rpc_" + method, None)
         if fn is None:
             raise RPCError(f"unknown method {method!r}")
@@ -53,11 +90,19 @@ class NodeService:
         # the caller stopped waiting for.
         if deadline is not None:
             deadline.check(method)
-        self._local.deadline = deadline
-        try:
-            return fn(**args)
-        finally:
-            self._local.deadline = None
+        ql = self._limits if self._limits is not None else xlimits.get_global()
+        # Admission THEN limits scope: a shed request must cost nothing
+        # beyond the gate check. The scope's child enforcers chain every
+        # storage/index charge below this request to the global budgets
+        # and release them all on the way out — 1k rejected queries leak
+        # zero budget (asserted by scripts/overload_smoke.py).
+        with self.gate.held(priority=method_priority(method, priority_hint)):
+            with ql.scope(f"rpc.{method}"):
+                self._local.deadline = deadline
+                try:
+                    return fn(**args)
+                finally:
+                    self._local.deadline = None
 
     def _check_deadline(self, what: str):
         dl = getattr(self._local, "deadline", None)
@@ -97,8 +142,12 @@ class NodeService:
         return {"t": t, "v": v}
 
     def _series_segments(self, shard, idx: int, start_ns: int, end_ns: int) -> dict:
-        """Encoded sealed-block rows + raw buffer columns for one series."""
+        """Encoded sealed-block rows + raw buffer columns for one series.
+        Encoded bytes about to cross the wire charge the bytes-read limit
+        (query_limits.go bytes-read): the budget rejects a fetch mid
+        fan-in before it materializes the rest of an oversized result."""
         segs = []
+        nbytes = 0
         with shard.write_lock:  # snapshot racing tick's expiry/seal
             blocks = dict(shard.blocks)
             bt, bv = shard.buffer.read(idx, start_ns, end_ns)
@@ -109,14 +158,17 @@ class NodeService:
             row = blk.row_of(idx)
             if row is None:
                 continue
+            words = np.asarray(blk.words[row])
+            nbytes += words.nbytes
             segs.append({
                 "bs": bs,
-                "words": np.asarray(blk.words[row]),
+                "words": words,
                 "nbits": int(blk.nbits[row]),
                 "npoints": int(blk.npoints[row]),
                 "window": int(blk.window),
                 "time_unit": int(blk.time_unit),
             })
+        charge_read(n_bytes=nbytes + bt.nbytes + bv.nbytes)
         return {"segments": segs, "buf_t": bt, "buf_v": bv}
 
     def rpc_fetch_tagged(self, ns: bytes, query: dict, start_ns: int, end_ns: int,
@@ -140,6 +192,9 @@ class NodeService:
                 out.append({"id": sid, "tags": {}, "segments": [],
                             "buf_t": np.zeros(0, np.int64), "buf_v": np.zeros(0)})
                 continue
+            # identity cost (id + tag pairs) charges bytes-read before the
+            # segment payloads do — a tags-only fetch is still metered
+            charge_read(n_bytes=shard.registry.entry_bytes(idx))
             entry = {"id": sid, "tags": shard.registry.tags_of(idx) or {}}
             if fetch_data:
                 entry.update(self._series_segments(shard, idx, start_ns, end_ns))
@@ -288,8 +343,11 @@ class NodeServer:
                         # on this host's monotonic clock.
                         deadline = wire.deadline_from_frame(req)
                         try:
+                            pri = req.get("pri")
                             result = svc.dispatch(req["m"], req.get("a", {}),
-                                                  deadline=deadline)
+                                                  deadline=deadline,
+                                                  priority_hint=pri if
+                                                  isinstance(pri, str) else None)
                             wire.write_frame(sock, {"id": msg_id, "ok": True, "r": result})
                         except DeadlineExceeded as e:
                             # Typed error frame: the caller distinguishes
@@ -298,6 +356,16 @@ class NodeServer:
                             wire.write_frame(sock, {"id": msg_id, "ok": False,
                                                     "kind": "deadline",
                                                     "err": str(e)})
+                        except ResourceExhausted as e:
+                            # Typed shed frame: a query limit or the
+                            # admission gate rejected this request. The
+                            # client classifies it retryable-with-backoff
+                            # (the condition clears as windows expire and
+                            # in-flight work drains) — the opposite of
+                            # "deadline", which never retries.
+                            wire.write_frame(sock, {
+                                "id": msg_id, "ok": False,
+                                "kind": "resource_exhausted", "err": str(e)})
                         # DELIBERATE broad except: the dispatch contract is
                         # to relay ANY server-side application error to the
                         # caller as a typed error frame — the wire write in

@@ -15,6 +15,7 @@ from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 import numpy as np
 
 from .namespace import Namespace, NamespaceOptions
+from .series import charge_read
 
 
 def fold_tags(out: Dict[bytes, set], tags, filter_set, name_only: bool):
@@ -128,19 +129,30 @@ class Database:
     # ------------------------------------------------------------------- read
 
     def read(self, namespace: bytes, series_id: bytes, start_ns: int, end_ns: int):
-        """database.go:739 ReadEncoded equivalent, returning decoded points."""
+        """database.go:739 ReadEncoded equivalent, returning decoded
+        points. Charges the series/datapoint/bytes query limits
+        (query_limits.go): a read that lands inside a query scope bills
+        that query's child enforcer; a bare RPC read bills the global
+        per-second windows."""
         ns = self.namespace(namespace)
-        return ns.read(self.shard_set.lookup(series_id), series_id, start_ns, end_ns)
+        t, v = ns.read(self.shard_set.lookup(series_id), series_id,
+                       start_ns, end_ns)
+        charge_read(n_series=1, n_points=len(t), n_bytes=t.nbytes + v.nbytes)
+        return t, v
 
     def query_ids(self, namespace: bytes, query, start_ns: int = 0, end_ns: int = 2**63 - 1,
                   limit: int = 0):
         """database.go:724 QueryIDs -> reverse index query. `limit`
         pushes the RPC's series cap down to the index (sorted-prefix
-        semantics preserved: the index truncates after the sorted union)."""
+        semantics preserved: the index truncates after the sorted union).
+        The materialized id count charges the series-fetched limit (the
+        index already charged docs-matched per segment pre-gather)."""
         ns = self.namespace(namespace)
         if ns.index is None:
             raise RuntimeError(f"namespace {namespace!r} has no index")
-        return ns.index.query(query, start_ns, end_ns, limit=limit)
+        ids = ns.index.query(query, start_ns, end_ns, limit=limit)
+        charge_read(n_series=len(ids))
+        return ids
 
     def aggregate_tags(self, namespace: bytes, query, start_ns: int, end_ns: int,
                        name_only: bool = False,

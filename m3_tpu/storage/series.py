@@ -56,3 +56,32 @@ class SeriesRegistry:
 
     def all_ids(self) -> List[bytes]:
         return list(self._ids)
+
+    def entry_bytes(self, idx: int) -> int:
+        """Approximate wire bytes for serving this series' identity (id +
+        tag pairs) — the per-series floor a tagged fetch pays before any
+        datapoint bytes. Feeds the bytes-read query limit (the registry
+        is the only id-keyed structure on the hot path, so identity-cost
+        accounting lives here with it)."""
+        n = len(self._ids[idx])
+        tags = self._tags[idx]
+        if tags:
+            for k, v in tags.items():
+                n += len(k) + len(v)
+        return n
+
+
+def charge_read(n_series: int = 0, n_points: int = 0, n_bytes: int = 0):
+    """Charge a storage read against the query limits registry
+    (utils.limits): series materialized, datapoints decoded, encoded
+    bytes touched. One helper so every read path (database.read,
+    query_ids, the node fetch fan-ins) meters identically; raises
+    ResourceExhausted past a budget."""
+    from ..utils import limits as xlimits
+
+    if n_series:
+        xlimits.charge("series_fetched", n_series)
+    if n_points:
+        xlimits.charge("datapoints_decoded", n_points)
+    if n_bytes:
+        xlimits.charge("bytes_read", n_bytes)

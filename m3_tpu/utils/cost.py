@@ -49,11 +49,18 @@ class Enforcer:
             return self._current
 
     def release(self, cost: Optional[float] = None):
-        """Return capacity when a query finishes (enforcer.go Remove)."""
+        """Return capacity when a query finishes (enforcer.go Remove).
+
+        cost=None releases this enforcer's FULL current charge. The
+        amount actually released is captured BEFORE the local decrement
+        and propagated to the parent: a full release must credit the
+        whole chain, or every completed query would permanently leak
+        its charge from the global budget (the release(None) parent
+        leak — regression-tested in tests/test_overload.py)."""
         with self._lock:
-            self._current -= self._current if cost is None else cost
-            released = cost
-        if self.parent is not None and released is not None:
+            released = self._current if cost is None else cost
+            self._current -= released
+        if self.parent is not None and released:
             self.parent.release(released)
 
     def child(self, limit: Optional[float] = None, name: str = "query"

@@ -34,6 +34,12 @@ echo "== chaos smoke (seeded faultnet, one scenario per layer) =="
 # seconds, not twenty minutes in; the full matrix is tests/test_resilience.py.
 JAX_PLATFORMS=cpu python scripts/chaos_smoke.py --seed 7
 
+echo "== overload smoke (<5s; seeded 3x overload, shed-by-priority asserted) =="
+# Overload-protection regressions (query limits / admission control /
+# typed ResourceExhausted / budget leaks) fail here in seconds; the full
+# matrix is tests/test_overload.py. Wall budget via OVERLOAD_SMOKE_BUDGET_S.
+JAX_PLATFORMS=cpu python scripts/overload_smoke.py --seed 7
+
 echo "== test suite =="
 python -m pytest tests/ -x -q
 

@@ -1,0 +1,207 @@
+#!/usr/bin/env python
+"""Seeded overload smoke: the <5s check_all tier for the overload-
+protection layer (query limits + admission control + typed shedding).
+The full matrix lives in tests/test_overload.py; this drives ONE real
+node server through a seeded 3x-overload schedule (m3_tpu.testing.
+loadgen — open loop, so a degrading server cannot hide the offered
+load) and asserts the headline guarantees:
+
+  1. health/replication traffic is NEVER shed, even at 3x;
+  2. in-flight work (the memory bound) never exceeds the gate's
+     capacity plus the critical overshoot, and p99 latency of served
+     requests stays bounded under overload;
+  3. after load drops, throughput recovers to within 10% of baseline;
+  4. ResourceExhausted rides the wire as a typed frame and is
+     classified retryable (a retrying client converges post-overload);
+  5. 1000+ rejected queries leak zero budget: every enforcer reads 0
+     in-flight when the storm ends.
+
+Usage: python scripts/overload_smoke.py [--seed N]
+Wall budget: OVERLOAD_SMOKE_BUDGET_S (default 5.0 seconds).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from m3_tpu.client.session import HostClient  # noqa: E402
+from m3_tpu.index import query as iq  # noqa: E402
+from m3_tpu.parallel.sharding import ShardSet  # noqa: E402
+from m3_tpu.rpc import NodeServer, NodeService, wire  # noqa: E402
+from m3_tpu.storage.database import Database  # noqa: E402
+from m3_tpu.storage.namespace import NamespaceOptions  # noqa: E402
+from m3_tpu.testing.loadgen import LoadGen, LoadSchedule, Phase  # noqa: E402
+from m3_tpu.utils.health import (  # noqa: E402
+    AdmissionGate,
+    HealthTracker,
+)
+from m3_tpu.utils.limits import (  # noqa: E402
+    LimitOptions,
+    QueryLimits,
+    ResourceExhausted,
+)
+from m3_tpu.utils.retry import RetryOptions, default_is_retryable  # noqa: E402
+
+NS = b"smoke"
+N_SERIES = 20
+# docs window sized between baseline (~60 q/s x 20 docs = 1200/s) and
+# 3x overload (~3600/s): baseline passes untouched, overload sheds.
+DOCS_PER_SECOND = 2000.0
+
+
+def build_server():
+    db = Database(ShardSet(2), clock=lambda: 10**9)
+    db.mark_bootstrapped()
+    db.ensure_namespace(NS, NamespaceOptions(index_enabled=True,
+                                             writes_to_commitlog=False))
+    for i in range(N_SERIES):
+        db.write(NS, b"s-%03d" % i, 10**6 * i, float(i),
+                 tags={b"__name__": b"m", b"host": b"h%03d" % i})
+    limits = QueryLimits(docs_matched=LimitOptions(per_second=DOCS_PER_SECOND,
+                                                   concurrent=100_000))
+    gate = AdmissionGate(capacity=64, high_watermark=0.75,
+                         name="smoke.node", tracker=HealthTracker())
+    srv = NodeServer(NodeService(db, gate=gate, limits=limits), port=0).start()
+    return srv, gate, limits
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="seeded overload smoke")
+    ap.add_argument("--seed", type=int, default=7)
+    args = ap.parse_args(argv)
+    budget_s = float(os.environ.get("OVERLOAD_SMOKE_BUDGET_S", "5.0"))
+    t_start = time.monotonic()
+
+    srv, gate, limits = build_server()
+    # Serving traffic and critical probes ride separate clients, like a
+    # real deployment's separate channels: a saturated data pool must
+    # not queue health checks client-side.
+    no_retry = RetryOptions(max_attempts=1, seed=args.seed)
+    data_hc = HostClient(srv.endpoint, pool_size=64, timeout=5,
+                         retry_opts=no_retry)
+    crit_hc = HostClient(srv.endpoint, pool_size=8, timeout=5,
+                         retry_opts=no_retry)
+    all_q = wire.query_to_wire(iq.AllQuery())
+
+    def fire(kind: str):
+        if kind == "query":
+            data_hc.call("fetch_tagged", ns=NS, query=all_q,
+                         start_ns=0, end_ns=2**62)
+        elif kind == "write":
+            data_hc.call("write", ns=NS, id=b"s-000", t_ns=5 * 10**6,
+                         value=1.0)
+        elif kind == "health":
+            assert crit_hc.call("health")["ok"]
+        else:  # repl: bootstrap/repair metadata stream
+            crit_hc.call("fetch_blocks_metadata", ns=NS, shard=0,
+                         start_ns=0, end_ns=2**62)
+
+    sched = LoadSchedule(
+        seed=args.seed, base_rate=120.0,
+        phases=(Phase("base", 0.8, 1.0),
+                Phase("overload", 0.8, 3.0),
+                Phase("drain", 0.5, 0.05),
+                Phase("recover", 0.8, 1.0)),
+        kinds=(("query", 0.5), ("write", 0.3),
+               ("health", 0.1), ("repl", 0.1)))
+    report = LoadGen(sched).run(fire, join_timeout_s=10.0)
+
+    failures = []
+
+    def check(name, ok, detail=""):
+        print(f"  {name:44s} {'ok' if ok else 'FAIL'}"
+              f"{('  ' + detail) if detail else ''}")
+        if not ok:
+            failures.append(name)
+
+    # 1. critical traffic never shed (and never failed at all)
+    for kind in ("health", "repl"):
+        bad = {o: n for o, n in report.outcomes(kind=kind).items()
+               if o != "ok"}
+        n = len(report.select(kind=kind))
+        check(f"zero shed {kind} requests ({n} sent)", not bad, str(bad))
+    check("gate shed zero critical", gate.shed["critical"] == 0,
+          str(gate.shed))
+
+    # 2. bounded memory + bounded p99 under 3x overload
+    crit_inflight_margin = 32
+    check("in-flight depth bounded by gate capacity",
+          gate.max_depth() <= gate.capacity + crit_inflight_margin,
+          f"max_depth={gate.max_depth()} cap={gate.capacity}")
+    p99 = report.p99(phase="overload")
+    check("p99 bounded under 3x overload", p99 < 1.0, f"p99={p99 * 1e3:.1f}ms")
+    n_overload = len(report.select(phase="overload"))
+    done = len(report.records)
+    check("open loop delivered every arrival",
+          done == sum(round(120 * ph.rate_multiplier * ph.duration_s)
+                      for ph in sched.phases),
+          f"records={done}")
+
+    # 3. throughput recovery within 10% of baseline
+    def success_rate(phase, kind="query"):
+        sel = report.select(phase=phase, kind=kind)
+        if not sel:
+            return 1.0
+        return len([r for r in sel if r.outcome == "ok"]) / len(sel)
+
+    base_sr, rec_sr = success_rate("base"), success_rate("recover")
+    check("baseline queries mostly admitted", base_sr >= 0.95,
+          f"{base_sr:.2f}")
+    check("recovery within 10% of baseline", rec_sr >= base_sr - 0.10,
+          f"base={base_sr:.2f} recover={rec_sr:.2f}")
+
+    # 4. the overload actually shed typed, retryable rejections
+    shed = report.outcomes(phase="overload", kind="query").get(
+        "ResourceExhausted", 0)
+    check("typed ResourceExhausted shed under overload", shed > 0,
+          f"shed={shed}/{n_overload}")
+    check("classified retryable",
+          default_is_retryable(ResourceExhausted("x")))
+    retry_hc = HostClient(srv.endpoint, timeout=5,
+                          retry_opts=RetryOptions(max_attempts=4,
+                                                  initial_backoff_s=0.05,
+                                                  seed=args.seed))
+    try:
+        retry_hc.call("fetch_tagged", ns=NS, query=all_q,
+                      start_ns=0, end_ns=2**62)
+        check("retrying client converges post-overload", True)
+    except Exception as e:  # noqa: BLE001
+        check("retrying client converges post-overload", False, str(e))
+    retry_hc.close()
+
+    # 5. 1k+ rejected queries leak zero budget
+    rejected = 0
+    for _ in range(1500):
+        try:
+            data_hc.call("fetch_tagged", ns=NS, query=all_q,
+                         start_ns=0, end_ns=2**62)
+        except ResourceExhausted:
+            rejected += 1
+        if rejected >= 1000:
+            break
+    check("1000 queries rejected for the leak probe", rejected >= 1000,
+          f"rejected={rejected}")
+    for kind in ("docs_matched", "series_fetched", "datapoints_decoded",
+                 "bytes_read"):
+        cur = limits.enforcer(kind).current()
+        check(f"no leaked {kind} budget", cur == 0, f"in_flight={cur}")
+    check("gate fully released", gate.depth() == 0,
+          f"depth={gate.depth()}")
+
+    data_hc.close()
+    crit_hc.close()
+    srv.close()
+    total = time.monotonic() - t_start
+    check("wall budget", total < budget_s, f"{total:.2f}s/{budget_s:.0f}s")
+    print(f"overload smoke: {len(failures)} failure(s) in {total:.1f}s "
+          f"(seed {args.seed})")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

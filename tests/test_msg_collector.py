@@ -157,11 +157,30 @@ class TestProducerConsumer:
             prod.close()
             consumer.close()
 
-    def test_drop_oldest_bounds_buffer(self):
-        # No consumer reachable: everything stays buffered; cap forces drops.
+    def test_publish_backpressure_before_drop_oldest(self):
+        # No consumer reachable: the high watermark surfaces typed
+        # Backpressure to publish() BEFORE any data loss — the buffer
+        # stays bounded and nothing is silently dropped.
+        from m3_tpu.utils.limits import Backpressure
+
         topic = Topic("t", 1, (ConsumerService("svc"),))
         dead = one_instance_placement("127.0.0.1:1", num_shards=1)
         prod = Producer(topic, {"svc": lambda: dead}, max_buffer_bytes=1000)
+        with pytest.raises(Backpressure):
+            for i in range(50):
+                prod.publish(0, b"x" * 100)
+        assert prod.buffered_bytes() <= 1000
+        assert prod.backpressure_rejections >= 1
+        assert prod.dropped_oldest == 0  # bounded WITHOUT silent loss
+        prod.close()
+
+    def test_drop_oldest_bounds_buffer(self):
+        # high_watermark > 1 opts out of the backpressure gate: the
+        # reference's pure drop-oldest semantics — cap forces drops.
+        topic = Topic("t", 1, (ConsumerService("svc"),))
+        dead = one_instance_placement("127.0.0.1:1", num_shards=1)
+        prod = Producer(topic, {"svc": lambda: dead}, max_buffer_bytes=1000,
+                        high_watermark=2.0)
         for i in range(50):
             prod.publish(0, b"x" * 100)
         assert prod.buffered_bytes() <= 1000

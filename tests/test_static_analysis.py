@@ -16,6 +16,7 @@ from m3_tpu.analysis.cache_rules import (CacheKeyBufferRule,
 from m3_tpu.analysis.jax_rules import (ItemInLoopRule, JaxPurityRule,
                                        NonStaticJitCacheRule)
 from m3_tpu.analysis.lock_rules import LockDisciplineRule
+from m3_tpu.analysis.overload_rules import UnboundedQueueRule
 from m3_tpu.analysis.retry_rules import (BroadExceptWireIORule,
                                          RawSleepRetryRule)
 
@@ -898,6 +899,98 @@ class TestRetryRules:
                     pass
         """
         assert lint(src, BroadExceptWireIORule()) == []
+
+
+class TestUnboundedQueueRule:
+    """unbounded-queue: stdlib Queue()/deque() without a bound inside the
+    buffering layers (storage/msg/coordinator/aggregator/rpc) turn
+    overload into OOM instead of backpressure."""
+
+    def test_flags_unbounded_deque_in_msg(self):
+        src = """
+            from collections import deque
+
+            pending = deque()
+        """
+        found = lint(src, UnboundedQueueRule(), "m3_tpu/msg/mod.py")
+        assert rule_ids(found) == ["unbounded-queue"]
+
+    def test_flags_unbounded_queue_in_storage(self):
+        src = """
+            import queue
+
+            work = queue.Queue()
+        """
+        found = lint(src, UnboundedQueueRule(), "m3_tpu/storage/mod.py")
+        assert rule_ids(found) == ["unbounded-queue"]
+
+    def test_flags_literal_unbounded_maxsize(self):
+        # Queue semantics: maxsize <= 0 means infinite — a literal 0 or
+        # negative bound is no bound
+        src = """
+            import queue
+
+            a = queue.Queue(0)
+            b = queue.Queue(maxsize=-1)
+        """
+        found = lint(src, UnboundedQueueRule(), "m3_tpu/rpc/mod.py")
+        assert rule_ids(found) == ["unbounded-queue", "unbounded-queue"]
+
+    def test_simple_queue_always_flags(self):
+        src = """
+            import queue
+
+            q = queue.SimpleQueue()
+        """
+        found = lint(src, UnboundedQueueRule(), "m3_tpu/aggregator/mod.py")
+        assert rule_ids(found) == ["unbounded-queue"]
+        assert "no capacity bound" in found[0].message
+
+    def test_bounded_forms_are_fine(self):
+        src = """
+            import queue
+            from collections import deque
+
+            a = queue.Queue(100)
+            b = queue.Queue(maxsize=64)
+            c = deque(maxlen=4096)
+            d = deque([], 16)
+        """
+        assert lint(src, UnboundedQueueRule(), "m3_tpu/msg/mod.py") == []
+
+    def test_out_of_scope_dirs_are_ignored(self):
+        src = """
+            from collections import deque
+
+            scratch = deque()
+        """
+        assert lint(src, UnboundedQueueRule(), "m3_tpu/ops/mod.py") == []
+
+    def test_local_helper_named_deque_is_not_stdlib(self):
+        src = """
+            def deque():
+                return []
+
+            pending = deque()
+        """
+        assert lint(src, UnboundedQueueRule(), "m3_tpu/msg/mod.py") == []
+
+    def test_dotted_non_stdlib_parent_is_ignored(self):
+        src = """
+            import mylib
+
+            q = mylib.Queue()
+        """
+        assert lint(src, UnboundedQueueRule(), "m3_tpu/msg/mod.py") == []
+
+    def test_suppression_with_justification(self):
+        src = """
+            from collections import deque
+
+            # DELIBERATE: control-plane only, bounded by topic count
+            topics = deque()  # m3lint: disable=unbounded-queue
+        """
+        assert lint(src, UnboundedQueueRule(), "m3_tpu/msg/mod.py") == []
 
 
 class TestTreeGate:
