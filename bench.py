@@ -732,6 +732,136 @@ def bench_index_fetch_tagged():
     }
 
 
+def bench_write_path_ingest():
+    """Config #7: storage write path (datapoints/sec through
+    database.write_batch), the host-plane path every ingest RPC pays
+    before any device work: shard route -> series registry resolve ->
+    reverse-index insert for first-seen series -> columnar buffer append.
+
+    Two mixes, both against the exact Database wiring the node RPC
+    serves (namespace index enabled, commitlog off so the measurement
+    isolates the registry/index/buffer path):
+
+      * new-series burst — every batch is ~80% first-seen series with
+        full tag sets (deploy/topology-churn shape). Pre-change this
+        pays a per-id synchronous registry + index insert under the
+        shard write lock (the gap the reference covers with
+        shard_insert_queue.go / index_insert_queue.go); the headline
+        value measures that rebuild directly.
+      * steady-state known series — the same ids re-written each pass
+        with fresh timestamps, the scrape-interval hot path. Reported
+        as extra.steady_dps and compared against the
+        write_path_ingest_steady baseline key (the queue must not tax
+        the known-series fast path).
+
+    Pure host work by design (like index_fetch_tagged): the number is
+    platform-independent."""
+    from m3_tpu.parallel.sharding import ShardSet
+    from m3_tpu.storage.database import Database
+    from m3_tpu.utils import xtime
+
+    n_series = int(os.environ.get("BENCH_WRITE_SERIES", "40000"))
+    batch = int(os.environ.get("BENCH_WRITE_BATCH", "2000"))
+    iters = int(os.environ.get("BENCH_WRITE_ITERS", "3"))
+    steady_passes = int(os.environ.get("BENCH_WRITE_PASSES", "3"))
+    rng = np.random.default_rng(47)
+    t0 = 1_700_000_000 * 1_000_000_000
+    now = {"t": t0}
+
+    names = [b"svc_%03d_latency" % i for i in range(100)]
+    dcs = [b"dc_%d" % i for i in range(4)]
+    roles = [b"role_%d" % i for i in range(8)]
+
+    def make_tags(i: int) -> dict:
+        return {
+            b"__name__": names[int(rng.integers(len(names)))],
+            b"host": b"host-%05d" % int(rng.integers(n_series // 10 or 1)),
+            b"dc": dcs[int(rng.integers(len(dcs)))],
+            b"role": roles[int(rng.integers(len(roles)))],
+            b"pod": b"pod-%07d" % i,
+        }
+
+    _phase(f"write: building {n_series} ids/tags")
+    all_ids = [b"wseries-%07d" % i for i in range(n_series)]
+    all_tags = [make_tags(i) for i in range(n_series)]
+
+    # Burst batches: 80% new ids in first-seen order, 20% re-writes of
+    # ids from earlier batches (the mixed new/known shape of a rollout).
+    new_frac = 0.8
+    burst_batches = []
+    cursor = 0
+    while cursor < n_series:
+        n_new = min(int(batch * new_frac), n_series - cursor)
+        sel = list(range(cursor, cursor + n_new))
+        if cursor:
+            sel += [int(x) for x in rng.integers(0, cursor, batch - n_new)]
+        cursor += n_new
+        burst_batches.append(
+            ([all_ids[j] for j in sel], [all_tags[j] for j in sel]))
+    burst_points = sum(len(ids) for ids, _ in burst_batches)
+
+    def fresh_db() -> Database:
+        db = Database(ShardSet(num_shards=16),
+                      clock=lambda: now["t"])
+        db.ensure_namespace(b"bench")
+        return db
+
+    def run_burst() -> Database:
+        db = fresh_db()
+        for ids, tags in burst_batches:
+            ts = np.full(len(ids), now["t"], np.int64)
+            db.write_batch(b"bench", ids, ts, np.ones(len(ids)), tags=tags)
+        return db
+
+    _phase(f"write: burst mix ({len(burst_batches)} batches, "
+           f"{burst_points} points)")
+    run_burst()  # warm allocator/caches outside the timing loop
+    burst_dts = []
+    for _ in range(iters):
+        t1 = time.perf_counter()
+        db = run_burst()
+        burst_dts.append(time.perf_counter() - t1)
+    burst_dps = burst_points / min(burst_dts)
+    ns = db.namespace(b"bench")
+    assert sum(s.num_series() for s in ns.shards.values()) == n_series
+
+    # Steady state: same ids re-written against the LAST burst database
+    # (registry and index fully warm), fresh timestamps per pass.
+    steady_order = [all_ids[j]
+                    for j in rng.permutation(n_series)]
+    steady_batches = [steady_order[i:i + batch]
+                      for i in range(0, n_series, batch)]
+
+    def run_steady():
+        for p in range(steady_passes):
+            now["t"] = t0 + (p + 1) * xtime.SECOND
+            for ids in steady_batches:
+                ts = np.full(len(ids), now["t"], np.int64)
+                db.write_batch(b"bench", ids, ts, np.ones(len(ids)))
+
+    _phase(f"write: steady mix ({steady_passes} passes)")
+    steady_points = n_series * steady_passes
+    steady_dts = []
+    for _ in range(iters):
+        t1 = time.perf_counter()
+        run_steady()
+        steady_dts.append(time.perf_counter() - t1)
+    steady_dps = steady_points / min(steady_dts)
+    _phase("write: done")
+    return {
+        "metric": "write_path_ingest",
+        "value": round(burst_dps, 1),
+        "unit": "datapoints/sec",
+        "extra": {
+            "series": n_series, "batch": batch,
+            "new_series_frac": new_frac,
+            "steady_dps": round(steady_dps, 1),
+            "steady_passes": steady_passes,
+            "shards": 16,
+        },
+    }
+
+
 _BENCHES = [
     ("m3tsz_encode_1m_rollup", bench_encode_rollup),
     ("counter_gauge_rollup", bench_counter_gauge),
@@ -739,6 +869,7 @@ _BENCHES = [
     ("timer_quantile_rollup", bench_timer_quantiles),
     ("shard_flush_merge", bench_flush_merge),
     ("index_fetch_tagged", bench_index_fetch_tagged),
+    ("write_path_ingest", bench_write_path_ingest),
 ]
 
 
@@ -1015,6 +1146,14 @@ def main():
         if e2e and e2e_base:
             extra["cpu_e2e_baseline_dps"] = e2e_base
             extra["e2e_vs_cpu_e2e"] = round(e2e / e2e_base, 3)
+        # Steady-state companion ratio for the write-path config: the
+        # new-series burst is the headline, but the known-series fast
+        # path must not regress (>=0.95x is the acceptance bar).
+        steady = extra.get("steady_dps")
+        steady_base = baselines.get("write_path_ingest_steady")
+        if steady and steady_base:
+            extra["steady_baseline_dps"] = steady_base
+            extra["steady_vs_baseline"] = round(steady / steady_base, 3)
         if errors:
             extra["retries"] = errors
         vs = (r["value"] / base) if (base and r["value"]) else None

@@ -341,4 +341,82 @@ class LockDisciplineRule(Rule):
                         self.severity)
 
 
-RULES: List[Rule] = [LockDisciplineRule()]
+# Per-item mutation calls that mark a hot loop: one dict/registry/index
+# mutation per iteration while every other writer waits on the lock.
+# Batch entrypoints (insert_batch, insert_many, get_or_create_batch*) do
+# NOT match — exact names only — because one batched call per lock hold
+# is precisely the fix.
+_HOT_MUTATION_METHODS = frozenset({"get_or_create", "setdefault", "insert"})
+
+
+class HotLoopUnderLockRule(Rule):
+    """hot-loop-under-lock: a per-item Python loop performing dict-style
+    mutations (`get_or_create(...)`, `.setdefault(...)`, `.insert(...)`)
+    inside a `with <lock>` block in the storage/index/aggregator write
+    paths. Every iteration pays a Python-level mutation while every
+    other writer of that lock waits — the shape the insert-queue rebuild
+    removed from Shard.write_batch (shard_insert_queue.go batches these
+    into ONE apply per drain). Fix by resolving/batching outside the
+    lock and applying through a bulk entrypoint (insert_batch /
+    insert_many / get_or_create_batch_tagged), or justify-suppress a
+    cold-path loop."""
+
+    id = "hot-loop-under-lock"
+    severity = "warning"
+    dirs = ("storage", "index", "aggregator")
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        model = _LockModel(mod)
+        seen: Set[int] = set()  # a loop nested in two locked withs reports once
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.With):
+                continue
+            lock_name = None
+            for item in node.items:
+                kind = model.lock_kind(item.context_expr)
+                if kind in ("lock", "rlock"):
+                    key = _attr_key(item.context_expr)
+                    lock_name = key.split(".")[-1]
+                    break
+            if lock_name is None:
+                continue
+            for loop in self._loops_in(node.body):
+                call = self._first_mutation(loop)
+                if call is not None and call.lineno not in seen:
+                    seen.add(call.lineno)
+                    yield Finding(
+                        self.id, mod.relpath, call.lineno,
+                        f"per-item .{call.func.attr}() loop while holding "
+                        f"{lock_name!r} — every writer contending on that "
+                        "lock waits out N Python-level mutations; batch "
+                        "outside the lock and apply through a bulk "
+                        "entrypoint (insert_batch / insert_many / "
+                        "get_or_create_batch_tagged), or justify-suppress "
+                        "a cold path",
+                        self.severity)
+
+    def _loops_in(self, stmts) -> Iterator[ast.AST]:
+        """Loop statements anywhere under `stmts`, NOT descending into
+        nested function/class scopes (they run on their own call stack,
+        not under this with-block's hold)."""
+        stack = list(stmts)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(node, (ast.For, ast.While)):
+                yield node
+                continue  # _first_mutation scans the whole loop body
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _first_mutation(self, loop: ast.AST) -> Optional[ast.Call]:
+        for sub in ast.walk(loop):
+            if isinstance(sub, ast.Call) and \
+                    isinstance(sub.func, ast.Attribute) and \
+                    sub.func.attr in _HOT_MUTATION_METHODS:
+                return sub
+        return None
+
+
+RULES: List[Rule] = [LockDisciplineRule(), HotLoopUnderLockRule()]

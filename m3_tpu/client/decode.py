@@ -21,11 +21,18 @@ from ..utils import xtime
 
 
 class ConflictStrategy(enum.Enum):
-    """Cross-replica same-timestamp resolution (encoding/iterators.go:60-105)."""
+    """Cross-replica same-timestamp resolution (encoding/iterators.go:60-105).
+
+    4/4 parity with the reference's IterateLastPushed / IterateHighest /
+    IterateLowest / IterateHighestFrequencyValue: HIGHEST_FREQUENCY_VALUE
+    picks the value the most replicas agree on at a timestamp, and a
+    frequency tie falls back to the last-pushed value among the tied
+    candidates, matching the reference's tie behavior."""
 
     LAST_PUSHED = "last_pushed"
     HIGHEST_VALUE = "highest_value"
     LOWEST_VALUE = "lowest_value"
+    HIGHEST_FREQUENCY_VALUE = "highest_frequency_value"
 
 
 def decode_segment_groups(segments: Sequence[dict]) -> List[Tuple[np.ndarray, np.ndarray]]:
@@ -85,6 +92,35 @@ def merge_replica_points(
     if strategy == ConflictStrategy.LAST_PUSHED:
         picked = np.zeros(len(uniq), np.float64)
         picked[inverse] = v  # later writes overwrite earlier per slot
+    elif strategy == ConflictStrategy.HIGHEST_FREQUENCY_VALUE:
+        # Majority vote per timestamp, resolved for ALL slots in one
+        # vectorized grouping pass (with full replica overlap EVERY slot
+        # is conflicted, so a per-slot Python scan would be quadratic):
+        # group points into (slot, value) runs, count each run, then per
+        # slot keep the run with the highest count — ties by the run
+        # whose last push arrived latest (last-pushed fallback).
+        arrival = np.arange(len(v))
+        order = np.lexsort((arrival, v, inverse))
+        sv, si, sa = v[order], inverse[order], arrival[order]
+        new_run = np.empty(len(sv), bool)
+        new_run[0] = True
+        np.logical_or(si[1:] != si[:-1], sv[1:] != sv[:-1],
+                      out=new_run[1:])
+        run_starts = np.flatnonzero(new_run)
+        run_slot = si[run_starts]
+        run_val = sv[run_starts]
+        run_count = np.diff(np.append(run_starts, len(sv)))
+        run_last_arrival = sa[np.append(run_starts[1:], len(sv)) - 1]
+        # Per slot take the lexicographically greatest (count, last
+        # arrival) run: sort runs so it lands last within each slot.
+        sel = np.lexsort((run_last_arrival, run_count, run_slot))
+        slot_sorted = run_slot[sel]
+        last_of_slot = np.empty(len(sel), bool)
+        np.not_equal(slot_sorted[1:], slot_sorted[:-1],
+                     out=last_of_slot[:-1])
+        last_of_slot[-1] = True
+        picked = np.zeros(len(uniq), np.float64)
+        picked[slot_sorted[last_of_slot]] = run_val[sel[last_of_slot]]
     elif strategy == ConflictStrategy.HIGHEST_VALUE:
         picked = np.full(len(uniq), -np.inf)
         np.maximum.at(picked, inverse, v)

@@ -3,10 +3,13 @@ src/dbnode/storage/index nsIndex: per-blockstart index blocks, mutable
 segments sealed and compacted into immutable segments, queried via m3ninx
 searchers).
 
-Writes land in the active block's mutable segment (async-batched in the
-reference via index_insert_queue; synchronous here — the storage write path
-already batches). Tick seals past blocks (mutable -> immutable compaction)
-and expires blocks beyond retention."""
+Writes land in the active block's mutable segment through the batched
+`insert_many` entrypoint: the storage tier's per-shard insert queue
+(storage/insert_queue.py, the shard_insert_queue/index_insert_queue
+analog) coalesces new-series documents so one queue drain costs one lock
+acquisition and one mutable-segment insert call, not N. Tick seals past
+blocks (mutable -> immutable compaction) and expires blocks beyond
+retention."""
 
 from __future__ import annotations
 
@@ -44,6 +47,12 @@ class IndexBlock:
 
     def insert(self, doc):
         self.mutable.insert(doc)
+        self._gen += 1
+
+    def insert_many(self, docs):
+        """Batched insert: one mutable-segment call and one generation
+        bump per queue drain, not per document."""
+        self.mutable.insert_batch(docs)
         self._gen += 1
 
     def segments(self):
@@ -112,10 +121,16 @@ class IndexBlock:
         return out
 
 
+_tuple_new = tuple.__new__
+
+
 def tags_to_doc(series_id: bytes, tags: dict) -> Document:
-    """index/convert: series id + tags -> indexed document."""
-    fields = tuple(sorted((k, v) for k, v in tags.items()))
-    return Document(series_id, fields)
+    """index/convert: series id + tags -> indexed document. Runs once
+    per new series on the write path's insert-queue drain, so it skips
+    the NamedTuple's generated Python-level __new__ and constructs the
+    underlying tuple directly (identical object; Document is a plain
+    tuple subclass)."""
+    return _tuple_new(Document, (series_id, tuple(sorted(tags.items()))))
 
 
 class NamespaceIndex:
@@ -155,12 +170,26 @@ class NamespaceIndex:
             self._block_for(t_ns).insert(tags_to_doc(series_id, tags))
 
     def insert_batch(self, items: List[Tuple[bytes, dict]], t_ns: int):
+        self.insert_many(items, t_ns)
+
+    def insert_many(self, items: List[Tuple[bytes, dict]],
+                    t_ns: Optional[int] = None):
+        """Batched nsIndex insert — the insert-queue drain entrypoint
+        (index_insert_queue.go InsertBatch): documents are built outside
+        the lock, the lock is taken ONCE, already-known ids are filtered
+        with set ops, and the survivors land in one mutable-segment
+        insert call. One drain therefore costs one lock acquisition and
+        one segment insert, not N of each."""
+        if t_ns is None:
+            t_ns = self.clock() if self.clock else 0
+        docs = [tags_to_doc(sid, tags) for sid, tags in items]
         with self._lock:
-            blk = self._block_for(t_ns)
-            for sid, tags in items:
-                if sid not in self._known:
-                    self._known.add(sid)
-                    blk.insert(tags_to_doc(sid, tags))
+            known = self._known
+            fresh = [d for d in docs if d.id not in known]
+            if not fresh:
+                return
+            known.update(d.id for d in fresh)
+            self._block_for(t_ns).insert_many(fresh)
 
     def _snapshot_segments(self, start_ns, end_ns) -> List[ImmutableSegment]:
         """Frozen immutable views of every overlapping block. The lock is

@@ -26,9 +26,8 @@ test_index_property.py proves them result-identical)."""
 
 from __future__ import annotations
 
-import dataclasses
 import threading
-from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import Dict, Iterable, List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -59,9 +58,13 @@ def _next_gen() -> int:
         return _GEN[0]
 
 
-@dataclasses.dataclass(frozen=True)
-class Document:
-    """m3ninx/doc Document: opaque id + (name, value) fields."""
+class Document(NamedTuple):
+    """m3ninx/doc Document: opaque id + (name, value) fields.
+
+    A NamedTuple, not a frozen dataclass: documents are built once per
+    new series on the write path's insert-queue drain, and NamedTuple
+    construction is a single C call where the frozen dataclass pays two
+    object.__setattr__ round-trips."""
 
     id: bytes
     fields: Tuple[Tuple[bytes, bytes], ...]
@@ -207,12 +210,25 @@ def _prefix_successor(prefix: bytes) -> Optional[bytes]:
 
 
 class MutableSegment:
-    """segment/mem: concurrent terms dict of field -> value -> postings."""
+    """segment/mem: docs + id map on the write path; the terms dict
+    (field -> value -> postings) integrates LAZILY on first read.
+
+    Inserts are the storage write path's per-new-series cost (they run
+    per insert-queue drain), so they do only the O(1) work dedup needs:
+    append the doc, map its id. The field/term inversion is paid once,
+    incrementally, when something actually reads terms — a query
+    against the mutable segment, seal's from_mutable compaction, or
+    fields()/terms() enumeration. This mirrors the reference's builder
+    split (segment/builder accumulates docs; the FST is built at
+    compaction, not per insert), and it is work-conserving: the
+    namespace's query snapshot path already re-derives segments from
+    the doc list, so no reader pays twice."""
 
     def __init__(self):
         self._docs: List[Document] = []
         self._ids: Dict[bytes, int] = {}
         self._terms: Dict[bytes, Dict[bytes, List[int]]] = {}
+        self._terms_n = 0  # docs integrated into _terms so far
 
     def __len__(self) -> int:
         return len(self._docs)
@@ -224,16 +240,60 @@ class MutableSegment:
         pos = len(self._docs)
         self._docs.append(doc)
         self._ids[doc.id] = pos
-        for name, value in doc.fields:
-            plist = self._terms.setdefault(name, {}).setdefault(value, [])
-            # A doc repeating the same (name, value) pair must not post
-            # twice; appends are in pos order, so lists stay sorted unique.
-            if not plist or plist[-1] != pos:
-                plist.append(pos)
         return pos
 
     def insert_batch(self, docs: Iterable[Document]) -> List[int]:
-        return [self.insert(d) for d in docs]
+        """Bulk insert — the per-drain cost of the storage insert
+        queue's batched index path (segment/mem's InsertBatch). The
+        namespace filters already-known ids before calling, so the
+        all-new case is the common one: one C-level membership probe,
+        then extend + dict.update(zip(...)); duplicates fall back to a
+        local-ref loop."""
+        if not isinstance(docs, list):
+            docs = list(docs)
+        doc_list = self._docs
+        ids = self._ids
+        base = len(doc_list)
+        new_ids = [d.id for d in docs]
+        if not any(map(ids.__contains__, new_ids)) and \
+                len(dict.fromkeys(new_ids)) == len(new_ids):
+            doc_list.extend(docs)
+            positions = range(base, base + len(docs))
+            ids.update(zip(new_ids, positions))
+            return list(positions)
+        out: List[int] = []
+        append_doc = doc_list.append
+        append_out = out.append
+        for d in docs:
+            pos = ids.get(d.id)
+            if pos is None:
+                pos = len(doc_list)
+                append_doc(d)
+                ids[d.id] = pos
+            append_out(pos)
+        return out
+
+    def _ensure_terms(self) -> Dict[bytes, Dict[bytes, List[int]]]:
+        """Integrate not-yet-inverted docs into the terms dict. Postings
+        lists stay sorted unique: positions only grow, and a doc
+        repeating a (name, value) pair is caught by the tail check."""
+        terms = self._terms
+        docs = self._docs
+        n = len(docs)
+        if self._terms_n == n:
+            return terms
+        for pos in range(self._terms_n, n):
+            for name, value in docs[pos].fields:
+                fmap = terms.get(name)
+                if fmap is None:
+                    fmap = terms[name] = {}
+                plist = fmap.get(value)
+                if plist is None:
+                    fmap[value] = [pos]
+                elif plist[-1] != pos:
+                    plist.append(pos)
+        self._terms_n = n
+        return terms
 
     def doc(self, pos: int) -> Document:
         return self._docs[pos]
@@ -245,13 +305,13 @@ class MutableSegment:
         return np.arange(len(self._docs), dtype=np.int32)
 
     def term_postings(self, field: bytes, value: bytes) -> np.ndarray:
-        vals = self._terms.get(field)
+        vals = self._ensure_terms().get(field)
         if not vals or value not in vals:
             return EMPTY
         return np.asarray(vals[value], np.int32)
 
     def regexp_postings(self, field: bytes, pattern) -> np.ndarray:
-        vals = self._terms.get(field)
+        vals = self._ensure_terms().get(field)
         if not vals:
             return EMPTY
         out = [np.asarray(p, np.int32) for v, p in vals.items() if pattern.fullmatch(v)]
@@ -260,10 +320,10 @@ class MutableSegment:
         return np.unique(np.concatenate(out))
 
     def fields(self) -> List[bytes]:
-        return sorted(self._terms)
+        return sorted(self._ensure_terms())
 
     def terms(self, field: bytes) -> List[bytes]:
-        return sorted(self._terms.get(field, ()))
+        return sorted(self._ensure_terms().get(field, ()))
 
 
 class ImmutableSegment:

@@ -857,22 +857,15 @@ def _seal_mesh():
     return Mesh(np.asarray(devs), ("s",))
 
 
-def encode_with_boundary(timestamps, values, npoints=None,
-                         max_words: int | None = None):
-    """encode() that also returns the boundary metadata dict (seal path).
-    On a multi-device platform, blocks whose (padded) series count divides
-    the mesh run as ONE SPMD program sharded over the "s" axis."""
-    ts = np.asarray(timestamps)
-    if npoints is None:
-        npoints = np.full(ts.shape[0], ts.shape[1], dtype=np.int32)
-    if max_words is None:
-        max_words = max_words_for(ts.shape[1])
-    inp = prepare_encode_inputs(ts, values, npoints)
+def encode_prepared(inp: dict, max_words: int):
+    """encode_batch from prepared inputs (seal path). On a multi-device
+    platform, blocks whose (padded) series count divides the mesh run as
+    ONE SPMD program sharded over the "s" axis."""
     dt, t0, vhi, vlo = inp["dt"], inp["t0"], inp["vhi"], inp["vlo"]
     int_mode, k, npts = inp["int_mode"], inp["k"], inp["npoints"]
     ts_regular, delta0 = inp["ts_regular"], inp["delta0"]
     mesh = _seal_mesh()
-    if mesh is not None and ts.shape[0] % mesh.shape["s"] == 0:
+    if mesh is not None and np.asarray(dt).shape[0] % mesh.shape["s"] == 0:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         row = NamedSharding(mesh, P("s"))
@@ -882,9 +875,21 @@ def encode_with_boundary(timestamps, values, npoints=None,
         t0 = tuple(put(a, row) for a in t0)
         int_mode, k, npts, ts_regular, delta0 = (
             put(a, row) for a in (int_mode, k, npts, ts_regular, delta0))
-    words, nbits = encode_batch(
+    return encode_batch(
         dt, t0, vhi, vlo, int_mode, k, npts, ts_regular, delta0,
         max_words=max_words)
+
+
+def encode_with_boundary(timestamps, values, npoints=None,
+                         max_words: int | None = None):
+    """encode() that also returns the boundary metadata dict (seal path)."""
+    ts = np.asarray(timestamps)
+    if npoints is None:
+        npoints = np.full(ts.shape[0], ts.shape[1], dtype=np.int32)
+    if max_words is None:
+        max_words = max_words_for(ts.shape[1])
+    inp = prepare_encode_inputs(ts, values, npoints)
+    words, nbits = encode_prepared(inp, max_words)
     return words, nbits, boundary_metadata(inp)
 
 

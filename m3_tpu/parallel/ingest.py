@@ -41,6 +41,22 @@ from ..ops import bits64 as b64
 from ..ops import tsz
 
 
+def shard_map_compat(fn, *, mesh, in_specs, out_specs):
+    """jax.shard_map across JAX versions: the top-level API (newer
+    releases, `check_vma` kwarg) or jax.experimental.shard_map (0.4.x,
+    `check_rep` kwarg). The serving flush path routes through this, so
+    mesh encode must not depend on which spelling the installed JAX
+    ships."""
+    top = getattr(jax, "shard_map", None)
+    if top is not None:
+        return top(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_vma=False)
+    from jax.experimental.shard_map import shard_map as exp_shard_map
+
+    return exp_shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_rep=False)
+
+
 class IngestBatch(NamedTuple):
     """Device inputs for one shard x block-window ingest step.
 
@@ -160,6 +176,80 @@ def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
     return Mesh(devices.reshape(n_devices // t, t), ("shard", "time"))
 
 
+@functools.lru_cache(maxsize=1)
+def flush_mesh() -> Mesh | None:
+    """The serving flush's shard x time mesh: make_mesh() over every
+    attached device when >1 is present, else None (single-device
+    platforms keep the plain jit path). M3_TPU_MESH_FLUSH=0 disables
+    mesh routing for A/B comparison (write_smoke uses it to prove
+    bit-equality against the single-device encode)."""
+    import os
+
+    if os.environ.get("M3_TPU_MESH_FLUSH", "1") == "0":
+        return None
+    if len(jax.devices()) <= 1:
+        return None
+    return make_mesh()
+
+
+@functools.lru_cache(maxsize=32)
+def make_flush_encoder(mesh: Mesh, max_words: int):
+    """The serving-flush encode as a shard_map program over the
+    shard x time mesh: sealed-block rows (series) are data-parallel, so
+    they shard across BOTH mesh axes — every attached device encodes its
+    slice of the block with the same kernel the single-device path runs,
+    and the results are bit-identical by construction (encode_batch is
+    row-independent; no collectives are needed). This is
+    make_sharded_ingest's mesh carrying the REAL flush path
+    (storage/block.py encode_block -> Shard._tick_locked /
+    mediator.snapshot), not just the dryrun/bench ingest program."""
+    rows = P(("shard", "time"))
+    rowc = P(("shard", "time"), None)
+
+    def local_encode(dt, t0_hi, t0_lo, vhi, vlo, int_mode, k, npoints,
+                     ts_regular, delta0):
+        from ..ops import tsz
+
+        return tsz.encode_batch(
+            dt, (t0_hi, t0_lo), vhi, vlo, int_mode, k, npoints,
+            ts_regular, delta0, max_words=max_words)
+
+    fn = shard_map_compat(
+        local_encode, mesh=mesh,
+        in_specs=(rowc, rows, rows, rowc, rowc, rows, rows, rows, rows,
+                  rows),
+        out_specs=(rowc, rows))
+    return jax.jit(fn)
+
+
+def flush_encode_prepared(inp: dict, max_words: int):
+    """Route prepared encode inputs (ops.tsz.prepare_encode_inputs)
+    through the shard x time mesh. Returns (words, nbits) — bit-identical
+    to the single-device encode — or None when no mesh is attached, the
+    padded row count does not divide it (caller falls back to the plain
+    path; encode_block's power-of-two row padding makes most real blocks
+    divisible), or the tile is below the dispatch floor
+    (M3_TPU_MESH_FLUSH_MIN_CELLS, default 2048): a tiny seal costs more
+    in multi-device dispatch than the parallel encode saves."""
+    import os
+
+    mesh = flush_mesh()
+    if mesh is None:
+        return None
+    shape = np.asarray(inp["dt"]).shape
+    n = shape[0]
+    ndev = mesh.devices.size
+    if n < ndev or n % ndev:
+        return None
+    min_cells = int(os.environ.get("M3_TPU_MESH_FLUSH_MIN_CELLS", "2048"))
+    if n * shape[1] < min_cells:
+        return None
+    enc = make_flush_encoder(mesh, max_words)
+    return enc(inp["dt"], inp["t0"][0], inp["t0"][1], inp["vhi"],
+               inp["vlo"], inp["int_mode"], inp["k"], inp["npoints"],
+               inp["ts_regular"], inp["delta0"])
+
+
 def make_sharded_ingest(mesh: Mesh, *, rollup_factor: int, max_words: int, quantile_qs=(0.5, 0.99)):
     """Build the jitted multi-chip ingest step over `mesh`.
 
@@ -225,13 +315,12 @@ def make_sharded_ingest(mesh: Mesh, *, rollup_factor: int, max_words: int, quant
             total_bits,
         )
 
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         local_step,
         mesh=mesh,
         in_specs=(chunk, per_series, per_series, chunk, chunk, per_series,
                   per_series, per_series, per_series, per_series, chunk),
         out_specs=(chunk, per_series, chunk, chunk, merged, P()),
-        check_vma=False,
     )
     return jax.jit(fn)
 

@@ -98,6 +98,10 @@ class DBNodeHandle:
         if self.httpjson is not None:
             self.httpjson.close()
         self.server.close()
+        # Drain every shard's insert queue AFTER the listeners stop
+        # accepting writes — queued async inserts are never stranded by
+        # teardown (shard_insert_queue.go Stop during server Close).
+        self.db.close()
         if self.kv is not None and hasattr(self.kv, "close"):
             self.kv.close()  # RemoteStore: stops watch threads + socket
         if self.lock is not None:

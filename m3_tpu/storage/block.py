@@ -17,7 +17,13 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 from ..ops import tsz
+from ..parallel import ingest as par_ingest
 from ..utils import xtime
+from ..utils.instrument import ROOT
+
+# Fires once per block encoded through the shard x time mesh — the
+# dryrun/tests assert the serving flush actually took the mesh path.
+_FLUSH_METRICS = ROOT.sub_scope("storage.flush")
 
 
 def choose_time_unit(ts: np.ndarray) -> xtime.Unit:
@@ -78,9 +84,22 @@ class SealedBlock:
         return ts[0, :n] * self.time_unit.nanos, vals[0, :n]
 
     def read_all(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-        """Decode every series in one batched launch: (ts [S, W], vals, npoints)."""
-        ts, vals = tsz.decode(self.words, self.npoints, window=self.window)
-        return ts * self.time_unit.nanos, vals, self.npoints
+        """Decode every series in one batched launch: (ts [S, W], vals, npoints).
+
+        Rows are padded to a power of two (replicating the first stream,
+        always valid) so one compiled decode kernel serves every block
+        with this window geometry — the decode-side twin of
+        encode_block's shape bucketing; merge/repair paths decode blocks
+        of arbitrary series counts without per-count recompiles."""
+        s = len(self.series_indices)
+        sp = _next_pow2(s, floor=1)
+        words, npoints = self.words, self.npoints
+        if sp != s:
+            words = np.concatenate([words, np.repeat(words[:1], sp - s, 0)])
+            npoints = np.concatenate(
+                [npoints, np.repeat(npoints[:1], sp - s)])
+        ts, vals = tsz.decode(words, npoints, window=self.window)
+        return (ts[:s] * self.time_unit.nanos, vals[:s], self.npoints)
 
     def nbytes(self) -> int:
         return int(self.words.nbytes)
@@ -98,7 +117,14 @@ def encode_block(block_start: int, series_indices, tdense, vdense, npoints,
     Tiles are padded to power-of-two (series, window) geometry so XLA
     re-uses one compiled kernel across shards/blocks instead of compiling
     per exact shape (shape bucketing; padding columns replicate the last
-    point, padding rows are npoints=1 dummies sliced away afterwards)."""
+    point, padding rows are npoints=1 dummies sliced away afterwards).
+
+    On a multi-device platform the encode routes through the shard x time
+    mesh (parallel.ingest.flush_encode_prepared): rows shard across every
+    attached device and the output bitstreams are bit-identical to the
+    single-device encode — this is the serving flush path's use of the
+    mesh (Shard._tick_locked seals, mediator snapshots), closing the gap
+    where make_sharded_ingest was exercised only by dryrun/bench."""
     s, w = tdense.shape
     wp = _next_pow2(w)
     sp = _next_pow2(s, floor=1)
@@ -114,8 +140,15 @@ def encode_block(block_start: int, series_indices, tdense, vdense, npoints,
         npoints = np.concatenate([npoints, np.ones(sp - s, np.int32)])
     window = wp
     unit = choose_time_unit(tdense)
-    words, nbits, boundary = tsz.encode_with_boundary(
-        tdense // unit.nanos, vdense, npoints, max_words=max_words)
+    mw = max_words if max_words is not None else tsz.max_words_for(window)
+    inp = tsz.prepare_encode_inputs(tdense // unit.nanos, vdense, npoints)
+    got = par_ingest.flush_encode_prepared(inp, max_words=mw)
+    if got is not None:
+        words, nbits = got
+        _FLUSH_METRICS.counter("mesh_encode").inc()
+    else:
+        words, nbits = tsz.encode_prepared(inp, max_words=mw)
+    boundary = tsz.boundary_metadata(inp)
     words = np.asarray(words)[:s]
     nbits = np.asarray(nbits)[:s]
     npoints = npoints[:s]
@@ -275,6 +308,52 @@ def _merge_by_full_recode(b1: SealedBlock, b2: SealedBlock) -> SealedBlock:
         ts[i, : tt.size] = tt
         vs[i, : tt.size] = vv
         if tt.size < w:
+            ts[i, tt.size:] = tt[-1]
+            vs[i, tt.size:] = vv[-1]
+    return encode_block(b1.block_start, union.astype(np.int32), ts, vs, npts)
+
+
+def merge_same_start(b1: SealedBlock, b2: SealedBlock) -> SealedBlock:
+    """Merge two sealed blocks covering the SAME block start into one
+    (an insert-queue drain racing tick can land late writes for a block
+    start that already sealed; the re-seal must union, not overwrite).
+
+    b2 is the later arrival: on duplicate (series, timestamp) pairs its
+    value wins, matching the buffer's last-arrival-wins drain dedup."""
+    if b1.block_start != b2.block_start:
+        raise ValueError("merge_same_start: blocks must share a block start")
+    t1, v1, n1 = b1.read_all()
+    t2, v2, n2 = b2.read_all()
+    union = np.union1d(b1.series_indices, b2.series_indices)
+    parts_t: List[np.ndarray] = []
+    parts_v: List[np.ndarray] = []
+    npts = np.zeros(len(union), np.int32)
+    for i, sid in enumerate(union):
+        tt_parts, vv_parts = [], []
+        for blk, t, v, n in ((b1, t1, v1, n1), (b2, t2, v2, n2)):
+            row = blk.row_of(int(sid))
+            if row is not None:
+                tt_parts.append(t[row, : n[row]])
+                vv_parts.append(v[row, : n[row]])
+        tt = np.concatenate(tt_parts)
+        vv = np.concatenate(vv_parts)
+        # Stable sort by time keeps b1-then-b2 arrival order within a
+        # duplicate timestamp; keep the LAST arrival per timestamp.
+        order = np.argsort(tt, kind="stable")
+        tt, vv = tt[order], vv[order]
+        if len(tt) > 1:
+            keep = np.concatenate([tt[:-1] != tt[1:], [True]])
+            tt, vv = tt[keep], vv[keep]
+        npts[i] = tt.size
+        parts_t.append(tt)
+        parts_v.append(vv)
+    w = int(npts.max(initial=1))
+    ts = np.zeros((len(union), w), np.int64)
+    vs = np.zeros((len(union), w), np.float64)
+    for i, (tt, vv) in enumerate(zip(parts_t, parts_v)):
+        ts[i, : tt.size] = tt
+        vs[i, : tt.size] = vv
+        if tt.size < w:  # pad with the last real point (codec contract)
             ts[i, tt.size:] = tt[-1]
             vs[i, tt.size:] = vv[-1]
     return encode_block(b1.block_start, union.astype(np.int32), ts, vs, npts)

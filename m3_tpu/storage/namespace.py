@@ -25,6 +25,11 @@ class NamespaceOptions:
     index_enabled: bool = True
     index_block_size_ns: int = 4 * xtime.HOUR
     snapshot_enabled: bool = True
+    # shard_insert_queue.go knobs: async new-series visibility + the
+    # bounded queue depth that sheds via Backpressure (see ShardOptions).
+    write_new_series_async: bool = False
+    insert_max_pending: int = 65536
+    insert_interval_ns: int = 0
 
     def shard_options(self) -> ShardOptions:
         return ShardOptions(
@@ -32,6 +37,9 @@ class NamespaceOptions:
             retention_ns=self.retention_ns,
             buffer_past_ns=self.buffer_past_ns,
             buffer_future_ns=self.buffer_future_ns,
+            write_new_series_async=self.write_new_series_async,
+            insert_max_pending=self.insert_max_pending,
+            insert_interval_ns=self.insert_interval_ns,
         )
 
 
@@ -50,7 +58,9 @@ class Namespace:
         """Add a shard on placement change (storage/cluster/database.go:133)."""
         if shard_id in self.shards:
             return self.shards[shard_id]
-        sh = Shard(shard_id, self.opts.shard_options(), on_new_series=self._on_new_series, state=state)
+        sh = Shard(shard_id, self.opts.shard_options(),
+                   on_new_series=self._on_new_series, state=state,
+                   on_new_series_batch=self._on_new_series_batch)
         if self.retriever is not None:
             sh.attach_retriever(self.retriever, self.name)
         self.shards[shard_id] = sh
@@ -68,6 +78,21 @@ class Namespace:
     def _on_new_series(self, series_id: bytes, tags: Optional[dict], idx: int):
         if self.index is not None and self.opts.index_enabled and tags is not None:
             self.index.insert(series_id, tags)
+
+    def _on_new_series_batch(self, items):
+        """One insert-queue drain -> one batched reverse-index insert
+        (index_insert_queue.go parity); untagged series are skipped the
+        same way the per-series hook skips them."""
+        if self.index is None or not self.opts.index_enabled:
+            return
+        tagged = [(sid, tags) for sid, tags, _idx in items if tags is not None]
+        if tagged:
+            self.index.insert_many(tagged)
+
+    def close(self):
+        """Drain + stop every shard's insert queue."""
+        for sh in self.shards.values():
+            sh.close()
 
     def shard_for(self, shard_id: int) -> Shard:
         sh = self.shards.get(shard_id)

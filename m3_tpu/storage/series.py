@@ -8,6 +8,7 @@ is the only id-keyed structure on the hot path."""
 
 from __future__ import annotations
 
+from itertools import repeat
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -38,21 +39,84 @@ class SeriesRegistry:
                 self._tags[idx] = tags
             return idx, False
         idx = len(self._ids)
-        self._index[series_id] = idx
+        # Lists BEFORE the id map: lock-free readers (lookup_batch, the
+        # write fast path) resolve through _index and then read
+        # _ids/_tags without the shard lock — an index published first
+        # would briefly point past the lists.
         self._ids.append(series_id)
         self._tags.append(tags)
+        self._index[series_id] = idx
         return idx, True
 
     def get_or_create_batch(self, ids: Sequence[bytes]) -> Tuple[np.ndarray, List[int]]:
         """Bulk resolve; returns (indices [N], list of newly created idxs)."""
-        out = np.empty(len(ids), np.int32)
+        out, created = self.get_or_create_batch_tagged(ids, None)
+        return out, [int(out[j]) for j in created]
+
+    def get_or_create_batch_tagged(
+            self, ids: Sequence[bytes],
+            tags: Optional[Sequence[Optional[dict]]],
+    ) -> Tuple[np.ndarray, List[int]]:
+        """Bulk resolve with tags; returns (indices [N], positions in
+        `ids` that created a NEW series). This is the insert-queue
+        drain's registry cost, paid once per coalesced batch under the
+        shard lock (shard_insert_queue.go insertSeriesBatch).
+
+        Queued ids were unknown at enqueue time, so the all-new case is
+        the common one: probe it with one C-level membership pass and
+        commit with dict.update(zip(...)) instead of a Python-level
+        per-id loop; races and duplicate enqueues fall back to the
+        general loop."""
+        n = len(ids)
+        index = self._index
+        id_list = self._ids
+        tag_list = self._tags
+        base = len(id_list)
+        if not any(map(index.__contains__, ids)) and \
+                len(dict.fromkeys(ids)) == n:
+            out = np.arange(base, base + n, dtype=np.int32)
+            # Lists BEFORE the id map (see get_or_create): lock-free
+            # readers must never resolve an index past the lists' ends.
+            id_list.extend(ids)
+            tag_list.extend(tags if tags is not None else (None,) * n)
+            index.update(zip(ids, range(base, base + n)))
+            return out, list(range(n))
+        out = np.empty(n, np.int32)
         created: List[int] = []
+        get = index.get
         for i, sid in enumerate(ids):
-            idx, is_new = self.get_or_create(sid)
+            t = tags[i] if tags is not None else None
+            idx = get(sid)
+            if idx is None:
+                idx = len(id_list)
+                id_list.append(sid)
+                tag_list.append(t)
+                index[sid] = idx
+                created.append(i)
+            elif t is not None and tag_list[idx] is None:
+                tag_list[idx] = t
             out[i] = idx
-            if is_new:
-                created.append(idx)
         return out, created
+
+    def lookup_batch(self, ids: Sequence[bytes]) -> np.ndarray:
+        """Lock-free bulk resolve against a registry snapshot (-1 for
+        unknown ids). Safe without the shard lock: the id->index map is
+        append-only and an index, once assigned, is never reassigned —
+        a concurrent insert can only turn a miss into a hit for later
+        reads, never corrupt a resolved index. This is the write path's
+        fast-path resolve (the lock-free read the reference gets from
+        its concurrent shard map, shard.go lookupEntryWithLock's RLock
+        fast path)."""
+        # map(get, ids, repeat(-1)) iterates at C speed — no Python frame
+        # per id, unlike a generator expression.
+        return np.fromiter(map(self._index.get, ids, repeat(-1)), np.int32,
+                           count=len(ids))
+
+    def ensure_tags(self, idx: int, tags: Optional[dict]):
+        """Backfill tags for an existing series (benign when racing: both
+        writers carry equivalent tags for the same id)."""
+        if tags is not None and self._tags[idx] is None:
+            self._tags[idx] = tags
 
     def all_ids(self) -> List[bytes]:
         return list(self._ids)

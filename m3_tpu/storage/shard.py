@@ -2,10 +2,13 @@
 
 Owns one virtual shard's series registry, mutable columnar buffer, sealed
 blocks, and lifecycle (tick-driven sealing, retention expiry, flush state).
-The reference's write path (shard.go:769 writeAndIndex) resolves a series
-entry, appends to its encoder, and enqueues async index inserts; here
-writes append to shard columns and new series surface index insert batches
-for the namespace's reverse index."""
+The write path mirrors shard.go:769 writeAndIndex: known-series ids
+resolve through a lock-free registry snapshot and append columnar under a
+narrowed shard lock (the fast path), while first-seen ids enqueue on the
+shard's InsertQueue — new-series registration, their pending datapoints,
+and the reverse-index document insert all land in ONE coalesced batch per
+drain (shard_insert_queue.go / index_insert_queue.go parity), sync-waited
+or async per ShardOptions.write_new_series_async."""
 
 from __future__ import annotations
 
@@ -17,8 +20,10 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..utils import xtime
-from .block import SealedBlock, encode_block
+from ..utils.health import Priority
+from .block import SealedBlock, encode_block, merge_same_start
 from .buffer import ShardBuffer
+from .insert_queue import InsertGroup, InsertQueue
 from .series import SeriesRegistry
 
 
@@ -45,12 +50,22 @@ class ShardOptions:
     retention_ns: int = 2 * xtime.DAY
     buffer_past_ns: int = 10 * xtime.MINUTE
     buffer_future_ns: int = 2 * xtime.MINUTE
+    # Insert-queue knobs (shard_insert_queue.go). write_new_series_async
+    # mirrors the reference's WriteNewSeriesAsync: False = writers wait
+    # for the batch drain (read-your-write); True = writes return
+    # immediately and new series become visible after one drain (tick,
+    # background drainer, or shutdown).
+    write_new_series_async: bool = False
+    insert_max_pending: int = 65536
+    insert_high_watermark: float = 0.75
+    insert_interval_ns: int = 0
 
 
 class Shard:
     def __init__(self, shard_id: int, opts: ShardOptions,
                  on_new_series: Optional[Callable] = None,
-                 state: ShardState = ShardState.AVAILABLE):
+                 state: ShardState = ShardState.AVAILABLE,
+                 on_new_series_batch: Optional[Callable] = None):
         self.shard_id = shard_id
         self.opts = opts
         self.state = state
@@ -66,8 +81,19 @@ class Shard:
         self.flush_states: Dict[int, FlushState] = {}
         # Callback (series_id, tags, series_idx) when a series is first seen
         # — the namespace wires this to reverse-index insertion
-        # (shard.go:769 writeAndIndex's index hook).
+        # (shard.go:769 writeAndIndex's index hook). The batch form
+        # receives one [(series_id, tags, idx)] list per queue drain so a
+        # drain costs ONE index insert call, not N; when set it replaces
+        # the per-series callback.
         self.on_new_series = on_new_series
+        self.on_new_series_batch = on_new_series_batch
+        # New-series inserts coalesce here; drains apply the whole batch
+        # under one write_lock acquisition (shard_insert_queue.go:52).
+        self.insert_queue = InsertQueue(
+            self._drain_inserts,
+            max_pending=opts.insert_max_pending,
+            high_watermark=opts.insert_high_watermark,
+            interval_ns=opts.insert_interval_ns)
         # Disk retriever for cold reads (block/retriever_manager.go hook);
         # bound by Namespace.assign_shard when the database has one.
         self._retriever = None
@@ -79,52 +105,166 @@ class Shard:
     # ------------------------------------------------------------------ write
 
     def write(self, series_id: bytes, t_ns: int, value: float, now_ns: int,
-              tags: Optional[dict] = None) -> bool:
+              tags: Optional[dict] = None,
+              priority: Priority = Priority.NORMAL) -> bool:
         if not self.buffer.accepts(now_ns, t_ns):
             raise ValueError(
                 f"datapoint at {t_ns} outside acceptance window at {now_ns} "
                 f"(past {self.opts.buffer_past_ns}, future {self.opts.buffer_future_ns})"
             )
-        with self.write_lock:
-            idx, is_new = self.registry.get_or_create(series_id, tags)
-            if is_new and self.on_new_series is not None:
-                self.on_new_series(series_id, tags, idx)
-            self.buffer.write(idx, t_ns, value)
-        return is_new
+        idx = self.registry.get(series_id)  # lock-free snapshot resolve
+        if idx is not None:
+            self.registry.ensure_tags(idx, tags)
+            with self.write_lock:
+                self.buffer.write(idx, t_ns, value)
+            return False
+        self.insert_queue.insert(
+            InsertGroup([series_id], [tags] if tags is not None else None,
+                        ts=np.array([t_ns], np.int64),
+                        vals=np.array([value], np.float64)),
+            priority=priority, sync=not self.opts.write_new_series_async)
+        return True
 
     def write_batch(self, ids: Sequence[bytes], ts: np.ndarray, vals: np.ndarray,
-                    now_ns: int, tags: Optional[Sequence[Optional[dict]]] = None):
+                    now_ns: int, tags: Optional[Sequence[Optional[dict]]] = None,
+                    priority: Priority = Priority.NORMAL):
         ts = np.asarray(ts, np.int64)
         vals = np.asarray(vals, np.float64)
         ok = (ts >= now_ns - self.opts.buffer_past_ns) & (ts <= now_ns + self.opts.buffer_future_ns)
         if not ok.all():
             bad = int((~ok).sum())
             raise ValueError(f"{bad} datapoints outside acceptance window")
-        sidx = np.empty(len(ids), np.int32)
+        # Fast path: resolve every id against a lock-free registry
+        # snapshot; the write lock narrows to the columnar append.
+        sidx = self.registry.lookup_batch(ids)
+        unknown = sidx < 0
+        if tags:
+            # Known series first written untagged (bootstrap, tagless
+            # writes) backfill their tags here, matching the single-write
+            # path and the old get_or_create(sid, tags) behavior.
+            ensure = self.registry.ensure_tags
+            for i in np.flatnonzero(sidx >= 0):
+                t = tags[i]
+                if t is not None:
+                    ensure(int(sidx[i]), t)
+        if not unknown.any():
+            with self.write_lock:
+                self.buffer.write_batch(sidx, ts, vals)
+            return
+        # Slow path: coalesce the first-seen remainder into the insert
+        # queue as ONE columnar group (distinct new ids + their pending
+        # points). Admission happens BEFORE any buffer append, so a
+        # Backpressure shed leaves nothing partially written.
+        upos = np.flatnonzero(unknown)
+        uids = [ids[i] for i in upos]
+        utags = [tags[i] for i in upos] if tags else None
+        uniq = dict.fromkeys(uids)
+        if len(uniq) == len(uids):
+            # Common burst shape: every new id distinct -> zero-copy
+            # columns, one point per id.
+            group = InsertGroup(uids, utags, ts=ts[upos], vals=vals[upos])
+        else:
+            # Duplicates within the batch: order rows so each id's
+            # points are one contiguous counts-run.
+            rank = {sid: r for r, sid in enumerate(uniq)}
+            rarr = np.fromiter((rank[sid] for sid in uids), np.int64,
+                               count=len(uids))
+            order = np.argsort(rarr, kind="stable")
+            gids = list(uniq)
+            gtags = None
+            if utags is not None:
+                first = {}
+                for sid, tg in zip(uids, utags):
+                    if sid not in first:
+                        first[sid] = tg
+                gtags = [first[sid] for sid in gids]
+            group = InsertGroup(
+                gids, gtags, counts=np.bincount(rarr, minlength=len(gids)),
+                ts=ts[upos][order], vals=vals[upos][order])
+        batch = self.insert_queue.insert(
+            group, priority=priority, sync=False)
+        known = ~unknown
+        if known.any():
+            with self.write_lock:
+                self.buffer.write_batch(sidx[known], ts[known], vals[known])
+        if not self.opts.write_new_series_async:
+            if not batch.drained:
+                self.insert_queue.drain()
+            batch.wait()
+
+    def _drain_inserts(self, groups: List[InsertGroup]):
+        """Insert-queue drain: apply one coalesced batch — register every
+        new series, append each group's pending datapoints in ONE
+        columnar write, then fire ONE batched reverse-index insert for
+        the whole drain. The write lock is held only for the
+        registry/buffer mutation; the index insert runs outside it (the
+        index has its own lock, and queries never take the shard lock —
+        same visibility order as the synchronous path, minus the
+        cross-component lock coupling)."""
+        new_items: List[Tuple[bytes, Optional[dict], int]] = []
         with self.write_lock:
-            for i, sid in enumerate(ids):
-                idx, is_new = self.registry.get_or_create(sid, tags[i] if tags else None)
-                sidx[i] = idx
-                if is_new and self.on_new_series is not None:
-                    self.on_new_series(sid, tags[i] if tags else None, idx)
-            self.buffer.write_batch(sidx, ts, vals)
+            for g in groups:
+                idxs, created = self.registry.get_or_create_batch_tagged(
+                    g.ids, g.tags)
+                if g.ts is not None and len(g.ts):
+                    sidx = (idxs if g.counts is None
+                            else np.repeat(idxs, g.counts).astype(np.int32))
+                    self.buffer.write_batch(sidx, g.ts, g.vals)
+                if created:
+                    gt = g.tags
+                    new_items.extend(
+                        (g.ids[j], gt[j] if gt is not None else None,
+                         int(idxs[j]))
+                        for j in created)
+        if not new_items:
+            return
+        if self.on_new_series_batch is not None:
+            self.on_new_series_batch(new_items)
+        elif self.on_new_series is not None:
+            for sid, tg, ix in new_items:
+                self.on_new_series(sid, tg, ix)
+
+    def close(self):
+        """Shutdown: drain and stop the insert queue — no queued write
+        is ever stranded by teardown."""
+        self.insert_queue.stop()
 
     # ------------------------------------------------------------------- tick
 
     def tick(self, now_ns: int) -> dict:
         """Seal no-longer-writable buckets into device-encoded blocks and
         expire blocks past retention (shard.go:573 tick + cleanup)."""
+        # Pending async inserts land first, so seal decisions see every
+        # accepted write (the queue's "visible after one drain" bound).
+        self.insert_queue.drain()
         with self.write_lock:
             return self._tick_locked(now_ns)
 
     def _tick_locked(self, now_ns: int) -> dict:
+        """Runs under the write lock. Multi-device platforms route the
+        seal-time encode through the shard x time mesh (encode_block
+        dispatches to parallel.ingest's flush encoder when >1 device is
+        attached and the tile is mesh-divisible; single-device behavior
+        and the resulting bitstreams are unchanged)."""
         sealed, expired = 0, 0
         for bs in self.buffer.sealable(now_ns):
             dense = self.buffer.drain(bs)
             if dense is not None:
                 series, tdense, vdense, npoints = dense
-                self.blocks[bs] = encode_block(bs, series, tdense, vdense, npoints)
+                blk = encode_block(bs, series, tdense, vdense, npoints)
+                prev = self.blocks.get(bs)
+                if prev is not None:
+                    # A drain can land writes for a block start that was
+                    # already sealed (async insert racing tick): merge
+                    # instead of overwriting, so nothing is lost.
+                    blk = merge_same_start(prev, blk)
+                self.blocks[bs] = blk
                 self.flush_states.setdefault(bs, FlushState.NOT_STARTED)
+                if prev is not None and \
+                        self.flush_states.get(bs) == FlushState.SUCCESS:
+                    # The durable fileset no longer matches the merged
+                    # block — re-flush it.
+                    self.flush_states[bs] = FlushState.NOT_STARTED
                 sealed += 1
         cutoff = now_ns - self.opts.retention_ns
         self._retention_cutoff = cutoff

@@ -40,6 +40,14 @@ echo "== overload smoke (<5s; seeded 3x overload, shed-by-priority asserted) =="
 # matrix is tests/test_overload.py. Wall budget via OVERLOAD_SMOKE_BUDGET_S.
 JAX_PLATFORMS=cpu python scripts/overload_smoke.py --seed 7
 
+echo "== write-path smoke (~5s; queue drain on shutdown, zero lost writes, mesh encode bit-equality) =="
+# Insert-queue regressions (stranded queued writes, lost writes racing
+# tick/seal, mesh-vs-single-device flush encode divergence) fail here in
+# seconds; the full matrix is tests/test_write_path.py. Wall budget via
+# WRITE_SMOKE_BUDGET_S.
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  python scripts/write_smoke.py
+
 echo "== test suite =="
 python -m pytest tests/ -x -q
 

@@ -62,6 +62,85 @@ def test_merge_replica_conflicts():
     np.testing.assert_array_equal(v, [1.0, 2.0, 3.0, 4.0])
 
 
+def test_merge_replica_highest_frequency_value():
+    """iterators.go:60-105 IterateHighestFrequencyValue parity: majority
+    value wins per timestamp; singletons pass through untouched."""
+    t = np.array([10, 20], np.int64)
+    parts_t = [t, t, t]
+    parts_v = [np.array([5.0, 1.0]), np.array([5.0, 2.0]),
+               np.array([7.0, 2.0])]
+    got_t, got_v = merge_replica_points(
+        parts_t, parts_v, ConflictStrategy.HIGHEST_FREQUENCY_VALUE)
+    np.testing.assert_array_equal(got_t, [10, 20])
+    np.testing.assert_array_equal(got_v, [5.0, 2.0])  # 2-of-3 majorities
+    # No conflicts at a timestamp -> identical to last-pushed.
+    got_t, got_v = merge_replica_points(
+        [np.array([10], np.int64), np.array([20], np.int64)],
+        [np.array([1.0]), np.array([2.0])],
+        ConflictStrategy.HIGHEST_FREQUENCY_VALUE)
+    np.testing.assert_array_equal(got_v, [1.0, 2.0])
+
+
+def test_merge_replica_frequency_tie_falls_back_to_last_pushed():
+    """Frequency ties resolve to the LAST-pushed value among the tied
+    candidates (reference tie behavior), not min/max of them."""
+    t = np.array([10], np.int64)
+    # 2x 9.0 vs 2x 3.0 — tie; 3.0's last push arrives after 9.0's.
+    got_t, got_v = merge_replica_points(
+        [t, t, t, t],
+        [np.array([9.0]), np.array([3.0]), np.array([9.0]),
+         np.array([3.0])],
+        ConflictStrategy.HIGHEST_FREQUENCY_VALUE)
+    np.testing.assert_array_equal(got_v, [3.0])
+    # Reversed arrival order flips the tie-break.
+    got_t, got_v = merge_replica_points(
+        [t, t, t, t],
+        [np.array([3.0]), np.array([9.0]), np.array([3.0]),
+         np.array([9.0])],
+        ConflictStrategy.HIGHEST_FREQUENCY_VALUE)
+    np.testing.assert_array_equal(got_v, [9.0])
+    # A strict majority beats a numerically higher tied pair.
+    got_t, got_v = merge_replica_points(
+        [t, t, t], [np.array([9.0]), np.array([1.0]), np.array([1.0])],
+        ConflictStrategy.HIGHEST_FREQUENCY_VALUE)
+    np.testing.assert_array_equal(got_v, [1.0])
+
+
+def test_merge_replica_all_strategies_three_replica_conflicts(rng):
+    """Property sweep: 3 replicas with injected same-timestamp conflicts
+    resolve per-strategy against a brute-force oracle on every slot."""
+    base_t = np.arange(30, dtype=np.int64) * 10
+    parts_t, parts_v = [], []
+    for r in range(3):
+        keep = rng.random(30) < 0.8
+        parts_t.append(base_t[keep])
+        parts_v.append(rng.integers(0, 4, int(keep.sum())).astype(float))
+    strategies = [ConflictStrategy.LAST_PUSHED,
+                  ConflictStrategy.HIGHEST_VALUE,
+                  ConflictStrategy.LOWEST_VALUE,
+                  ConflictStrategy.HIGHEST_FREQUENCY_VALUE]
+    for strat in strategies:
+        got_t, got_v = merge_replica_points(parts_t, parts_v, strat)
+        slots = {}
+        for t_arr, v_arr in zip(parts_t, parts_v):
+            for tt, vv in zip(t_arr, v_arr):
+                slots.setdefault(int(tt), []).append(float(vv))
+        assert list(got_t) == sorted(slots)
+        for tt, vv in zip(got_t, got_v):
+            vals = slots[int(tt)]
+            if strat == ConflictStrategy.LAST_PUSHED:
+                want = vals[-1]
+            elif strat == ConflictStrategy.HIGHEST_VALUE:
+                want = max(vals)
+            elif strat == ConflictStrategy.LOWEST_VALUE:
+                want = min(vals)
+            else:
+                freq = {x: vals.count(x) for x in vals}
+                top = max(freq.values())
+                want = [x for x in vals if freq[x] == top][-1]
+            assert vv == want, (strat, tt, vals, vv, want)
+
+
 @pytest.fixture(scope="module")
 def cluster():
     h = ClusterHarness(n_nodes=3, replica_factor=3, num_shards=16)

@@ -15,7 +15,7 @@ from m3_tpu.analysis.cache_rules import (CacheKeyBufferRule,
                                          CacheMethodBufferKeyRule)
 from m3_tpu.analysis.jax_rules import (ItemInLoopRule, JaxPurityRule,
                                        NonStaticJitCacheRule)
-from m3_tpu.analysis.lock_rules import LockDisciplineRule
+from m3_tpu.analysis.lock_rules import HotLoopUnderLockRule, LockDisciplineRule
 from m3_tpu.analysis.overload_rules import UnboundedQueueRule
 from m3_tpu.analysis.retry_rules import (BroadExceptWireIORule,
                                          RawSleepRetryRule)
@@ -991,6 +991,138 @@ class TestUnboundedQueueRule:
             topics = deque()  # m3lint: disable=unbounded-queue
         """
         assert lint(src, UnboundedQueueRule(), "m3_tpu/msg/mod.py") == []
+
+
+class TestHotLoopUnderLock:
+    """hot-loop-under-lock: per-item dict-mutation loops inside a
+    `with <lock>` block in the storage/index/aggregator write paths —
+    the shape the insert-queue rebuild removed from Shard.write_batch."""
+
+    PRE_CHANGE_WRITE_BATCH = """
+        import threading
+
+        class Shard:
+            def __init__(self):
+                self.write_lock = threading.RLock()
+
+            def write_batch(self, ids, ts, vals, tags):
+                with self.write_lock:
+                    for i, sid in enumerate(ids):
+                        idx, is_new = self.registry.get_or_create(
+                            sid, tags[i] if tags else None)
+                        if is_new and self.on_new_series is not None:
+                            self.on_new_series(sid, tags[i], idx)
+                    self.buffer.write_batch(ids, ts, vals)
+    """
+
+    def test_flags_the_pre_change_shard_write_batch(self):
+        # The seeded true positive: the EXACT pre-rebuild write path.
+        found = lint(self.PRE_CHANGE_WRITE_BATCH, HotLoopUnderLockRule(),
+                     "m3_tpu/storage/shard.py")
+        assert rule_ids(found) == ["hot-loop-under-lock"]
+        assert "get_or_create" in found[0].message
+
+    def test_flags_setdefault_and_insert_loops(self):
+        src = """
+            import threading
+
+            class Index:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def insert_all(self, items, docs):
+                    with self._lock:
+                        for sid, tags in items:
+                            self._terms.setdefault(sid, []).append(tags)
+                        i = 0
+                        while i < len(docs):
+                            self.mutable.insert(docs[i])
+                            i += 1
+        """
+        found = lint(src, HotLoopUnderLockRule(), "m3_tpu/index/mod.py")
+        assert rule_ids(found) == ["hot-loop-under-lock"] * 2
+
+    def test_batched_entrypoints_under_lock_are_fine(self):
+        # The post-rebuild shape: one bulk apply per lock hold.
+        src = """
+            import threading
+
+            class Shard:
+                def __init__(self):
+                    self.write_lock = threading.Lock()
+
+                def drain(self, groups):
+                    with self.write_lock:
+                        for g in groups:
+                            idxs, created = \\
+                                self.registry.get_or_create_batch_tagged(
+                                    g.ids, g.tags)
+                            self.buffer.write_batch(idxs, g.ts, g.vals)
+
+                def index_drain(self, docs):
+                    with self._lock:
+                        self.mutable.insert_batch(docs)
+        """
+        assert lint(src, HotLoopUnderLockRule(),
+                    "m3_tpu/storage/shard.py") == []
+
+    def test_loop_outside_lock_is_fine(self):
+        src = """
+            import threading
+
+            class Shard:
+                def __init__(self):
+                    self.write_lock = threading.Lock()
+
+                def write_batch(self, ids):
+                    entries = []
+                    for sid in ids:
+                        entries.append(self.groups.setdefault(sid, []))
+                    with self.write_lock:
+                        self.buffer.write_batch(entries)
+        """
+        assert lint(src, HotLoopUnderLockRule(),
+                    "m3_tpu/storage/shard.py") == []
+
+    def test_nested_function_under_lock_not_attributed(self):
+        src = """
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def setup(self):
+                    with self._lock:
+                        def later(items):
+                            for it in items:
+                                self.m.insert(it)
+                        self.cb = later
+        """
+        assert lint(src, HotLoopUnderLockRule(),
+                    "m3_tpu/storage/mod.py") == []
+
+    def test_out_of_scope_dirs_are_ignored(self):
+        found = lint(self.PRE_CHANGE_WRITE_BATCH, HotLoopUnderLockRule(),
+                     "m3_tpu/query/mod.py")
+        assert found == []
+
+    def test_suppression_with_justification(self):
+        src = """
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def rebuild(self, items):
+                    with self._lock:
+                        for it in items:
+                            # DELIBERATE: cold recovery path, runs at boot
+                            self.map.insert(it)  # m3lint: disable=hot-loop-under-lock
+        """
+        assert lint(src, HotLoopUnderLockRule(),
+                    "m3_tpu/storage/mod.py") == []
 
 
 class TestTreeGate:
