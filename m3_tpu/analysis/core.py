@@ -242,8 +242,8 @@ def index_functions(mod: Module) -> Dict[str, ast.FunctionDef]:
 
 
 def _registry() -> List[Rule]:
-    from . import (batch_rules, cache_rules, jax_rules, lock_rules,
-                   overload_rules, retry_rules)
+    from . import (batch_rules, cache_rules, hbm_rules, jax_rules,
+                   lock_rules, overload_rules, retry_rules)
 
     return [
         *cache_rules.RULES,
@@ -252,6 +252,7 @@ def _registry() -> List[Rule]:
         *batch_rules.RULES,
         *retry_rules.RULES,
         *overload_rules.RULES,
+        *hbm_rules.RULES,
     ]
 
 

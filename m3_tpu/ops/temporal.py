@@ -42,7 +42,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.instrument import ROOT
+
 _F32 = jnp.float32
+
+# Hit/miss/eviction visibility for the device caches below (satellite of
+# the block-cache round: cold-vs-warm bench splits are measurable from
+# metrics alone). Process-wide tallies via the instrument convention.
+_UPLOAD_METRICS = ROOT.sub_scope("ops.upload_cache")
+_DERIVED_METRICS = ROOT.sub_scope("ops.derived_cache")
 
 # ------------------------------------------------------- query placement
 #
@@ -80,8 +88,12 @@ def _place_tag():
 
 
 def _placed_put(arr):
+    # DELIBERATE raw puts: this is the implementation under the content-
+    # addressed upload/derived caches, whose entries are charged to the
+    # shared HBM budget by the callers below.
+    # m3lint: disable=unbudgeted-device-put
     dev = _place_device()
-    return jax.device_put(arr, dev) if dev is not None else jax.device_put(arr)
+    return jax.device_put(arr, dev) if dev is not None else jax.device_put(arr)  # m3lint: disable=unbudgeted-device-put
 
 # ------------------------------------------------------------ upload cache
 #
@@ -97,6 +109,10 @@ _PUT_CACHE: "collections.OrderedDict[tuple, tuple]" = collections.OrderedDict() 
 _PUT_CACHE_LOCK = threading.Lock()
 # Evict by device bytes, not entry count: one [100k, 500] f32 grid is
 # ~200MB of HBM, so a count cap could pin multiple GB and starve kernels.
+# The per-cache ceiling below is this cache's SHARE; the process-wide sum
+# across every resident tier (this, the derived caches, the storage block
+# cache) is additionally bounded by utils.hbm's shared HBMBudget
+# (M3_TPU_HBM_BUDGET_BYTES), which reclaims across tenants.
 _PUT_CACHE_MAX_BYTES = int(os.environ.get(
     "M3_TPU_UPLOAD_CACHE_BYTES", str(512 * 1024 * 1024)))
 _put_cache_bytes = 0
@@ -108,6 +124,57 @@ def _cache_enabled() -> bool:
     # costs more than the memcpy it avoids and the cache would just pin
     # duplicate host arrays.
     return jax.default_backend() != "cpu"
+
+
+@functools.lru_cache(maxsize=1)
+def _hbm_budget():
+    """The process-wide HBM budget (utils.hbm), with this module's three
+    device caches registered as tenants on first use: their per-cache
+    ceilings keep their historical meaning as SHARES, while the shared
+    budget bounds the sum (including the storage-layer block cache) and
+    can reclaim across tenants. Usage probes read the live byte counters
+    (pull accounting), evictors pop one LRU entry each."""
+    from ..utils import hbm
+
+    budget = hbm.shared_budget()
+    budget.register("upload", lambda: _put_cache_bytes, _evict_one_upload)
+    budget.register("derived", lambda: _derived_cache_bytes,
+                    _evict_one_derived)
+    budget.register("derived_id", lambda: _derived_id_fast_bytes,
+                    _evict_one_id_fast)
+    return budget
+
+
+def _evict_one_upload() -> int:
+    global _put_cache_bytes
+    with _PUT_CACHE_LOCK:
+        if len(_PUT_CACHE) <= 1:
+            return 0
+        _, (_, freed) = _PUT_CACHE.popitem(last=False)
+        _put_cache_bytes -= freed
+        _UPLOAD_METRICS.counter("evictions").inc()
+        return freed
+
+
+def _evict_one_derived() -> int:
+    global _derived_cache_bytes
+    with _PUT_CACHE_LOCK:
+        if len(_DERIVED_CACHE) <= 1:
+            return 0
+        _, (_, freed) = _DERIVED_CACHE.popitem(last=False)
+        _derived_cache_bytes -= freed
+        _DERIVED_METRICS.counter("evictions").inc()
+        return freed
+
+
+def _evict_one_id_fast() -> int:
+    global _derived_id_fast_bytes
+    with _PUT_CACHE_LOCK:
+        if len(_DERIVED_ID_FAST) <= 1:
+            return 0
+        _, (_, _, freed) = _DERIVED_ID_FAST.popitem(last=False)
+        _derived_id_fast_bytes -= freed
+        return freed
 
 
 # Derived-input cache: device-resident (adj/finite/grid32) and
@@ -147,6 +214,7 @@ def _derived(grid: np.ndarray, kind: str, build):
         fast = _DERIVED_ID_FAST.get(fast_key)
         if fast is not None and fast[0] is grid:
             _DERIVED_ID_FAST.move_to_end(fast_key)
+            _DERIVED_METRICS.counter("hits").inc()
             return fast[1]
     if not _cache_enabled():
         val, _ = build(grid)
@@ -161,7 +229,9 @@ def _derived(grid: np.ndarray, kind: str, build):
         if hit is not None:
             _DERIVED_CACHE.move_to_end(key)
             _id_fast_store(fast_key, grid, hit[0])
+            _DERIVED_METRICS.counter("hits").inc()
             return hit[0]
+    _DERIVED_METRICS.counter("misses").inc()
     val, nbytes = build(g)
     with _PUT_CACHE_LOCK:
         if key not in _DERIVED_CACHE:
@@ -171,7 +241,9 @@ def _derived(grid: np.ndarray, kind: str, build):
                and len(_DERIVED_CACHE) > 1):
             _, (_, freed) = _DERIVED_CACHE.popitem(last=False)
             _derived_cache_bytes -= freed
+            _DERIVED_METRICS.counter("evictions").inc()
         _id_fast_store(fast_key, grid, val)
+    _hbm_budget().reclaim()
     return val
 
 
@@ -205,18 +277,25 @@ def _cached_put(arr: np.ndarray):
         hit = _PUT_CACHE.get(key)
         if hit is not None:
             _PUT_CACHE.move_to_end(key)
+            _UPLOAD_METRICS.counter("hits").inc()
             return hit[0]
+    _UPLOAD_METRICS.counter("misses").inc()
     dev = _placed_put(arr)
     with _PUT_CACHE_LOCK:
         if key not in _PUT_CACHE:
-            # Charge the HOST size we measured; device_put may canonicalize
-            # dtypes, so re-reading device nbytes at evict time would drift
-            # the counter.
-            _PUT_CACHE[key] = (dev, arr.nbytes)
-            _put_cache_bytes += arr.nbytes
+            # Charge the ACTUAL device-buffer size (device_put may
+            # canonicalize dtypes, so the host size can diverge from what
+            # the entry really pins in HBM); the charged value is stored
+            # with the entry, so eviction releases exactly what was
+            # charged — no drift either way.
+            charged = int(getattr(dev, "nbytes", arr.nbytes))
+            _PUT_CACHE[key] = (dev, charged)
+            _put_cache_bytes += charged
         while _put_cache_bytes > _PUT_CACHE_MAX_BYTES and len(_PUT_CACHE) > 1:
             _, (_, freed) = _PUT_CACHE.popitem(last=False)
             _put_cache_bytes -= freed
+            _UPLOAD_METRICS.counter("evictions").inc()
+    _hbm_budget().reclaim()
     return dev
 
 
@@ -416,8 +495,9 @@ def _rate_args(grid: np.ndarray, is_counter: bool):
         arrs = (adj, finite) + ((grid32,) if is_counter else ())
         if not _cache_enabled() and _place_device() is None:
             return arrs, 0
-        return tuple(_placed_put(a) for a in arrs), sum(
-            a.nbytes for a in arrs)
+        devs = tuple(_placed_put(a) for a in arrs)
+        # Charge the canonicalized device sizes (what the entry pins).
+        return devs, sum(int(getattr(a, "nbytes", 0)) for a in devs)
 
     return _derived(grid, f"rate:{is_counter}", build)
 
@@ -680,8 +760,10 @@ def _resid_args(grid: np.ndarray):
         base32 = base.astype(np.float32)
         if not _cache_enabled() and _place_device() is None:
             return (resid, base, base32), 0
-        return ((_placed_put(resid), base, _placed_put(base32)),
-                resid.nbytes + base32.nbytes)
+        resid_dev, base32_dev = _placed_put(resid), _placed_put(base32)
+        return ((resid_dev, base, base32_dev),
+                int(getattr(resid_dev, "nbytes", resid.nbytes))
+                + int(getattr(base32_dev, "nbytes", base32.nbytes)))
 
     return _derived(grid, "resid", build)
 

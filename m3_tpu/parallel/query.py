@@ -108,7 +108,10 @@ def shard_grid(grid: np.ndarray, mesh: Mesh, is_counter: bool = True):
     if grid32 is None:
         grid32 = np.zeros_like(adj)
     sharding = NamedSharding(mesh, P("shard", None))
-    return tuple(jax.device_put(a, sharding) for a in (adj, finite, grid32))
+    # DELIBERATE raw put (sharded-query staging): the placed grid feeds
+    # the SPMD aggregation immediately and dies with the query; resident
+    # device grids are the upload/derived caches' (budgeted) job.
+    return tuple(jax.device_put(a, sharding) for a in (adj, finite, grid32))  # m3lint: disable=unbudgeted-device-put
 
 
 def agg_rate(grid: np.ndarray, mesh: Mesh, *, op: str, func: str, W: int,

@@ -760,9 +760,13 @@ class Engine:
                 placed = self._placement.choose(cells, xfer_bytes)
                 arr = vals
                 if placed is not None:
-                    import jax
+                    from ..utils import hbm
 
-                    arr = jax.device_put(
+                    # Budget-charged upload (utils.hbm): the transient
+                    # [S, T] f32 plane is real HBM pressure for its
+                    # lifetime and must count against the same budget the
+                    # resident caches share.
+                    arr = hbm.budgeted_put(
                         np.asarray(vals, dtype=np.float32), placed)
                 t0 = time.perf_counter()
                 out = series_agg.grouped_reduce(arr, group_ids, G, kind)

@@ -124,7 +124,9 @@ class QueryPlacement:
             t0 = time.perf_counter()
             np.asarray(f(tiny))
             rtt = time.perf_counter() - t0
-            buf = jax.device_put(
+            # DELIBERATE raw put: a fixed 1MB link-bandwidth probe,
+            # serialized and immediately fetched back — not block traffic.
+            buf = jax.device_put(  # m3lint: disable=unbudgeted-device-put
                 np.zeros(_PROBE_BYTES // 4, dtype=np.float32))
             jax.block_until_ready(buf)
             t0 = time.perf_counter()

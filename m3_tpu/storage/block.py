@@ -10,6 +10,7 @@ filesets persist. Per-series access slices a row."""
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import zlib
 from collections import OrderedDict
 from typing import Dict, Optional, Tuple
@@ -20,6 +21,16 @@ from ..ops import tsz
 from ..parallel import ingest as par_ingest
 from ..utils import xtime
 from ..utils.instrument import ROOT
+from . import block_cache
+
+# Process-unique block generations (device-block-cache keys): every
+# SealedBlock CONSTRUCTION gets a fresh one — merge/re-seal/bootstrap
+# replacement produces a new generation by construction, so stale cache
+# entries are unreachable even before eager invalidation lands.
+# dataclasses.replace() builds a new object and therefore a new gen too
+# (two blocks must never share a generation: load_block permutes rows
+# in place after replace()).
+_GEN = itertools.count(1)
 
 # Fires once per block encoded through the shard x time mesh — the
 # dryrun/tests assert the serving flush actually took the mesh path.
@@ -56,6 +67,7 @@ class SealedBlock:
     boundary: Optional[dict] = None
 
     def __post_init__(self):
+        self.gen = next(_GEN)
         if self.checksum == 0:
             self.checksum = zlib.adler32(np.ascontiguousarray(self.words).tobytes())
 
@@ -75,31 +87,82 @@ class SealedBlock:
         return None
 
     def read(self, series_idx: int) -> Optional[Tuple[np.ndarray, np.ndarray]]:
-        """Decode one series' datapoints (device launch batched to 1 row)."""
+        """Decode one series' datapoints (device launch batched to 1 row).
+
+        Consults the device block cache first: a hot block's decoded
+        planes are resident (admission after repeated touches), turning
+        the per-series read into a row slice with no decode launch.
+
+        Returned arrays are READ-ONLY on every path (cache hits hand out
+        views of shared planes; the miss path freezes to keep the
+        contract observable cold — the query layer already treats fetch
+        results as immutable throughout)."""
         row = self.row_of(series_idx)
         if row is None:
             return None
+        cache = block_cache.active()
+        if cache is not None:
+            dec = cache.decoded(self)
+            if dec is not None:
+                n = int(self.npoints[row])
+                return dec[0][row, :n], dec[1][row, :n]
         ts, vals = tsz.decode(self.words[row : row + 1], self.npoints[row : row + 1], window=self.window)
         n = int(self.npoints[row])
-        return ts[0, :n] * self.time_unit.nanos, vals[0, :n]
+        t_out = ts[0, :n] * self.time_unit.nanos
+        v_out = np.ascontiguousarray(vals[0, :n])
+        t_out.setflags(write=False)
+        v_out.setflags(write=False)
+        return t_out, v_out
 
     def read_all(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Decode every series in one batched launch: (ts [S, W], vals, npoints).
+
+        Hot blocks serve from the device block cache; cold blocks decode
+        via _decode_plane's pow2 row bucketing. The planes are READ-ONLY
+        on every path (cache hits share them across readers — the
+        fetch-result immutability contract the query layer already
+        relies on; the cold path freezes so the contract is observable
+        before a block turns hot)."""
+        cache = block_cache.active()
+        if cache is not None:
+            dec = cache.decoded(self)
+            if dec is not None:
+                return dec[0], dec[1], self.npoints
+        ts, vals = self._decode_plane()
+        return ts, vals, self.npoints
+
+    def _decode_plane(self, encoded: Optional[tuple] = None
+                      ) -> Tuple[np.ndarray, np.ndarray]:
+        """Whole-block decode to (ts_ns [S, W], vals [S, W]).
 
         Rows are padded to a power of two (replicating the first stream,
         always valid) so one compiled decode kernel serves every block
         with this window geometry — the decode-side twin of
         encode_block's shape bucketing; merge/repair paths decode blocks
-        of arbitrary series counts without per-count recompiles."""
+        of arbitrary series counts without per-count recompiles.
+
+        `encoded` is the cache's retained device (words, padded npoints)
+        from the seal-time encode: decoding from it skips the H2D
+        re-upload of the stream words entirely (the row padding matches
+        encode_block's, and decode is row-independent, so rows [:S] are
+        bit-identical either way). Planes come back read-only — they may
+        be cache-shared across readers."""
         s = len(self.series_indices)
-        sp = _next_pow2(s, floor=1)
-        words, npoints = self.words, self.npoints
-        if sp != s:
-            words = np.concatenate([words, np.repeat(words[:1], sp - s, 0)])
-            npoints = np.concatenate(
-                [npoints, np.repeat(npoints[:1], sp - s)])
+        if encoded is not None:
+            words, npoints = encoded
+        else:
+            sp = _next_pow2(s, floor=1)
+            words, npoints = self.words, self.npoints
+            if sp != s:
+                words = np.concatenate([words, np.repeat(words[:1], sp - s, 0)])
+                npoints = np.concatenate(
+                    [npoints, np.repeat(npoints[:1], sp - s)])
         ts, vals = tsz.decode(words, npoints, window=self.window)
-        return (ts[:s] * self.time_unit.nanos, vals[:s], self.npoints)
+        ts = ts[:s] * self.time_unit.nanos
+        vals = np.ascontiguousarray(vals[:s])
+        ts.setflags(write=False)
+        vals.setflags(write=False)
+        return ts, vals
 
     def nbytes(self) -> int:
         return int(self.words.nbytes)
@@ -149,11 +212,21 @@ def encode_block(block_start: int, series_indices, tdense, vdense, npoints,
     else:
         words, nbits = tsz.encode_prepared(inp, max_words=mw)
     boundary = tsz.boundary_metadata(inp)
+    # Keep the just-encoded DEVICE buffers (padded [sp, mw] words + padded
+    # npoints — exactly what a later whole-block decode consumes) for the
+    # device block cache: the seal hook (Shard._tick_locked) adopts them
+    # via retain_encoded, so warm reads decode without re-uploading what
+    # this encode just produced on the mesh. Transient blocks (snapshots,
+    # merge intermediates) that nobody retains drop the handle with the
+    # block object.
+    encoded_dev = None
+    if block_cache.wants_encoded():
+        encoded_dev = (words, np.asarray(npoints, np.int32))
     words = np.asarray(words)[:s]
     nbits = np.asarray(nbits)[:s]
     npoints = npoints[:s]
     boundary = {k: v[:s] for k, v in boundary.items()}
-    return SealedBlock(
+    blk = SealedBlock(
         block_start=block_start,
         window=window,
         series_indices=np.asarray(series_indices, np.int32),
@@ -163,6 +236,9 @@ def encode_block(block_start: int, series_indices, tdense, vdense, npoints,
         time_unit=unit,
         boundary=boundary,
     )
+    if encoded_dev is not None:
+        blk._encoded_dev = encoded_dev
+    return blk
 
 
 def merge_sealed_blocks(b1: SealedBlock, b2: SealedBlock) -> SealedBlock:
@@ -380,6 +456,10 @@ class WiredList:
             return blk
 
     def put(self, key, blk: SealedBlock):
+        # Invalidation goes through get_cache(), not active(): dropping
+        # residency must happen even while a thread is inside a
+        # block_cache.disabled() bypass.
+        cache = block_cache.get_cache()
         with self._lock:
             if key in self._items:
                 self._items.move_to_end(key)
@@ -389,13 +469,20 @@ class WiredList:
             while self._bytes > self.max_bytes and len(self._items) > 1:
                 _, old = self._items.popitem(last=False)
                 self._bytes -= old.nbytes()
+                # An unwired block can never be read again (the next
+                # retrieve builds a NEW block/generation): drop its
+                # decoded residency too.
+                cache.invalidate_block(old)
 
     def drop(self, pred) -> int:
         """Remove entries whose key matches `pred` (fileset invalidation)."""
+        cache = block_cache.get_cache()
         with self._lock:
             doomed = [k for k in self._items if pred(k)]
             for k in doomed:
-                self._bytes -= self._items.pop(k).nbytes()
+                old = self._items.pop(k)
+                self._bytes -= old.nbytes()
+                cache.invalidate_block(old)
             return len(doomed)
 
     def __len__(self):

@@ -244,11 +244,30 @@ class PeersBootstrapper(Bootstrapper):
                     vs = np.concatenate([v for _t, v in decoded])
                     order = np.lexsort((ts, sidx))
                     series, td, vd, counts = to_dense(sidx[order], ts[order], vs[order])
+                    from . import block_cache
                     from .shard import FlushState
 
+                    built = encode_block(bs, series, td, vd, counts)
+                    cache = block_cache.get_cache()
                     with shard.write_lock:
-                        shard.blocks[bs] = encode_block(bs, series, td, vd, counts)
+                        old = shard.blocks.get(bs)
+                        if old is not None:
+                            # Replacing a resident block: its generation's
+                            # cached planes die with it.
+                            cache.invalidate_block(old)
+                        shard.blocks[bs] = built
+                        # Adopt (or drop) the encode's device buffers: a
+                        # long-lived block must never pin them outside the
+                        # budget's sight.
+                        cache.retain_encoded(
+                            built, getattr(shard, "namespace_name", None),
+                            shard.shard_id)
                         shard.flush_states.setdefault(bs, FlushState.SUCCESS)
+                    # Per-block reclaim OUTSIDE the shard lock: a many-
+                    # block peers bootstrap must not overshoot the HBM
+                    # budget for the whole recovery window (Shard.tick
+                    # makes the same call after its seals).
+                    cache.budget.reclaim()
             for s, e in ranges:
                 claimed.add(shard_id, s, e)
         return claimed

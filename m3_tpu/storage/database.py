@@ -86,9 +86,14 @@ class Database:
     def drop_namespace(self, name: bytes):
         """Remove a namespace (namespace_watch.go applying a registry
         removal): in-flight reads of the dropped object finish against its
-        now-orphaned state; new operations get KeyError."""
+        now-orphaned state; new operations get KeyError. The namespace is
+        closed after removal — insert queues drain and its device-block-
+        cache residency drops (in-flight reads re-decode; dead-generation
+        puts are refused)."""
         with self._ns_lock:
-            self.namespaces.pop(name, None)
+            ns = self.namespaces.pop(name, None)
+        if ns is not None:
+            ns.close()
 
     def namespace(self, name: bytes) -> Namespace:
         ns = self.namespaces.get(name)

@@ -60,7 +60,8 @@ class Namespace:
             return self.shards[shard_id]
         sh = Shard(shard_id, self.opts.shard_options(),
                    on_new_series=self._on_new_series, state=state,
-                   on_new_series_batch=self._on_new_series_batch)
+                   on_new_series_batch=self._on_new_series_batch,
+                   namespace_name=self.name)
         if self.retriever is not None:
             sh.attach_retriever(self.retriever, self.name)
         self.shards[shard_id] = sh
@@ -90,7 +91,9 @@ class Namespace:
             self.index.insert_many(tagged)
 
     def close(self):
-        """Drain + stop every shard's insert queue."""
+        """Drain + stop every shard's insert queue; shard close also drops
+        this namespace's device-block-cache residency (zero HBM pinned by
+        a closed namespace)."""
         for sh in self.shards.values():
             sh.close()
 

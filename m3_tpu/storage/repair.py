@@ -17,6 +17,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..client.decode import decode_segment_groups, merge_replica_points
+from . import block_cache
 from .block import encode_block
 from .buffer import to_dense
 
@@ -105,8 +106,12 @@ class ShardRepairer:
         blocks/flush_states dicts share the per-shard synchronization
         contract with the write path (no more global node mutex)."""
         with shard.write_lock:
-            return self._rebuild_block_locked(ns, shard, bs, peer_rows,
-                                              tags_by_sid)
+            out = self._rebuild_block_locked(ns, shard, bs, peer_rows,
+                                             tags_by_sid)
+        # Rebuilt-block retains count against the shared HBM budget;
+        # reclaim OUTSIDE the shard lock (evictors take their own locks).
+        block_cache.get_cache().budget.reclaim()
+        return out
 
     def _rebuild_block_locked(self, ns, shard, bs, peer_rows, tags_by_sid):
         points: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
@@ -131,7 +136,16 @@ class ShardRepairer:
         vs = np.concatenate([v for _t, v in points.values()])
         order = np.lexsort((ts, sidx))
         series, tdense, vdense, counts = to_dense(sidx[order], ts[order], vs[order])
-        shard.blocks[bs] = encode_block(bs, series, tdense, vdense, counts)
+        rebuilt = encode_block(bs, series, tdense, vdense, counts)
+        cache = block_cache.get_cache()
+        if blk is not None:
+            # The divergent block is replaced wholesale: its generation's
+            # cached planes must die with it (a concurrent query holding
+            # the old object re-decodes, put refused).
+            cache.invalidate_block(blk)
+        shard.blocks[bs] = rebuilt
+        cache.retain_encoded(rebuilt, getattr(shard, "namespace_name", None),
+                             shard.shard_id)
         shard.flush_states.pop(bs, None)  # needs re-flush
 
 

@@ -21,6 +21,7 @@ import numpy as np
 
 from ..utils import xtime
 from ..utils.health import Priority
+from . import block_cache
 from .block import SealedBlock, encode_block, merge_same_start
 from .buffer import ShardBuffer
 from .insert_queue import InsertGroup, InsertQueue
@@ -65,10 +66,14 @@ class Shard:
     def __init__(self, shard_id: int, opts: ShardOptions,
                  on_new_series: Optional[Callable] = None,
                  state: ShardState = ShardState.AVAILABLE,
-                 on_new_series_batch: Optional[Callable] = None):
+                 on_new_series_batch: Optional[Callable] = None,
+                 namespace_name: Optional[bytes] = None):
         self.shard_id = shard_id
         self.opts = opts
         self.state = state
+        # Owning namespace (device-block-cache entry metadata); bound by
+        # Namespace.assign_shard.
+        self.namespace_name = namespace_name
         # Per-shard write/seal lock (shard.go:769 per-shard RWMutex): writes
         # to different shards never contend; a write only serializes with
         # writes to the same shard and with that shard's tick/seal. Reads
@@ -226,8 +231,13 @@ class Shard:
 
     def close(self):
         """Shutdown: drain and stop the insert queue — no queued write
-        is ever stranded by teardown."""
+        is ever stranded by teardown — and drop this shard's device-
+        block-cache residency (zero HBM held after namespace close)."""
         self.insert_queue.stop()
+        cache = block_cache.get_cache()
+        with self.write_lock:
+            for blk in self.blocks.values():
+                cache.invalidate_block(blk)
 
     # ------------------------------------------------------------------- tick
 
@@ -238,7 +248,13 @@ class Shard:
         # accepted write (the queue's "visible after one drain" bound).
         self.insert_queue.drain()
         with self.write_lock:
-            return self._tick_locked(now_ns)
+            stats = self._tick_locked(now_ns)
+        if stats["sealed"] and block_cache.active() is not None:
+            # Newly retained seal buffers count against the shared HBM
+            # budget; reclaim OUTSIDE the shard lock (evictors take cache
+            # locks of their own).
+            block_cache.get_cache().budget.reclaim()
+        return stats
 
     def _tick_locked(self, now_ns: int) -> dict:
         """Runs under the write lock. Multi-device platforms route the
@@ -247,6 +263,7 @@ class Shard:
         attached and the tile is mesh-divisible; single-device behavior
         and the resulting bitstreams are unchanged)."""
         sealed, expired = 0, 0
+        cache = block_cache.get_cache()
         for bs in self.buffer.sealable(now_ns):
             dense = self.buffer.drain(bs)
             if dense is not None:
@@ -256,9 +273,18 @@ class Shard:
                 if prev is not None:
                     # A drain can land writes for a block start that was
                     # already sealed (async insert racing tick): merge
-                    # instead of overwriting, so nothing is lost.
-                    blk = merge_same_start(prev, blk)
+                    # instead of overwriting, so nothing is lost. Both
+                    # inputs' generations die with the merge (a racing
+                    # query must not re-pin them; same hazard class the
+                    # postings cache handles on index seal).
+                    merged = merge_same_start(prev, blk)
+                    cache.invalidate_block(prev)
+                    cache.invalidate_block(blk)
+                    blk = merged
                 self.blocks[bs] = blk
+                # Hot tier: adopt the seal's still-device-resident encode
+                # output so warm reads decode without re-uploading it.
+                cache.retain_encoded(blk, self.namespace_name, self.shard_id)
                 self.flush_states.setdefault(bs, FlushState.NOT_STARTED)
                 if prev is not None and \
                         self.flush_states.get(bs) == FlushState.SUCCESS:
@@ -269,6 +295,7 @@ class Shard:
         cutoff = now_ns - self.opts.retention_ns
         self._retention_cutoff = cutoff
         for bs in [b for b in self.blocks if b + self.opts.block_size_ns <= cutoff]:
+            cache.invalidate_block(self.blocks[bs])
             del self.blocks[bs]
             expired += 1
         # Flush states expire with retention even for blocks already evicted
@@ -370,9 +397,11 @@ class Shard:
             return 0
         on_disk = self._retriever.block_starts(self._retriever_ns, self.shard_id)
         evicted = 0
+        cache = block_cache.get_cache()
         with self.write_lock:
             for bs in [b for b, st in self.flush_states.items()
                        if st == FlushState.SUCCESS and b in self.blocks and b in on_disk]:
+                cache.invalidate_block(self.blocks[bs])
                 del self.blocks[bs]
                 evicted += 1
         return evicted
@@ -390,6 +419,9 @@ class Shard:
             blk.nbits = blk.nbits[order]
             blk.npoints = blk.npoints[order]
         with self.write_lock:
+            old = self.blocks.get(blk.block_start)
+            if old is not None:
+                block_cache.get_cache().invalidate_block(old)
             self.blocks[blk.block_start] = blk
             self.flush_states.setdefault(blk.block_start, FlushState.SUCCESS)
 

@@ -29,6 +29,14 @@ echo "== index microbench smoke (<5s; bitmap-vs-ref + cache hit-rate asserted) =
 # (full matrix: tests/test_index_property.py; bench: index_fetch_tagged).
 python scripts/index_smoke.py
 
+echo "== block-cache smoke (<5s; warm hit-rate, eviction under tiny budget, zero residency after close) =="
+# HBM-resident block cache: warm reads must hit, results must be
+# bit-identical to the uncached decode, a tiny budget must evict, and
+# namespace close must drop every cached byte. Full matrix:
+# tests/test_block_cache.py; bench: hot_set_read. Wall budget via
+# CACHE_SMOKE_BUDGET_S.
+JAX_PLATFORMS=cpu python scripts/cache_smoke.py
+
 echo "== chaos smoke (seeded faultnet, one scenario per layer) =="
 # Resilience regressions (retry/breaker/deadline/dedup) fail HERE in
 # seconds, not twenty minutes in; the full matrix is tests/test_resilience.py.

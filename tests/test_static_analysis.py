@@ -16,6 +16,7 @@ from m3_tpu.analysis.cache_rules import (CacheKeyBufferRule,
 from m3_tpu.analysis.jax_rules import (ItemInLoopRule, JaxPurityRule,
                                        NonStaticJitCacheRule)
 from m3_tpu.analysis.lock_rules import HotLoopUnderLockRule, LockDisciplineRule
+from m3_tpu.analysis.hbm_rules import UnbudgetedDevicePutRule
 from m3_tpu.analysis.overload_rules import UnboundedQueueRule
 from m3_tpu.analysis.retry_rules import (BroadExceptWireIORule,
                                          RawSleepRetryRule)
@@ -991,6 +992,95 @@ class TestUnboundedQueueRule:
             topics = deque()  # m3lint: disable=unbounded-queue
         """
         assert lint(src, UnboundedQueueRule(), "m3_tpu/msg/mod.py") == []
+
+
+class TestUnbudgetedDevicePut:
+    """unbudgeted-device-put: raw jax.device_put on the storage/query
+    serving path pins HBM the shared budget (utils/hbm.py) can't see."""
+
+    def test_flags_dotted_call_in_storage(self):
+        src = """
+            import jax
+
+            dev = jax.device_put(words)
+        """
+        found = lint(src, UnbudgetedDevicePutRule(),
+                     "m3_tpu/storage/mod.py")
+        assert rule_ids(found) == ["unbudgeted-device-put"]
+
+    def test_flags_from_import_form_in_query(self):
+        src = """
+            import jax
+            from jax import device_put
+
+            arr = device_put(grid, dev)
+        """
+        found = lint(src, UnbudgetedDevicePutRule(), "m3_tpu/query/mod.py")
+        assert rule_ids(found) == ["unbudgeted-device-put"]
+
+    def test_flags_module_level_alias(self):
+        # the encode_prepared staging idiom: put = jax.device_put
+        src = """
+            import jax
+
+            put = jax.device_put
+            a = put(x, sharding)
+            b = put(y, sharding)
+        """
+        found = lint(src, UnbudgetedDevicePutRule(), "m3_tpu/ops/mod.py")
+        assert rule_ids(found) == ["unbudgeted-device-put"] * 2
+
+    def test_budgeted_put_is_fine(self):
+        src = """
+            import jax
+            from m3_tpu.utils import hbm
+
+            dev = hbm.budgeted_put(words)
+        """
+        assert lint(src, UnbudgetedDevicePutRule(),
+                    "m3_tpu/storage/mod.py") == []
+
+    def test_out_of_scope_dirs_are_ignored(self):
+        src = """
+            import jax
+
+            dev = jax.device_put(frame)
+        """
+        assert lint(src, UnbudgetedDevicePutRule(),
+                    "m3_tpu/testing/mod.py") == []
+
+    def test_module_without_jax_import_is_skipped(self):
+        src = """
+            def device_put(x):
+                return x
+
+            dev = device_put(words)
+        """
+        assert lint(src, UnbudgetedDevicePutRule(),
+                    "m3_tpu/storage/mod.py") == []
+
+    def test_local_name_is_not_jax_device_put(self):
+        # jax imported, but the called name is a local helper
+        src = """
+            import jax
+
+            def device_put(x):
+                return x
+
+            dev = device_put(words)
+        """
+        assert lint(src, UnbudgetedDevicePutRule(),
+                    "m3_tpu/storage/mod.py") == []
+
+    def test_suppression_with_justification(self):
+        src = """
+            import jax
+
+            # DELIBERATE: mesh-flush staging, freed when encode returns
+            dev = jax.device_put(tile, sharding)  # m3lint: disable=unbudgeted-device-put
+        """
+        assert lint(src, UnbudgetedDevicePutRule(),
+                    "m3_tpu/storage/mod.py") == []
 
 
 class TestHotLoopUnderLock:
