@@ -987,6 +987,136 @@ def bench_hot_set_read():
     }
 
 
+def bench_peer_migration():
+    """Config #9: peer-streaming shard migration (series/sec through
+    PeersBootstrapper over a real node RPC session), the data plane of
+    placement churn: a replacement node streams every sealed block of
+    its shards from a donor replica and installs them locally.
+
+    Build: one donor Database (8 shards, index off, commitlog off) holds
+    N series x 4 points in one sealed 2h block, served by a real
+    NodeServer; a fresh empty Database peer-bootstraps the whole shard
+    space through a Session (metadata diff -> checksum-majority plan ->
+    block fetch -> local apply). The measurement is the full migration
+    wall time, series/sec — metadata paging, wire encode/decode, and
+    the apply path all included, exactly what an operator waits on
+    during replace-node.
+
+    The pre-change baseline is the per-row path (per-series metadata
+    dicts, per-series registry get_or_create, per-row np fills into the
+    block tile), so vs_baseline measures the columnar-tile rebuild
+    directly — same protocol as rounds 6-8. Post-change the bench
+    additionally asserts the batched apply bit-identical to the
+    retained per-row oracle on one shard's fetched tiles."""
+    from m3_tpu.client.session import Session, SessionOptions
+    from m3_tpu.cluster.placement import Instance, initial_placement
+    from m3_tpu.cluster.topology import StaticTopology
+    from m3_tpu.parallel.sharding import ShardSet
+    from m3_tpu.rpc import NodeServer, NodeService
+    from m3_tpu.storage import bootstrap as bs_mod
+    from m3_tpu.storage.bootstrap import BootstrapContext, BootstrapProcess
+    from m3_tpu.storage.database import Database
+    from m3_tpu.storage.namespace import NamespaceOptions
+    from m3_tpu.utils import xtime
+
+    n_series = int(os.environ.get("BENCH_PEER_SERIES", "100000"))
+    ppb = int(os.environ.get("BENCH_PEER_POINTS", "4"))
+    iters = int(os.environ.get("BENCH_PEER_ITERS", "2"))
+    num_shards = 8
+    ns_name = b"bench"
+    block_ns = 2 * xtime.HOUR
+    t0 = (1_700_000_000 * 1_000_000_000 // block_ns) * block_ns
+    now = {"t": t0}
+    ns_opts = NamespaceOptions(index_enabled=False, snapshot_enabled=False,
+                               writes_to_commitlog=False)
+
+    _phase(f"peer_migration: seeding donor ({n_series} series x {ppb} pts)")
+    donor = Database(ShardSet(num_shards), clock=lambda: now["t"])
+    donor.ensure_namespace(ns_name, ns_opts)
+    ids = [b"mig-%07d" % i for i in range(n_series)]
+    rng = np.random.default_rng(61)
+    step_ns = block_ns // (ppb + 1)
+    for s in range(ppb):
+        ts_i = t0 + s * step_ns
+        now["t"] = ts_i
+        donor.write_batch(ns_name, ids, np.full(n_series, ts_i, np.int64),
+                          rng.standard_normal(n_series))
+    now["t"] = t0 + block_ns + 11 * xtime.MINUTE
+    stats = donor.tick()
+    assert stats["sealed"] >= num_shards, stats
+    donor.mark_bootstrapped()
+
+    srv = NodeServer(NodeService(donor)).start()
+    placement = initial_placement(
+        [Instance(id="donor", endpoint=srv.endpoint)], num_shards, 1)
+    session = Session(StaticTopology(placement), SessionOptions(timeout_s=120))
+
+    def fresh_db() -> Database:
+        db = Database(ShardSet(num_shards), clock=lambda: now["t"])
+        db.ensure_namespace(ns_name, ns_opts)
+        return db
+
+    def migrate() -> Database:
+        db = fresh_db()
+        proc = BootstrapProcess(
+            chain=("peers",),
+            ctx=BootstrapContext(session=session, placement=placement,
+                                 host_id="joiner"))
+        proc.run(db, now_ns=now["t"])
+        return db
+
+    _phase("peer_migration: warm pass")
+    db = migrate()  # warm sockets/compile caches outside the timing loop
+    got = sum(s.num_series() for s in db.namespace(ns_name).shards.values())
+    assert got == n_series, f"migrated {got}/{n_series} series"
+    sample = ids[n_series // 2]
+    t_new, v_new = db.read(ns_name, sample, 0, now["t"])
+    t_old, v_old = donor.read(ns_name, sample, 0, now["t"])
+    assert np.array_equal(t_new, t_old) and np.array_equal(v_new, v_old), \
+        "migrated series diverged from donor"
+
+    _phase(f"peer_migration: timing ({iters} iters)")
+    dts = []
+    for _ in range(iters):
+        t1 = time.perf_counter()
+        migrate()
+        dts.append(time.perf_counter() - t1)
+    sps = n_series / min(dts)
+
+    extra = {
+        "series": n_series, "points_per_series": ppb,
+        "shards": num_shards, "iters": iters,
+        "migration_s": round(min(dts), 3),
+    }
+    # Oracle split (post-change only): the batched tile apply must be
+    # state-identical to the retained per-row reference apply.
+    if hasattr(bs_mod, "apply_peer_tiles_ref"):
+        from m3_tpu.storage.shard import Shard
+        tiles, tags, _failed = session.fetch_block_tiles_from_peers(
+            ns_name, 0, t0, now["t"], exclude_host="joiner")
+        opts = ns_opts.shard_options()
+        sh_new, sh_ref = Shard(0, opts), Shard(0, opts)
+        bs_mod.apply_peer_tiles(sh_new, tiles, tags)
+        bs_mod.apply_peer_tiles_ref(sh_ref, tiles, tags)
+        assert sorted(sh_new.blocks) == sorted(sh_ref.blocks)
+        for bs_key, blk in sh_new.blocks.items():
+            ref = sh_ref.blocks[bs_key]
+            assert np.array_equal(blk.series_indices, ref.series_indices)
+            assert np.array_equal(blk.words, ref.words)
+            assert np.array_equal(blk.nbits, ref.nbits)
+            assert np.array_equal(blk.npoints, ref.npoints)
+        extra["oracle_blocks_checked"] = len(sh_new.blocks)
+    session.close()
+    srv.close()
+    _phase("peer_migration: done")
+    return {
+        "metric": "peer_migration",
+        "value": round(sps, 1),
+        "unit": "series/sec",
+        "extra": extra,
+    }
+
+
 _BENCHES = [
     ("m3tsz_encode_1m_rollup", bench_encode_rollup),
     ("counter_gauge_rollup", bench_counter_gauge),
@@ -996,6 +1126,7 @@ _BENCHES = [
     ("index_fetch_tagged", bench_index_fetch_tagged),
     ("write_path_ingest", bench_write_path_ingest),
     ("hot_set_read", bench_hot_set_read),
+    ("peer_migration", bench_peer_migration),
 ]
 
 

@@ -32,6 +32,23 @@ from .core import Finding, Module, Rule, qualname
 _WIRE_IO = {"read_frame", "write_frame", "read_dict_frame"}
 _BROAD = {"Exception", "BaseException"}
 
+# Peer-streaming session calls are wire I/O one hop removed: inside the
+# peer-replication data plane (storage/bootstrap.py, storage/repair.py)
+# a broad except around them eats the typed transport classification
+# (client.session.PEER_SKIP_ERRORS) exactly like a broad except around
+# read_frame would — the pre-fix `except Exception: continue` hole in
+# PeersBootstrapper.bootstrap (peers unavailable silently claimed
+# nothing) is the seeded positive for this scope extension.
+_PEER_IO = {
+    "fetch_bootstrap_blocks_from_peers", "fetch_blocks_metadata_from_peers",
+    "fetch_block_metadata_tiles_from_peers", "fetch_block_tiles_from_peers",
+    "fetch_block_tiles", "fetch_block_tiles_from_host",
+    "fetch_blocks_from_host", "fetch_blocks",
+}
+_PEER_IO_SCOPES = {
+    ("storage", "bootstrap.py"), ("storage", "repair.py"),
+}
+
 
 def _is_exempt(mod: Module) -> bool:
     return mod.scope_parts[-2:] == ("utils", "retry.py")
@@ -106,7 +123,8 @@ class BroadExceptWireIORule(Rule):
         return any(n is not None and n.split(".")[-1] in _BROAD
                    for n in names)
 
-    def _wire_calls(self, try_node: ast.Try) -> List[Tuple[str, int]]:
+    def _wire_calls(self, try_node: ast.Try,
+                    peer_io: bool = False) -> List[Tuple[str, int]]:
         out: List[Tuple[str, int]] = []
         stack = list(try_node.body)
         while stack:
@@ -123,30 +141,42 @@ class BroadExceptWireIORule(Rule):
                     if parts[-1] in _WIRE_IO and \
                             (len(parts) == 1 or parts[-2] == "wire"):
                         out.append((parts[-1], sub.lineno))
+                    elif peer_io and parts[-1] in _PEER_IO:
+                        out.append((parts[-1], sub.lineno))
             stack.extend(ast.iter_child_nodes(sub))
         return out
 
     def check(self, mod: Module) -> Iterator[Finding]:
         if _is_exempt(mod):
             return
+        peer_io = tuple(mod.scope_parts[-2:]) in _PEER_IO_SCOPES
         for node in ast.walk(mod.tree):
             if not isinstance(node, ast.Try):
                 continue
-            calls = self._wire_calls(node)
+            calls = self._wire_calls(node, peer_io)
             if not calls:
                 continue
             for handler in node.handlers:
                 if not self._is_broad(handler):
                     continue
                 fn, line = calls[0]
-                yield Finding(
-                    self.id, mod.relpath, handler.lineno,
-                    f"broad except around wire.{fn} (line {line}): framed "
-                    "I/O fails typed (ConnectionError/WireTruncated, "
-                    "OSError, ValueError) and the retry/breaker layer "
-                    "classifies on those — catch the typed set or route "
-                    "through utils.retry",
-                    self.severity)
+                if fn in _WIRE_IO:
+                    msg = (f"broad except around wire.{fn} (line {line}): "
+                           "framed I/O fails typed (ConnectionError/"
+                           "WireTruncated, OSError, ValueError) and the "
+                           "retry/breaker layer classifies on those — "
+                           "catch the typed set or route through "
+                           "utils.retry")
+                else:
+                    msg = (f"broad except around peer-streaming {fn} "
+                           f"(line {line}): peer RPC failures are typed "
+                           "(client.session.PEER_SKIP_ERRORS + "
+                           "RemoteError) — a broad handler eats the "
+                           "classification and turns a dead peer into a "
+                           "silent coverage hole; catch the typed set and "
+                           "count the skip")
+                yield Finding(self.id, mod.relpath, handler.lineno, msg,
+                              self.severity)
 
 
 RULES: List[Rule] = [RawSleepRetryRule(), BroadExceptWireIORule()]

@@ -67,6 +67,30 @@ def decode_segment_groups(segments: Sequence[dict]) -> List[Tuple[np.ndarray, np
     return out
 
 
+def decode_tile(words, npoints, window: int, time_unit: int
+                ) -> Tuple[np.ndarray, np.ndarray]:
+    """Decode one columnar block tile ([rows, max_words] words +
+    per-row npoints) in a single batched kernel launch, rows padded to a
+    power of two so one compiled decode serves every tile with this
+    geometry (the decode-side twin of encode_block's shape bucketing —
+    same bucketing SealedBlock._decode_plane uses).
+
+    Returns dense ([rows, window] ts_ns, [rows, window] vals) planes;
+    row i's valid points are the first npoints[i] columns."""
+    words = np.asarray(words)
+    npoints = np.asarray(npoints, np.int32)
+    n = words.shape[0]
+    rp = 1 << (max(n, 1) - 1).bit_length()
+    if rp != n:
+        words = np.concatenate([words, np.repeat(words[:1], rp - n, 0)])
+        np_pad = np.concatenate([npoints, np.repeat(npoints[:1], rp - n)])
+    else:
+        np_pad = npoints
+    ts, vs = tsz.decode(words, np_pad, window)
+    scale = xtime.Unit(time_unit).nanos
+    return np.asarray(ts[:n]) * scale, np.asarray(vs[:n])
+
+
 def merge_replica_points(
     ts_parts: Sequence[np.ndarray],
     vs_parts: Sequence[np.ndarray],

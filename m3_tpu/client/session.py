@@ -15,7 +15,6 @@ import dataclasses
 import socket
 import threading
 import time
-from collections import Counter
 from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait as futures_wait
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -29,6 +28,7 @@ from ..cluster.topology import (
 )
 from ..parallel.sharding import ShardSet
 from ..rpc import wire
+from ..utils.instrument import ROOT
 from ..utils.limits import ResourceExhausted
 from ..utils.retry import (
     Breaker,
@@ -45,6 +45,19 @@ from .decode import ConflictStrategy, merge_replica_points, series_points
 
 class ConsistencyError(Exception):
     """Not enough replica acks/responses to satisfy the consistency level."""
+
+
+# The typed ways a peer RPC fails without implicating this process's own
+# logic: transport death (ConnectionError covers WireTruncated and
+# BreakerOpen), socket/connect errors, an expired budget, or a deliberate
+# shed by a healthy-but-overloaded peer. Peer-streaming paths classify on
+# exactly this set — anything else is a programming error and propagates.
+PEER_SKIP_ERRORS = (ConnectionError, OSError, DeadlineExceeded,
+                    ResourceExhausted)
+
+# AdminSession peer-streaming instrumentation (bootstrap/repair observe
+# peer failures through these instead of silent except/continue).
+_PEER_METRICS = ROOT.sub_scope("session.peers")
 
 
 # ------------------------------------------------------------------ transport
@@ -64,9 +77,14 @@ class Connection:
         self._msg_id = 0
 
     def call(self, method: str, args: dict,
-             deadline: Optional[Deadline] = None):
+             deadline: Optional[Deadline] = None,
+             priority: Optional[str] = None):
         self._msg_id += 1
         req = {"m": method, "id": self._msg_id, "a": args}
+        if priority is not None:
+            # Admission hint for the server's gate ("bulk" sheds first at
+            # the high watermark); rides the frame, not the args.
+            req["pri"] = priority
         if deadline is not None:
             deadline.check(method)
             req[wire.DEADLINE_KEY] = deadline.to_wire()
@@ -77,7 +95,35 @@ class Connection:
             self.sock.settimeout(self.request_timeout)
         wire.write_frame(self.sock, req)
         try:
-            resp = wire.read_dict_frame(self.sock)
+            while True:
+                resp = wire.read_dict_frame(self.sock)
+                rid = resp.get("id", self._msg_id)
+                if rid == self._msg_id:
+                    break
+                if rid > self._msg_id:
+                    # A response from the future: the stream is not
+                    # request/response-paired anymore — unusable.
+                    self.close()
+                    raise ConnectionError(
+                        f"node reply desync: got id {rid}, "
+                        f"expected {self._msg_id}")
+                # rid < current: a STALE response — a duplicated request
+                # frame (at-least-once delivery) made the server answer
+                # an earlier exchange twice. Discard and keep reading;
+                # matching on id restores pairing instead of handing the
+                # caller another method's result. Re-arm the socket
+                # timeout to the REMAINING budget each iteration: stale
+                # frames dripping in just under the timeout must not
+                # extend a deadlined call past its budget (the unread
+                # real response leaves the stream desynced — drop it).
+                if deadline is not None:
+                    if deadline.expired:
+                        self.close()
+                        raise DeadlineExceeded(
+                            f"{method}: deadline exceeded draining "
+                            "stale responses")
+                    self.sock.settimeout(
+                        deadline.min_timeout(self.request_timeout))
         except socket.timeout:
             # The response may still land later: this stream is desynced
             # for any further request/response pairing — drop it.
@@ -145,11 +191,13 @@ class HostClient:
         if self._on_outcome is not None:
             self._on_outcome(ok)
 
-    def call(self, method: str, _deadline: Optional[Deadline] = None, **args):
+    def call(self, method: str, _deadline: Optional[Deadline] = None,
+             _priority: Optional[str] = None, **args):
         return self.retrier.attempt(self._call_once, method, args,
-                                    _deadline, deadline=_deadline)
+                                    _deadline, _priority, deadline=_deadline)
 
-    def _call_once(self, method: str, args: dict, deadline: Optional[Deadline]):
+    def _call_once(self, method: str, args: dict, deadline: Optional[Deadline],
+                   priority: Optional[str] = None):
         if self.breaker.state == Breaker.OPEN:
             # fast shed: no pool-slot wait, no grant claimed
             raise BreakerOpen(f"host {self.endpoint} shed by open breaker")
@@ -172,7 +220,8 @@ class HostClient:
                     self._record(ok)
 
             try:
-                return self._call_on_conn(method, args, deadline, record)
+                return self._call_on_conn(method, args, deadline, record,
+                                          priority)
             except DeadlineExceeded as e:
                 if getattr(e, "pre_io", False) and not recorded[0]:
                     # budget died in CLIENT-side queueing (retry backoff,
@@ -188,7 +237,8 @@ class HostClient:
                 raise
 
     def _call_on_conn(self, method: str, args: dict,
-                      deadline: Optional[Deadline], record):
+                      deadline: Optional[Deadline], record,
+                      priority: Optional[str] = None):
         """One attempt on a pooled connection (pool semaphore + breaker
         grant both held by _call_once)."""
         with self._lock:
@@ -207,7 +257,7 @@ class HostClient:
                 record(False)
                 raise
         try:
-            result = conn.call(method, args, deadline)
+            result = conn.call(method, args, deadline, priority)
         except RemoteError:
             # The HOST is healthy — it parsed, ran, and answered; the
             # application errored. Keep the connection and the breaker
@@ -314,6 +364,9 @@ class _WriteOp:
     value: float
     tags: Optional[dict]
     completion: _Completion
+    # Wire admission hint ("bulk" backfill sheds first server-side); None
+    # is NORMAL serving traffic.
+    priority: Optional[str] = None
 
 
 class HostQueue:
@@ -348,13 +401,14 @@ class HostQueue:
             self._flush(batch)
 
     def _flush(self, batch: List[_WriteOp]):
-        by_ns: Dict[bytes, List[_WriteOp]] = {}
+        by_ns: Dict[Tuple[bytes, Optional[str]], List[_WriteOp]] = {}
         for op in batch:
-            by_ns.setdefault(op.ns, []).append(op)
-        for ns, ops in by_ns.items():
+            by_ns.setdefault((op.ns, op.priority), []).append(op)
+        for (ns, pri), ops in by_ns.items():
             try:
                 self.client.call(
                     "write_batch",
+                    _priority=pri,
                     ns=ns,
                     ids=[o.id for o in ops],
                     ts=np.array([o.t_ns for o in ops], np.int64),
@@ -395,6 +449,10 @@ class SessionOptions:
     request_timeout_s: Optional[float] = None
     retry: RetryOptions = RetryOptions(max_attempts=3, initial_backoff_s=0.05)
     breaker: BreakerOptions = BreakerOptions()
+    # Read-fanout worker pool: open-loop traffic with slow/faulted
+    # replicas queues here before any socket — size it for the offered
+    # concurrency, not just the host count.
+    fanout_workers: int = 16
 
     @property
     def effective_request_timeout_s(self) -> float:
@@ -417,7 +475,7 @@ class Session:
         self._clients: Dict[str, HostClient] = {}
         self._queues: Dict[str, HostQueue] = {}
         self._lock = threading.RLock()  # _queue -> _client nest on this lock
-        self._pool = ThreadPoolExecutor(max_workers=16)
+        self._pool = ThreadPoolExecutor(max_workers=opts.fanout_workers)
         self._shard_set: Optional[ShardSet] = None
         if hasattr(topology, "subscribe"):
             topology.subscribe(lambda _m: None)  # keep map fresh
@@ -467,7 +525,7 @@ class Session:
     # ----------------------------------------------------------------- writes
 
     def write(self, ns: bytes, id: bytes, t_ns: int, value: float,
-              tags: Optional[dict] = None):
+              tags: Optional[dict] = None, priority: Optional[str] = None):
         """session.go:867 Write: fan out to all shard replicas, wait quorum."""
         m = self._map()
         shard = self._shards().lookup(id)
@@ -476,7 +534,7 @@ class Session:
             raise ConsistencyError(f"no hosts own shard {shard}")
         required = required_acks(self.opts.write_consistency, m.replica_factor)
         completion = _Completion(required=min(required, len(hosts)), total=len(hosts))
-        op = _WriteOp(ns, id, t_ns, value, tags, completion)
+        op = _WriteOp(ns, id, t_ns, value, tags, completion, priority)
         for h in hosts:
             self._queue(h).enqueue(op)
         completion.wait(self.opts.timeout_s)
@@ -485,7 +543,8 @@ class Session:
         self.write(ns, id, t_ns, value, tags)
 
     def write_batch(self, ns: bytes, ids: Sequence[bytes], ts, vals,
-                    tags: Optional[Sequence[Optional[dict]]] = None):
+                    tags: Optional[Sequence[Optional[dict]]] = None,
+                    priority: Optional[str] = None):
         """Batched write: one quorum completion per datapoint, ops fanned
         through the same host queues (host queues re-batch per host)."""
         ts = np.asarray(ts, np.int64)
@@ -501,7 +560,7 @@ class Session:
             c = _Completion(required=min(required, len(hosts)), total=len(hosts))
             completions.append(c)
             op = _WriteOp(ns, sid, int(ts[i]), float(vals[i]),
-                          tags[i] if tags else None, c)
+                          tags[i] if tags else None, c, priority)
             for h in hosts:
                 self._queue(h).enqueue(op)
         for c in completions:
@@ -652,9 +711,18 @@ class Session:
     # ------------------------------------------------------------------ admin
 
     def fetch_blocks_metadata_from_peers(self, ns: bytes, shard: int, start_ns: int,
-                                         end_ns: int, exclude_host: Optional[str] = None):
+                                         end_ns: int, exclude_host: Optional[str] = None,
+                                         deadline: Optional[Deadline] = None,
+                                         errors: Optional[Dict[str, str]] = None):
         """AdminSession peer metadata streaming: paged metadata from every
-        replica of a shard -> {host_id: {series_id: {tags, blocks}}}."""
+        replica of a shard -> {host_id: {series_id: {tags, blocks}}}.
+
+        A peer that fails in one of the typed transport ways (connection
+        death, expired budget, deliberate shed) or relays a server-side
+        error is SKIPPED — counted in the `session.peers` scope and
+        reported into `errors` (host_id -> message) when the caller passes
+        a dict — so bootstrap/repair see partial coverage instead of a
+        silently smaller quorum. Anything untyped propagates."""
         m = self._map()
         out: Dict[str, Dict[bytes, dict]] = {}
         # Peer streaming reads block data: only readable owners hold any
@@ -664,67 +732,310 @@ class Session:
                 continue
             series: Dict[bytes, dict] = {}
             token = 0
-            while token is not None:
-                try:
+            try:
+                while token is not None:
                     r = self._client(h).call(
-                        "fetch_blocks_metadata", ns=ns, shard=shard,
-                        start_ns=start_ns, end_ns=end_ns, page_token=token)
-                except Exception:  # noqa: BLE001 — peer down: skip
-                    series = None
-                    break
-                for s in r["series"]:
-                    series[s["id"]] = {"tags": s["tags"], "blocks": s["blocks"]}
-                token = r["next_page_token"]
-            if series is not None:
-                out[h.id] = series
+                        "fetch_blocks_metadata", _deadline=deadline, ns=ns,
+                        shard=shard, start_ns=start_ns, end_ns=end_ns,
+                        page_token=token)
+                    for s in r["series"]:
+                        series[s["id"]] = {"tags": s["tags"],
+                                           "blocks": s["blocks"]}
+                    token = r["next_page_token"]
+            except PEER_SKIP_ERRORS + (RemoteError,) as e:
+                _PEER_METRICS.counter("metadata_peer_errors").inc()
+                if errors is not None:
+                    errors[h.id] = f"{type(e).__name__}: {e}"
+                continue
+            out[h.id] = series
         return out
 
-    def fetch_bootstrap_blocks_from_peers(self, ns: bytes, shard: int, start_ns: int,
-                                          end_ns: int, exclude_host: Optional[str] = None
-                                          ) -> Dict[bytes, dict]:
-        """Peer bootstrap streaming (session FetchBootstrapBlocksFromPeers):
-        diff peer metadata, pick the best peer per block by checksum
-        agreement (majority checksum first, else any), stream the blocks.
+    def fetch_block_metadata_tiles_from_peers(
+            self, ns: bytes, shard: int, start_ns: int, end_ns: int,
+            exclude_host: Optional[str] = None,
+            deadline: Optional[Deadline] = None,
+            errors: Optional[Dict[str, str]] = None) -> Dict[str, dict]:
+        """Columnar peer metadata streaming: per responding host,
+        {"ids": [...], "tags": [...], "blocks": [{"bs", "pos", "sums"}]}
+        with pages concatenated (block `pos` re-based onto the combined
+        ids list). Same typed skip/count semantics as the per-series
+        form."""
+        m = self._map()
+        out: Dict[str, dict] = {}
+        for h in m.route_shard_readable(shard):
+            if h.id == exclude_host:
+                continue
+            ids: List[bytes] = []
+            tags: List[dict] = []
+            blocks: List[dict] = []
+            token = 0
+            try:
+                while token is not None:
+                    r = self._client(h).call(
+                        "fetch_block_metadata_tiles", _deadline=deadline,
+                        ns=ns, shard=shard, start_ns=start_ns,
+                        end_ns=end_ns, page_token=token)
+                    offset = len(ids)
+                    ids.extend(r["ids"])
+                    tags.extend(r["tags"])
+                    for b in r["blocks"]:
+                        pos = np.asarray(b["pos"], np.int64)
+                        blocks.append({"bs": int(b["bs"]),
+                                       "pos": pos + offset,
+                                       "sums": np.asarray(b["sums"],
+                                                          np.int64)})
+                    token = r["next_page_token"]
+            except PEER_SKIP_ERRORS + (RemoteError,) as e:
+                _PEER_METRICS.counter("metadata_peer_errors").inc()
+                if errors is not None:
+                    errors[h.id] = f"{type(e).__name__}: {e}"
+                continue
+            out[h.id] = {"ids": ids, "tags": tags, "blocks": blocks}
+        return out
 
-        Returns {series_id: {"tags": .., "blocks": [wire block dicts]}}."""
-        meta = self.fetch_blocks_metadata_from_peers(ns, shard, start_ns, end_ns,
-                                                     exclude_host)
-        # (series, block_start) -> {checksum -> [host_ids]}
-        wanted: Dict[bytes, dict] = {}
-        plan: Dict[str, Dict[bytes, List[int]]] = {}
-        for sid in {s for hs in meta.values() for s in hs}:
-            per_block: Dict[int, Counter] = {}
-            tags = {}
-            for host_id, hseries in meta.items():
-                e = hseries.get(sid)
-                if e is None:
-                    continue
-                tags = tags or e["tags"]
-                for b in e["blocks"]:
-                    per_block.setdefault(b["bs"], Counter())[(b["checksum"], host_id)] = 1
-            wanted[sid] = {"tags": tags, "blocks": []}
-            for bs, ck in per_block.items():
-                by_sum = Counter()
-                hosts_by_sum: Dict[int, List[str]] = {}
-                for (checksum, host_id), _n in ck.items():
-                    by_sum[checksum] += 1
-                    hosts_by_sum.setdefault(checksum, []).append(host_id)
-                best_sum, _cnt = by_sum.most_common(1)[0]
-                host_id = hosts_by_sum[best_sum][0]
-                plan.setdefault(host_id, {}).setdefault(sid, []).append(bs)
+    @staticmethod
+    def plan_block_majority(meta: Dict[str, dict]):
+        """Vectorized checksum-majority planning over COLUMNAR peer
+        metadata: group every (series, block, checksum) observation,
+        vote per (series, block), and return, per block start, the
+        winning checksum + the lowest-ranked host actually holding it
+        for every series — plus the per-checksum ranked host lists a
+        consumer needs to build failover chains.
+
+        Returns (tags_by_sid, sids, hosts_list, per_bs) where per_bs maps
+        block_start -> {"gids": int64[], "sums": int64[] (majority
+        checksum per gid), "primary": int64[] (host rank holding it),
+        "by_sum": {checksum: [host_id ranked]}} and `sids[g]` resolves a
+        gid back to its series id."""
+        tags_by_sid: Dict[bytes, dict] = {}
+        gmap: Dict[bytes, int] = {}
+        sids: List[bytes] = []
+        hosts_list = list(meta)
+        per_bs_rows: Dict[int, List[Tuple[int, np.ndarray, np.ndarray]]] = {}
+        for rank, (host_id, m) in enumerate(meta.items()):
+            ids = m["ids"]
+            for sid, tg in zip(ids, m["tags"]):
+                if tg and not tags_by_sid.get(sid):
+                    tags_by_sid[sid] = tg
+            garr = np.empty(len(ids), np.int64)
+            for j, sid in enumerate(ids):
+                g = gmap.get(sid)
+                if g is None:
+                    g = gmap[sid] = len(sids)
+                    sids.append(sid)
+                garr[j] = g
+            for b in m["blocks"]:
+                per_bs_rows.setdefault(int(b["bs"]), []).append(
+                    (rank, garr[np.asarray(b["pos"], np.int64)],
+                     np.asarray(b["sums"], np.int64)))
+        per_bs: Dict[int, dict] = {}
+        for bs, entries in per_bs_rows.items():
+            g_all = np.concatenate([g for _r, g, _s in entries])
+            c_all = np.concatenate([s for _r, _g, s in entries])
+            r_all = np.concatenate([np.full(len(g), r, np.int64)
+                                    for r, g, _s in entries])
+            order = np.lexsort((r_all, c_all, g_all))
+            g, c, r = g_all[order], c_all[order], r_all[order]
+            # (gid, checksum) runs: count = votes, first host = the
+            # lowest-ranked holder of that copy.
+            new = np.empty(len(g), bool)
+            new[0] = True
+            np.logical_or(g[1:] != g[:-1], c[1:] != c[:-1], out=new[1:])
+            starts = np.flatnonzero(new)
+            run_g = g[starts]
+            run_c = c[starts]
+            run_r0 = r[starts]
+            run_n = np.diff(np.append(starts, len(g)))
+            # Winner per gid: the run sorting LAST under (gid, count,
+            # checksum) — max votes, deterministic checksum tie-break.
+            sel = np.lexsort((run_c, run_n, run_g))
+            gs = run_g[sel]
+            last = np.empty(len(sel), bool)
+            if len(sel):
+                np.not_equal(gs[1:], gs[:-1], out=last[:-1])
+                last[-1] = True
+            win = sel[last]
+            # Ranked host list per checksum (any row with that checksum
+            # in this block) for failover chains.
+            pairs = np.unique(np.stack([c_all, r_all], 1), axis=0)
+            by_sum: Dict[int, List[str]] = {}
+            for cc, rr in pairs:
+                by_sum.setdefault(int(cc), []).append(hosts_list[int(rr)])
+            per_bs[bs] = {"gids": run_g[win], "sums": run_c[win],
+                          "primary": run_r0[win], "by_sum": by_sum,
+                          # EVERY (gid, checksum) observation (not just
+                          # the winner): repair merges all distinct peer
+                          # copies so divergence converges in one sweep
+                          # per node instead of pairwise over many.
+                          "run_g": run_g, "run_c": run_c,
+                          "run_r0": run_r0}
+        return tags_by_sid, sids, hosts_list, per_bs
+
+    @staticmethod
+    def holder_chain_builder(p: dict, hosts_list: List[str],
+                             cross_checksum_tail: bool):
+        """Memoized failover-chain factory over one plan_block_majority
+        block entry: chain(checksum, first_holder_rank) -> ranked host
+        list ([first holder] + every other host with the SAME checksum,
+        then — when `cross_checksum_tail` — every remaining holder of
+        any copy: any copy beats no copy once the whole same-sum set is
+        dead). Chains are SHARED per (checksum, rank) combo and the
+        cross-checksum tail is built once per block: per-series list
+        construction is quadratic when checksums are per-row distinct.
+        The single definition both the bootstrap and repair planners
+        rank holders with."""
+        by_sum = p["by_sum"]
+        all_hosts = [h for h in hosts_list
+                     if any(h in hl for hl in by_sum.values())] \
+            if cross_checksum_tail and len(by_sum) > 1 else []
+        combos: Dict[Tuple[int, int], List[str]] = {}
+
+        def chain(cc: int, rr: int) -> List[str]:
+            key = (cc, rr)
+            lst = combos.get(key)
+            if lst is None:
+                first = hosts_list[rr]
+                lst = [first] + [h for h in by_sum[cc] if h != first]
+                if all_hosts:
+                    lst += [h for h in all_hosts if h not in lst]
+                combos[key] = lst
+            return lst
+
+        return chain
+
+    def fetch_block_tiles(self, ns: bytes, shard: int,
+                     holders: Dict[Tuple[bytes, int], List[str]],
+                     deadline: Optional[Deadline] = None,
+                     errors: Optional[Dict[str, str]] = None):
+        """Stream columnar block tiles for a holder plan, one wave per
+        holder rank: rank-0 requests batch per host; anything a host
+        failed to serve (typed transport error, shed, or a row that
+        vanished server-side) re-plans onto each key's next holder. Only
+        keys every holder failed come back in `failed`."""
         m = self._map()
         hosts = {h.id: h for h in m.hosts.values()}
-        for host_id, reqs in plan.items():
-            r = self._client(hosts[host_id]).call(
-                "fetch_blocks", ns=ns, shard=shard,
-                requests=[{"id": sid, "block_starts": bss} for sid, bss in reqs.items()])
-            for s in r["series"]:
-                wanted[s["id"]]["blocks"].extend(s["blocks"])
-        return {sid: e for sid, e in wanted.items() if e["blocks"]}
+        tiles: Dict[int, List[dict]] = {}
+        remaining = dict.fromkeys(holders)
+        max_rank = max((len(v) for v in holders.values()), default=0)
+        for rank in range(max_rank):
+            if not remaining:
+                break
+            wave: Dict[str, Dict[int, List[bytes]]] = {}
+            for (sid, bs) in remaining:
+                hlist = holders[(sid, bs)]
+                if rank < len(hlist) and hlist[rank] in hosts:
+                    wave.setdefault(hlist[rank], {}).setdefault(
+                        bs, []).append(sid)
+            for host_id, by_bs in wave.items():
+                reqs = [{"bs": bs, "ids": sids} for bs, sids in by_bs.items()]
+                try:
+                    r = self._client(hosts[host_id]).call(
+                        "fetch_block_tiles", _deadline=deadline, ns=ns,
+                        shard=shard, blocks=reqs)
+                except PEER_SKIP_ERRORS + (RemoteError,) as e:
+                    # This host's whole wave re-plans onto the next
+                    # holders (keys stay in `remaining`).
+                    _PEER_METRICS.counter("block_fetch_peer_errors").inc()
+                    if errors is not None:
+                        errors[host_id] = f"{type(e).__name__}: {e}"
+                    continue
+                for tile in r["blocks"]:
+                    ids = tile["ids"]
+                    if not len(ids):
+                        continue
+                    tiles.setdefault(int(tile["bs"]), []).append(tile)
+                    for sid in ids:
+                        remaining.pop((sid, int(tile["bs"])), None)
+        failed = sorted(remaining)
+        if failed:
+            _PEER_METRICS.counter("blocks_unfetchable").inc(len(failed))
+        return tiles, failed
+
+    def fetch_block_tiles_from_peers(self, ns: bytes, shard: int, start_ns: int,
+                                     end_ns: int,
+                                     exclude_host: Optional[str] = None,
+                                     deadline: Optional[Deadline] = None,
+                                     errors: Optional[Dict[str, str]] = None,
+                                     meta_errors: Optional[Dict[str, str]]
+                                     = None):
+        """Columnar peer bootstrap streaming: diff peer metadata, plan by
+        checksum majority, stream whole-block tiles ([rows, max_words]
+        word matrices + per-row nbits/npoints columns — one ndarray per
+        (host, block) instead of one dict per series).
+
+        Returns (tiles, tags_by_sid, failed):
+          tiles        {block_start: [tile dict]}
+          tags_by_sid  {series_id: tags} from the metadata phase
+          failed       [(series_id, block_start)] every holder failed —
+                       the partial-coverage surface bootstrap subtracts
+                       from its claim.
+
+        `meta_errors` collects METADATA-phase peer failures separately
+        from block-fetch failures (`errors`): a peer skipped during
+        metadata may have held blocks nobody else has, so its loss means
+        the plan itself — not just some fetches — is incomplete, and
+        callers claiming coverage must treat it as such."""
+        meta = self.fetch_block_metadata_tiles_from_peers(
+            ns, shard, start_ns, end_ns, exclude_host, deadline,
+            meta_errors if meta_errors is not None else errors)
+        tags_by_sid, sids, hosts_list, per_bs = self.plan_block_majority(meta)
+        holders: Dict[Tuple[bytes, int], List[str]] = {}
+        for bs, p in per_bs.items():
+            chain = self.holder_chain_builder(p, hosts_list,
+                                              cross_checksum_tail=True)
+            for gi, cc, rr in zip(p["gids"].tolist(), p["sums"].tolist(),
+                                  p["primary"].tolist()):
+                holders[(sids[gi], bs)] = chain(cc, rr)
+        tiles, failed = self.fetch_block_tiles(ns, shard, holders, deadline,
+                                               errors)
+        return tiles, tags_by_sid, failed
+
+    def fetch_bootstrap_blocks_from_peers(self, ns: bytes, shard: int, start_ns: int,
+                                          end_ns: int, exclude_host: Optional[str] = None,
+                                          deadline: Optional[Deadline] = None
+                                          ) -> Dict[bytes, dict]:
+        """Per-series view of peer bootstrap streaming (the session
+        FetchBootstrapBlocksFromPeers shape, kept for callers that want
+        row dicts): same tile fetch + holder fallback underneath.
+
+        Returns {series_id: {"tags": .., "blocks": [wire block dicts]}}."""
+        tiles, tags_by_sid, _failed = self.fetch_block_tiles_from_peers(
+            ns, shard, start_ns, end_ns, exclude_host, deadline)
+        out: Dict[bytes, dict] = {}
+        for bs, tlist in sorted(tiles.items()):
+            for tile in tlist:
+                words = np.asarray(tile["words"])
+                nbits = np.asarray(tile["nbits"])
+                npoints = np.asarray(tile["npoints"])
+                for i, sid in enumerate(tile["ids"]):
+                    e = out.setdefault(
+                        sid, {"tags": tags_by_sid.get(sid) or {}, "blocks": []})
+                    e["blocks"].append({
+                        "bs": bs, "words": words[i], "nbits": int(nbits[i]),
+                        "npoints": int(npoints[i]),
+                        "window": int(tile["window"]),
+                        "time_unit": int(tile["time_unit"]),
+                    })
+        return out
+
+    def fetch_block_tiles_from_host(self, host_id: str, ns: bytes, shard: int,
+                                    blocks: List[dict],
+                                    deadline: Optional[Deadline] = None) -> dict:
+        """Columnar tiles from one specific replica (repair streams from
+        the host holding the majority checksum); blocks =
+        [{"bs": block_start, "ids": [series_id]}]."""
+        m = self._map()
+        host = m.hosts.get(host_id)
+        if host is None:
+            raise ConnectionError(f"unknown host {host_id}")
+        return self._client(host).call("fetch_block_tiles", _deadline=deadline,
+                                       ns=ns, shard=shard, blocks=blocks)
 
     def fetch_blocks_from_host(self, host_id: str, ns: bytes, shard: int,
                                requests: List[dict]) -> dict:
-        """Raw encoded blocks from one specific replica (repair path)."""
+        """Raw encoded blocks from one specific replica (per-series
+        request shape; the batched repair path uses
+        fetch_block_tiles_from_host)."""
         m = self._map()
         host = m.hosts.get(host_id)
         if host is None:

@@ -43,7 +43,8 @@ class RPCError(Exception):
 # (backfill), which sheds first at the high watermark.
 _CRITICAL_METHODS = frozenset({
     "health", "namespaces", "truncate",
-    "fetch_blocks", "fetch_blocks_metadata",
+    "fetch_blocks", "fetch_blocks_metadata", "fetch_block_tiles",
+    "fetch_block_metadata_tiles",
 })
 
 
@@ -96,18 +97,29 @@ class NodeService:
         # storage/index charge below this request to the global budgets
         # and release them all on the way out — 1k rejected queries leak
         # zero budget (asserted by scripts/overload_smoke.py).
-        with self.gate.held(priority=method_priority(method, priority_hint)):
+        priority = method_priority(method, priority_hint)
+        with self.gate.held(priority=priority):
             with ql.scope(f"rpc.{method}"):
                 self._local.deadline = deadline
+                # Down-stack admission (shard insert queues) sheds by the
+                # same priority the gate admitted at — BULK backfill that
+                # squeezed past the gate still sheds first at a full
+                # queue, and CRITICAL replication never sheds.
+                self._local.priority = priority
                 try:
                     return fn(**args)
                 finally:
                     self._local.deadline = None
+                    self._local.priority = None
 
     def _check_deadline(self, what: str):
         dl = getattr(self._local, "deadline", None)
         if dl is not None:
             dl.check(what)
+
+    def _request_priority(self) -> Priority:
+        pri = getattr(self._local, "priority", None)
+        return Priority.NORMAL if pri is None else pri
 
     # ----------------------------------------------------------------- health
 
@@ -127,12 +139,14 @@ class NodeService:
         shard.go:769 per-shard RWMutex), the reverse index and commit log
         serialize internally, so writes to different shards proceed in
         parallel across server threads."""
-        self.db.write(ns, id, t_ns, value, tags)
+        self.db.write(ns, id, t_ns, value, tags,
+                      priority=self._request_priority())
         return True
 
     def rpc_write_batch(self, ns: bytes, ids: list, ts: np.ndarray, vals: np.ndarray,
                         tags: Optional[list] = None):
-        self.db.write_batch(ns, ids, ts, vals, tags)
+        self.db.write_batch(ns, ids, ts, vals, tags,
+                            priority=self._request_priority())
         return len(ids)
 
     # ------------------------------------------------------------------ reads
@@ -297,6 +311,102 @@ class NodeService:
                         })
             out.append(entry)
         return {"series": out}
+
+    def rpc_fetch_block_metadata_tiles(self, ns: bytes, shard: int,
+                                       start_ns: int, end_ns: int,
+                                       page_token: int = 0,
+                                       limit: int = 8192):
+        """Columnar FetchBlocksMetadataRawV2: one page covers a
+        contiguous registry-index window [page_token, page_token+limit)
+        and returns the page's ids/tags plus, per sealed block, the
+        positions (into the page's ids) and row checksums as ARRAYS —
+        no per-series dicts on the wire. Registry indices are assigned
+        densely in insertion order and block series_indices are sorted,
+        so each block's page rows are one searchsorted slice."""
+        nsobj = self.db.namespace(ns)
+        sh = nsobj.shards.get(shard)
+        if sh is None:
+            return {"ids": [], "tags": [], "blocks": [],
+                    "next_page_token": None}
+        all_ids = sh.registry.all_ids()
+        i0 = int(page_token)
+        i1 = min(len(all_ids), i0 + int(limit))
+        ids = all_ids[i0:i1]
+        tags = [sh.registry.tags_of(i0 + j) or {} for j in range(len(ids))]
+        with sh.write_lock:  # snapshot racing tick's expiry/seal
+            shard_blocks = dict(sh.blocks)
+        blocks = []
+        total_bytes = sum(len(s) for s in ids)
+        for bs in sorted(shard_blocks):
+            self._check_deadline("fetch_block_metadata_tiles")
+            blk = shard_blocks[bs]
+            if bs + sh.opts.block_size_ns <= start_ns or bs >= end_ns:
+                continue
+            si = blk.series_indices
+            lo = int(np.searchsorted(si, i0))
+            hi = int(np.searchsorted(si, i1))
+            if lo == hi:
+                continue
+            # Memoized per-block row checksums: repeated metadata pages
+            # (every repair sweep, every bootstrap) reuse one pass.
+            sums = blk.row_checksums()[lo:hi]
+            total_bytes += sums.nbytes
+            blocks.append({
+                "bs": bs,
+                "pos": np.ascontiguousarray(si[lo:hi] - i0, np.int32),
+                "sums": sums,
+            })
+        charge_read(n_bytes=int(total_bytes))
+        next_token = i1 if i1 < len(all_ids) else None
+        return {"ids": ids, "tags": tags, "blocks": blocks,
+                "next_page_token": next_token}
+
+    def rpc_fetch_block_tiles(self, ns: bytes, shard: int, blocks: list):
+        """Columnar FetchBlocksRaw: for [{"bs", "ids": [...]}] requests,
+        return per-block TILES — one [rows, max_words] word matrix plus
+        nbits/npoints columns and the row-aligned id list — instead of
+        one dict per series. The whole tile is three fancy-indexes into
+        the sealed block's arrays, and the client applies it as one
+        batched registry insert + one block install (the peer-streaming
+        data plane's unit of work; ids absent locally or rows the block
+        doesn't hold are simply absent from the response ids)."""
+        nsobj = self.db.namespace(ns)
+        sh = nsobj.shards.get(shard)
+        out = []
+        if sh is None:
+            return {"blocks": out}
+        with sh.write_lock:  # snapshot racing tick's expiry/seal
+            shard_blocks = dict(sh.blocks)
+        for req in blocks:
+            self._check_deadline("fetch_block_tiles")
+            bs = int(req["bs"])
+            blk = shard_blocks.get(bs)
+            if blk is None:
+                continue
+            ids = req["ids"]
+            idxs = sh.registry.lookup_batch(ids)
+            known = idxs >= 0
+            # Row resolve for every known id in one vectorized search
+            # (series_indices is sorted).
+            cand = np.searchsorted(blk.series_indices, idxs[known])
+            cand = np.minimum(cand, len(blk.series_indices) - 1)
+            present = blk.series_indices[cand] == idxs[known]
+            rows = cand[present]
+            if not len(rows):
+                continue
+            kpos = np.flatnonzero(known)[present]
+            words = np.ascontiguousarray(blk.words[rows])
+            charge_read(n_bytes=int(words.nbytes))
+            out.append({
+                "bs": bs,
+                "ids": [ids[int(i)] for i in kpos],
+                "words": words,
+                "nbits": np.ascontiguousarray(blk.nbits[rows]),
+                "npoints": np.ascontiguousarray(blk.npoints[rows]),
+                "window": int(blk.window),
+                "time_unit": int(blk.time_unit),
+            })
+        return {"blocks": out}
 
     # ------------------------------------------------------------------ admin
 

@@ -75,10 +75,27 @@ class SealedBlock:
     def num_series(self) -> int:
         return len(self.series_indices)
 
+    def row_checksums(self) -> np.ndarray:
+        """adler32 of every series' packed stream, int64 [S] — the ONE
+        definition of the per-row checksum convention that repair local
+        compare, the peer metadata tiles RPC, and `row_checksum` all
+        share (divergent re-implementations would silently report
+        permanent replica divergence). Memoized: blocks are immutable
+        once published, and repair sweeps + metadata pages re-read it
+        every cycle."""
+        sums = getattr(self, "_row_sums", None)
+        if sums is None:
+            w = np.ascontiguousarray(self.words)
+            sums = np.fromiter((zlib.adler32(r.tobytes()) for r in w),
+                               np.int64, count=len(w))
+            sums.setflags(write=False)
+            self._row_sums = sums
+        return sums
+
     def row_checksum(self, row: int) -> int:
         """adler32 of one series' packed stream (the unit of repair/peer
         metadata comparison, persist/fs write.go per-entry checksum)."""
-        return zlib.adler32(np.ascontiguousarray(self.words[row]).tobytes())
+        return int(self.row_checksums()[row])
 
     def row_of(self, series_idx: int) -> Optional[int]:
         i = int(np.searchsorted(self.series_indices, series_idx))

@@ -3,6 +3,13 @@ src/dbnode/integration/setup.go newTestSetup + fake cluster services)."""
 
 from .cluster import ClusterHarness, ClusterNode, make_node_server
 from .faultnet import FaultPlan, FaultProxy
+from .scenario import (
+    ChurnScenario,
+    ChurnScenarioOptions,
+    ScenarioResult,
+    WriteLedger,
+)
 
 __all__ = ["ClusterHarness", "ClusterNode", "FaultPlan", "FaultProxy",
-           "make_node_server"]
+           "make_node_server", "ChurnScenario", "ChurnScenarioOptions",
+           "ScenarioResult", "WriteLedger"]

@@ -22,6 +22,7 @@ from ..persist.fs import PersistManager
 from ..rpc import NodeServer, NodeService
 from ..storage.database import Database
 from ..storage.namespace import NamespaceOptions
+from .faultnet import FaultPlan, FaultProxy
 
 
 class SettableClock:
@@ -48,19 +49,27 @@ def make_node_server(num_shards: int = 2, port: int = 0) -> NodeServer:
 
 class ClusterNode:
     def __init__(self, host_id: str, db: Database, server: NodeServer,
-                 persist: PersistManager, data_dir: str):
+                 persist: PersistManager, data_dir: str,
+                 proxy: Optional[FaultProxy] = None):
         self.host_id = host_id
         self.db = db
         self.server = server
         self.persist = persist
         self.data_dir = data_dir
+        # Optional faultnet proxy fronting this node: the placement
+        # advertises the PROXY endpoint, so every client/session/peer
+        # stream crosses the chaos layer.
+        self.proxy = proxy
 
     @property
     def endpoint(self) -> str:
-        return self.server.endpoint
+        return self.proxy.endpoint if self.proxy is not None \
+            else self.server.endpoint
 
     def stop(self):
         self.server.close()
+        if self.proxy is not None:
+            self.proxy.close()
 
 
 class ClusterHarness:
@@ -72,7 +81,8 @@ class ClusterHarness:
                  namespaces: List[bytes] = (b"default",),
                  start_ns: int = 1_600_000_000_000_000_000,
                  data_root: Optional[str] = None,
-                 with_commitlog: bool = False):
+                 with_commitlog: bool = False,
+                 fault_plan: Optional[FaultPlan] = None):
         self.kv = MemStore()
         self.clock = SettableClock(start_ns)
         self.num_shards = num_shards
@@ -81,6 +91,11 @@ class ClusterHarness:
         self.nodes: Dict[str, ClusterNode] = {}
         self.data_root = data_root or tempfile.mkdtemp(prefix="m3tpu-cluster-")
         self.with_commitlog = with_commitlog
+        # Seeded chaos: when set, every node (including later add/replace
+        # joiners) is fronted by a faultnet proxy speaking this plan and
+        # the placement advertises the proxy endpoints. set_fault_plan()
+        # swaps plans live (e.g. to quiesce before convergence checks).
+        self.fault_plan = fault_plan
 
         # Start servers first so endpoints exist for the placement.
         self._pending: List[ClusterNode] = []
@@ -111,8 +126,11 @@ class ClusterHarness:
                 if self.ns_opts.index_enabled else None
             db.create_namespace(ns, self.ns_opts, index=index)
         server = NodeServer(NodeService(db)).start()
+        proxy = None
+        if self.fault_plan is not None:
+            proxy = FaultProxy(server.endpoint, self.fault_plan).start()
         return ClusterNode(host_id, db, server, PersistManager(os.path.join(data_dir, "data")),
-                           data_dir)
+                           data_dir, proxy=proxy)
 
     # ----------------------------------------------------------------- admin
 
@@ -127,9 +145,33 @@ class ClusterHarness:
         self.nodes[host_id].stop()
 
     def remove_node(self, host_id: str):
+        # Placement first: a replica-safety refusal (ValueError) must not
+        # leave a healthy node stopped.
+        self.placement_svc.remove_instance(host_id)
         self.stop_node(host_id)
         del self.nodes[host_id]
-        self.placement_svc.remove_instance(host_id)
+
+    def replace_node(self, host_id: str,
+                     new_id: Optional[str] = None) -> ClusterNode:
+        """replace_down_node shape: kill the victim, stand up a
+        replacement inheriting its shards (INITIALIZING until
+        peer-bootstrapped + marked available)."""
+        new_id = new_id or f"node{len(self.nodes)}r"
+        self.stop_node(host_id)
+        node = self._make_node(new_id)
+        self.placement_svc.replace_instance(
+            host_id, Instance(id=new_id, endpoint=node.endpoint))
+        del self.nodes[host_id]
+        self.nodes[new_id] = node
+        return node
+
+    def set_fault_plan(self, plan: FaultPlan):
+        """Swap the live fault schedule on every proxy (new frames pick
+        it up immediately); a benign FaultPlan() quiesces the chaos."""
+        self.fault_plan = plan
+        for n in self.nodes.values():
+            if n.proxy is not None:
+                n.proxy.plan = plan
 
     def tick_all(self):
         for n in self.nodes.values():
@@ -137,4 +179,4 @@ class ClusterHarness:
 
     def close(self):
         for n in self.nodes.values():
-            n.server.close()
+            n.stop()

@@ -1,0 +1,518 @@
+"""SLO-under-churn macro-scenario harness: the composition tier that
+runs every production ingredient AT ONCE and asserts hard SLOs.
+
+The reference's production story is surviving topology churn — peer
+bootstrap, repair, and placement changes running WHILE the node serves
+traffic (dbnode bootstrapper/peers, repair.go, and the dtest destructive
+scenarios). Each ingredient exists in-tree (testing/cluster.py,
+testing/loadgen.py, testing/faultnet.py, the xresil stack, admission
+gates); this module composes them:
+
+  an RF=3 cluster, every node fronted by a seeded faultnet proxy,
+  under seeded OPEN-LOOP load (mixed bulk/normal writes, reads, and
+  critical health/replication probes), while a seeded churn driver
+  runs placement operations CONCURRENTLY — add-node (peer-bootstrap +
+  cutover), remove-node (receivers bootstrap the leaver's shards),
+  replace-down-node, and jittered repair sweeps — then quiesces the
+  chaos and asserts:
+
+  * zero lost acked writes: every quorum-acked datapoint (recorded in
+    a WriteLedger at ack time) is readable after convergence;
+  * zero shed CRITICAL traffic: no Backpressure/ResourceExhausted
+    outcome on the critical kind, ever, at any load;
+  * bounded p99 latency for served reads/writes;
+  * bounded queue depths: RPC admission gates and shard insert queues
+    never exceed their configured bounds;
+  * clean convergence: every placement shard AVAILABLE, and every
+    sealed block's per-row checksums replica-consistent after the
+    final repair sweep.
+
+Determinism: the load schedule, the fault schedule, and the churn op
+sequence are all pure functions of `seed` (loadgen / faultnet /
+random.Random(seed)); wall-clock timing of course is not, which is why
+the assertions are SLO-shaped (bounds and zero-counts), not traces.
+
+Why writes that land during churn still converge: peer streaming is
+block-granular (sealed blocks move; mutable buffers do not), so a
+freshly bootstrapped owner can lack buffer-resident points until the
+final seal + repair sweep unions them back — the scenario's convergence
+phase is exactly that pipeline, and DIVERGENCES.md records the design
+choice.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..client.session import Session, SessionOptions
+from ..cluster.placement import ShardState
+from ..storage.bootstrap import BootstrapContext, BootstrapProcess
+from ..storage.repair import DatabaseRepairer, RepairOptions
+from ..utils import xtime
+from ..utils.retry import RetryOptions
+from .cluster import ClusterHarness
+from .faultnet import FaultPlan
+from .loadgen import LoadGen, LoadReport, LoadSchedule, Phase
+
+__all__ = ["ChurnScenarioOptions", "ChurnScenario", "ScenarioResult",
+           "WriteLedger"]
+
+# Outcome type names that mean "the server deliberately shed this"
+# (Backpressure subclasses ResourceExhausted and rides the wire as the
+# typed resource_exhausted frame).
+SHED_OUTCOMES = frozenset({"ResourceExhausted", "Backpressure"})
+
+
+class WriteLedger:
+    """Thread-safe record of every ACKED write: the ground truth the
+    post-scenario verification replays against quorum reads. Timestamps
+    are allocated from one atomic sequence (microsecond steps), so every
+    (series, timestamp) pair is unique and carries a unique value —
+    verification is exact, no last-wins ambiguity."""
+
+    def __init__(self, base_t_ns: int):
+        self.base_t_ns = base_t_ns
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._acked: Dict[bytes, List[Tuple[int, float]]] = {}
+
+    def next_write(self, sid: bytes) -> Tuple[int, float]:
+        """Allocate (t_ns, value) for an attempt on `sid` (not yet
+        acked)."""
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        return self.base_t_ns + seq * xtime.Unit.MICROSECOND.nanos, float(seq)
+
+    def ack(self, sid: bytes, t_ns: int, value: float):
+        with self._lock:
+            self._acked.setdefault(sid, []).append((t_ns, value))
+
+    def acked(self) -> Dict[bytes, List[Tuple[int, float]]]:
+        with self._lock:
+            return {sid: list(points) for sid, points in self._acked.items()}
+
+    def total_acked(self) -> int:
+        with self._lock:
+            return sum(len(p) for p in self._acked.values())
+
+
+@dataclasses.dataclass(frozen=True)
+class ChurnScenarioOptions:
+    seed: int = 7
+    n_nodes: int = 4              # RF + 1 so remove-node stays replica-safe
+    replica_factor: int = 3
+    num_shards: int = 16
+    n_series: int = 48            # write/read id pool
+    # Open-loop offered load (requests/sec) and phase plan.
+    base_rate: float = 60.0
+    duration_s: float = 4.0
+    time_scale: float = 1.0
+    # Relative kind weights: bulk writes shed first under pressure,
+    # critical is health + peer-metadata probes (never shed).
+    write_weight: float = 5.0
+    bulk_weight: float = 2.0
+    read_weight: float = 4.0
+    critical_weight: float = 2.0
+    # Seeded chaos plan applied to every node's proxy during the run.
+    fault_reset: float = 0.01
+    fault_truncate: float = 0.01
+    fault_delay: float = 0.03
+    fault_delay_s: float = 0.03
+    fault_duplicate: float = 0.01
+    # Churn ops executed concurrently with the load, in seeded order.
+    churn_ops: Tuple[str, ...] = ("add", "repair", "remove", "replace")
+    churn_spacing_s: float = 0.35
+    # SLO bounds asserted by verify().
+    p99_write_s: float = 2.0
+    p99_read_s: float = 2.0
+    min_ok_rate: float = 0.5      # at least half the offered load served
+    session_timeout_s: float = 5.0
+    # In-flight bound slack for CRITICAL traffic, which the gate admits
+    # past capacity by design (never shed): the asserted memory bound is
+    # gate capacity + this allowance.
+    gate_critical_allowance: int = 64
+    # Pre-compile the encode/decode shape buckets churn touches: XLA
+    # compiles are multi-second and serialize process-wide, so a mid-run
+    # first-compile would bill pure compilation into the serving p99 (a
+    # real deployment pre-warms its kernels / ships a warm compile
+    # cache the same way; churn_smoke.py additionally persists the JAX
+    # compilation cache across runs).
+    warm_kernels: bool = True
+
+
+@dataclasses.dataclass
+class ScenarioResult:
+    report: LoadReport
+    ledger: WriteLedger
+    churn_log: List[str]
+    max_gate_depth: int
+    gate_capacity: int
+    max_queue_pending: int
+    queue_capacity: int
+    repair_stats: List[dict]
+    verified_points: int = 0
+    checksum_blocks_checked: int = 0
+
+    def outcome_counts(self, kind: Optional[str] = None) -> Dict[str, int]:
+        return self.report.outcomes(kind=kind)
+
+
+class ChurnScenario:
+    """One seeded SLO-under-churn run over an in-process cluster."""
+
+    NS = b"default"
+
+    def __init__(self, opts: ChurnScenarioOptions = ChurnScenarioOptions()):
+        self.opts = opts
+        self.plan = FaultPlan(
+            seed=opts.seed,
+            reset=opts.fault_reset, truncate=opts.fault_truncate,
+            delay=opts.fault_delay, delay_s=opts.fault_delay_s,
+            duplicate=opts.fault_duplicate)
+        # Proxies are in place from the start (the placement advertises
+        # their endpoints) but stay benign through setup — the chaos
+        # plan arms when the SLO'd load window opens.
+        self.cluster = ClusterHarness(
+            n_nodes=opts.n_nodes, replica_factor=opts.replica_factor,
+            num_shards=opts.num_shards, fault_plan=FaultPlan())
+        self.ids = [b"churn-%04d" % i for i in range(opts.n_series)]
+        self.ledger = WriteLedger(self.cluster.clock.now_ns)
+        self.churn_log: List[str] = []
+        self._churn_errors: List[str] = []
+        self._rng = random.Random(f"churn-scenario/{opts.seed}")
+        self._op_counter = 0
+        self._stop = threading.Event()
+        self._max_queue_pending = 0
+        self._repair_stats: List[dict] = []
+        # Serving session rides the chaos proxies; retries kept tight so
+        # open-loop threads do not pile up behind long backoffs.
+        self.session = Session(
+            self.cluster.topology,
+            SessionOptions(timeout_s=opts.session_timeout_s,
+                           retry=RetryOptions(max_attempts=2,
+                                              initial_backoff_s=0.02),
+                           # Open-loop fanout must not queue client-side
+                           # behind chaos-slowed calls: size the pool for
+                           # offered concurrency (rate x timeout x RF).
+                           fanout_workers=128,
+                           pool_size=16))
+        # The churn driver gets its own session: bootstrap/repair streams
+        # must not contend with the serving pool's sockets.
+        self.admin_session = Session(
+            self.cluster.topology,
+            SessionOptions(timeout_s=max(10.0, opts.session_timeout_s)))
+
+    # ------------------------------------------------------------------ load
+
+    def _schedule(self) -> LoadSchedule:
+        o = self.opts
+        return LoadSchedule(
+            seed=o.seed, base_rate=o.base_rate,
+            phases=(Phase("churn", o.duration_s, 1.0),),
+            kinds=(("write", o.write_weight), ("write_bulk", o.bulk_weight),
+                   ("read", o.read_weight), ("critical", o.critical_weight)))
+
+    def _fire(self, kind: str):
+        rng = random.Random()  # content only; schedule is already seeded
+        sid = self.ids[rng.randrange(len(self.ids))]
+        if kind in ("write", "write_bulk"):
+            t_ns, value = self.ledger.next_write(sid)
+            self.session.write(
+                self.NS, sid, t_ns, value,
+                priority="bulk" if kind == "write_bulk" else None)
+            # Only reached on quorum ack — the ledger records EXACTLY the
+            # writes the cluster owes the verifier.
+            self.ledger.ack(sid, t_ns, value)
+        elif kind == "read":
+            self.session.fetch(self.NS, sid, 0,
+                               self.cluster.clock.now_ns + xtime.HOUR)
+        else:  # critical: health + replication-plane metadata probe
+            m = self.cluster.topology.get()
+            hosts = list(m.hosts.values())
+            h = hosts[rng.randrange(len(hosts))]
+            client = self.session._client(h)
+            if rng.random() < 0.5:
+                client.call("health")
+            else:
+                client.call("fetch_blocks_metadata", ns=self.NS,
+                            shard=rng.randrange(self.opts.num_shards),
+                            start_ns=0,
+                            end_ns=self.cluster.clock.now_ns + xtime.HOUR,
+                            page_token=0)
+
+    # ----------------------------------------------------------------- churn
+
+    def _bootstrap_initializing(self, host_id: str):
+        """Peer-bootstrap every INITIALIZING shard of one instance, then
+        cut it over (MarkShardAvailable semantics) — the add/remove/
+        replace data plane, through the chaos proxies."""
+        p = self.cluster.placement_svc.get()
+        inst = p.instances.get(host_id)
+        if inst is None:
+            return
+        init_shards = [a.shard for a in inst.shards.values()
+                       if a.state == ShardState.INITIALIZING]
+        if not init_shards:
+            return
+        node = self.cluster.nodes[host_id]
+        proc = BootstrapProcess(
+            chain=("peers", "uninitialized_topology"),
+            ctx=BootstrapContext(session=self.admin_session, host_id=host_id,
+                                 placement=p, peer_deadline_s=30.0))
+        proc.run(node.db, shard_ids=init_shards)
+        self.cluster.placement_svc.mark_instance_available(host_id)
+
+    def _run_repair(self, host_id: str):
+        node = self.cluster.nodes.get(host_id)
+        if node is None:
+            return
+        rep = DatabaseRepairer(
+            node.db, self.admin_session, host_id=host_id,
+            opts=RepairOptions(throttle_s=0.002, seed=self.opts.seed,
+                               deadline_s=30.0))
+        stats = rep.run()
+        for name, s in stats.items():
+            self._repair_stats.append(
+                {"host": host_id, "ns": name, **dataclasses.asdict(s)})
+
+    def _churn_op(self, op: str):
+        c = self.cluster
+        if op == "add":
+            self._op_counter += 1
+            node = c.add_node(f"joiner{self._op_counter}")
+            self.churn_log.append(f"add {node.host_id}")
+            self._bootstrap_initializing(node.host_id)
+        elif op == "remove":
+            # Only safe with > RF nodes; receivers of the leaver's shards
+            # peer-bootstrap them before cutover.
+            if len(c.nodes) <= self.opts.replica_factor:
+                self.churn_log.append("remove skipped (at RF)")
+                return
+            victim = self._rng.choice(sorted(c.nodes))
+            try:
+                c.remove_node(victim)
+            except ValueError as e:
+                # Replica-safety refusal (pending moves unsettled): a
+                # legitimate outcome under concurrent churn.
+                self.churn_log.append(f"remove {victim} refused: {e}")
+                return
+            self.churn_log.append(f"remove {victim}")
+            p = c.placement_svc.get()
+            for host_id, inst in sorted(p.instances.items()):
+                if any(a.state == ShardState.INITIALIZING
+                       for a in inst.shards.values()):
+                    self._bootstrap_initializing(host_id)
+        elif op == "replace":
+            victim = self._rng.choice(sorted(c.nodes))
+            node = c.replace_node(victim)
+            self.churn_log.append(f"replace {victim} -> {node.host_id}")
+            self._bootstrap_initializing(node.host_id)
+        elif op == "repair":
+            host_id = self._rng.choice(sorted(c.nodes))
+            self.churn_log.append(f"repair {host_id}")
+            self._run_repair(host_id)
+        else:
+            raise ValueError(f"unknown churn op {op!r}")
+
+    def _churn_loop(self):
+        for op in self.opts.churn_ops:
+            if self._stop.is_set():
+                return
+            try:
+                self._churn_op(op)
+            except Exception as e:  # noqa: BLE001 — surfaced by verify()
+                self._churn_errors.append(f"{op}: {type(e).__name__}: {e}")
+            self._sample_queues()
+            if self._stop.wait(self.opts.churn_spacing_s):
+                return
+
+    def _sample_queues(self):
+        pending = 0
+        for node in list(self.cluster.nodes.values()):
+            for ns in node.db.namespaces.values():
+                for sh in ns.shards.values():
+                    pending = max(pending, sh.insert_queue.pending())
+        self._max_queue_pending = max(self._max_queue_pending, pending)
+
+    # ------------------------------------------------------------------- run
+
+    def _warm_kernels(self):
+        """Compile the encode/decode buckets the churn ops will hit
+        (pow2 row buckets at the seed window geometry) BEFORE the SLO'd
+        window opens. Repair rebuilds and bootstrap mixed-unit merges
+        encode fresh tiles mid-run; without warming, their first-compile
+        (seconds, serialized process-wide by XLA) queues every
+        concurrent read behind it and the measured p99 is compile time,
+        not serving time."""
+        from ..storage.block import encode_block
+
+        max_rows = max(16, 1 << (max(1, (2 * self.opts.n_series)
+                                     // self.opts.num_shards) - 1).bit_length())
+        bs = self.cluster.clock.now_ns - 4 * xtime.HOUR
+        rows = 1
+        while rows <= max_rows:
+            ts = np.tile(
+                bs + np.arange(4, dtype=np.int64) * xtime.SECOND, (rows, 1))
+            vs = np.ones((rows, 4), np.float64)
+            blk = encode_block(bs, np.arange(rows, dtype=np.int32), ts, vs,
+                               np.full(rows, 4, np.int32))
+            blk.read_all()
+            blk.read(0)
+            rows *= 2
+
+    def _seed_and_seal(self):
+        """Pre-churn seed: every pool series gets sealed-block history so
+        peer bootstrap has blocks to stream from the first churn op."""
+        now = self.cluster.clock.now_ns
+        ts = [now - (i + 1) * xtime.SECOND for i in range(4)]
+        for j, sid in enumerate(self.ids):
+            self.session.write_batch(
+                self.NS, [sid] * len(ts), ts,
+                np.arange(len(ts), dtype=np.float64) + 1000.0 * j)
+        self.cluster.clock.advance(2 * xtime.HOUR + 11 * xtime.MINUTE)
+        self.cluster.tick_all()
+        # Ledger timestamps start AFTER the seal: the mutable-buffer
+        # acceptance window follows the (static-during-load) clock.
+        self.ledger.base_t_ns = self.cluster.clock.now_ns
+
+    def run(self) -> ScenarioResult:
+        o = self.opts
+        if o.warm_kernels:
+            self._warm_kernels()
+        self._seed_and_seal()
+        self.cluster.set_fault_plan(self.plan)  # chaos on: SLO window opens
+        churn = threading.Thread(target=self._churn_loop, name="churn-driver",
+                                 daemon=True)
+        churn.start()
+        gen = LoadGen(self._schedule(), time_scale=o.time_scale)
+        report = gen.run(self._fire, join_timeout_s=max(30.0, 10 * o.duration_s))
+        # The op list is finite: let churn complete even when the load
+        # window closed first (convergence is verified after BOTH end;
+        # _stop stays an abort/close signal only).
+        churn.join(timeout=120)
+
+        # ---------------- convergence: quiesce -> seal -> repair -> verify
+        self.cluster.set_fault_plan(FaultPlan())  # benign: chaos off
+        self.cluster.clock.advance(4 * xtime.HOUR + 11 * xtime.MINUTE)
+        self.cluster.tick_all()
+        for host_id in sorted(self.cluster.nodes):
+            self._run_repair(host_id)
+
+        gate_depth = 0
+        gate_cap = 0
+        for node in self.cluster.nodes.values():
+            g = node.server.service.gate
+            gate_depth = max(gate_depth, g.max_depth())
+            gate_cap = max(gate_cap, g.capacity)
+        queue_cap = self.cluster.ns_opts.insert_max_pending
+        return ScenarioResult(
+            report=report, ledger=self.ledger, churn_log=self.churn_log,
+            max_gate_depth=gate_depth, gate_capacity=gate_cap,
+            max_queue_pending=self._max_queue_pending,
+            queue_capacity=queue_cap, repair_stats=self._repair_stats)
+
+    # ---------------------------------------------------------------- verify
+
+    def verify(self, result: ScenarioResult) -> ScenarioResult:
+        """Assert every SLO; raises AssertionError naming the violated
+        guarantee. Returns the result with verification counters filled."""
+        o = self.opts
+        rep = result.report
+
+        assert not self._churn_errors, \
+            f"churn driver errors: {self._churn_errors}"
+
+        # 1. zero shed CRITICAL traffic.
+        crit = rep.outcomes(kind="critical")
+        shed = {k: n for k, n in crit.items() if k in SHED_OUTCOMES}
+        assert not shed, f"CRITICAL traffic shed under churn: {shed}"
+
+        # 2. bounded p99 for served traffic + a served-rate floor.
+        p99_w = rep.quantile_latency(0.99, kind="write")
+        p99_r = rep.quantile_latency(0.99, kind="read")
+        assert p99_w <= o.p99_write_s, \
+            f"write p99 {p99_w:.3f}s > bound {o.p99_write_s}s"
+        assert p99_r <= o.p99_read_s, \
+            f"read p99 {p99_r:.3f}s > bound {o.p99_read_s}s"
+        total = len(rep.records)
+        ok = len(rep.select(outcome="ok"))
+        assert total > 0 and ok / total >= o.min_ok_rate, \
+            f"served {ok}/{total} below floor {o.min_ok_rate}"
+
+        # 3. bounded in-flight work and queue depths. The gate enforces
+        # capacity for NORMAL/BULK but admits CRITICAL unconditionally
+        # (by design — shedding replication converts overload into
+        # under-replication), so the memory bound is capacity plus a
+        # critical-overshoot allowance, the same contract
+        # overload_smoke asserts.
+        bound = result.gate_capacity + o.gate_critical_allowance
+        assert result.max_gate_depth <= bound, \
+            (f"RPC gate depth {result.max_gate_depth} exceeded capacity "
+             f"{result.gate_capacity} + critical allowance "
+             f"{o.gate_critical_allowance}")
+        assert result.max_queue_pending <= result.queue_capacity, \
+            (f"insert queue pending {result.max_queue_pending} exceeded "
+             f"bound {result.queue_capacity}")
+
+        # 4. clean placement convergence: every shard AVAILABLE.
+        p = self.cluster.placement_svc.get()
+        p.validate()
+        unsettled = [
+            (iid, a.shard, a.state.value)
+            for iid, inst in p.instances.items()
+            for a in inst.shards.values() if a.state != ShardState.AVAILABLE]
+        assert not unsettled, f"placement not converged: {unsettled}"
+
+        # 5. zero lost acked writes: every quorum-acked point readable.
+        verified = 0
+        now = self.cluster.clock.now_ns
+        for sid, points in sorted(result.ledger.acked().items()):
+            t, v = self.session.fetch(self.NS, sid, 0, now + 1)
+            got = dict(zip(t.tolist(), v.tolist()))
+            for t_ns, value in points:
+                assert got.get(t_ns) == value, \
+                    (f"ACKED write lost: {sid!r} t={t_ns} v={value} "
+                     f"(fetched {len(got)} points)")
+                verified += 1
+        result.verified_points = verified
+
+        # 6. replica-consistent convergence: per-row checksums agree
+        # across every readable owner of every shard.
+        result.checksum_blocks_checked = self._verify_checksums()
+        return result
+
+    def _verify_checksums(self) -> int:
+        checked = 0
+        for shard in range(self.opts.num_shards):
+            meta = self.admin_session.fetch_blocks_metadata_from_peers(
+                self.NS, shard, 0, self.cluster.clock.now_ns)
+            # {(sid, bs): {host: checksum}}
+            sums: Dict[Tuple[bytes, int], Dict[str, int]] = {}
+            for host_id, series in meta.items():
+                for sid, entry in series.items():
+                    for b in entry["blocks"]:
+                        sums.setdefault((sid, b["bs"]), {})[host_id] = \
+                            b["checksum"]
+            for (sid, bs), by_host in sums.items():
+                assert len(by_host) == len(meta), \
+                    (f"replica coverage hole after repair: shard {shard} "
+                     f"sid {sid!r} bs {bs} held by {sorted(by_host)} of "
+                     f"{sorted(meta)}")
+                owners = set(by_host.values())
+                assert len(owners) == 1, \
+                    (f"replica checksum divergence after repair: shard "
+                     f"{shard} sid {sid!r} bs {bs}: {by_host}")
+                checked += 1
+        return checked
+
+    def close(self):
+        self._stop.set()
+        self.session.close()
+        self.admin_session.close()
+        self.cluster.close()

@@ -56,6 +56,17 @@ echo "== write-path smoke (~5s; queue drain on shutdown, zero lost writes, mesh 
 JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   python scripts/write_smoke.py
 
+echo "== churn smoke (SLO-under-churn: chaos + placement churn + concurrent repair, hard SLOs asserted) =="
+# The composed production story (ROADMAP item 3): RF=3 cluster behind
+# seeded faultnet proxies under seeded open-loop mixed-priority load
+# WHILE add/remove/replace-node churn and a repair sweep run — zero lost
+# acked writes, zero shed CRITICAL, bounded p99/queues, replica-
+# consistent convergence. Full matrix: tests/test_dtest_scenarios.py +
+# tests/test_bootstrap_repair.py; bench: peer_migration. Wall budget via
+# CHURN_SMOKE_BUDGET_S (first cold run pays one-time kernel compiles,
+# persisted to .jax_cache for later runs).
+JAX_PLATFORMS=cpu python scripts/churn_smoke.py --seed 7
+
 echo "== test suite =="
 python -m pytest tests/ -x -q
 

@@ -888,6 +888,67 @@ class TestRetryRules:
         """
         assert lint(src, BroadExceptWireIORule()) == []
 
+    def test_flags_broad_except_around_peer_streaming_in_bootstrap(self):
+        # the pre-fix PeersBootstrapper.bootstrap hole: peers unavailable
+        # silently claimed nothing
+        src = """
+            def bootstrap(ns, shard_id, ctx):
+                try:
+                    series = ctx.session.fetch_bootstrap_blocks_from_peers(
+                        ns.name, shard_id, 0, 1)
+                except Exception:
+                    return None
+        """
+        found = lint(src, BroadExceptWireIORule(),
+                     "m3_tpu/storage/bootstrap.py")
+        assert rule_ids(found) == ["broad-except-wire-io"]
+        assert "peer-streaming" in found[0].message
+
+    def test_flags_broad_except_around_tile_fetch_in_repair(self):
+        src = """
+            def sweep(self, ns, shard_id, plan):
+                try:
+                    tiles, failed = self.session.fetch_block_tiles(
+                        ns.name, shard_id, plan)
+                except Exception:
+                    tiles, failed = {}, []
+                return tiles
+        """
+        assert rule_ids(lint(src, BroadExceptWireIORule(),
+                             "m3_tpu/storage/repair.py")) == \
+            ["broad-except-wire-io"]
+
+    def test_peer_streaming_scope_is_bootstrap_and_repair_only(self):
+        # the same shape elsewhere (e.g. a query-layer helper) is out of
+        # this extension's scope — only the peer-replication data plane
+        # carries the typed PEER_SKIP_ERRORS contract
+        src = """
+            def mirror(session, ns):
+                try:
+                    return session.fetch_bootstrap_blocks_from_peers(
+                        ns, 0, 0, 1)
+                except Exception:
+                    return {}
+        """
+        assert lint(src, BroadExceptWireIORule(),
+                    "m3_tpu/query/mod.py") == []
+
+    def test_typed_peer_skip_set_is_fine_in_bootstrap(self):
+        # the post-fix shape: typed classification, counted skip
+        src = """
+            from ..client.session import PEER_SKIP_ERRORS
+
+            def bootstrap(ns, shard_id, ctx):
+                try:
+                    tiles, tags, failed = \\
+                        ctx.session.fetch_block_tiles_from_peers(
+                            ns.name, shard_id, 0, 1)
+                except PEER_SKIP_ERRORS:
+                    return None
+        """
+        assert lint(src, BroadExceptWireIORule(),
+                    "m3_tpu/storage/bootstrap.py") == []
+
     def test_suppression_silences_with_justification(self):
         src = """
             from ..rpc import wire
