@@ -243,7 +243,7 @@ def index_functions(mod: Module) -> Dict[str, ast.FunctionDef]:
 
 def _registry() -> List[Rule]:
     from . import (batch_rules, cache_rules, hbm_rules, jax_rules,
-                   lock_rules, overload_rules, retry_rules)
+                   lock_rules, obs_rules, overload_rules, retry_rules)
 
     return [
         *cache_rules.RULES,
@@ -253,6 +253,7 @@ def _registry() -> List[Rule]:
         *retry_rules.RULES,
         *overload_rules.RULES,
         *hbm_rules.RULES,
+        *obs_rules.RULES,
     ]
 
 

@@ -1,0 +1,113 @@
+"""Observability-discipline rules.
+
+Rules:
+  wall-clock-latency   an elapsed-time measurement computed from
+                       `time.time()` / `time.time_ns()` deltas inside the
+                       serving layers (storage / rpc / client / query /
+                       msg). Wall clocks step under NTP correction and
+                       jump across suspend, so a latency/uptime/backoff
+                       measured as `time.time() - t0` can go NEGATIVE or
+                       gain hours — every elapsed measurement must use
+                       `time.perf_counter()` / `perf_counter_ns()` (or
+                       `monotonic`/`monotonic_ns`). Wall-clock READS are
+                       fine (data timestamps, default query ranges): the
+                       rule flags only SUBTRACTIONS where one side is a
+                       wall-clock call or a name/attribute assigned from
+                       one — i.e. an elapsed computation.
+
+The pre-fix seeded positive was rpc/node_server.py's uptime
+(`time.time_ns() - self.start_ns` with `self.start_ns = time.time_ns()`),
+fixed to monotonic_ns in the same pass. Tree is at 0 findings.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set
+
+from .core import Finding, Module, Rule, qualname
+
+_WALL_CALLS = {"time.time", "time.time_ns"}
+
+
+def _is_wall_call(node: ast.AST, bare_time_names: Set[str]) -> bool:
+    """`time.time()` / `time.time_ns()` (or a bare `time()`/`time_ns()`
+    imported from the time module)."""
+    if not isinstance(node, ast.Call):
+        return False
+    q = qualname(node.func)
+    if q in _WALL_CALLS:
+        return True
+    return q in bare_time_names
+
+
+class WallClockLatencyRule(Rule):
+    """wall-clock-latency: elapsed time measured on the wall clock."""
+
+    id = "wall-clock-latency"
+    severity = "error"
+    dirs = ("storage", "rpc", "client", "query", "msg")
+
+    @staticmethod
+    def _bare_time_names(mod: Module) -> Set[str]:
+        """Names bound by `from time import time [as t]` / `time_ns`."""
+        out: Set[str] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ImportFrom) and node.module == "time":
+                for a in node.names:
+                    if a.name in ("time", "time_ns"):
+                        out.add(a.asname or a.name)
+        return out
+
+    @staticmethod
+    def _wall_assigned(mod: Module, bare: Set[str]) -> Set[str]:
+        """Names and `self.attr` qualnames assigned from a wall-clock
+        call anywhere in the module — `t0 = time.time()` in one method
+        subtracted in another is still an elapsed measurement."""
+        out: Set[str] = set()
+        for node in ast.walk(mod.tree):
+            value: Optional[ast.AST] = None
+            targets: List[ast.AST] = []
+            if isinstance(node, ast.Assign):
+                value, targets = node.value, list(node.targets)
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                value = node.value
+                targets = [node.target]
+            if value is None or not _is_wall_call(value, bare):
+                continue
+            for tgt in targets:
+                q = qualname(tgt)
+                if q:
+                    out.add(q)
+        return out
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        bare = self._bare_time_names(mod)
+        assigned = self._wall_assigned(mod, bare)
+
+        def is_wall(node: ast.AST) -> bool:
+            if _is_wall_call(node, bare):
+                return True
+            q = qualname(node)
+            return q is not None and q in assigned
+
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.BinOp)
+                    and isinstance(node.op, ast.Sub)):
+                continue
+            left_wall = is_wall(node.left)
+            right_wall = is_wall(node.right)
+            # An elapsed computation subtracts two wall readings (call or
+            # stored reading on either side). A single wall operand minus
+            # a constant/duration is range arithmetic, not a measurement.
+            if not (left_wall and right_wall):
+                continue
+            yield self.finding(
+                mod, node,
+                "elapsed time measured with time.time()/time_ns() deltas — "
+                "wall clocks step under NTP and suspend; use "
+                "time.perf_counter()/perf_counter_ns() (or monotonic) for "
+                "latency/uptime/backoff measurements")
+
+
+RULES: List[Rule] = [WallClockLatencyRule()]

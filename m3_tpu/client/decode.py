@@ -17,6 +17,7 @@ from typing import Dict, List, Sequence, Tuple
 import numpy as np
 
 from ..ops import tsz
+from ..parallel import telemetry
 from ..utils import xtime
 
 
@@ -59,6 +60,9 @@ def decode_segment_groups(segments: Sequence[dict]) -> List[Tuple[np.ndarray, np
         for r, i in enumerate(idxs):
             words[r] = np.asarray(segments[i]["words"])
             npoints[r] = segments[i]["npoints"]
+        # Shape-bucket telemetry: a first-seen (rows-pow2, width, window)
+        # geometry means a fresh decode-kernel compile for this fetch.
+        telemetry.record_bucket("client.decode", (rp, mw, window, unit))
         ts, vs = tsz.decode(words, npoints, window)
         scale = xtime.Unit(unit).nanos
         for row, i in enumerate(idxs):
@@ -86,6 +90,8 @@ def decode_tile(words, npoints, window: int, time_unit: int
         np_pad = np.concatenate([npoints, np.repeat(npoints[:1], rp - n)])
     else:
         np_pad = npoints
+    telemetry.record_bucket("client.decode_tile",
+                            (rp, int(words.shape[-1]), int(window)))
     ts, vs = tsz.decode(words, np_pad, window)
     scale = xtime.Unit(time_unit).nanos
     return np.asarray(ts[:n]) * scale, np.asarray(vs[:n])

@@ -28,6 +28,7 @@ from ..cluster.topology import (
 )
 from ..parallel.sharding import ShardSet
 from ..rpc import wire
+from ..utils import tracing
 from ..utils.instrument import ROOT
 from ..utils.limits import ResourceExhausted
 from ..utils.retry import (
@@ -69,6 +70,7 @@ class Connection:
     def __init__(self, endpoint: str, connect_timeout: float = 10.0,
                  request_timeout: float = 10.0):
         host, port = endpoint.rsplit(":", 1)
+        self.endpoint = endpoint
         self.sock = socket.create_connection((host, int(port)),
                                              timeout=connect_timeout)
         self.sock.settimeout(request_timeout)
@@ -85,6 +87,13 @@ class Connection:
             # Admission hint for the server's gate ("bulk" sheds first at
             # the high watermark); rides the frame, not the args.
             req["pri"] = priority
+        # Trace context rides the frame beside "d"/"pri" — only when a
+        # SAMPLED span is active on this thread, so unsampled traffic
+        # costs one thread-local read and no wire bytes. The server's
+        # finished span tree comes back under "sp" and is grafted below.
+        cur_span = tracing.TRACER.current()
+        if cur_span is not None:
+            req[wire.TRACE_KEY] = cur_span.context().to_wire()
         if deadline is not None:
             deadline.check(method)
             req[wire.DEADLINE_KEY] = deadline.to_wire()
@@ -149,6 +158,16 @@ class Connection:
                 raise ResourceExhausted(
                     resp.get("err", "server resource exhausted"))
             raise RemoteError(resp.get("err", "unknown remote error"))
+        if cur_span is not None and cur_span.end_ns is None:
+            # Graft the server-side tree under the calling span, tagged
+            # with the endpoint it ran on — the cross-process hop becomes
+            # one child in the caller's tree. A FINISHED span (quorum met
+            # and returned while this replica straggled) never mutates:
+            # it may already be published in the tracer's recent ring.
+            sp = resp.get(wire.SPAN_KEY)
+            if isinstance(sp, dict):
+                sp.setdefault("tags", {})["endpoint"] = self.endpoint
+                cur_span.attach(sp)
         return resp["r"]
 
     def close(self):
@@ -568,6 +587,14 @@ class Session:
 
     # ------------------------------------------------------------------ reads
 
+    def _traced_call(self, span, client: HostClient, method: str, **kwargs):
+        """HostClient call with `span` active on the worker thread: the
+        fanout pool's threads don't inherit the submitting thread's
+        span stack, so propagation into the wire frames (and the graft
+        of server spans back onto `span`) needs the explicit handoff."""
+        with tracing.TRACER.activate(span):
+            return client.call(method, **kwargs)
+
     def fetch(self, ns: bytes, id: bytes, start_ns: int, end_ns: int
               ) -> Tuple[np.ndarray, np.ndarray]:
         """Fetch decoded + replica-merged datapoints for one series."""
@@ -580,9 +607,19 @@ class Session:
         # frame: a faulted/slow replica returns DeadlineExceeded instead
         # of stalling past the caller's budget.
         dl = Deadline.after(self.opts.timeout_s)
-        pending = {self._pool.submit(self._client(h).call, "fetch", _deadline=dl,
-                                     ns=ns, id=id,
-                                     start_ns=start_ns, end_ns=end_ns) for h in hosts}
+        with tracing.span("client.fetch", replicas=len(hosts)) as csp:
+            pending = {self._pool.submit(
+                self._traced_call, csp, self._client(h), "fetch",
+                _deadline=dl, ns=ns, id=id,
+                start_ns=start_ns, end_ns=end_ns) for h in hosts}
+            results, errs = self._await_quorum(pending, dl, required, results,
+                                               errs)
+        if len(results) < required:
+            raise ConsistencyError(f"{len(results)}/{len(hosts)} reads, need {required}: {errs}")
+        return merge_replica_points([r["t"] for r in results], [r["v"] for r in results],
+                                    self.opts.conflict_strategy)
+
+    def _await_quorum(self, pending, dl, required, results, errs):
         # Return as soon as the read consistency level is satisfied — a dead
         # replica must not stall a quorum-satisfiable read.
         while pending and len(results) < required:
@@ -596,10 +633,7 @@ class Session:
                     results.append(fut.result())
                 except Exception as e:  # noqa: BLE001
                     errs.append(str(e))
-        if len(results) < required:
-            raise ConsistencyError(f"{len(results)}/{len(hosts)} reads, need {required}: {errs}")
-        return merge_replica_points([r["t"] for r in results], [r["v"] for r in results],
-                                    self.opts.conflict_strategy)
+        return results, errs
 
     def fetch_tagged(self, ns: bytes, query, start_ns: int, end_ns: int,
                      limit: int = 0) -> Dict[bytes, dict]:
@@ -627,23 +661,26 @@ class Session:
         results, errs = [], []
         ok_ids = set()
         dl = Deadline.after(self.opts.timeout_s)
-        pending = {self._pool.submit(self._client(h).call, "fetch_tagged",
-                                     _deadline=dl, ns=ns,
-                                     query=q, start_ns=start_ns, end_ns=end_ns,
-                                     limit=limit): h for h in hosts}
-        while pending and not coverage_met(ok_ids):
-            done, _ = futures_wait(
-                set(pending), timeout=max(0.0, dl.remaining()),
-                return_when=FIRST_COMPLETED)
-            if not done:
-                break
-            for fut in done:
-                h = pending.pop(fut)
-                try:
-                    results.append(fut.result())
-                    ok_ids.add(h.id)
-                except Exception as e:  # noqa: BLE001
-                    errs.append(f"{h.id}: {e}")
+        with tracing.span("client.fetch_tagged", hosts=len(hosts)) as csp:
+            pending = {self._pool.submit(
+                self._traced_call, csp, self._client(h), "fetch_tagged",
+                _deadline=dl, ns=ns,
+                query=q, start_ns=start_ns, end_ns=end_ns,
+                limit=limit): h for h in hosts}
+            while pending and not coverage_met(ok_ids):
+                done, _ = futures_wait(
+                    set(pending), timeout=max(0.0, dl.remaining()),
+                    return_when=FIRST_COMPLETED)
+                if not done:
+                    break
+                for fut in done:
+                    h = pending.pop(fut)
+                    try:
+                        results.append(fut.result())
+                        ok_ids.add(h.id)
+                    except Exception as e:  # noqa: BLE001
+                        errs.append(f"{h.id}: {e}")
+            csp.set_tag("responders", len(ok_ids))
         if not coverage_met(ok_ids):
             raise ConsistencyError(
                 f"insufficient replica coverage ({len(ok_ids)} responders, "

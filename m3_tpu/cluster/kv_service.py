@@ -28,6 +28,7 @@ import threading
 from typing import Callable, Dict, List, Optional
 
 from ..rpc import wire
+from ..utils import tracing
 from ..utils.retry import Deadline, DeadlineExceeded, Retrier, RetryOptions
 from . import kv as cluster_kv
 
@@ -57,7 +58,17 @@ class KVServer:
                                 "ok": False, "kind": "deadline",
                                 "err": f"kv {req.get('op')}: deadline exceeded"})
                             continue
-                        wire.write_frame(self.request, outer._handle(req))
+                        # Propagated span context: kv ops under a sampled
+                        # caller join its trace; the finished span rides
+                        # the response for the client-side graft.
+                        sp = tracing.TRACER.span_from(
+                            wire.trace_from_frame(req),
+                            f"kv.{req.get('op')}")
+                        with sp:
+                            resp = outer._handle(req)
+                        if sp.sampled and resp.get("ok"):
+                            resp[wire.SPAN_KEY] = sp.to_dict()
+                        wire.write_frame(self.request, resp)
                 except (ConnectionError, OSError, EOFError, ValueError):
                     # ValueError = malformed frame: stream desync, drop conn
                     pass
@@ -213,6 +224,10 @@ class RemoteStore:
                     req = dict(req)
                     req[wire.DEADLINE_KEY] = deadline.to_wire()
                     self._sock.settimeout(deadline.min_timeout(self._timeout))
+                cur_span = tracing.TRACER.current()
+                if cur_span is not None:
+                    req = dict(req)
+                    req[wire.TRACE_KEY] = cur_span.context().to_wire()
                 # DELIBERATE I/O under _lock: this lock exists to
                 # serialize whole request/response exchanges on the
                 # single pooled socket — interleaved frames from two
@@ -220,7 +235,14 @@ class RemoteStore:
                 # by the connect/read timeout set in _connect.
                 wire.write_frame(self._sock, req)  # m3lint: disable=lock-held-blocking-call
                 try:
-                    return wire.read_dict_frame(self._sock)  # m3lint: disable=lock-held-blocking-call
+                    resp = wire.read_dict_frame(self._sock)  # m3lint: disable=lock-held-blocking-call
+                    if cur_span is not None:
+                        sp = resp.pop(wire.SPAN_KEY, None)
+                        if isinstance(sp, dict):
+                            sp.setdefault("tags", {})["endpoint"] = \
+                                self._endpoint
+                            cur_span.attach(sp)
+                    return resp
                 except ValueError as e:
                     # malformed reply = stream desync: the pooled
                     # socket is unusable; surface as a CONNECTION

@@ -6,10 +6,11 @@ from .admin import AdminAPI
 from .downsample import Downsampler
 from .http_api import HTTPApi, HTTPError, Request
 from .ingest import DownsamplerAndWriter, M3MsgIngester
+from .selfscrape import SelfScraper
 from .server import Coordinator, run_clustered, run_embedded
 
 __all__ = [
     "AdminAPI", "Coordinator", "Downsampler", "DownsamplerAndWriter",
-    "HTTPApi", "HTTPError", "M3MsgIngester", "Request", "run_clustered",
-    "run_embedded",
+    "HTTPApi", "HTTPError", "M3MsgIngester", "Request", "SelfScraper",
+    "run_clustered", "run_embedded",
 ]

@@ -65,6 +65,7 @@ class HTTPApi:
             ("GET", r"/debug/traces", self.debug_traces),
             ("GET", r"/debug/pprof/profile", self.debug_profile),
             ("GET", r"/debug/pprof/goroutine", self.debug_stacks),
+            ("GET", r"/debug/pprof/threads", self.debug_stacks),
         ]
         if admin is not None:
             self.routes += [
@@ -128,20 +129,25 @@ class HTTPApi:
                 "query_placement": self.engine.placement_snapshot()}
 
     def debug_traces(self, req) -> dict:
-        """Recent finished span trees (opentracing-analog)."""
-        from ..utils.tracing import TRACER
-
-        return {"traces": TRACER.recent_traces()}
-
-    def debug_profile(self, req) -> dict:
-        """Statistical CPU profile: /debug/pprof/profile?seconds=N."""
+        """Recent finished span trees (opentracing-analog) + the
+        slow-query ring (?trace_id=N filters the trees to one trace)."""
         from ..utils import tracing
 
-        seconds = min(float(req.param("seconds", "1")), 30.0)
-        return {"profile": tracing.profile(seconds=seconds)}
+        tid = req.param("trace_id", None)
+        return tracing.debug_traces_payload(int(tid) if tid else None)
+
+    def debug_profile(self, req) -> dict:
+        """Statistical CPU profile: /debug/pprof/profile?seconds=N.
+        Sampling runs on ONE shared background thread with a hard cap
+        (M3_TPU_PROFILE_MAX_S): a profile request cannot stall a serving
+        thread past the cap, and concurrent requests share the window."""
+        from ..utils import tracing
+
+        return tracing.debug_profile_payload(float(req.param("seconds", "1")))
 
     def debug_stacks(self, req):
-        """All-threads stack dump (goroutine-dump analog, debug=2 form)."""
+        """All-threads stack dump (goroutine-dump analog, debug=2 form;
+        also served as /debug/pprof/threads)."""
         from ..utils import tracing
 
         return RawResponse("text/plain; charset=utf-8",
@@ -410,13 +416,25 @@ class HTTPApi:
                     ctype = self.headers.get("Content-Type", "")
                     if "form" in ctype:
                         params.update(urllib.parse.parse_qs(body.decode()))
-                req = Request(self.command, parsed.path, params, body)
+                req = Request(self.command, parsed.path, params, body,
+                              headers=dict(self.headers))
                 for method, pattern, fn in api._compiled:
                     m = pattern.match(parsed.path)
                     if m and method == self.command:
                         req.path_params = m.groupdict()
+                        # External trace ingress: an "X-M3-Trace:
+                        # <trace_id>:<span_id>" header joins this request
+                        # to the caller's trace (the HTTP twin of the
+                        # wire frames' "tr" field). No header, no span —
+                        # plain requests pay one dict get.
+                        from ..utils import tracing as _tracing
+
+                        tspan = _tracing.TRACER.span_from(
+                            _trace_header_ctx(self.headers.get("X-M3-Trace")),
+                            f"http.{self.command} {parsed.path}")
                         try:
-                            out = fn(req)
+                            with tspan:
+                                out = fn(req)
                             code = 200
                         except HTTPError as e:
                             out, code = {"status": "error", "error": e.msg}, e.code
@@ -476,11 +494,12 @@ class RawResponse:
 
 class Request:
     def __init__(self, method: str, path: str, params: Dict[str, list],
-                 body: bytes):
+                 body: bytes, headers: Optional[Dict[str, str]] = None):
         self.method = method
         self.path = path
         self.params = params
         self.body = body
+        self.headers = headers or {}
         self.path_params: Dict[str, str] = {}
 
     def param(self, name: str, default: Optional[str] = "__required__"):
@@ -506,6 +525,23 @@ class HTTPError(Exception):
 
 
 # ---------------------------------------------------------------- helpers
+
+def _trace_header_ctx(header: Optional[str]):
+    """SpanContext from an "X-M3-Trace: <trace_id>:<span_id>" header, or
+    None — malformed values are absent, never fatal (the HTTP twin of
+    wire.trace_from_frame)."""
+    if not header:
+        return None
+    from ..utils.tracing import SpanContext
+
+    parts = header.split(":")
+    if len(parts) != 2:
+        return None
+    try:
+        return SpanContext(int(parts[0]), int(parts[1]))
+    except ValueError:
+        return None
+
 
 def _parse_time(s) -> int:
     """Unix seconds (float) or RFC3339 -> nanos."""

@@ -18,6 +18,7 @@ from .admin import AdminAPI
 from .downsample import Downsampler
 from .http_api import HTTPApi
 from .ingest import DownsamplerAndWriter
+from .selfscrape import SelfScraper
 
 
 @dataclasses.dataclass
@@ -27,6 +28,9 @@ class Coordinator:
     api: HTTPApi
     downsampler: Optional[Downsampler]
     admin: AdminAPI
+    # Self-scrape loop (instrument snapshot -> own ingest path) when the
+    # deployment enables it; tests/smokes drive scrape_once() directly.
+    self_scraper: Optional[SelfScraper] = None
 
     @property
     def endpoint(self) -> str:
@@ -36,13 +40,16 @@ class Coordinator:
         return self.downsampler.flush(now_nanos) if self.downsampler else 0
 
     def close(self):
+        if self.self_scraper is not None:
+            self.self_scraper.stop()
         self.api.close()
 
 
 def _build(storage, aggregated_storages: Dict[StoragePolicy, object],
            kv_store: Optional[cluster_kv.MemStore],
            rules_namespace: bytes, clock, create_namespace,
-           listen=("127.0.0.1", 0)) -> Coordinator:
+           listen=("127.0.0.1", 0),
+           self_scrape_interval_s: Optional[float] = None) -> Coordinator:
     downsampler = None
     if kv_store is not None:
         matcher = Matcher(RuleSetStore(kv_store), rules_namespace, clock=clock)
@@ -57,7 +64,13 @@ def _build(storage, aggregated_storages: Dict[StoragePolicy, object],
     admin = AdminAPI(kv_store if kv_store is not None else cluster_kv.MemStore(),
                      create_namespace=create_namespace)
     api = HTTPApi(engine, writer, admin=admin).serve(*listen)
-    return Coordinator(engine, writer, api, downsampler, admin)
+    scraper = None
+    if self_scrape_interval_s is not None:
+        # Dogfooding like the reference: the coordinator's own instrument
+        # registry scraped back through its ingest path.
+        scraper = SelfScraper(writer, clock=clock,
+                              interval_s=self_scrape_interval_s).start()
+    return Coordinator(engine, writer, api, downsampler, admin, scraper)
 
 
 def run_embedded(db, namespace: bytes = b"default",
@@ -65,7 +78,8 @@ def run_embedded(db, namespace: bytes = b"default",
                  rules_namespace: bytes = b"default",
                  aggregated_namespaces: Optional[Dict[StoragePolicy, bytes]] = None,
                  clock=None, listen=("127.0.0.1", 0),
-                 create_namespace=None) -> Coordinator:
+                 create_namespace=None,
+                 self_scrape_interval_s: Optional[float] = None) -> Coordinator:
     storage = LocalStorage(db, namespace)
     agg = {
         policy: LocalStorage(db, ns)
@@ -80,18 +94,20 @@ def run_embedded(db, namespace: bytes = b"default",
                 name, NamespaceOptions(retention_ns=retention_ns))
 
     return _build(storage, agg, kv_store, rules_namespace, clock,
-                  create_namespace, listen)
+                  create_namespace, listen,
+                  self_scrape_interval_s=self_scrape_interval_s)
 
 
 def run_clustered(session, namespace: bytes = b"default",
                   kv_store: Optional[cluster_kv.MemStore] = None,
                   rules_namespace: bytes = b"default",
                   aggregated_namespaces: Optional[Dict[StoragePolicy, bytes]] = None,
-                  clock=None, listen=("127.0.0.1", 0)) -> Coordinator:
+                  clock=None, listen=("127.0.0.1", 0),
+                  self_scrape_interval_s: Optional[float] = None) -> Coordinator:
     storage = SessionStorage(session, namespace)
     agg = {
         policy: SessionStorage(session, ns)
         for policy, ns in (aggregated_namespaces or {}).items()
     }
     return _build(storage, agg, kv_store, rules_namespace, clock, None,
-                  listen)
+                  listen, self_scrape_interval_s=self_scrape_interval_s)

@@ -20,6 +20,7 @@ from typing import Dict, List, Optional, Set, Tuple
 import numpy as np
 
 from ..utils import limits as xlimits
+from ..utils import tracing
 from ..utils import xtime
 from .postings_cache import PostingsListCache
 from .query import Query
@@ -235,22 +236,29 @@ class NamespaceIndex:
         query limit BEFORE materialization (query_limits.go charges docs
         at postings evaluation): a regexp matching the whole namespace is
         rejected by ResourceExhausted before it gathers a single id."""
-        parts = []
-        for seg in self._snapshot_segments(start_ns, end_ns):
-            pos = execute(seg, q, cache=self.postings_cache)
-            if len(pos):
-                xlimits.charge("docs_matched", int(len(pos)))
-                parts.append(seg.sorted_ids_for(pos))
-        if not parts:
-            return []
-        if len(parts) == 1:
-            ids = parts[0]
-        else:
-            ids = np.concatenate(parts)
-            ids.sort(kind="stable")
-            ids = dedup_sorted_ids(ids)
-        out = ids.tolist()
-        return out[:limit] if limit else out
+        # child_span: real only under an already-sampled request (rpc
+        # dispatch / executor) — a bare index query pays one TLS read
+        # (the obs_overhead_guard's index bench contract).
+        with tracing.child_span("index.query") as sp:
+            parts = []
+            segs = 0
+            for seg in self._snapshot_segments(start_ns, end_ns):
+                segs += 1
+                pos = execute(seg, q, cache=self.postings_cache)
+                if len(pos):
+                    xlimits.charge("docs_matched", int(len(pos)))
+                    parts.append(seg.sorted_ids_for(pos))
+            if not parts:
+                return []
+            if len(parts) == 1:
+                ids = parts[0]
+            else:
+                ids = np.concatenate(parts)
+                ids.sort(kind="stable")
+                ids = dedup_sorted_ids(ids)
+            out = ids.tolist()
+            sp.set_tag("segments", segs).set_tag("ids", len(out))
+            return out[:limit] if limit else out
 
     def postings_cache_stats(self) -> dict:
         return self.postings_cache.stats()

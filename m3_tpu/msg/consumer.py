@@ -18,6 +18,7 @@ from collections import deque
 from typing import Callable, List, Optional
 
 from ..rpc import wire
+from ..utils import tracing
 
 
 class Consumer:
@@ -145,8 +146,17 @@ class Consumer:
                             if len(pending_acks) >= outer._ack_batch:
                                 flush()
                             continue
+                        # Producer trace context (if the publish was
+                        # sampled): the handler runs under a remote-
+                        # parented span sharing the publishing trace id —
+                        # fire-and-forget delivery has no response frame
+                        # to graft through, so the consumer-side tree is
+                        # joined by trace id (/debug/traces?trace_id=).
+                        tctx = wire.trace_from_frame(frame)
                         try:
-                            outer._handler(shard, value)
+                            with tracing.TRACER.span_from(
+                                    tctx, "msg.consume", shard=shard):
+                                outer._handler(shard, value)
                         except Exception:  # noqa: BLE001 - app error, not desync
                             # Handler failure is the APPLICATION's error:
                             # log it, skip the ack, keep consuming — the

@@ -20,20 +20,27 @@ from typing import Callable, Dict, List, Optional
 
 from ..cluster.placement import Placement, ShardState
 from ..rpc import wire
+from ..utils import tracing
 from ..utils.limits import Backpressure
 from ..utils.retry import Breaker, BreakerOptions, Retrier, RetryOptions
 from .topic import ConsumptionType, Topic
 
 
 class _Message:
-    __slots__ = ("id", "shard", "value", "refs", "size")
+    __slots__ = ("id", "shard", "value", "refs", "size", "trace")
 
-    def __init__(self, mid: int, shard: int, value: bytes, refs: int):
+    def __init__(self, mid: int, shard: int, value: bytes, refs: int,
+                 trace: Optional[dict] = None):
         self.id = mid
         self.shard = shard
         self.value = value
         self.refs = refs
         self.size = len(value)
+        # Wire span context captured at PUBLISH time (None when the
+        # publisher was unsampled): redeliveries re-send the original
+        # context, so the consumer's span joins the producing trace no
+        # matter which retry pass delivered it.
+        self.trace = trace
 
 
 class _Tracked:
@@ -154,6 +161,8 @@ class MessageWriter:
                     "t": "msg", "shard": msg.shard, "id": msg.id,
                     "sent_at": time.monotonic_ns(), "value": msg.value,
                 }
+                if msg.trace is not None:
+                    frame[wire.TRACE_KEY] = msg.trace
                 if self._src is not None:
                     # producer identity: consumers key duplicate-delivery
                     # dedup on (src, id) so a RESTARTED producer reusing
@@ -423,7 +432,10 @@ class Producer:
                     f"bytes buffered): consumers behind — back off")
             mid = self._next_id
             self._next_id += 1
-            msg = _Message(mid, shard, value, refs=len(self._service_writers))
+            cur = tracing.TRACER.current()
+            msg = _Message(mid, shard, value, refs=len(self._service_writers),
+                           trace=(cur.context().to_wire()
+                                  if cur is not None else None))
             self._order[mid] = msg
             self._buffered_bytes += msg.size
         try:

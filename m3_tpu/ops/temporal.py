@@ -42,6 +42,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..parallel import telemetry
 from ..utils.instrument import ROOT
 
 _F32 = jnp.float32
@@ -281,6 +282,9 @@ def _cached_put(arr: np.ndarray):
             return hit[0]
     _UPLOAD_METRICS.counter("misses").inc()
     dev = _placed_put(arr)
+    # A miss IS a host->device transfer: count the bytes at the choke
+    # point so /debug/vars shows real upload volume per process.
+    telemetry.count_h2d(int(getattr(dev, "nbytes", arr.nbytes)))
     with _PUT_CACHE_LOCK:
         if key not in _PUT_CACHE:
             # Charge the ACTUAL device-buffer size (device_put may
@@ -379,6 +383,7 @@ def _take_t(grid, abs_idx):
         grid, jnp.clip(abs_idx, 0, grid.shape[-1] - 1), axis=-1)
 
 
+@telemetry.jit_builder("rate")
 @functools.lru_cache(maxsize=256)
 def _rate_fn(W: int, step_s: float, range_s: float, is_counter: bool,
              is_rate: bool, stride: int = 1):
@@ -565,6 +570,7 @@ def delta_async(grid: np.ndarray, W: int, step_ns: int, range_ns: int,
                                stride)
 
 
+@telemetry.jit_builder("last_two_idx")
 @functools.lru_cache(maxsize=256)
 def _last_two_idx_fn(W: int, stride: int = 1):
     """irate/idelta index pass: last two valid window indices."""
@@ -689,6 +695,7 @@ def _window_stat_strided(resid, W: int, stat: str, stride: int):
     return out[..., ::stride], cnt[..., ::stride]
 
 
+@telemetry.jit_builder("over_time")
 @functools.lru_cache(maxsize=256)
 def _over_time_fn(W: int, stat: str, stride: int = 1):
     """One masked window moment for *_over_time (temporal/aggregation.go):
@@ -726,6 +733,7 @@ def _finish_over_time(xp, kind: str, stat, cnt, b):
     raise ValueError(f"unknown over_time kind {kind!r}")
 
 
+@telemetry.jit_builder("over_time_finish")
 @functools.lru_cache(maxsize=256)
 def _over_time_finish_fn(W: int, kind: str, stride: int = 1):
     """Fully-fused *_over_time: stat + baseline correction + NaN masking on
@@ -807,6 +815,7 @@ def over_time(grid: np.ndarray, W: int, kind: str, stride: int = 1,
     return over_time_async(grid, W, kind, stride, finish)()
 
 
+@telemetry.jit_builder("quantile_idx")
 @functools.lru_cache(maxsize=256)
 def _quantile_idx_fn(W: int, stride: int = 1):
     """Window-quantile index selection; host gathers exact f64 values."""
@@ -846,6 +855,7 @@ def quantile_over_time(grid: np.ndarray, W: int, q: float,
     return np.where(cnt > 0, out, np.nan)
 
 
+@telemetry.jit_builder("changes_resets")
 @functools.lru_cache(maxsize=256)
 def _changes_resets_fn(W: int, count_resets: bool, stride: int = 1):
     def fn(resid):
@@ -877,6 +887,7 @@ def resets(grid: np.ndarray, W: int, stride: int = 1) -> np.ndarray:
     return np.asarray(_changes_resets_fn(W, True, stride)(resid))
 
 
+@telemetry.jit_builder("regression")
 @functools.lru_cache(maxsize=256)
 def _regression_fn(W: int, step_s: float, predict_offset_s: float,
                    is_deriv: bool, stride: int = 1):
@@ -925,6 +936,7 @@ def predict_linear(grid: np.ndarray, W: int, step_ns: int,
     return out + base[:, None]
 
 
+@telemetry.jit_builder("holt_winters")
 @functools.lru_cache(maxsize=256)
 def _holt_winters_fn(W: int, sf: float, tf: float, stride: int = 1):
     """Double exponential smoothing (temporal/holt_winters.go; promql

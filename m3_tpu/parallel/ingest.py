@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from . import telemetry
 from ..ops import aggregation as agg
 from ..ops import bits64 as b64
 from ..ops import tsz
@@ -192,6 +193,7 @@ def flush_mesh() -> Mesh | None:
     return make_mesh()
 
 
+@telemetry.jit_builder("flush_encoder")
 @functools.lru_cache(maxsize=32)
 def make_flush_encoder(mesh: Mesh, max_words: int):
     """The serving-flush encode as a shard_map program over the
@@ -245,6 +247,7 @@ def flush_encode_prepared(inp: dict, max_words: int):
     if n * shape[1] < min_cells:
         return None
     enc = make_flush_encoder(mesh, max_words)
+    telemetry.mesh_dispatch("flush_encode", cells=int(n * shape[1]))
     return enc(inp["dt"], inp["t0"][0], inp["t0"][1], inp["vhi"],
                inp["vlo"], inp["int_mode"], inp["k"], inp["npoints"],
                inp["ts_regular"], inp["delta0"])

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from . import telemetry
 from ..ops import temporal
 
 
@@ -32,6 +33,7 @@ RANGE_FUNCS = {"rate": (True, True), "increase": (True, False),
 AGG_OPS = ("sum", "avg", "count", "min", "max")
 
 
+@telemetry.jit_builder("sharded_agg_rate")
 @functools.lru_cache(maxsize=64)
 def make_sharded_agg_rate(mesh: Mesh, *, op: str, func: str, W: int,
                           step_ns: int, range_ns: int, stride: int = 1):
@@ -123,6 +125,7 @@ def agg_rate(grid: np.ndarray, mesh: Mesh, *, op: str, func: str, W: int,
     args = shard_grid(grid, mesh, is_counter)
     fn = make_sharded_agg_rate(mesh, op=op, func=func, W=W, step_ns=step_ns,
                                range_ns=range_ns, stride=stride)
+    telemetry.mesh_dispatch("agg_rate", cells=int(np.asarray(grid).size))
     total, n = fn(*args)
     total = np.asarray(total, np.float64)
     n = np.asarray(n)

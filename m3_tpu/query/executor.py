@@ -22,7 +22,8 @@ import numpy as np
 from ..ops import series_agg, temporal
 from . import promql
 from ..utils import limits as xlimits
-from ..utils.tracing import span
+from ..utils.retry import DeadlineExceeded
+from ..utils.tracing import SLOW_QUERIES, span
 from .block import Block, BlockMeta, consolidate_series
 from .model import Matcher, MatchType, METRIC_NAME, Tags
 from .promql import (
@@ -229,9 +230,42 @@ class Engine:
 
         ROOT.counter("query.executed").inc()
         timer = ROOT.timer("query.latency_s")
-        with timer, span("query.execute_range", query=query):
-            return self._execute_range(query, start_ns, end_ns, step_ns,
-                                       ast=ast)
+        sp = span("query.execute_range", query=query)
+        # A failure before this query's scope runs must not inherit the
+        # previous query's totals on this reused serving thread.
+        xlimits.reset_last_totals()
+        t0 = time.perf_counter_ns()
+        # Slow-query accounting: typed sheds record regardless of
+        # duration; completed queries record past the threshold, with
+        # cost attribution from the span (QueryScope exit annotates it)
+        # or, unsampled, the thread-local last-scope totals.
+        try:
+            with timer, sp:
+                result = self._execute_range(query, start_ns, end_ns,
+                                             step_ns, ast=ast)
+        except xlimits.ResourceExhausted:
+            SLOW_QUERIES.maybe("query", query, time.perf_counter_ns() - t0,
+                               costs=xlimits.last_scope_totals(),
+                               reason="limit-shed",
+                               trace_id=sp.trace_id or None)
+            raise
+        except DeadlineExceeded:
+            SLOW_QUERIES.maybe("query", query, time.perf_counter_ns() - t0,
+                               costs=xlimits.last_scope_totals(),
+                               reason="deadline",
+                               trace_id=sp.trace_id or None)
+            raise
+        from ..utils import tracing
+
+        SLOW_QUERIES.maybe("query", query, time.perf_counter_ns() - t0,
+                           # Lazy SUBTREE rollup: cache events accrue on
+                           # child/grafted spans, and only entries that
+                           # actually record pay the walk.
+                           costs=((lambda: tracing.collect_costs(sp))
+                                  if sp.sampled
+                                  else xlimits.last_scope_totals()),
+                           trace_id=sp.trace_id or None)
+        return result
 
     def _execute_range(self, query: str, start_ns: int, end_ns: int,
                        step_ns: int, ast: Optional[Node] = None) -> Block:
@@ -379,13 +413,17 @@ class Engine:
         cache skips its content hash when the same grid object returns)."""
         from ..utils.instrument import ROOT
 
+        from ..utils import tracing
+
         key = (promql.selector_matchers(sel),
                meta.start_ns, meta.step_ns, meta.steps, lookback_ns)
         hit = self._grid_cache.get(key, series)
         if hit is not None:
             ROOT.counter("query.grid_cache.hit").inc()
+            tracing.count_cost("grid_cache_hit")
             return hit
         ROOT.counter("query.grid_cache.miss").inc()
+        tracing.count_cost("grid_cache_miss")
         tags_list, values = consolidate_series(series, meta, lookback_ns)
         self._grid_cache.put(key, series, tags_list, values)
         return tags_list, values
@@ -579,15 +617,23 @@ class Engine:
             dispatch_s = time.perf_counter() - t_dispatch
 
             def observed_fetch():
+                from ..parallel import telemetry
+
                 t0 = time.perf_counter()
                 result = inner()
                 placement.observe(placed, cells, result_bytes,
                                   dispatch_s + time.perf_counter() - t0)
+                # Result materialization is THE device->host transfer on
+                # the query path (kernels consolidate on device first).
+                telemetry.count_d2h(result_bytes)
                 return result
 
             return LazyBlock(params.meta(), tags, observed_fetch)
         self._placement.observe(placed, cells, result_bytes,
                                 time.perf_counter() - t_dispatch)
+        from ..parallel import telemetry
+
+        telemetry.count_d2h(result_bytes)
         return Block(params.meta(), tags, out)
 
     def _eval_instant_func(self, node: Call, params: QueryParams) -> Value:

@@ -1,7 +1,12 @@
 """HTTP/JSON mirror of the node RPC (reference:
 src/dbnode/network/server/httpjson — every thrift method exposed as POST
 /<method> with a JSON body, used for debugging and simple integrations;
-server.go:555 wires it next to the tchannel listener).
+server.go:555 wires it next to the tchannel listener), plus the dbnode
+/debug surface: GET /debug/vars (instrument snapshot), /debug/traces
+(span trees + slow-query log), /debug/pprof/profile (shared capped
+background sampler) and /debug/pprof/threads|goroutine (all-threads
+stack dump) — the same endpoints every reference service exposes
+(dbnode/server/server.go:575 debug listener).
 
 Numpy columns serialize as lists; bytes as latin-1-safe strings."""
 
@@ -10,11 +15,14 @@ from __future__ import annotations
 import base64
 import json
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any
 
 import numpy as np
 
+from ..utils import tracing
+from ..utils.instrument import ROOT
 from ..utils.limits import ResourceExhausted
 from .node_server import NodeService
 
@@ -84,6 +92,44 @@ class HTTPJSONServer:
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)
+
+            def do_GET(self):
+                """dbnode /debug surface (everything else is POST rpc)."""
+                parsed = urllib.parse.urlsplit(self.path)
+                params = urllib.parse.parse_qs(parsed.query)
+                path = parsed.path
+                ctype = "application/json"
+                code = 200
+                try:
+                    if path == "/debug/vars":
+                        out = json.dumps({"metrics": ROOT.snapshot()}).encode()
+                    elif path == "/debug/traces":
+                        tid = params.get("trace_id", [None])[0]
+                        out = json.dumps(tracing.debug_traces_payload(
+                            int(tid) if tid else None)).encode()
+                    elif path == "/debug/pprof/profile":
+                        out = json.dumps(tracing.debug_profile_payload(
+                            float(params.get("seconds", ["1"])[0]))).encode()
+                    elif path in ("/debug/pprof/threads",
+                                  "/debug/pprof/goroutine"):
+                        ctype = "text/plain; charset=utf-8"
+                        out = tracing.thread_stacks().encode()
+                    else:
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                except Exception as e:  # noqa: BLE001 — bad params
+                    # (seconds=abc, trace_id=xyz) must answer a typed
+                    # 400 like do_POST, not drop the connection with a
+                    # handler traceback.
+                    ctype = "application/json"
+                    out = json.dumps({"ok": False, "err": str(e)}).encode()
+                    code = 400
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(out)))
+                self.end_headers()
+                self.wfile.write(out)
 
         self._server = ThreadingHTTPServer((host, port), Handler)
 

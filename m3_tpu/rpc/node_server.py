@@ -24,6 +24,7 @@ import numpy as np
 from ..storage.database import Database
 from ..storage.series import charge_read
 from ..utils import limits as xlimits
+from ..utils import tracing
 from ..utils.health import AdmissionGate, Priority
 from ..utils.limits import ResourceExhausted
 from ..utils.retry import Deadline, DeadlineExceeded
@@ -66,7 +67,9 @@ class NodeService:
     def __init__(self, db: Database, gate: Optional[AdmissionGate] = None,
                  limits: Optional[xlimits.QueryLimits] = None):
         self.db = db
-        self.start_ns = time.time_ns()
+        # monotonic, not wall clock: uptime is an ELAPSED measurement and
+        # must not jump with NTP steps (m3lint wall-clock-latency).
+        self.start_ns = time.monotonic_ns()
         # Default gate is generous (threaded server, sub-ms dispatches:
         # 1024 in flight means the node is drowning) but FINITE — overload
         # protection must be on by default, not a config opt-in.
@@ -82,7 +85,22 @@ class NodeService:
 
     def dispatch(self, method: str, args: dict,
                  deadline: Optional[Deadline] = None,
-                 priority_hint: Optional[str] = None):
+                 priority_hint: Optional[str] = None,
+                 trace_ctx=None):
+        result, _sp = self.dispatch_traced(method, args, deadline,
+                                           priority_hint, trace_ctx)
+        return result
+
+    def dispatch_traced(self, method: str, args: dict,
+                        deadline: Optional[Deadline] = None,
+                        priority_hint: Optional[str] = None,
+                        trace_ctx=None):
+        """dispatch + span plumbing: returns (result, finished span dict
+        or None). A request frame carrying the "tr" context gets a
+        remote-parented span around its whole dispatch — QueryScope exit
+        annotates it with the request's cost tallies — and the finished
+        tree rides the response frame back for the caller to graft
+        (tracing module docstring). Untraced requests pay one NOOP test."""
         fn = getattr(self, "rpc_" + method, None)
         if fn is None:
             raise RPCError(f"unknown method {method!r}")
@@ -98,19 +116,48 @@ class NodeService:
         # and release them all on the way out — 1k rejected queries leak
         # zero budget (asserted by scripts/overload_smoke.py).
         priority = method_priority(method, priority_hint)
-        with self.gate.held(priority=priority):
-            with ql.scope(f"rpc.{method}"):
-                self._local.deadline = deadline
-                # Down-stack admission (shard insert queues) sheds by the
-                # same priority the gate admitted at — BULK backfill that
-                # squeezed past the gate still sheds first at a full
-                # queue, and CRITICAL replication never sheds.
-                self._local.priority = priority
-                try:
-                    return fn(**args)
-                finally:
-                    self._local.deadline = None
-                    self._local.priority = None
+        sp = tracing.TRACER.span_from(trace_ctx, "rpc." + method)
+        # A shed BEFORE the scope runs (gate full) must log empty costs,
+        # not the previous request's on this reused serving thread.
+        xlimits.reset_last_totals()
+        t0 = time.perf_counter_ns()
+        try:
+            with sp:
+                with self.gate.held(priority=priority):
+                    with ql.scope(f"rpc.{method}"):
+                        self._local.deadline = deadline
+                        # Down-stack admission (shard insert queues) sheds
+                        # by the same priority the gate admitted at — BULK
+                        # backfill that squeezed past the gate still sheds
+                        # first at a full queue, and CRITICAL replication
+                        # never sheds.
+                        self._local.priority = priority
+                        try:
+                            result = fn(**args)
+                        finally:
+                            self._local.deadline = None
+                            self._local.priority = None
+        except ResourceExhausted:
+            tracing.SLOW_QUERIES.maybe(
+                "rpc", method, time.perf_counter_ns() - t0,
+                costs=xlimits.last_scope_totals(), reason="limit-shed",
+                trace_id=sp.trace_id or None)
+            raise
+        except DeadlineExceeded:
+            tracing.SLOW_QUERIES.maybe(
+                "rpc", method, time.perf_counter_ns() - t0,
+                costs=xlimits.last_scope_totals(), reason="deadline",
+                trace_id=sp.trace_id or None)
+            raise
+        dur = time.perf_counter_ns() - t0
+        tracing.SLOW_QUERIES.maybe(
+            "rpc", method, dur,
+            # Sampled: lazy subtree rollup (cache events live on storage
+            # child spans); unsampled: the scope's charge totals.
+            costs=((lambda: tracing.collect_costs(sp)) if sp.sampled
+                   else xlimits.last_scope_totals()),
+            trace_id=sp.trace_id or None)
+        return result, (sp.to_dict() if sp.sampled else None)
 
     def _check_deadline(self, what: str):
         dl = getattr(self._local, "deadline", None)
@@ -127,7 +174,7 @@ class NodeService:
         return {
             "ok": True,
             "bootstrapped": self.db.bootstrapped,
-            "uptime_ns": time.time_ns() - self.start_ns,
+            "uptime_ns": time.monotonic_ns() - self.start_ns,
         }
 
     # ----------------------------------------------------------------- writes
@@ -454,11 +501,19 @@ class NodeServer:
                         deadline = wire.deadline_from_frame(req)
                         try:
                             pri = req.get("pri")
-                            result = svc.dispatch(req["m"], req.get("a", {}),
-                                                  deadline=deadline,
-                                                  priority_hint=pri if
-                                                  isinstance(pri, str) else None)
-                            wire.write_frame(sock, {"id": msg_id, "ok": True, "r": result})
+                            result, sp = svc.dispatch_traced(
+                                req["m"], req.get("a", {}),
+                                deadline=deadline,
+                                priority_hint=pri if
+                                isinstance(pri, str) else None,
+                                trace_ctx=wire.trace_from_frame(req))
+                            resp = {"id": msg_id, "ok": True, "r": result}
+                            if sp is not None:
+                                # Finished server-side span tree for the
+                                # caller to graft (one cross-process tree
+                                # per request).
+                                resp[wire.SPAN_KEY] = sp
+                            wire.write_frame(sock, resp)
                         except DeadlineExceeded as e:
                             # Typed error frame: the caller distinguishes
                             # "server killed it for MY deadline" (stop

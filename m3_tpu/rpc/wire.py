@@ -253,6 +253,26 @@ def deadline_from_frame(req: dict):
     return Deadline.from_wire(budget)
 
 
+# ------------------------------------------------------ trace propagation
+
+# Optional request-frame key carrying the caller's span context (trace id
+# + parent span id) — only attached for SAMPLED traces, so its presence
+# is the sampling decision and the server never rolls its own. Rides the
+# frame beside the deadline "d" and priority "pri" hints. The matching
+# RESPONSE key "sp" carries the server's finished span tree back for the
+# client to graft, making one cross-process tree per request.
+TRACE_KEY = "tr"
+SPAN_KEY = "sp"
+
+
+def trace_from_frame(req: dict):
+    """SpanContext from a request frame, or None. Malformed trace
+    metadata is treated as absent (same contract as the deadline field)."""
+    from ..utils.tracing import SpanContext
+
+    return SpanContext.from_wire(req.get(TRACE_KEY))
+
+
 # -------------------------------------------------- index query serialization
 
 

@@ -62,6 +62,16 @@ class CoordinatorConfig:
     lookback: str = "5m"
     kv_endpoint: str = ""              # standalone mode: cluster KV service
     placement_key: str = "_placement"  # dbnode placement watched for routing
+    # Self-scrape interval (e.g. "10s"): the coordinator's instrument
+    # registry written back through its own ingest path each interval
+    # (tally-self-reporting analog). Empty disables.
+    self_scrape_interval: str = ""
+
+    @property
+    def self_scrape_interval_s(self) -> Optional[float]:
+        if not self.self_scrape_interval:
+            return None
+        return parse_duration_ns(self.self_scrape_interval) / 1e9
 
 
 @dataclasses.dataclass

@@ -151,7 +151,8 @@ def run_dbnode(cfg: DBNodeConfig, clock=None) -> DBNodeHandle:
             rules_namespace=cfg.coordinator.rules_namespace.encode(),
             clock=db.clock, listen=_host_port(cfg.coordinator.listen_address),
             create_namespace=lambda name, retention_ns:
-                ns_watch.add(name, retention_ns))
+                ns_watch.add(name, retention_ns),
+            self_scrape_interval_s=cfg.coordinator.self_scrape_interval_s)
     return DBNodeHandle(db, server, persist, coordinator, kv, lock, httpjson,
                         ns_watch)
 
@@ -284,16 +285,19 @@ def run_coordinator(cfg: CoordinatorConfig, session=None, db=None,
     if (session is None) == (db is None):
         raise ValueError("exactly one of session/db required")
     listen = _host_port(cfg.listen_address)
+    scrape_s = cfg.self_scrape_interval_s
     if db is not None:
         coord = run_embedded(db, namespace=cfg.namespace.encode(),
                              kv_store=kv_store,
                              rules_namespace=cfg.rules_namespace.encode(),
-                             clock=clock, listen=listen)
+                             clock=clock, listen=listen,
+                             self_scrape_interval_s=scrape_s)
     else:
         coord = run_clustered(session, namespace=cfg.namespace.encode(),
                               kv_store=kv_store,
                               rules_namespace=cfg.rules_namespace.encode(),
-                              clock=clock, listen=listen)
+                              clock=clock, listen=listen,
+                              self_scrape_interval_s=scrape_s)
     if cfg.remotes:
         stores = [coord.engine.storage] + [RemoteStorage(r) for r in cfg.remotes]
         coord.engine.storage = FanoutStorage(stores)

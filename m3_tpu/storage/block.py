@@ -164,6 +164,8 @@ class SealedBlock:
         encode_block's, and decode is row-independent, so rows [:S] are
         bit-identical either way). Planes come back read-only — they may
         be cache-shared across readers."""
+        from ..parallel import telemetry
+
         s = len(self.series_indices)
         if encoded is not None:
             words, npoints = encoded
@@ -174,6 +176,10 @@ class SealedBlock:
                 words = np.concatenate([words, np.repeat(words[:1], sp - s, 0)])
                 npoints = np.concatenate(
                     [npoints, np.repeat(npoints[:1], sp - s)])
+        telemetry.record_bucket(
+            "block.decode_plane",
+            (int(np.asarray(words).shape[0]),
+             int(np.asarray(words).shape[-1]), int(self.window)))
         ts, vals = tsz.decode(words, npoints, window=self.window)
         ts = ts[:s] * self.time_unit.nanos
         vals = np.ascontiguousarray(vals[:s])

@@ -49,7 +49,7 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
-from ..utils import instrument
+from ..utils import instrument, tracing
 from ..utils.hbm import HBMBudget, shared_budget
 
 __all__ = ["DeviceBlockCache", "get_cache", "active", "disabled"]
@@ -125,9 +125,13 @@ class DeviceBlockCache:
                 self._entries.move_to_end(gen)
                 self._n["hits"] += 1
                 self._hits.inc()
+                tracing.count_cost("block_cache_hit")
                 return e.decoded
             self._n["misses"] += 1
             self._misses.inc()
+            # Per-span cache attribution: a slow query whose span shows
+            # block_cache_miss > 0 gets the typed "cold-cache" reason.
+            tracing.count_cost("block_cache_miss")
             if gen in self._dead:
                 return None
             touches = self._touch.pop(gen, 0) + 1

@@ -21,7 +21,7 @@ import numpy as np
 
 from ..persist import commitlog as cl
 from ..persist.fs import FilesetReader, PersistManager
-from ..utils import xtime
+from ..utils import tracing, xtime
 from ..utils.instrument import ROOT
 from ..utils.retry import Deadline
 from .block import SealedBlock
@@ -390,23 +390,30 @@ class PeersBootstrapper(Bootstrapper):
                         if ctx.peer_deadline_s is not None else None)
             errors: Dict[str, str] = {}
             meta_errors: Dict[str, str] = {}
-            try:
-                tiles, tags_by_sid, failed = \
-                    ctx.session.fetch_block_tiles_from_peers(
-                        ns.name, shard_id, start, end,
-                        exclude_host=ctx.host_id, deadline=deadline,
-                        errors=errors, meta_errors=meta_errors)
-            except PEER_SKIP_ERRORS:
-                # Whole-shard typed transport failure (topology gone,
-                # budget spent before any peer answered): claim nothing
-                # for THIS shard, keep bootstrapping the rest.
-                _PEER_BOOT_METRICS.counter("on_error").inc()
-                continue
-            if errors or meta_errors:
-                _PEER_BOOT_METRICS.counter("on_error").inc(
-                    len(errors) + len(meta_errors))
-            # Whatever DID arrive is real data — always install it.
-            apply_peer_tiles(shard, tiles, tags_by_sid)
+            # Span per peer-streamed shard: a churn-era bootstrap under a
+            # sampled span yields one tree whose children are the peer
+            # metadata/tile RPCs (grafted server spans included), so
+            # shard-migration time is attributable per hop.
+            with tracing.child_span("bootstrap.peer_shard",
+                                    shard=shard_id) as bsp:
+                try:
+                    tiles, tags_by_sid, failed = \
+                        ctx.session.fetch_block_tiles_from_peers(
+                            ns.name, shard_id, start, end,
+                            exclude_host=ctx.host_id, deadline=deadline,
+                            errors=errors, meta_errors=meta_errors)
+                except PEER_SKIP_ERRORS:
+                    # Whole-shard typed transport failure (topology gone,
+                    # budget spent before any peer answered): claim nothing
+                    # for THIS shard, keep bootstrapping the rest.
+                    _PEER_BOOT_METRICS.counter("on_error").inc()
+                    continue
+                if errors or meta_errors:
+                    _PEER_BOOT_METRICS.counter("on_error").inc(
+                        len(errors) + len(meta_errors))
+                bsp.set_tag("blocks", sum(len(t) for t in tiles.values()))
+                # Whatever DID arrive is real data — always install it.
+                apply_peer_tiles(shard, tiles, tags_by_sid)
             if failed:
                 _PEER_BOOT_METRICS.counter("blocks_failed").inc(len(failed))
             if meta_errors:

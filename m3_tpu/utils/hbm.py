@@ -189,6 +189,11 @@ class HBMBudget:
             else jax.device_put(arr)  # m3lint: disable=unbudgeted-device-put
         # DELIBERATE raw put above: this IS the budget API's charge point.
         n = int(getattr(dev, "nbytes", getattr(arr, "nbytes", 0)))
+        # Transfer telemetry at the same choke point the budget charges
+        # (lazy import: utils must stay importable without parallel).
+        from ..parallel import telemetry
+
+        telemetry.count_h2d(n)
         with self._lock:
             self._transient += n
         try:

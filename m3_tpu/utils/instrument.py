@@ -62,9 +62,15 @@ class Histogram:
             self._n += 1
 
     def snapshot(self) -> dict:
+        # One consistent (counts, sum, n) triple under this histogram's
+        # own lock — snapshot() is called OUTSIDE the root registry lock
+        # (Scope.snapshot), so a racing record() must not tear the read.
+        with self._lock:
+            counts = list(self._counts)
+            total, n = self._sum, self._n
         return {"buckets": dict(zip([str(b) for b in self.boundaries] + ["+Inf"],
-                                    self._counts)),
-                "sum": self._sum, "count": self._n}
+                                    counts)),
+                "sum": total, "count": n}
 
 
 class Timer:
@@ -125,15 +131,21 @@ class Scope:
         return Timer(self.histogram(name))
 
     def snapshot(self) -> Dict[str, object]:
+        # Copy metric REFS under the registry lock, snapshot OUTSIDE it:
+        # Histogram.snapshot() takes its own lock, and holding the root
+        # lock across every histogram made /debug/vars an O(metrics)
+        # critical section that serialized against every _get() on the
+        # hot path (plus a nested root->histogram lock acquisition).
         root = self._root
         with root._lock:
-            out = {}
-            for key, m in sorted(root._metrics.items()):
-                if isinstance(m, (Counter, Gauge)):
-                    out[key] = m.value()
-                else:
-                    out[key] = m.snapshot()
-            return out
+            metrics = sorted(root._metrics.items())
+        out = {}
+        for key, m in metrics:
+            if isinstance(m, (Counter, Gauge)):
+                out[key] = m.value()
+            else:
+                out[key] = m.snapshot()
+        return out
 
 
 ROOT = Scope()

@@ -45,7 +45,7 @@ from .retry import RetryableError
 __all__ = [
     "ResourceExhausted", "Backpressure", "LimitOptions", "SlidingWindow",
     "QueryLimits", "QueryScope", "KINDS",
-    "charge", "get_global", "set_global",
+    "charge", "get_global", "set_global", "last_scope_totals",
 ]
 
 # Resource kinds, matching the reference's query limit trio plus the
@@ -180,6 +180,10 @@ class QueryScope:
     def __init__(self, limits: "QueryLimits", name: str):
         self.name = name
         self._limits = limits
+        # Cumulative per-kind charges for THIS scope's lifetime (the
+        # enforcers only know in-flight): the span/slow-query cost
+        # attribution — what did this request actually touch.
+        self.totals: Dict[str, float] = {}
         self._children: Dict[str, Enforcer] = {
             kind: lim.enforcer.child(
                 lim.opts.per_query
@@ -206,6 +210,7 @@ class QueryScope:
         except ResourceExhausted:
             self._children[kind].release(n)
             raise
+        self.totals[kind] = self.totals.get(kind, 0) + n
         _scope_metrics.counter(f"{kind}.charged").inc(int(n))
 
     def current(self, kind: str) -> float:
@@ -222,6 +227,19 @@ class QueryScope:
 
     def __exit__(self, *exc):
         _TLS.scope = self._prev
+        # Cost attribution on the way out: tag the active span with this
+        # scope's cumulative charges (per-span docs/bytes/datapoints) and
+        # stash them thread-local so the slow-query log can attribute
+        # costs even for UNSAMPLED requests (outermost scope wins — it
+        # exits last).
+        if self.totals:
+            from . import tracing
+
+            sp = tracing.TRACER.current()
+            if sp is not None:
+                for kind, n in self.totals.items():
+                    sp.add_cost(kind, n)
+        _TLS.last_totals = self.totals
         self.release_all()
         return False
 
@@ -292,6 +310,21 @@ def set_global(limits: QueryLimits) -> QueryLimits:
 
 def current_scope() -> Optional[QueryScope]:
     return getattr(_TLS, "scope", None)
+
+
+def last_scope_totals() -> Dict[str, float]:
+    """Cumulative charges of the most recently EXITED scope on this
+    thread — the slow-query log's cost source (a dispatch reads it right
+    after its scope closes, before any other scope runs on the thread)."""
+    return getattr(_TLS, "last_totals", None) or {}
+
+
+def reset_last_totals():
+    """Clear this thread's last-scope totals. Dispatchers call it BEFORE
+    admission/scope entry so a request shed before its scope ever runs
+    (admission gate full) attributes EMPTY costs, not the previous
+    request's — serving threads are reused."""
+    _TLS.last_totals = None
 
 
 def charge(kind: str, n: float):

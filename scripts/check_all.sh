@@ -67,12 +67,29 @@ echo "== churn smoke (SLO-under-churn: chaos + placement churn + concurrent repa
 # persisted to .jax_cache for later runs).
 JAX_PLATFORMS=cpu python scripts/churn_smoke.py --seed 7
 
+echo "== observability smoke (<10s; cross-process span tree, slow-query log, self-scrape PromQL round trip, jit telemetry) =="
+# The tracing / /debug / self-scrape plane: one 2-node clustered run
+# asserting a client->coordinator->dbnode span tree (>=3 hops, grafted
+# server spans, per-span QueryScope costs), a slow-query entry with cost
+# attribution, instrument counters queryable back via PromQL against the
+# platform's own dbnodes, and non-empty jit-compile counters. Full
+# matrix: tests/test_observability.py. Wall budget via OBS_SMOKE_BUDGET_S.
+JAX_PLATFORMS=cpu python scripts/obs_smoke.py --seed 7
+
 echo "== test suite =="
 python -m pytest tests/ -x -q
 
 echo "== multichip dryrun (virtual 8-device mesh) =="
 JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun OK')"
+
+echo "== instrumentation-overhead guard (tracing <3% on write/index benches) =="
+# Tracing at default sampling (every child span REAL — harsher than
+# production) must stay within 3% of the untraced run on
+# write_path_ingest and index_fetch_tagged, and above the recorded
+# bench_baseline.json floors. ~3-4 minutes (full bench configs,
+# interleaved A/B reps). Numbers recorded in PERF.md round 10.
+python scripts/obs_overhead_guard.py
 
 echo "== fuzz campaigns =="
 JAX_PLATFORMS=cpu python scripts/fuzz_codec.py --rounds "$ROUNDS" --seed 7

@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Instrumentation-overhead bench guard (PERF.md round 10): tracing at
+default sampling must cost <3% on the two host-plane benches the spans
+ride — `write_path_ingest` (storage.write_batch child span per batch)
+and `index_fetch_tagged` (index.query child span per query).
+
+Protocol:
+  * each bench runs at its FULL default config (so the absolute floors
+    against bench_baseline.json stay meaningful), alternating modes
+    OFF, ON, OFF, ON (`OBS_GUARD_REPS` pairs, default 2), best value
+    per mode — interleaving cancels allocator/cache warmup drift, and
+    the benches' internal best-of-N damps per-run noise further;
+  * OFF = tracing's idle state: no active span, every child_span is the
+    shared NOOP (one thread-local read per call site);
+  * ON = a sampled root span active around the whole bench at default
+    sampling (M3_TPU_TRACE_SAMPLE=1), so EVERY child span on the path
+    is real — strictly harsher than production, where only sampled
+    requests pay;
+  * asserts ON >= (1 - OBS_GUARD_MAX_REGRESSION) * OFF per metric
+    (default 3%), and ON >= the recorded bench_baseline.json floor
+    (the acceptance criterion's "vs recorded baselines").
+
+Usage: python scripts/obs_overhead_guard.py
+Env: OBS_GUARD_REPS, OBS_GUARD_MAX_REGRESSION, the benches' own
+BENCH_WRITE_*/BENCH_INDEX_* knobs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("M3_TPU_TRACE_SAMPLE", "1")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main() -> int:
+    reps = int(os.environ.get("OBS_GUARD_REPS", "2"))
+    max_reg = float(os.environ.get("OBS_GUARD_MAX_REGRESSION", "0.03"))
+
+    import bench
+    from m3_tpu.utils import tracing
+
+    with open(os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "bench_baseline.json")) as f:
+        baselines = json.load(f)["metrics"]
+
+    def run(fn, traced: bool) -> dict:
+        if not traced:
+            return fn()
+        with tracing.TRACER.span("bench.obs_guard"):
+            return fn()
+
+    def series(fn, extract):
+        """Alternate OFF/ON reps; return (best_off, best_on) dicts of
+        metric -> value (max across reps per mode)."""
+        best = ({}, {})
+        for _ in range(reps):
+            for mode in (0, 1):
+                vals = extract(run(fn, traced=bool(mode)))
+                for k, v in vals.items():
+                    best[mode][k] = max(best[mode].get(k, 0.0), v)
+        return best
+
+    failures = []
+
+    def check(name, ok, detail=""):
+        print(f"  {name:58s} {'ok' if ok else 'FAIL'}"
+              f"{('  ' + detail) if detail else ''}")
+        if not ok:
+            failures.append(name)
+
+    def guard(label, off, on, floor_key):
+        for metric, off_v in off.items():
+            on_v = on[metric]
+            ratio = on_v / off_v if off_v else 1.0
+            check(f"{label}.{metric} traced within {max_reg:.0%} of untraced",
+                  ratio >= 1.0 - max_reg,
+                  f"off={off_v:.1f} on={on_v:.1f} ratio={ratio:.3f}")
+        floor = baselines.get(floor_key)
+        head = next(iter(on.values()))
+        if floor:
+            check(f"{label} traced beats recorded baseline",
+                  head >= floor, f"on={head:.1f} floor={floor:.1f}")
+
+    print("== index_fetch_tagged (traced vs untraced) ==")
+    off, on = series(
+        bench.bench_index_fetch_tagged,
+        lambda r: {"warm_qps": float(r["value"]),
+                   "cold_qps": float(r["extra"]["cold_qps"])})
+    guard("index_fetch_tagged", off, on, "index_fetch_tagged")
+
+    print("== write_path_ingest (traced vs untraced) ==")
+    off_w, on_w = series(
+        bench.bench_write_path_ingest,
+        lambda r: {"burst_dps": float(r["value"]),
+                   "steady_dps": float(r["extra"]["steady_dps"])})
+    guard("write_path_ingest",
+          {"burst_dps": off_w["burst_dps"]},
+          {"burst_dps": on_w["burst_dps"]}, "write_path_ingest")
+    guard("write_path_ingest",
+          {"steady_dps": off_w["steady_dps"]},
+          {"steady_dps": on_w["steady_dps"]}, "write_path_ingest_steady")
+
+    out = {
+        "index_fetch_tagged": {"off": off, "on": on},
+        "write_path_ingest": {"off": off_w, "on": on_w},
+    }
+    print(json.dumps(out, indent=1))
+    print(f"obs overhead guard: {len(failures)} failure(s)")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,6 +17,7 @@ from m3_tpu.analysis.jax_rules import (ItemInLoopRule, JaxPurityRule,
                                        NonStaticJitCacheRule)
 from m3_tpu.analysis.lock_rules import HotLoopUnderLockRule, LockDisciplineRule
 from m3_tpu.analysis.hbm_rules import UnbudgetedDevicePutRule
+from m3_tpu.analysis.obs_rules import WallClockLatencyRule
 from m3_tpu.analysis.overload_rules import UnboundedQueueRule
 from m3_tpu.analysis.retry_rules import (BroadExceptWireIORule,
                                          RawSleepRetryRule)
@@ -1273,6 +1274,98 @@ class TestHotLoopUnderLock:
                             self.map.insert(it)  # m3lint: disable=hot-loop-under-lock
         """
         assert lint(src, HotLoopUnderLockRule(),
+                    "m3_tpu/storage/mod.py") == []
+
+
+class TestObsRules:
+    # the EXACT pre-fix rpc/node_server.py shape: uptime measured as a
+    # wall-clock delta across methods (assignment in __init__, the
+    # subtraction in a handler) — the rule's seeded positive.
+    PRE_FIX_UPTIME = """
+        import time
+
+        class NodeService:
+            def __init__(self):
+                self.start_ns = time.time_ns()
+
+            def rpc_health(self):
+                return {"uptime_ns": time.time_ns() - self.start_ns}
+    """
+
+    def test_flags_pre_fix_uptime_pattern(self):
+        found = lint(self.PRE_FIX_UPTIME, WallClockLatencyRule(),
+                     "m3_tpu/rpc/mod.py")
+        assert rule_ids(found) == ["wall-clock-latency"]
+
+    def test_flags_direct_latency_delta(self):
+        src = """
+            import time
+
+            def handle(fn):
+                t0 = time.time()
+                fn()
+                return time.time() - t0
+        """
+        found = lint(src, WallClockLatencyRule(), "m3_tpu/storage/mod.py")
+        assert rule_ids(found) == ["wall-clock-latency"]
+
+    def test_flags_bare_import_form(self):
+        src = """
+            from time import time
+
+            def measure(fn):
+                start = time()
+                fn()
+                return time() - start
+        """
+        found = lint(src, WallClockLatencyRule(), "m3_tpu/msg/mod.py")
+        assert rule_ids(found) == ["wall-clock-latency"]
+
+    def test_perf_counter_delta_is_fine(self):
+        src = """
+            import time
+
+            def handle(fn):
+                t0 = time.perf_counter()
+                fn()
+                return time.perf_counter() - t0
+        """
+        assert lint(src, WallClockLatencyRule(),
+                    "m3_tpu/storage/mod.py") == []
+
+    def test_wall_reads_and_range_arithmetic_are_fine(self):
+        # data timestamps and range math read the wall clock without
+        # measuring elapsed time: a single wall operand never flags.
+        src = """
+            import time
+
+            def default_range(window_s):
+                end = time.time()
+                start = end - window_s
+                return start, end
+
+            def stamp():
+                return time.time_ns()
+        """
+        assert lint(src, WallClockLatencyRule(),
+                    "m3_tpu/query/mod.py") == []
+
+    def test_out_of_scope_dirs_skipped(self):
+        found = lint(self.PRE_FIX_UPTIME, WallClockLatencyRule(),
+                     "m3_tpu/coordinator/mod.py")
+        assert found == []
+
+    def test_suppression_silences(self):
+        src = """
+            import time
+
+            def handle(fn):
+                t0 = time.time()
+                fn()
+                # DELIBERATE: test fixture comparing against wall stamps
+                return time.time() - t0  # m3lint: disable=wall-clock-latency
+        """
+        assert lint(src, WallClockLatencyRule(),
                     "m3_tpu/storage/mod.py") == []
 
 
