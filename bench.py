@@ -367,6 +367,93 @@ def bench_promql():
     }
 
 
+def bench_promql_plan_agg():
+    """Round 11: multi-shard grouped aggregation through the query engine —
+    sum by (host) (rate(m[5m])) over ALL shards, the dashboard fan-in shape
+    the per-shard sharded-agg fast path can't touch (grouping forces the
+    host fan-in pre-plan-compiler: per-series rate kernel, full [S, T_out]
+    result materialization, then a separate grouped reduce). The plan
+    compiler fuses the whole physical plan into ONE program whose only
+    host transfer is the [G, T_out] answer."""
+    from m3_tpu.query import Engine
+
+    n = int(os.environ.get("BENCH_PLAN_SERIES", "10000"))
+    hosts = int(os.environ.get("BENCH_PLAN_HOSTS", "200"))
+    iters = int(os.environ.get("BENCH_PLAN_ITERS", "5"))
+    s_ns = 1_000_000_000
+    npts = 360  # 1h @ 10s
+    rng = np.random.default_rng(17)
+    t = (1_700_000_000 * s_ns + np.arange(npts, dtype=np.int64) * 10 * s_ns)
+    vals = np.cumsum(rng.poisson(5.0, (n, npts)), axis=1).astype(np.float64)
+
+    series = {}
+    for i in range(n):
+        host = b"host-%03d" % (i % hosts)
+        sid = b"bench_requests{host=%s,i=%d}" % (host, i)
+        series[sid] = {
+            "tags": {b"__name__": b"bench_requests", b"host": host,
+                     b"i": str(i).encode()},
+            "t": t, "v": vals[i],
+        }
+
+    class _Storage:
+        def fetch_raw(self, matchers, start_ns, end_ns):
+            return series
+
+    eng = Engine(_Storage())
+    start = int(t[30])
+    end = int(t[-1])
+    step = 30 * s_ns
+    q = "sum by (host) (rate(bench_requests[5m]))"
+
+    def run_query(e):
+        return e.execute_range(q, start, end, step)
+
+    _phase("plan_agg: compiling")
+    b = run_query(eng)
+    assert b.n_series == hosts, b.n_series
+    vals_first = np.asarray(b.values)
+    _phase("plan_agg: steady state")
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = run_query(eng)
+        out.values  # materialize
+    dt = (time.perf_counter() - t0) / iters
+    _phase("plan_agg: done")
+    dps = n * npts / dt
+    # Route attribution: did the steady state actually run compiled plans?
+    from m3_tpu.utils.instrument import ROOT
+
+    snap = ROOT.snapshot()
+    compiled = {k: v for k, v in snap.items()
+                if k.startswith(("query.plan", "telemetry.plan_cache"))}
+    extra = {
+        "series": n, "hosts": hosts, "points_per_series": npts,
+        "query": q, "steps": int(out.meta.steps),
+        "query_ms": round(dt * 1000, 2),
+        "plan_counters": {k: v for k, v in sorted(compiled.items())},
+    }
+    # Compiled-vs-interpreter equivalence asserted in-bench when the
+    # compiled route exists (post-change builds): the retained interpreter
+    # is the oracle.
+    if hasattr(eng, "execute_range_ref"):
+        ref = eng.execute_range_ref(q, start, end, step)
+        order = {bytes(t.id()): i for i, t in enumerate(ref.series_tags)}
+        got = np.asarray(out.values)
+        idx = [order[bytes(t.id())] for t in out.series_tags]
+        assert np.allclose(got, np.asarray(ref.values)[idx],
+                           rtol=1e-5, atol=1e-8, equal_nan=True), (
+            "compiled plan diverged from the interpreter oracle")
+        extra["oracle"] = "interpreter execute_range_ref, rtol 1e-5"
+    del vals_first
+    return {
+        "metric": "promql_plan_agg",
+        "value": round(dps, 1),
+        "unit": "datapoints/sec",
+        "extra": extra,
+    }
+
+
 def bench_timer_quantiles():
     """BASELINE config #4: batched timer quantile rollups (exact sort-based
     replacement for the reference's CM quantile sketches)."""
@@ -1121,6 +1208,7 @@ _BENCHES = [
     ("m3tsz_encode_1m_rollup", bench_encode_rollup),
     ("counter_gauge_rollup", bench_counter_gauge),
     ("promql_rate_sum_over_time_1h", bench_promql),
+    ("promql_plan_agg", bench_promql_plan_agg),
     ("timer_quantile_rollup", bench_timer_quantiles),
     ("shard_flush_merge", bench_flush_merge),
     ("index_fetch_tagged", bench_index_fetch_tagged),

@@ -15,7 +15,20 @@ Rules:
                        wall-clock call or a name/attribute assigned from
                        one — i.e. an elapsed computation.
 
-The pre-fix seeded positive was rpc/node_server.py's uptime
+  host-sync-in-plan    a host synchronization (`np.asarray`,
+                       `jax.device_get`, `.item()`) inside the whole-plan
+                       compiler's lowering surface (parallel/compile.py's
+                       `_lower_*` / `_emit` rules and the traced `body`
+                       they build). The lowering rules run UNDER JAX
+                       TRACE: a host sync there re-introduces the per-op
+                       "dispatch one kernel, pull the result to the host,
+                       dispatch the next" round trip the plan compiler
+                       exists to remove (the pre-change per-op executor
+                       dispatch is the seeded positive shape). Host
+                       finishes belong in `execute()` AFTER the compiled
+                       program returns, never inside a lowering rule.
+
+The wall-clock pre-fix seeded positive was rpc/node_server.py's uptime
 (`time.time_ns() - self.start_ns` with `self.start_ns = time.time_ns()`),
 fixed to monotonic_ns in the same pass. Tree is at 0 findings.
 """
@@ -110,4 +123,68 @@ class WallClockLatencyRule(Rule):
                 "latency/uptime/backoff measurements")
 
 
-RULES: List[Rule] = [WallClockLatencyRule()]
+_SYNC_CALLS = {"np.asarray", "numpy.asarray", "jax.device_get"}
+_SYNC_BARE = {"asarray": ("numpy", "np"), "device_get": ("jax",)}
+
+
+class HostSyncInPlanRule(Rule):
+    """host-sync-in-plan: a traced-value host sync inside a whole-plan
+    lowering rule."""
+
+    id = "host-sync-in-plan"
+    severity = "error"
+    dirs = ("parallel",)
+
+    _LOWER_NAMES = ("_emit", "body")
+
+    @classmethod
+    def _is_lowering_fn(cls, node: ast.AST) -> bool:
+        return (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and (node.name.startswith("_lower")
+                     or node.name in cls._LOWER_NAMES))
+
+    @staticmethod
+    def _bare_sync_names(mod: Module) -> Set[str]:
+        """Names bound by `from numpy import asarray` / `from jax import
+        device_get` (with aliases)."""
+        out: Set[str] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ImportFrom):
+                for a in node.names:
+                    mods = _SYNC_BARE.get(a.name)
+                    if mods and node.module in mods:
+                        out.add(a.asname or a.name)
+        return out
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        # The lowering surface exists only in the plan compiler module;
+        # execute()'s post-program host finish is the legitimate sync
+        # point and must not trip the rule.
+        if not mod.scope_parts or mod.scope_parts[-1] != "compile.py":
+            return
+        bare = self._bare_sync_names(mod)
+        seen: Set[int] = set()
+        for fn in ast.walk(mod.tree):
+            if not self._is_lowering_fn(fn):
+                continue
+            for node in ast.walk(fn):
+                if id(node) in seen or not isinstance(node, ast.Call):
+                    continue
+                seen.add(id(node))
+                q = qualname(node.func)
+                is_item = (isinstance(node.func, ast.Attribute)
+                           and node.func.attr == "item")
+                if not (q in _SYNC_CALLS or q in bare or is_item):
+                    continue
+                what = "`.item()`" if is_item else f"`{q}`"
+                yield self.finding(
+                    mod, node,
+                    f"{what} inside lowering rule `{fn.name}` syncs a "
+                    "traced value to the host mid-plan — this is the "
+                    "per-op dispatch round trip the whole-plan compiler "
+                    "removes; keep lowering rules pure jnp/lax and do "
+                    "host finishes in execute() after the compiled "
+                    "program returns")
+
+
+RULES: List[Rule] = [WallClockLatencyRule(), HostSyncInPlanRule()]

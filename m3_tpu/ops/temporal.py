@@ -324,9 +324,12 @@ def center(values: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
     return resid, baseline
 
 
-def _window_volume(resid, W: int):
-    T_out = resid.shape[1] - W + 1
-    idx = jnp.arange(T_out)[:, None] + jnp.arange(W)[None, :]
+def _window_volume(resid, W: int, stride: int = 1):
+    """[S, T_out, W] gather of every stride-th window (window k starts at
+    cell k*stride). Striding the INDEX — not the output — does stride-x
+    less gather work for the same per-window values."""
+    T_out = (resid.shape[1] - W) // stride + 1
+    idx = (jnp.arange(T_out) * stride)[:, None] + jnp.arange(W)[None, :]
     return resid[:, idx]  # [S, T_out, W]
 
 
@@ -351,8 +354,10 @@ def _take_w(vol, idx):
 # instead (~200ms -> ~0ms at 10k series x 139 cells x W=30 on a v5e).
 
 
-def _wsum(x, W: int):
-    """Windowed sum over the last axis, windows ending at cells W-1..T-1.
+def _wsum(x, W: int, stride: int = 1):
+    """Windowed sum over the last axis, windows ending at cells W-1..T-1
+    (every stride-th window — XLA's native window_strides computes ONLY
+    those, the same per-window accumulation as stride 1 + slice).
 
     reduce_window, NOT a cumsum difference: a global f32 cumsum over a
     high-total grid (bytes counters reach ~1e13, ulp ~2e6) cancels
@@ -360,21 +365,23 @@ def _wsum(x, W: int):
     reduce_window accumulates only the W cells of each window, so error
     stays at W ulps of the window's own sum."""
     return jax.lax.reduce_window(
-        x.astype(_F32), 0.0, jax.lax.add, (1, W), (1, 1), "valid")
+        x.astype(_F32), 0.0, jax.lax.add, (1, W), (1, stride), "valid")
 
 
-def _first_abs(finite, W: int):
+def _first_abs(finite, W: int, stride: int = 1):
     """Absolute index of each window's first valid cell (T when empty)."""
     T = finite.shape[-1]
     idxv = jnp.where(finite, jnp.arange(T, dtype=jnp.int32), T)
-    return jax.lax.reduce_window(idxv, T, jax.lax.min, (1, W), (1, 1), "valid")
+    return jax.lax.reduce_window(idxv, T, jax.lax.min, (1, W), (1, stride),
+                                 "valid")
 
 
-def _last_abs(finite, W: int):
+def _last_abs(finite, W: int, stride: int = 1):
     """Absolute index of each window's last valid cell (-1 when empty)."""
     T = finite.shape[-1]
     idxv = jnp.where(finite, jnp.arange(T, dtype=jnp.int32), -1)
-    return jax.lax.reduce_window(idxv, -1, jax.lax.max, (1, W), (1, 1), "valid")
+    return jax.lax.reduce_window(idxv, -1, jax.lax.max, (1, W), (1, stride),
+                                 "valid")
 
 
 def _take_t(grid, abs_idx):
@@ -409,15 +416,19 @@ def rate_math(adj, finite, grid32=None, *, W, step_s, range_s, is_counter,
     """The traceable body of the fused rate kernel — importable by sharded
     query paths (m3_tpu/parallel/query.py wraps it in shard_map)."""
     T = finite.shape[-1]
-    t_off = jnp.arange(T - W + 1, dtype=jnp.int32)[None, :]
-    cnt = _wsum(finite, W)
-    fa = _first_abs(finite, W)
-    la = _last_abs(finite, W)
+    T_out = (T - W) // stride + 1
+    # Strided from the primitives down: every windowed reduce and the
+    # whole finish ladder below run on [S, T_out], not [S, T-W+1] — the
+    # per-window values are the ones stride-1 + slice would produce.
+    t_off = (jnp.arange(T_out, dtype=jnp.int32) * stride)[None, :]
+    cnt = _wsum(finite, W, stride)
+    fa = _first_abs(finite, W, stride)
+    la = _last_abs(finite, W, stride)
     # Only cells strictly after the window's first valid sample
     # contribute — their previous-valid reference is inside the window,
     # so the window increase is the full adj sum minus the first valid
     # cell's adj (whose reference precedes the window).
-    increase = _wsum(adj, W) - _take_t(adj, fa)
+    increase = _wsum(adj, W, stride) - _take_t(adj, fa)
     ok = cnt >= 2
     fcnt = cnt
     fi = (fa - t_off).astype(_F32)
@@ -442,7 +453,7 @@ def rate_math(adj, finite, grid32=None, *, W, step_s, range_s, is_counter,
     out = increase * (extrap / jnp.where(sampled > 0, sampled, 1.0))
     if is_rate:
         out = out / range_s
-    return jnp.where(ok & (sampled > 0), out, jnp.nan)[..., ::stride]
+    return jnp.where(ok & (sampled > 0), out, jnp.nan)
 
 
 def _host_diff_grid(grid: np.ndarray, is_counter: bool):
@@ -628,28 +639,30 @@ _OVER_TIME_STATS = {
 }
 
 
-def _window_stat(resid, W: int, stat: str):
-    """Shared masked window-moment core: (stat plane, count plane)."""
+def _window_stat(resid, W: int, stat: str, stride: int = 1):
+    """Shared masked window-moment core: (stat plane, count plane),
+    consolidated to every stride-th window at the primitives."""
     mask = jnp.isfinite(resid)
-    cnt = _wsum(mask, W)
+    cnt = _wsum(mask, W, stride)
     if stat == "count":
         out = cnt
     elif stat == "sum":
-        out = _wsum(jnp.where(mask, resid, 0.0), W)
+        out = _wsum(jnp.where(mask, resid, 0.0), W, stride)
     elif stat == "min":
         out = jax.lax.reduce_window(
             jnp.where(mask, resid, jnp.inf), jnp.inf, jax.lax.min,
-            (1, W), (1, 1), "valid")
+            (1, W), (1, stride), "valid")
     elif stat == "max":
         out = jax.lax.reduce_window(
             jnp.where(mask, resid, -jnp.inf), -jnp.inf, jax.lax.max,
-            (1, W), (1, 1), "valid")
+            (1, W), (1, stride), "valid")
     elif stat == "last":
-        out = _take_t(jnp.where(mask, resid, 0.0), _last_abs(mask, W))
+        out = _take_t(jnp.where(mask, resid, 0.0),
+                      _last_abs(mask, W, stride))
     elif stat == "m2":
         # Two-pass over the window volume: the cumsum sumsq-minus-mean
         # form cancels catastrophically in f32 when |mu| >> sigma.
-        vol = _window_volume(resid, W)
+        vol = _window_volume(resid, W, stride)
         vmask = jnp.isfinite(vol)
         s = jnp.where(vmask, vol, 0.0).sum(axis=-1)
         mu = s / jnp.maximum(cnt, 1)
@@ -691,8 +704,7 @@ def _window_stat_strided(resid, W: int, stat: str, stride: int):
         if (stat in pallas_window.STATS
                 and t_out <= pallas_window.MAX_UNROLL_STEPS):
             return pallas_window.window_stat(resid, W, stride, stat)
-    out, cnt = _window_stat(resid, W, stat)
-    return out[..., ::stride], cnt[..., ::stride]
+    return _window_stat(resid, W, stat, stride)
 
 
 @telemetry.jit_builder("over_time")
@@ -733,6 +745,17 @@ def _finish_over_time(xp, kind: str, stat, cnt, b):
     raise ValueError(f"unknown over_time kind {kind!r}")
 
 
+def over_time_math(resid, base32, *, W: int, kind: str, stride: int = 1):
+    """Traceable *_over_time body (stat + baseline correction + NaN mask,
+    all on device, f32): the fusable prepared form the whole-plan compiler
+    (parallel/compile.py) fuses into one program, and the body of the
+    standalone fully-fused kernel below."""
+    stat_name = _OVER_TIME_STATS[kind]
+    stat, cnt = _window_stat_strided(resid, W, stat_name, stride)
+    out = _finish_over_time(jnp, kind, stat, cnt, base32[:, None])
+    return jnp.where(cnt > 0, out, jnp.nan).astype(_F32)
+
+
 @telemetry.jit_builder("over_time_finish")
 @functools.lru_cache(maxsize=256)
 def _over_time_finish_fn(W: int, kind: str, stride: int = 1):
@@ -742,14 +765,9 @@ def _over_time_finish_fn(W: int, kind: str, stride: int = 1):
     transfer is the floor; precision is that of the f32 result itself
     (baseline products round at f32, ~1e-7 relative — recorded in
     DIVERGENCES.md), which is why small blocks keep the exact host finish."""
-    stat_name = _OVER_TIME_STATS[kind]
 
-    def fn(resid, base32):
-        stat, cnt = _window_stat_strided(resid, W, stat_name, stride)
-        out = _finish_over_time(jnp, kind, stat, cnt, base32[:, None])
-        return jnp.where(cnt > 0, out, jnp.nan).astype(_F32)
-
-    return jax.jit(fn)
+    return jax.jit(functools.partial(over_time_math, W=W, kind=kind,
+                                     stride=stride))
 
 
 # A result grid this big is transfer-bound on a tunneled accelerator, so
@@ -855,26 +873,30 @@ def quantile_over_time(grid: np.ndarray, W: int, q: float,
     return np.where(cnt > 0, out, np.nan)
 
 
+def changes_resets_math(resid, *, W: int, count_resets: bool,
+                        stride: int = 1):
+    """Traceable changes()/resets() body — fusable prepared form."""
+    vol = _window_volume(resid, W, stride)
+    mask = jnp.isfinite(vol)
+    filled = _ffill(vol, mask)
+    prev = jnp.concatenate([filled[..., :1], filled[..., :-1]], axis=-1)
+    first_i, _, cnt = _first_last(mask)
+    after_first = jnp.arange(W) > first_i[..., None]
+    valid_pair = mask & after_first
+    d = vol - prev
+    if count_resets:
+        hits = valid_pair & (d < 0)
+    else:
+        hits = valid_pair & (d != 0)
+    return jnp.where(cnt > 0, hits.sum(axis=-1).astype(_F32), jnp.nan)
+
+
 @telemetry.jit_builder("changes_resets")
 @functools.lru_cache(maxsize=256)
 def _changes_resets_fn(W: int, count_resets: bool, stride: int = 1):
-    def fn(resid):
-        vol = _window_volume(resid, W)
-        mask = jnp.isfinite(vol)
-        filled = _ffill(vol, mask)
-        prev = jnp.concatenate([filled[..., :1], filled[..., :-1]], axis=-1)
-        first_i, _, cnt = _first_last(mask)
-        after_first = jnp.arange(W) > first_i[..., None]
-        valid_pair = mask & after_first
-        d = vol - prev
-        if count_resets:
-            hits = valid_pair & (d < 0)
-        else:
-            hits = valid_pair & (d != 0)
-        out = jnp.where(cnt > 0, hits.sum(axis=-1).astype(_F32), jnp.nan)
-        return out[..., ::stride]
-
-    return jax.jit(fn)
+    return jax.jit(functools.partial(changes_resets_math, W=W,
+                                     count_resets=count_resets,
+                                     stride=stride))
 
 
 def changes(grid: np.ndarray, W: int, stride: int = 1) -> np.ndarray:
@@ -887,38 +909,45 @@ def resets(grid: np.ndarray, W: int, stride: int = 1) -> np.ndarray:
     return np.asarray(_changes_resets_fn(W, True, stride)(resid))
 
 
+def regression_math(resid, *, W: int, step_s: float,
+                    predict_offset_s: float, is_deriv: bool,
+                    stride: int = 1):
+    """Traceable deriv()/predict_linear() body — fusable prepared form.
+    Least-squares over valid (t, v) window points; t relative to the
+    window's first valid sample for stability (promql linearRegression;
+    temporal/linear_regression.go). predict_linear results are in
+    RESIDUAL space: callers add the per-series baseline back."""
+    vol = _window_volume(resid, W, stride)
+    mask = jnp.isfinite(vol)
+    first_i, last_i, cnt = _first_last(mask)
+    ok = cnt >= 2
+    t = (jnp.arange(W)[None, None, :] - first_i[..., None]).astype(_F32) * step_s
+    tm = jnp.where(mask, t, 0.0)
+    v = jnp.where(mask, vol, 0.0)
+    n = cnt.astype(_F32)
+    st = tm.sum(-1)
+    sv = v.sum(-1)
+    stt = (tm * tm).sum(-1)
+    stv = (tm * v).sum(-1)
+    denom = n * stt - st * st
+    slope = jnp.where(denom != 0, (n * stv - st * sv) / denom, jnp.nan)
+    if is_deriv:
+        return jnp.where(ok, slope, jnp.nan)
+    intercept = (sv - slope * st) / n
+    # Evaluate at output time + offset: output time is the last window
+    # cell, i.e. t = (W-1-first_i)*step relative to the reference point.
+    t_eval = (W - 1 - first_i).astype(_F32) * step_s + predict_offset_s
+    return jnp.where(ok, intercept + slope * t_eval, jnp.nan)
+
+
 @telemetry.jit_builder("regression")
 @functools.lru_cache(maxsize=256)
 def _regression_fn(W: int, step_s: float, predict_offset_s: float,
                    is_deriv: bool, stride: int = 1):
-    """Least-squares over valid (t, v) window points; t relative to the
-    window's first valid sample for stability (promql linearRegression;
-    temporal/linear_regression.go)."""
-
-    def fn(resid):
-        vol = _window_volume(resid, W)
-        mask = jnp.isfinite(vol)
-        first_i, last_i, cnt = _first_last(mask)
-        ok = cnt >= 2
-        t = (jnp.arange(W)[None, None, :] - first_i[..., None]).astype(_F32) * step_s
-        tm = jnp.where(mask, t, 0.0)
-        v = jnp.where(mask, vol, 0.0)
-        n = cnt.astype(_F32)
-        st = tm.sum(-1)
-        sv = v.sum(-1)
-        stt = (tm * tm).sum(-1)
-        stv = (tm * v).sum(-1)
-        denom = n * stt - st * st
-        slope = jnp.where(denom != 0, (n * stv - st * sv) / denom, jnp.nan)
-        if is_deriv:
-            return jnp.where(ok, slope, jnp.nan)[..., ::stride]
-        intercept = (sv - slope * st) / n
-        # Evaluate at output time + offset: output time is the last window
-        # cell, i.e. t = (W-1-first_i)*step relative to the reference point.
-        t_eval = (W - 1 - first_i).astype(_F32) * step_s + predict_offset_s
-        return jnp.where(ok, intercept + slope * t_eval, jnp.nan)[..., ::stride]
-
-    return jax.jit(fn)
+    return jax.jit(functools.partial(
+        regression_math, W=W, step_s=step_s,
+        predict_offset_s=predict_offset_s, is_deriv=is_deriv,
+        stride=stride))
 
 
 def deriv(grid: np.ndarray, W: int, step_ns: int,
@@ -936,11 +965,12 @@ def predict_linear(grid: np.ndarray, W: int, step_ns: int,
     return out + base[:, None]
 
 
-@telemetry.jit_builder("holt_winters")
-@functools.lru_cache(maxsize=256)
-def _holt_winters_fn(W: int, sf: float, tf: float, stride: int = 1):
-    """Double exponential smoothing (temporal/holt_winters.go; promql
-    holt_winters): scan over the window, skipping invalid cells."""
+def holt_winters_math(resid, *, W: int, sf: float, tf: float,
+                      stride: int = 1):
+    """Traceable holt_winters body — fusable prepared form. Double
+    exponential smoothing (temporal/holt_winters.go; promql holt_winters):
+    scan over the window, skipping invalid cells. Results are in RESIDUAL
+    space: callers add the per-series baseline back."""
 
     def one_window(win, mask):
         def step(carry, xm):
@@ -958,12 +988,16 @@ def _holt_winters_fn(W: int, sf: float, tf: float, stride: int = 1):
         (s, b, n), _ = jax.lax.scan(step, (0.0, 0.0, 0), (win, mask))
         return jnp.where(n >= 2, s, jnp.nan)
 
-    def fn(resid):
-        vol = _window_volume(resid, W)
-        mask = jnp.isfinite(vol)
-        return jax.vmap(jax.vmap(one_window))(vol, mask)[..., ::stride]
+    vol = _window_volume(resid, W, stride)
+    mask = jnp.isfinite(vol)
+    return jax.vmap(jax.vmap(one_window))(vol, mask)
 
-    return jax.jit(fn)
+
+@telemetry.jit_builder("holt_winters")
+@functools.lru_cache(maxsize=256)
+def _holt_winters_fn(W: int, sf: float, tf: float, stride: int = 1):
+    return jax.jit(functools.partial(holt_winters_math, W=W, sf=float(sf),
+                                     tf=float(tf), stride=stride))
 
 
 def holt_winters(grid: np.ndarray, W: int, sf: float, tf: float,

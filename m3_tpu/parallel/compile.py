@@ -1,0 +1,731 @@
+"""Whole-plan compilation: one jitted program per PromQL physical plan
+over the shard x time mesh (ROADMAP item 1; the Titanax
+compile_step_with_plan shape from SNIPPETS.md [3]).
+
+The interpreter (query/executor.py, retained as the oracle
+`Engine.execute_range_ref`) dispatches one jitted kernel per temporal op
+per block with host round trips between operators and a fully host-side
+aggregation fan-in. Here the plan IR (query/plan.py) lowers into ONE
+traced function: operator chains fuse, cross-shard aggregation fan-in
+becomes XLA collectives (psum/pmin/pmax over ICI via shard_map_compat)
+instead of host gather, and the only device->host transfer is the final
+result. In/out shardings match the layout the selector staging places
+(rows partitioned over the mesh "shard" axis, NamedSharding
+P("shard", None)), so a staged grid feeds the program without
+repartitioning — SNIPPETS.md [1]'s advice of matching a producer's
+out_axis_resources to the consumer's in_axis_resources.
+
+Compiled executables are cached per (plan structure, pow2 shape bucket,
+mesh) — `telemetry.plan_cache` counts hits/misses/compile wall — with
+row/time padding chosen so one executable serves every query with the
+same plan shape: rows pad with NaN (masked everywhere), the time axis
+pads past the real output and the host slices it back. Selector label
+matchers are stripped from the key (one executable serves every metric
+with the same plan shape); scalar literals ride as runtime slots (one
+executable serves every threshold).
+
+Counter-sum exactness (the query/executor.py:789 contract): an
+aggregate sum/avg DIRECTLY over a raw selector decomposes each series
+as baseline + residual (ops/temporal.center). The device accumulates
+only the small f32 residuals (per-shard partials combine via psum —
+still residual-space, still small), while the baseline mass — where
+plain f32 accumulation of 1e9-magnitude counters loses the f64
+host-reduce semantics — is accounted on the host in exact f64 (group
+baseline totals minus per-missing-cell corrections).
+tests/test_plan_compile.py proves this against the interpreter oracle
+over seeded counter grids.
+
+The lowering rules (`_lower_*`) run under jax trace: they must never
+sync a traced value to the host (np.asarray / jax.device_get / .item()
+mid-plan is exactly the per-op dispatch this module replaces) — m3lint's
+`host-sync-in-plan` rule gates it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import telemetry
+from ..ops import temporal
+from ..query import plan as qplan
+from ..query import promql
+from ..query.plan import (
+    Aggregate, Binary, Fetch, InstantFunc, Plan, PlanNode, RangeFunc,
+    ScalarConst, SERIES, SCALAR, _preorder,
+)
+
+_F32 = jnp.float32
+
+
+class PlanFallback(Exception):
+    """The bound plan can't execute compiled (shape pathology, missing
+    backend feature); the executor falls back to the interpreter."""
+
+
+# --------------------------------------------------------------- geometry
+
+
+@dataclasses.dataclass(frozen=True)
+class Geometry:
+    """Static shape signature of one compiled executable: pow2 row/time
+    buckets per fetch, group buckets per aggregate, row buckets per
+    vector-vector binary (aggregate/binary entries in plan preorder)."""
+
+    t_pad: int                       # padded output steps
+    s_pads: Tuple[int, ...]          # per plan.fetches entry
+    g_pads: Tuple[int, ...]          # per Aggregate node, preorder
+    r_pads: Tuple[int, ...]          # per vv Binary node, preorder
+    n_shard: int                     # 1 = single-device
+
+
+# The aux-array ordering contract between bind(), _aux_layout(),
+# geometry_for() and execute() hangs on ONE preorder walk: plan.py's.
+def _is_vv(node: PlanNode) -> bool:
+    return (isinstance(node, Binary) and node.lhs.edge.kind == SERIES
+            and node.rhs.edge.kind == SERIES)
+
+
+def _row_bucket(s: int, n_shard: int) -> int:
+    """Rows padded to n_shard * bucket(per-device rows): the shard axis
+    divides evenly and one executable serves a half-octave bucket of
+    sizes (plan.next_bucket)."""
+    per_dev = max(1, -(-s // n_shard))
+    return n_shard * qplan.next_bucket(per_dev)
+
+
+def geometry_for(bound: "qplan.Bound", n_shard: int) -> Geometry:
+    plan = bound.plan
+    t_pad = qplan.next_bucket(plan.steps)
+    s_pads = tuple(_row_bucket(bound.fetches[f].grid.shape[0], n_shard)
+                   for f in plan.fetches)
+    nodes: List[PlanNode] = []
+    _preorder(plan.root, nodes)
+    g_pads = tuple(qplan.next_bucket(max(1, bound.aux[id(n)]["n_groups"]))
+                   for n in nodes if isinstance(n, Aggregate))
+    r_pads = tuple(qplan.next_bucket(max(1, len(bound.aux[id(n)]["many_idx"])))
+                   for n in nodes if _is_vv(n))
+    return Geometry(t_pad, s_pads, g_pads, r_pads, n_shard)
+
+
+# ---------------------------------------------------------- input staging
+
+# Which prepared arrays a fetch contributes, per consumer need, and how
+# many arrays each kind flattens to.
+#   ratec: (adj, finite, grid32)   rate/increase (ops/temporal.rate_inputs)
+#   rated: (adj, finite)           delta
+#   resid: (resid, base32)         *_over_time / regression / exact sums
+#   value: (value32,)              elementwise / binary / min-max-count
+_KIND_ARITY = {"ratec": 3, "rated": 2, "resid": 2, "value": 1}
+_RATE_COUNTER = frozenset({"rate", "increase"})
+
+
+def fetch_kinds(root: PlanNode) -> Dict[Fetch, Tuple[str, ...]]:
+    """Deterministic (sorted) set of staged-input kinds per fetch,
+    keyed by Fetch equality (equal selectors share staged inputs)."""
+    kinds: Dict[Fetch, set] = {}
+
+    def walk(node: PlanNode, consumer: Optional[PlanNode]):
+        if isinstance(node, Fetch):
+            if isinstance(consumer, RangeFunc):
+                if consumer.func in ("rate", "increase", "delta"):
+                    kind = ("ratec" if consumer.func in _RATE_COUNTER
+                            else "rated")
+                else:
+                    kind = "resid"
+            elif isinstance(consumer, Aggregate) and consumer.exact:
+                kind = "resid"
+            else:
+                kind = "value"
+            kinds.setdefault(node, set()).add(kind)
+            return
+        for fld in dataclasses.fields(node):
+            v = getattr(node, fld.name)
+            if isinstance(v, PlanNode):
+                walk(v, node)
+            elif isinstance(v, tuple):
+                for item in v:
+                    if isinstance(item, PlanNode):
+                        walk(item, node)
+
+    walk(root, None)
+    return {f: tuple(sorted(ks)) for f, ks in kinds.items()}
+
+
+def _ext_len(f: Fetch, t_pad: int) -> int:
+    """Padded extended-grid length for a fetch: long enough that the
+    strided window output covers t_pad columns. Every output step j <
+    real steps reads window cells [j*stride, j*stride + W) — real cells
+    only, so end-padding is exact."""
+    if f.role == "instant":
+        return t_pad
+    return (t_pad - 1) * f.stride + f.W
+
+
+def _pad_grid(grid: np.ndarray, s_pad: int, ext_pad: int) -> np.ndarray:
+    S, T = grid.shape
+    if S == s_pad and T == ext_pad:
+        return grid
+    out = np.full((s_pad, ext_pad), np.nan, dtype=grid.dtype)
+    out[:S, :T] = grid
+    return out
+
+
+def _stage_fetch(bf: "qplan.BoundFetch", kinds: Tuple[str, ...],
+                 s_pad: int, ext_pad: int, mesh: Optional[Mesh]):
+    """Prepared, padded, placed input arrays for one fetch — content/id
+    cached via ops/temporal's derived cache, so a repeat query (the grid
+    cache returning the same consolidated grid object, e.g. served off
+    the block cache's resident decoded planes) reuses the staged device
+    arrays without re-upload or repartitioning."""
+    mesh_tag = "1" if mesh is None else f"{mesh.shape['shard']}@{id(mesh)}"
+    kind_tag = f"plan:{','.join(kinds)}:{s_pad}x{ext_pad}:{mesh_tag}"
+
+    def build(g):
+        gp = _pad_grid(g, s_pad, ext_pad)
+        arrs: List[np.ndarray] = []
+        for kind in kinds:
+            if kind in ("ratec", "rated"):
+                adj, finite, grid32 = temporal.rate_inputs(
+                    gp, kind == "ratec")
+                arrs += [adj, finite]
+                if kind == "ratec":
+                    arrs.append(grid32)
+            elif kind == "resid":
+                resid, base = temporal.center(gp)
+                arrs += [resid, base.astype(np.float32)]
+            else:  # "value"
+                arrs.append(gp.astype(np.float32))
+        if mesh is not None:
+            sh2 = NamedSharding(mesh, P("shard", None))
+            sh1 = NamedSharding(mesh, P("shard"))
+            placed = tuple(
+                jax.device_put(a, sh1 if a.ndim == 1 else sh2)  # m3lint: disable=unbudgeted-device-put
+                for a in arrs)
+            # Charged at the canonicalized device sizes; the derived
+            # cache's HBM-budget tenant bounds the resident total.
+            return placed, sum(int(getattr(a, "nbytes", 0)) for a in placed)
+        if temporal._cache_enabled():
+            placed = tuple(temporal._placed_put(a) for a in arrs)
+            return placed, sum(int(getattr(a, "nbytes", 0)) for a in placed)
+        return tuple(arrs), 0
+
+    return temporal._derived(bf.grid, kind_tag, build)
+
+
+# --------------------------------------------------------- lowering rules
+#
+# Each _lower_* rule emits the traced computation for one plan node.
+# Everything here runs under jax trace: touching the host
+# (np.asarray / device_get / .item()) would reintroduce the per-op
+# dispatch this module exists to remove — m3lint's host-sync-in-plan
+# rule gates it.
+
+_MATH_JNP = {
+    "abs": jnp.abs, "ceil": jnp.ceil, "floor": jnp.floor, "exp": jnp.exp,
+    "sqrt": jnp.sqrt, "ln": jnp.log, "log2": jnp.log2, "log10": jnp.log10,
+    "sgn": jnp.sign, "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+    "asin": jnp.arcsin, "acos": jnp.arccos, "atan": jnp.arctan,
+    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+    "asinh": jnp.arcsinh, "acosh": jnp.arccosh, "atanh": jnp.arctanh,
+    "deg": jnp.degrees, "rad": jnp.radians,
+    "neg": lambda v: -v,
+}
+
+_BIN_JNP = {
+    "+": jnp.add, "-": jnp.subtract, "*": jnp.multiply, "/": jnp.divide,
+    "==": lambda a, b: (a == b).astype(_F32),
+    "!=": lambda a, b: (a != b).astype(_F32),
+    "<": lambda a, b: (a < b).astype(_F32),
+    ">": lambda a, b: (a > b).astype(_F32),
+    "<=": lambda a, b: (a <= b).astype(_F32),
+    ">=": lambda a, b: (a >= b).astype(_F32),
+}
+
+
+class _Ctx:
+    """Trace-time emission context: staged inputs per fetch, bind-time
+    index arrays per node path, scalar slots, mesh-axis state."""
+
+    def __init__(self, plan: Plan, geom: Geometry, fetch_ins, aux_ins,
+                 slots, sharded: bool):
+        self.plan = plan
+        self.geom = geom
+        self.fetch_ins = fetch_ins          # {Fetch: {kind: (arrays...)}}
+        self.aux_ins = aux_ins              # {path: (arrays...)}
+        self.slots = slots
+        self.sharded = sharded
+        self.cache: Dict[int, object] = {}
+        nodes: List[PlanNode] = []
+        _preorder(plan.root, nodes)
+        self.path_of = {id(n): i for i, n in enumerate(nodes)}
+        self.g_pad_of = dict(zip(
+            (id(n) for n in nodes if isinstance(n, Aggregate)),
+            geom.g_pads))
+        self.root_agg: Optional[tuple] = None   # (s, cnt) for sum/avg root
+
+
+def _lower_fetch(ctx: _Ctx, node: Fetch):
+    """A bare selector consumed as values: the absolute f32 plane,
+    sliced to the padded output grid."""
+    (value,) = ctx.fetch_ins[node]["value"]
+    return value[:, :ctx.geom.t_pad]
+
+
+def _lower_rangefunc(ctx: _Ctx, node: RangeFunc):
+    f = node.func
+    fetch = node.arg
+    W, stride = fetch.W, fetch.stride
+    step_s = node.step_ns / 1e9
+    if f in ("rate", "increase", "delta"):
+        kind = "ratec" if f in _RATE_COUNTER else "rated"
+        arrs = ctx.fetch_ins[fetch][kind]
+        grid32 = arrs[2] if f in _RATE_COUNTER else None
+        out = temporal.rate_math(
+            arrs[0], arrs[1], grid32, W=W, step_s=step_s,
+            range_s=node.range_ns / 1e9, is_counter=f in _RATE_COUNTER,
+            is_rate=f == "rate", stride=stride)
+    else:
+        resid, base32 = ctx.fetch_ins[fetch]["resid"]
+        if f.endswith("_over_time"):
+            out = temporal.over_time_math(
+                resid, base32, W=W, kind=f[:-len("_over_time")],
+                stride=stride)
+        elif f in ("changes", "resets"):
+            out = temporal.changes_resets_math(
+                resid, W=W, count_resets=f == "resets", stride=stride)
+        elif f == "deriv":
+            out = temporal.regression_math(
+                resid, W=W, step_s=step_s, predict_offset_s=0.0,
+                is_deriv=True, stride=stride)
+        elif f == "predict_linear":
+            out = temporal.regression_math(
+                resid, W=W, step_s=step_s,
+                predict_offset_s=float(node.params[0]), is_deriv=False,
+                stride=stride) + base32[:, None]
+        else:  # holt_winters (lowering admits nothing else)
+            out = temporal.holt_winters_math(
+                resid, W=W, sf=float(node.params[0]),
+                tf=float(node.params[1]), stride=stride) + base32[:, None]
+    return out[:, :ctx.geom.t_pad]
+
+
+def _lower_instantfunc(ctx: _Ctx, node: InstantFunc):
+    v = _emit(ctx, node.arg)
+    fn = _MATH_JNP.get(node.func)
+    if fn is not None:
+        return fn(v)
+    params = [ctx.slots[p.slot] for p in node.params]
+    if node.func == "round":
+        # DELIBERATE: branches on the STATIC slot arity (plan structure),
+        # not the traced slot values inside the list.
+        if not params:  # m3lint: disable=jax-traced-branch
+            return jnp.round(v)
+        return jnp.round(v / params[0]) * params[0]
+    if node.func == "clamp":
+        return jnp.clip(v, params[0], params[1])
+    if node.func == "clamp_min":
+        return jnp.maximum(v, params[0])
+    if node.func == "clamp_max":
+        return jnp.minimum(v, params[0])
+    raise PlanFallback(f"instant func {node.func}")  # pragma: no cover
+
+
+def _lower_aggregate(ctx: _Ctx, node: Aggregate):
+    """Cross-series reduce with collective fan-in (psum/pmin/pmax over
+    the mesh shard axis). Returns the collapsed f32 [G_pad, t_pad] plane;
+    a sum/avg ROOT additionally records its (residual-sum, count)
+    components so the host can finish in exact f64."""
+    (gids,) = ctx.aux_ins[ctx.path_of[id(node)]]
+    g_pad = ctx.g_pad_of[id(node)]
+    # Collectives only when the CHILD rows are partitioned over the mesh:
+    # a replicated child (an inner aggregate's output) is already whole
+    # on every device, and a psum would multiply it by the shard count.
+    fan_in = ctx.sharded and node.arg.edge.sharding == qplan.SHARDED
+    if node.exact:
+        resid, _base32 = ctx.fetch_ins[node.arg]["resid"]
+        v = resid[:, :ctx.geom.t_pad]
+    else:
+        v = _emit(ctx, node.arg)
+    mask = jnp.isfinite(v)
+    cnt = jax.ops.segment_sum(mask.astype(_F32), gids, num_segments=g_pad)
+    op = node.op
+    if op in ("sum", "avg"):
+        s = jax.ops.segment_sum(jnp.where(mask, v, 0.0), gids,
+                                num_segments=g_pad)
+        # DELIBERATE (x4 below): fan_in is static program structure — the
+        # mesh mode and the child edge's sharding annotation — fixed at
+        # trace time; the collectives are emitted or not per executable.
+        if fan_in:  # m3lint: disable=jax-traced-branch
+            s = jax.lax.psum(s, "shard")
+            cnt = jax.lax.psum(cnt, "shard")
+        if node is ctx.plan.root:
+            ctx.root_agg = (s, cnt)
+        out = s / jnp.maximum(cnt, 1) if op == "avg" else s
+        return jnp.where(cnt > 0, out, jnp.nan)
+    if fan_in:  # m3lint: disable=jax-traced-branch
+        cnt = jax.lax.psum(cnt, "shard")
+    if op == "count":
+        return jnp.where(cnt > 0, cnt, jnp.nan)
+    if op == "group":
+        return jnp.where(cnt > 0, 1.0, jnp.nan)
+    if op == "min":
+        m = jax.ops.segment_min(jnp.where(mask, v, jnp.inf), gids,
+                                num_segments=g_pad)
+        if fan_in:  # m3lint: disable=jax-traced-branch
+            m = jax.lax.pmin(m, "shard")
+        return jnp.where(cnt > 0, m, jnp.nan)
+    # max (lowering admits nothing else)
+    m = jax.ops.segment_max(jnp.where(mask, v, -jnp.inf), gids,
+                            num_segments=g_pad)
+    if fan_in:  # m3lint: disable=jax-traced-branch
+        m = jax.lax.pmax(m, "shard")
+    return jnp.where(cnt > 0, m, jnp.nan)
+
+
+def _lower_binary(ctx: _Ctx, node: Binary):
+    le, re_ = node.lhs.edge, node.rhs.edge
+    comparison = node.op in promql.COMPARISON_OPS
+    fn = _BIN_JNP[node.op]
+    if le.kind == SCALAR and re_.kind == SCALAR:
+        lv = _emit(ctx, node.lhs)
+        rv = _emit(ctx, node.rhs)
+        out = fn(lv, rv)
+        if comparison and not node.bool_mode:
+            return jnp.where(out > 0, lv, jnp.nan)
+        return out
+    if le.kind == SERIES and re_.kind == SERIES:
+        many_idx, one_idx = ctx.aux_ins[ctx.path_of[id(node)]]
+        lhs_v = _emit(ctx, node.lhs)
+        rhs_v = _emit(ctx, node.rhs)
+        many_v = rhs_v if node.swap else lhs_v
+        one_v = lhs_v if node.swap else rhs_v
+        # Index rows past the real match count pad with -1: a 0-padded
+        # gather would replay row 0's FINITE values into the padding lanes,
+        # and a downstream aggregate would fold that garbage into group 0.
+        valid = (many_idx >= 0)[:, None]
+        a = many_v[jnp.maximum(many_idx, 0)]
+        b = one_v[jnp.maximum(one_idx, 0)]
+        out = fn(b, a) if node.swap else fn(a, b)
+        if comparison and not node.bool_mode:
+            return jnp.where(valid & (out > 0), a, jnp.nan)
+        both = jnp.isfinite(a) & jnp.isfinite(b)
+        return jnp.where(valid & both, out, jnp.nan)
+    # vector <op> scalar (either side)
+    vec_left = le.kind == SERIES
+    vec = _emit(ctx, node.lhs if vec_left else node.rhs)
+    sc = _emit(ctx, node.rhs if vec_left else node.lhs)
+    out = fn(vec, sc) if vec_left else fn(sc, vec)
+    if comparison:
+        if node.bool_mode:
+            return jnp.where(jnp.isfinite(vec), out, jnp.nan)
+        return jnp.where(out > 0, vec, jnp.nan)
+    return out
+
+
+def _emit(ctx: _Ctx, node: PlanNode):
+    key = id(node)
+    # DELIBERATE: the memo is keyed on PLAN NODE identity (static DAG
+    # structure), not on any traced value.
+    if key in ctx.cache:  # m3lint: disable=jax-traced-branch
+        return ctx.cache[key]
+    if isinstance(node, Fetch):
+        val = _lower_fetch(ctx, node)
+    elif isinstance(node, RangeFunc):
+        val = _lower_rangefunc(ctx, node)
+    elif isinstance(node, InstantFunc):
+        val = _lower_instantfunc(ctx, node)
+    elif isinstance(node, Aggregate):
+        val = _lower_aggregate(ctx, node)
+    elif isinstance(node, Binary):
+        val = _lower_binary(ctx, node)
+    elif isinstance(node, ScalarConst):
+        val = ctx.slots[node.slot]
+    else:  # pragma: no cover
+        raise PlanFallback(type(node).__name__)
+    ctx.cache[key] = val
+    return val
+
+
+# -------------------------------------------------------------- compiler
+
+
+def _aux_layout(root: PlanNode) -> List[Tuple[int, int]]:
+    """(preorder path, arity) per aux-consuming node: aggregates take one
+    group-id array, vector-vector binaries take two index arrays. The
+    stager and the trace-time unflattener both follow this order."""
+    nodes: List[PlanNode] = []
+    _preorder(root, nodes)
+    out = []
+    for i, n in enumerate(nodes):
+        if isinstance(n, Aggregate):
+            out.append((i, 1))
+        elif _is_vv(n):
+            out.append((i, 2))
+    return out
+
+
+@telemetry.jit_builder("plan")
+@functools.lru_cache(maxsize=int(os.environ.get("M3_TPU_PLAN_CACHE", "128")))
+def _plan_executable(stripped: PlanNode, geom: Geometry,
+                     mesh: Optional[Mesh], kinds_sig: tuple):
+    """Build + jit ONE program for a plan structure. Keyed on the
+    matcher-stripped plan, the pow2 geometry bucket and the mesh — one
+    executable serves every query (any metric, any threshold, any series
+    count within the bucket) with this plan shape."""
+    fetches = tuple(f for f, _ in kinds_sig)
+    kinds_by_fetch = dict(kinds_sig)
+    sharded = geom.n_shard > 1
+    plan = Plan(stripped, 0, 0, fetches, sharded)
+    layout = _aux_layout(stripped)
+    root_is_sum = (isinstance(stripped, Aggregate)
+                   and stripped.op in ("sum", "avg"))
+
+    def body(fetch_flat, aux_flat, slots):
+        fetch_ins = {}
+        i = 0
+        for f in fetches:
+            per = {}
+            for kind in kinds_by_fetch[f]:
+                n = _KIND_ARITY[kind]
+                per[kind] = tuple(fetch_flat[i:i + n])
+                i += n
+            fetch_ins[f] = per
+        aux_ins = {}
+        k = 0
+        for path, arity in layout:
+            aux_ins[path] = tuple(aux_flat[k:k + arity])
+            k += arity
+        ctx = _Ctx(plan, geom, fetch_ins, aux_ins, slots, sharded)
+        root_val = _emit(ctx, plan.root)
+        extras = ctx.root_agg if root_is_sum else ()
+        return root_val, (extras if extras is not None else ())
+
+    if not sharded:
+        return jax.jit(body)
+
+    from .ingest import shard_map_compat
+
+    fetch_specs = []
+    for f in fetches:
+        for kind in kinds_by_fetch[f]:
+            for j in range(_KIND_ARITY[kind]):
+                # baseline vectors ([S]) shard on their only axis
+                one_d = kind == "resid" and j == 1
+                fetch_specs.append(P("shard") if one_d
+                                   else P("shard", None))
+    # agg group-id vectors shard with their child's rows; aggregates over
+    # replicated children take replicated ids (vv binaries never mesh)
+    nodes: List[PlanNode] = []
+    _preorder(stripped, nodes)
+    aux_specs = tuple(
+        P("shard") if n.arg.edge.sharding == qplan.SHARDED else P()
+        for n in nodes if isinstance(n, Aggregate))
+    root_edge = stripped.edge
+    out_root_spec = (P("shard", None)
+                     if root_edge.kind == SERIES
+                     and root_edge.sharding == qplan.SHARDED else P())
+    extras_spec = (P(), P()) if root_is_sum else ()
+    fn = shard_map_compat(
+        body, mesh=mesh,
+        in_specs=(tuple(fetch_specs), aux_specs, P()),
+        out_specs=(out_root_spec, extras_spec))
+    return jax.jit(fn)
+
+
+# -------------------------------------------------------------- execution
+
+
+@functools.lru_cache(maxsize=256)
+def _compile_sig(root: PlanNode, fetches: Tuple[Fetch, ...]):
+    """Matcher-stripped compile key + per-fetch staged-input kinds for a
+    plan structure — pure of (root, fetches), memoized so a repeated
+    query shape doesn't rebuild the projection every dispatch."""
+    fetch_index = {f: i for i, f in enumerate(fetches)}
+    kinds = fetch_kinds(root)
+    stripped = qplan.strip(root, fetch_index)
+    kinds_sig = tuple((qplan.strip(f, fetch_index), kinds[f])
+                      for f in fetches)
+    return stripped, kinds_sig, kinds
+
+
+def execute(bound: "qplan.Bound", mesh: Optional[Mesh]):
+    """Run one bound plan compiled: stage inputs, fetch (or build) the
+    plan executable, dispatch ONE program, host-finish. Returns
+    (values, tags, fetch_fn): scalar roots materialize `values` [steps]
+    f64 directly; series roots return fetch_fn, a closure lazily
+    materializing the [rows, steps] f64 plane (LazyBlock
+    double-buffering across a dashboard burst)."""
+    plan = bound.plan
+    sharded = (mesh is not None and plan.mesh_ok
+               and mesh.shape["shard"] > 1)
+    use_mesh = mesh if sharded else None
+    geom = geometry_for(bound, mesh.shape["shard"] if sharded else 1)
+    stripped, kinds_sig, kinds = _compile_sig(plan.root, plan.fetches)
+
+    # --- staged fetch inputs (device-resident via the derived cache)
+    fetch_flat: List = []
+    for fi, f in enumerate(plan.fetches):
+        arrs = _stage_fetch(bound.fetches[f], kinds[f], geom.s_pads[fi],
+                            _ext_len(f, geom.t_pad), use_mesh)
+        fetch_flat.extend(arrs)
+
+    # --- aux inputs (bind-time host label algebra -> index arrays)
+    nodes: List[PlanNode] = []
+    _preorder(plan.root, nodes)
+    pad_rows = _padded_rows_map(bound, geom, nodes)
+    aux_flat: List[np.ndarray] = []
+    vv_i = 0
+    for n in nodes:
+        if isinstance(n, Aggregate):
+            a = bound.aux[id(n)]
+            g = np.zeros(pad_rows[id(n.arg)], dtype=np.int32)
+            g[:len(a["group_ids"])] = a["group_ids"]
+            aux_flat.append(g)
+        elif _is_vv(n):
+            a = bound.aux[id(n)]
+            r_pad = geom.r_pads[vv_i]
+            vv_i += 1
+            mi = np.full(r_pad, -1, dtype=np.int32)
+            oi = np.full(r_pad, -1, dtype=np.int32)
+            mi[:len(a["many_idx"])] = a["many_idx"]
+            oi[:len(a["one_idx"])] = a["one_idx"]
+            aux_flat += [mi, oi]
+
+    slots = np.asarray(bound.slots, dtype=np.float32)
+    if slots.size == 0:
+        slots = np.zeros(1, dtype=np.float32)
+
+    fn = _plan_executable(stripped, geom, use_mesh, kinds_sig)
+    missed = isinstance(fn, telemetry._CompileTimed)
+    if missed:
+        telemetry.plan_cache_miss()
+    else:
+        telemetry.plan_cache_hit()
+    if sharded:
+        telemetry.mesh_dispatch("plan", cells=int(bound.total_cells))
+
+    t0 = time.perf_counter() if missed else 0.0
+    root_val, extras = fn(tuple(fetch_flat), tuple(aux_flat), slots)
+    if missed:
+        (root_val, extras) = jax.block_until_ready((root_val, extras))
+        telemetry.plan_compile_recorded(time.perf_counter() - t0)
+
+    # --- host finish
+    steps = plan.steps
+    root = plan.root
+    if root.edge.kind == SCALAR:
+        val = np.asarray(root_val, dtype=np.float64)
+        return np.full(steps, float(val)), bound.out_tags, None
+
+    n_rows = len(bound.out_tags)
+    result_bytes = n_rows * steps * (
+        8 if isinstance(root, Aggregate) and root.op in ("sum", "avg")
+        else 4)
+
+    if isinstance(root, Aggregate) and root.op in ("sum", "avg"):
+        s_dev, cnt_dev = extras
+        # The async D2H starts on the arrays fetch() actually reads (a
+        # sum/avg root finishes from its (s, cnt) components, not the
+        # collapsed root plane).
+        temporal._copy_async(s_dev, cnt_dev)
+
+        def fetch():
+            s = np.asarray(s_dev, dtype=np.float64)[:n_rows, :steps]
+            cnt = np.asarray(cnt_dev, dtype=np.float64)[:n_rows, :steps]
+            telemetry.count_d2h(result_bytes)
+            if root.exact:
+                s = s + _exact_base_contrib(bound, root, n_rows, steps)
+            out = s / np.maximum(cnt, 1) if root.op == "avg" else s
+            return np.where(cnt > 0, out, np.nan)
+
+        return None, bound.out_tags, fetch
+
+    temporal._copy_async(root_val)
+
+    def fetch():
+        telemetry.count_d2h(result_bytes)
+        # f32, like the per-op interpreter path's result planes: the
+        # padded [rows_pad, t_pad] plane is sliced, not up-converted.
+        return np.asarray(root_val)[:n_rows, :steps]
+
+    return None, bound.out_tags, fetch
+
+
+def _padded_rows_map(bound: "qplan.Bound", geom: Geometry,
+                     nodes: List[PlanNode]) -> Dict[int, int]:
+    """Padded row count of every series-valued node's output plane (the
+    length its consumer's per-row index inputs must be padded to)."""
+    plan = bound.plan
+    g_iter = iter(geom.g_pads)
+    r_iter = iter(geom.r_pads)
+    g_of: Dict[int, int] = {}
+    r_of: Dict[int, int] = {}
+    for n in nodes:
+        if isinstance(n, Aggregate):
+            g_of[id(n)] = next(g_iter)
+        elif _is_vv(n):
+            r_of[id(n)] = next(r_iter)
+
+    out: Dict[int, int] = {}
+
+    def rows(n: PlanNode) -> int:
+        key = id(n)
+        if key in out:
+            return out[key]
+        if isinstance(n, Fetch):
+            r = geom.s_pads[plan.fetches.index(n)]
+        elif isinstance(n, (RangeFunc, InstantFunc)):
+            r = rows(n.arg)
+        elif isinstance(n, Aggregate):
+            r = g_of[key]
+        elif isinstance(n, Binary):
+            if _is_vv(n):
+                r = r_of[key]
+            elif n.lhs.edge.kind == SERIES:
+                r = rows(n.lhs)
+            else:
+                r = rows(n.rhs)
+        else:
+            r = 0
+        out[key] = r
+        return r
+
+    for n in nodes:
+        rows(n)
+    return out
+
+
+def _exact_base_contrib(bound: "qplan.Bound", root: Aggregate,
+                        n_rows: int, steps: int) -> np.ndarray:
+    """Exact-f64 baseline mass for a counter sum: per-group baseline
+    totals minus the baselines of MISSING cells (host, f64 — the part
+    where f32 device accumulation of 1e9-magnitude counters would lose
+    the host-reduce semantics). The common fully-dense case costs one
+    isfinite pass; only rows with gaps pay the correction."""
+    fetch = root.arg
+    bf = bound.fetches[fetch]
+    grid = bf.grid[:, :steps]
+    finite = np.isfinite(grid)
+    _, base = temporal.center(bf.grid)
+    gids = bound.aux[id(root)]["group_ids"].astype(np.int64)
+    g = int(bound.aux[id(root)]["n_groups"])
+    base_g = np.zeros(g, dtype=np.float64)
+    np.add.at(base_g, gids, base)
+    out = np.repeat(base_g[:n_rows, None], steps, axis=1)
+    missing_rows = np.nonzero(~finite.all(axis=1))[0]
+    if missing_rows.size:
+        corr = np.zeros((g, steps), dtype=np.float64)
+        sub = np.where(finite[missing_rows], 0.0,
+                       base[missing_rows][:, None])
+        np.add.at(corr, gids[missing_rows], sub)
+        out = out - corr[:n_rows]
+    return out

@@ -141,6 +141,31 @@ def jit_builder(name: str):
     return deco
 
 
+# ------------------------------------------------------------ plan cache
+
+_PLAN_CACHE = _SCOPE.sub_scope("plan_cache")
+
+
+def plan_cache_hit():
+    """One compiled-plan executable served from the plan cache."""
+    _PLAN_CACHE.counter("hits").inc()
+
+
+def plan_cache_miss():
+    """One plan-cache miss: a fresh whole-plan trace + XLA compile is
+    about to happen (its wall time lands via plan_compile_recorded)."""
+    _PLAN_CACHE.counter("misses").inc()
+
+
+def plan_compile_recorded(seconds: float):
+    """Wall time of one whole-plan trace + compile (the first invocation
+    of a plan-cache miss), tagged onto the active span so the slow-query
+    log can attribute cold compiles."""
+    _PLAN_CACHE.counter("compiles").inc()
+    _PLAN_CACHE.histogram("compile_s", _COMPILE_BOUNDS).record(seconds)
+    tracing.count_cost("plan_compile")
+
+
 # ------------------------------------------------------------ transfers
 
 

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import os
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
@@ -225,7 +226,8 @@ class Engine:
         self._mesh = value
 
     def execute_range(self, query: str, start_ns: int, end_ns: int,
-                      step_ns: int, ast: Optional[Node] = None) -> Block:
+                      step_ns: int, ast: Optional[Node] = None,
+                      use_plan: bool = True) -> Block:
         from ..utils.instrument import ROOT
 
         ROOT.counter("query.executed").inc()
@@ -242,7 +244,8 @@ class Engine:
         try:
             with timer, sp:
                 result = self._execute_range(query, start_ns, end_ns,
-                                             step_ns, ast=ast)
+                                             step_ns, ast=ast,
+                                             use_plan=use_plan)
         except xlimits.ResourceExhausted:
             SLOW_QUERIES.maybe("query", query, time.perf_counter_ns() - t0,
                                costs=xlimits.last_scope_totals(),
@@ -268,7 +271,8 @@ class Engine:
         return result
 
     def _execute_range(self, query: str, start_ns: int, end_ns: int,
-                       step_ns: int, ast: Optional[Node] = None) -> Block:
+                       step_ns: int, ast: Optional[Node] = None,
+                       use_plan: bool = True) -> Block:
         # The HTTP layer parses once for its static type check and hands
         # the node in via `ast`; the query STRING still tags the spans.
         if ast is None:
@@ -285,19 +289,101 @@ class Engine:
                 child = self.cost_enforcer.child(self.per_query_cost_limit)
                 self._local.enforcer = child
                 try:
-                    val = self._eval(ast, params)
+                    val = self._eval_root(ast, params, use_plan)
                 finally:
                     self._local.enforcer = None
                     child.release(child.current())
             else:
-                val = self._eval(ast, params)
+                val = self._eval_root(ast, params, use_plan)
             return _to_block(val, params)
 
     def execute_instant(self, query: str, t_ns: int,
                         ast: Optional[Node] = None) -> Block:
         return self.execute_range(query, t_ns, t_ns, 1_000_000_000, ast=ast)
 
+    def execute_range_ref(self, query: str, start_ns: int, end_ns: int,
+                          step_ns: int, ast: Optional[Node] = None) -> Block:
+        """The retained per-node interpreter — the oracle the compiled
+        whole-plan route (query/plan.py -> parallel/compile.py) is proven
+        against, same pattern as PR 3's `execute_ref` and PR 7's
+        `apply_peer_tiles_ref`. Identical to execute_range with the plan
+        route forced off: every node evaluates through the _eval
+        tree-walk below, unchanged."""
+        return self.execute_range(query, start_ns, end_ns, step_ns, ast=ast,
+                                  use_plan=False)
+
     # -- evaluation --------------------------------------------------------
+
+    def _eval_root(self, node: Node, params: QueryParams,
+                   use_plan: bool) -> Value:
+        """Root dispatch: compile the WHOLE physical plan into one jitted
+        mesh program when every node lowers (query/plan.py), falling back
+        per-node to the interpreter otherwise — so a query outside the
+        compiled surface behaves exactly as before. The route (and the
+        fallback reason) is tagged onto the query span so the slow-query
+        log can attribute cold plan compiles."""
+        if use_plan and os.environ.get("M3_TPU_PLAN_DISABLE", "0") != "1":
+            # Selector overlay for the plan attempt: bind() fetches every
+            # selector through the normal charged paths; if the plan then
+            # falls back (below floor, backend gap), the interpreter
+            # re-evaluation below reuses those exact blocks instead of
+            # re-fetching (and re-charging) the storage layer.
+            self._local.sel_overlay = {}
+            try:
+                out = self._try_plan(node, params)
+                if out is not None:
+                    return out
+                return self._eval(node, params)
+            finally:
+                self._local.sel_overlay = None
+        return self._eval(node, params)
+
+    def _try_plan(self, node: Node, params: QueryParams) -> Optional[Value]:
+        from ..utils.instrument import ROOT
+        from . import plan as qplan
+
+        plan, reason, slot_values = qplan.lower_and_collect(
+            node, params, self.lookback_ns)
+        if plan is None:
+            self._tag_route("interpreter", reason)
+            return None
+        # bind() fetches + grids every selector through the SAME cached
+        # selector paths the interpreter uses and runs the host tag
+        # algebra; QueryError (matching violations) carries the
+        # interpreter's exact semantics and propagates.
+        bound = qplan.bind(plan, self, params, slot_values)
+        if bound.total_cells < qplan.PLAN_MIN_CELLS:
+            # Tiny queries keep the interpreter's exact-f64 finishes; the
+            # grids just fetched stay warm in the grid cache, so the
+            # fallback evaluation below re-reads them for free.
+            ROOT.counter("query.plan.below_floor").inc()
+            self._tag_route("interpreter", "below-plan-floor")
+            return None
+        from ..parallel import compile as pcompile
+
+        try:
+            values, tags, fetch = pcompile.execute(bound, self.mesh)
+        except pcompile.PlanFallback as e:
+            ROOT.counter("query.plan.fallback").inc()
+            self._tag_route("interpreter", str(e))
+            return None
+        ROOT.counter("query.plan.executed").inc()
+        self._tag_route("plan", "")
+        if fetch is None:
+            return values          # [steps] scalar; _to_block wraps it
+        from .block import LazyBlock
+
+        return LazyBlock(params.meta(), tags, fetch)
+
+    @staticmethod
+    def _tag_route(route: str, reason: str) -> None:
+        from ..utils import tracing
+
+        cur = getattr(tracing.TRACER._local, "current", None)
+        if cur is not None:
+            cur.set_tag("route", route)
+            if reason:
+                cur.set_tag("plan_fallback", reason)
 
     def _eval(self, node: Node, params: QueryParams) -> Value:
         if isinstance(node, NumberLiteral):
@@ -370,10 +456,24 @@ class Engine:
         return Block(params.meta(), blk.series_tags,
                      np.repeat(np.asarray(blk.values), params.steps, axis=1))
 
+    def _sel_overlay_get(self, role: str, sel: VectorSelector,
+                         params: QueryParams):
+        """One-query selector memo (plan bind -> interpreter fallback):
+        returns (key, hit). Populated only while a plan attempt is live;
+        interpreter-only queries (execute_range_ref) never see it."""
+        overlay = getattr(self._local, "sel_overlay", None)
+        if overlay is None:
+            return None, None
+        key = (role, sel, params.start_ns, params.end_ns, params.step_ns)
+        return key, overlay.get(key)
+
     def _eval_instant_selector(self, sel: VectorSelector,
                                params: QueryParams) -> Block:
         if sel.at_ns is not None:
             return self._pin_at(sel, sel, params)
+        key, hit = self._sel_overlay_get("instant", sel, params)
+        if hit is not None:
+            return hit
         off = sel.offset_ns
         meta = params.meta()
         series = self._fetch(sel, params.start_ns - self.lookback_ns - off,
@@ -381,13 +481,19 @@ class Engine:
         shifted = BlockMeta(meta.start_ns - off, meta.step_ns, meta.steps)
         tags_list, values = self._consolidate_cached(
             sel, series, shifted, self.lookback_ns)
-        return Block(meta, tags_list, values)
+        out = Block(meta, tags_list, values)
+        if key is not None:
+            self._local.sel_overlay[key] = out
+        return out
 
     def _eval_range_selector(self, sel: VectorSelector, params: QueryParams
                              ) -> Tuple[Block, int, int]:
         """Fetch + grid a matrix selector: returns (extended block at the
         window grid, W cells per window, stride to subsample back to the
         query step)."""
+        key, hit = self._sel_overlay_get("range", sel, params)
+        if hit is not None:
+            return hit
         off = sel.offset_ns
         wgrid = math.gcd(params.step_ns, sel.range_ns)
         W = sel.range_ns // wgrid
@@ -402,7 +508,10 @@ class Engine:
         # latest sample within its grid cell only.
         tags_list, values = self._consolidate_cached(
             sel, series, ext_meta, wgrid)
-        return Block(ext_meta, tags_list, values), W, stride
+        out = (Block(ext_meta, tags_list, values), W, stride)
+        if key is not None:
+            self._local.sel_overlay[key] = out
+        return out
 
     def _consolidate_cached(self, sel: VectorSelector, series: dict,
                             meta: BlockMeta, lookback_ns: int):

@@ -1,0 +1,698 @@
+"""Query plan IR: the logical->physical plan a PromQL AST lowers to
+before whole-plan compilation (reference: src/query/parser builds a
+logical DAG that executor/engine.go walks per block; here the DAG is
+lowered ONCE into a typed physical plan whose operator chain compiles to
+ONE jitted program over the shard x time mesh — parallel/compile.py).
+
+A plan is a frozen tree of physical nodes (Fetch / RangeFunc /
+InstantFunc / Aggregate / Binary / ScalarConst), each edge annotated
+with its value kind ("series" = a [S, T] block, "scalar" = a 0-d value
+broadcast over steps) and its mesh sharding ("shard" = rows partitioned
+over the mesh's shard axis, "replicated" = identical on every device).
+Sharding annotations are how the compiler picks its execution mode: a
+plan whose every series edge stays row-partitioned compiles to a
+shard_map program with collective fan-in (psum / all_gather over ICI);
+a plan needing cross-row gathers (vector-vector matching) compiles
+single-device; a plan containing any non-lowerable node doesn't compile
+at all and the executor falls back per-node to the retained interpreter
+(`Engine.execute_range_ref`, the oracle).
+
+Host/tag algebra stays OUT of the plan: `bind()` runs the label work
+(grouping, vector matching, result tags) on the host once per query and
+produces index arrays the compiled program consumes as inputs — the
+device program touches values only.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import math
+import os
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import promql
+from .model import Tags, METRIC_NAME
+from .promql import (
+    Aggregation,
+    BinaryOp,
+    Call,
+    Node as AstNode,
+    NumberLiteral,
+    Subquery,
+    Unary,
+    VectorSelector,
+)
+
+# Dispatch floor: a query fetching fewer grid cells than this stays on the
+# interpreter — tiny queries gain nothing from a compiled program and the
+# interpreter's exact-f64 finishes are the reference semantics for them
+# (same pattern as M3_TPU_MESH_FLUSH_MIN_CELLS on the flush path).
+PLAN_MIN_CELLS = int(os.environ.get("M3_TPU_PLAN_MIN_CELLS", "4096"))
+
+SERIES = "series"
+SCALAR = "scalar"
+
+SHARDED = "shard"
+REPLICATED = "replicated"
+
+
+@dataclasses.dataclass(frozen=True)
+class Edge:
+    """Type + sharding annotation of a node's output edge."""
+
+    kind: str        # SERIES | SCALAR
+    sharding: str    # SHARDED | REPLICATED
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanNode:
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class Fetch(PlanNode):
+    """A gridded selector: the consolidated [S, ext_T] grid at the window
+    grid (range role) or the step grid with lookback (instant role).
+    `sel` carries the source selector for binding; the compile key strips
+    it (the traced program depends only on the physical fields)."""
+
+    sel: VectorSelector
+    role: str                 # "range" | "instant"
+    W: int                    # cells per window (1 for instant)
+    stride: int               # window-grid cells per output step
+    wgrid_ns: int             # grid cell width
+
+    @property
+    def edge(self) -> Edge:
+        return Edge(SERIES, SHARDED)
+
+
+@dataclasses.dataclass(frozen=True)
+class RangeFunc(PlanNode):
+    """A temporal kernel over a range-gridded Fetch (ops/temporal math)."""
+
+    func: str
+    arg: Fetch
+    step_ns: int
+    range_ns: int
+    params: Tuple[float, ...] = ()
+
+    @property
+    def edge(self) -> Edge:
+        return Edge(SERIES, SHARDED)
+
+
+@dataclasses.dataclass(frozen=True)
+class InstantFunc(PlanNode):
+    """Elementwise math over a series plane (the _MATH_FUNCS subset with
+    jnp equivalents); scalar params ride as slots."""
+
+    func: str
+    arg: PlanNode
+    params: Tuple["ScalarConst", ...] = ()
+
+    @property
+    def edge(self) -> Edge:
+        return self.arg.edge
+
+
+@dataclasses.dataclass(frozen=True)
+class Aggregate(PlanNode):
+    """Cross-series aggregation; grouping structure is bind-time host
+    work, the reduce is a compensated device sum with collective fan-in.
+    exact=True marks the counter-sum path (aggregate directly over a raw
+    Fetch): residual/baseline decomposition + two-sum compensated
+    reduction preserve the interpreter's f64 host-reduce semantics."""
+
+    op: str
+    arg: PlanNode
+    grouping: Tuple[bytes, ...] = ()
+    without: bool = False
+    exact: bool = False
+
+    @property
+    def edge(self) -> Edge:
+        return Edge(SERIES, REPLICATED)
+
+
+@dataclasses.dataclass(frozen=True)
+class Binary(PlanNode):
+    op: str
+    lhs: PlanNode
+    rhs: PlanNode
+    bool_mode: bool = False
+    # vector-vector only: bind() computes row alignment; the compiled
+    # program gathers by the bound index arrays. `swap` (the many side
+    # is the RHS, i.e. group_right) is static program structure and
+    # survives compile-key stripping; the matching labels are bind-only.
+    matching: Optional[promql.VectorMatching] = None
+    swap: bool = False
+
+    @property
+    def edge(self) -> Edge:
+        le, re_ = self.lhs.edge, self.rhs.edge
+        if le.kind == SCALAR and re_.kind == SCALAR:
+            return Edge(SCALAR, REPLICATED)
+        if le.kind == SERIES and re_.kind == SERIES:
+            # vv matching needs cross-row gathers -> not mesh-shardable
+            return Edge(SERIES, REPLICATED)
+        vec = le if le.kind == SERIES else re_
+        return vec
+
+
+@dataclasses.dataclass(frozen=True)
+class ScalarConst(PlanNode):
+    """A runtime scalar slot: the VALUE is not part of the plan (so the
+    plan cache reuses one executable across thresholds); bind() records
+    slot values in plan order."""
+
+    slot: int
+
+    @property
+    def edge(self) -> Edge:
+        return Edge(SCALAR, REPLICATED)
+
+
+@dataclasses.dataclass(frozen=True)
+class Plan:
+    root: PlanNode
+    steps: int
+    n_slots: int
+    fetches: Tuple[Fetch, ...]
+    # True when every series edge stays row-partitioned (no cross-row
+    # gathers), i.e. the plan can run as ONE shard_map program with
+    # collective fan-in.
+    mesh_ok: bool
+
+
+class NotCompilable(Exception):
+    """Raised during lowering when a node falls outside the compiled
+    surface; the executor falls back to the per-node interpreter."""
+
+
+# Range functions with fully-traceable device bodies (ops/temporal math).
+# irate/idelta/quantile_over_time gather exact f64 values on the host by
+# device-computed indices — a host sync mid-plan — so they stay on the
+# interpreter.
+RANGE_FUNCS = frozenset({
+    "rate", "increase", "delta", "deriv", "changes", "resets",
+    "predict_linear", "holt_winters",
+    "sum_over_time", "avg_over_time", "min_over_time", "max_over_time",
+    "count_over_time", "last_over_time", "stddev_over_time",
+    "stdvar_over_time", "present_over_time",
+})
+
+# Elementwise math with exact jnp twins (NaN-propagating like the host
+# versions). round/clamp* take scalar params as slots.
+MATH_FUNCS = frozenset({
+    "abs", "ceil", "floor", "exp", "sqrt", "ln", "log2", "log10", "sgn",
+    "round", "clamp", "clamp_min", "clamp_max",
+    "sin", "cos", "tan", "asin", "acos", "atan", "sinh", "cosh", "tanh",
+    "asinh", "acosh", "atanh", "deg", "rad",
+})
+
+AGG_OPS = frozenset({"sum", "avg", "min", "max", "count", "group"})
+
+# %/^ stay on the interpreter: fmod/pow need f64 granularity at counter
+# magnitudes (2^m % 7 on an f32 plane is pure rounding noise), and the
+# compiled value planes are f32 by design.
+ARITH_OPS = frozenset({"+", "-", "*", "/"})
+
+
+# Range functions whose output is in the units of the raw samples
+# (reconstructed absolute magnitudes: window stats over values, or a
+# regression/forecast with the baseline added back) — as opposed to
+# difference/count space (rate, delta, changes, ...), which is small
+# regardless of counter magnitude.
+_ABS_RANGE_FUNCS = frozenset({
+    "sum_over_time", "avg_over_time", "min_over_time", "max_over_time",
+    "last_over_time", "predict_linear", "holt_winters",
+})
+
+
+def _abs_space(node: PlanNode) -> bool:
+    """True when the node's value plane carries raw-sample magnitudes
+    (1e9+ for counters), where f32 granularity is coarser than the
+    interpreter's f64 — a comparison there can flip sample PRESENCE, a
+    discrete divergence no FP tolerance covers."""
+    if isinstance(node, Fetch):
+        return True
+    if isinstance(node, RangeFunc):
+        return node.func in _ABS_RANGE_FUNCS
+    if isinstance(node, InstantFunc):
+        return _abs_space(node.arg)
+    if isinstance(node, Aggregate):
+        return node.op in ("sum", "avg", "min", "max") \
+            and _abs_space(node.arg)
+    if isinstance(node, Binary):
+        return _abs_space(node.lhs) or _abs_space(node.rhs)
+    return False
+
+
+class _Lowerer:
+    def __init__(self, params, lookback_ns: int):
+        self.params = params
+        self.lookback_ns = lookback_ns
+        self.slots: List[AstNode] = []
+
+    def _slot(self, node: AstNode) -> ScalarConst:
+        self.slots.append(node)
+        return ScalarConst(len(self.slots) - 1)
+
+    def lower(self, node: AstNode) -> PlanNode:
+        p = self.params
+        if isinstance(node, NumberLiteral):
+            return self._slot(node)
+        if isinstance(node, Unary):
+            inner = self.lower(node.expr)
+            return InstantFunc("neg", inner)
+        if isinstance(node, VectorSelector):
+            if node.range_ns or node.at_ns is not None:
+                raise NotCompilable("bare matrix selector / @-modifier")
+            return Fetch(node, "instant", 1, 1, p.step_ns)
+        if isinstance(node, Call):
+            return self._lower_call(node)
+        if isinstance(node, Aggregation):
+            return self._lower_aggregation(node)
+        if isinstance(node, BinaryOp):
+            return self._lower_binary(node)
+        raise NotCompilable(type(node).__name__)
+
+    def _lower_call(self, node: Call) -> PlanNode:
+        f = node.func
+        if f in RANGE_FUNCS:
+            sels = [a for a in node.args
+                    if isinstance(a, (VectorSelector, Subquery))]
+            if not sels or not isinstance(sels[-1], VectorSelector):
+                raise NotCompilable(f"{f} over subquery")
+            sel = sels[-1]
+            if not sel.range_ns or sel.at_ns is not None:
+                raise NotCompilable(f"{f} selector shape")
+            p = self.params
+            wgrid = math.gcd(p.step_ns, sel.range_ns)
+            W = sel.range_ns // wgrid
+            stride = p.step_ns // wgrid
+            fetch = Fetch(sel, "range", W, stride, wgrid)
+            params: Tuple[float, ...] = ()
+            if f == "predict_linear":
+                params = (self._const(node.args[1]),)
+            elif f == "holt_winters":
+                params = (self._const(node.args[1]),
+                          self._const(node.args[2]))
+            return RangeFunc(f, fetch, wgrid, sel.range_ns, params)
+        if f in MATH_FUNCS:
+            if not node.args:
+                raise NotCompilable(f"{f} with no args")
+            arg = self.lower(node.args[0])
+            for a in node.args[1:]:
+                self._const(a)  # only constant params compile
+            extra = tuple(self._slot(a) for a in node.args[1:])
+            return InstantFunc(f, arg, extra)
+        raise NotCompilable(f"function {f}")
+
+    def _lower_aggregation(self, node: Aggregation) -> PlanNode:
+        if node.op not in AGG_OPS:
+            raise NotCompilable(f"aggregation {node.op}")
+        arg = self.lower(node.expr)
+        if arg.edge.kind != SERIES:
+            raise NotCompilable("aggregation over scalar")
+        exact = isinstance(arg, Fetch) and node.op in ("sum", "avg")
+        return Aggregate(node.op, arg, node.grouping, node.without, exact)
+
+    def _lower_binary(self, node: BinaryOp) -> PlanNode:
+        if node.op in promql.SET_OPS:
+            raise NotCompilable(f"set op {node.op}")
+        if node.op not in ARITH_OPS and node.op not in promql.COMPARISON_OPS:
+            raise NotCompilable(f"f64-sensitive arithmetic {node.op}")
+        lhs = self.lower(node.lhs)
+        rhs = self.lower(node.rhs)
+        if node.op in promql.COMPARISON_OPS and (
+                _abs_space(lhs) or _abs_space(rhs)):
+            # A comparison FILTERS: flipping one side across the
+            # threshold changes which samples EXIST, not a value within
+            # tolerance. Absolute selector planes carry raw counter
+            # magnitudes (1e9+: f32 ulp 64) where the interpreter's f64
+            # compare and an f32 device compare disagree discretely —
+            # same f64-granularity reason %/^ stay on the interpreter.
+            # Difference-space planes (rate/delta) are f32 in BOTH
+            # routes, so those comparisons stay compiled.
+            raise NotCompilable(
+                "comparison over an absolute-magnitude plane (f64 "
+                "granularity)")
+        if lhs.edge.kind == SERIES and rhs.edge.kind == SERIES:
+            m = node.matching
+            if m is not None and (m.group_left or m.group_right):
+                raise NotCompilable("group_left/group_right matching")
+        swap = bool(node.matching and node.matching.group_right)
+        return Binary(node.op, lhs, rhs, node.bool_mode, node.matching,
+                      swap)
+
+    @staticmethod
+    def _const(node: AstNode) -> float:
+        if isinstance(node, NumberLiteral):
+            return float(node.value)
+        if isinstance(node, Unary) and isinstance(node.expr, NumberLiteral):
+            return -node.expr.value
+        raise NotCompilable("non-constant parameter")
+
+
+def _walk_fetches(node: PlanNode, out: List[Fetch]):
+    if isinstance(node, Fetch):
+        if node not in out:
+            out.append(node)
+        return
+    for f in dataclasses.fields(node):
+        v = getattr(node, f.name)
+        if isinstance(v, PlanNode):
+            _walk_fetches(v, out)
+        elif isinstance(v, tuple):
+            for item in v:
+                if isinstance(item, PlanNode):
+                    _walk_fetches(item, out)
+
+
+def _mesh_ok(node: PlanNode) -> bool:
+    """True when no node needs cross-row gathers: vector-vector binaries
+    re-align rows by bind-time index maps, which a row-partitioned device
+    cannot serve without a full gather — those plans compile
+    single-device instead."""
+    if isinstance(node, Binary):
+        if (node.lhs.edge.kind == SERIES and node.rhs.edge.kind == SERIES):
+            return False
+        return _mesh_ok(node.lhs) and _mesh_ok(node.rhs)
+    for f in dataclasses.fields(node):
+        v = getattr(node, f.name)
+        if isinstance(v, PlanNode) and not _mesh_ok(v):
+            return False
+    return True
+
+
+# ------------------------------------------------------------------ binding
+
+
+@dataclasses.dataclass
+class BoundFetch:
+    fetch: Fetch
+    grid: np.ndarray          # [S, ext_T] f64 consolidated grid
+    tags: List[Tags]
+    W: int
+    stride: int
+    step_ns: int
+
+
+@dataclasses.dataclass
+class Bound:
+    """Host-side query binding: grids, tag algebra, index maps and scalar
+    slot values — everything the compiled program consumes as inputs plus
+    everything the host needs to assemble the result Block."""
+
+    plan: Plan
+    params: object
+    fetches: Dict[Fetch, BoundFetch]
+    slots: np.ndarray                       # [n_slots] f64 slot values
+    node_tags: Dict[int, List[Tags]]        # id(plan node) -> output tags
+    aux: Dict[int, dict]                    # id(plan node) -> bind aux data
+    total_cells: int
+    out_tags: List[Tags]
+    out_kind: str                            # SERIES | SCALAR
+
+
+# Bind-time tag-algebra memo: the host label work (name stripping,
+# grouping, vector-match alignment) is a pure function of (plan
+# structure, the per-fetch tag LISTS) — and the grid cache hands back the
+# SAME list object on every repeat evaluation of an unchanged selector.
+# A dashboard burst re-running one query shape pays the O(series) tag
+# algebra once, not per refresh (measured 35-60ms/query at 10k series —
+# larger than the compiled dispatch it was feeding). Entries pin their
+# source lists (strong refs), so an id() can never be recycled while its
+# entry lives; the `is` checks make a stale hit structurally impossible.
+_BIND_MEMO: "collections.OrderedDict[tuple, tuple]" = (
+    collections.OrderedDict())
+_BIND_MEMO_LOCK = threading.Lock()
+_BIND_MEMO_MAX = int(os.environ.get("M3_TPU_BIND_MEMO", "256"))
+
+
+def _preorder(node: PlanNode, out: List[PlanNode]) -> List[PlanNode]:
+    out.append(node)
+    for f in dataclasses.fields(node):
+        v = getattr(node, f.name)
+        if isinstance(v, PlanNode):
+            _preorder(v, out)
+        elif isinstance(v, tuple):
+            for item in v:
+                if isinstance(item, PlanNode):
+                    _preorder(item, out)
+    return out
+
+
+def bind(plan: Plan, engine, params,
+         slot_values: Sequence[float] = ()) -> Bound:
+    """Fetch + grid every selector through the engine's cached selector
+    paths (grid cache, datapoint charging — identical to the interpreter)
+    and run the host tag algebra for every node. Raises QueryError with
+    the interpreter's exact semantics for matching violations."""
+    from . import executor as ex
+
+    fetches: Dict[Fetch, BoundFetch] = {}
+    total = 0
+    for f in plan.fetches:
+        if f.role == "range":
+            blk, W, stride = engine._eval_range_selector(f.sel, params)
+            bf = BoundFetch(f, np.asarray(blk.values, dtype=np.float64),
+                            blk.series_tags, W, stride,
+                            blk.meta.step_ns)
+        else:
+            blk = engine._eval_instant_selector(f.sel, params)
+            bf = BoundFetch(f, np.asarray(blk.values, dtype=np.float64),
+                            blk.series_tags, 1, 1, blk.meta.step_ns)
+        fetches[f] = bf
+        total += bf.grid.size
+
+    slots = np.zeros(plan.n_slots, dtype=np.float64)
+    for i, v in enumerate(slot_values):
+        slots[i] = v
+
+    src_lists = tuple(fetches[f].tags for f in plan.fetches)
+    memo_key = (plan.root, tuple(map(id, src_lists)))
+    with _BIND_MEMO_LOCK:
+        ent = _BIND_MEMO.get(memo_key)
+        if ent is not None and all(
+                a is b for a, b in zip(ent[0], src_lists)):
+            _BIND_MEMO.move_to_end(memo_key)
+            _, tags_seq, aux_seq, out_kind = ent
+        else:
+            ent = None
+    if ent is not None:
+        nodes = _preorder(plan.root, [])
+        node_tags = {id(n): t for n, t in zip(nodes, tags_seq)}
+        aux = {id(n): a for n, a in zip(nodes, aux_seq) if a is not None}
+        return Bound(plan, params, fetches, slots, node_tags, aux, total,
+                     node_tags[id(plan.root)], out_kind)
+
+    node_tags: Dict[int, List[Tags]] = {}
+    aux: Dict[int, dict] = {}
+
+    def tags_of(node: PlanNode) -> List[Tags]:
+        key = id(node)
+        if key in node_tags:
+            return node_tags[key]
+        if isinstance(node, Fetch):
+            out = fetches[node].tags
+        elif isinstance(node, RangeFunc):
+            base = tags_of(node.arg)
+            if node.func == "last_over_time":
+                out = list(base)
+            else:
+                out = [ex._strip_name(t) for t in base]
+        elif isinstance(node, InstantFunc):
+            base = tags_of(node.arg)
+            if node.func == "neg":
+                out = list(base)
+            else:
+                out = [ex._strip_name(t) for t in base]
+        elif isinstance(node, Aggregate):
+            base = tags_of(node.arg)
+            gids, gtags = ex._group_series(base, node.grouping, node.without)
+            aux[id(node)] = {"group_ids": gids.astype(np.int32),
+                             "n_groups": len(gtags)}
+            out = gtags
+        elif isinstance(node, Binary):
+            out = _bind_binary(node, tags_of, aux)
+        elif isinstance(node, ScalarConst):
+            out = []
+        else:  # pragma: no cover
+            raise ex.QueryError(f"unbound plan node {type(node).__name__}")
+        node_tags[key] = out
+        return out
+
+    def _bind_binary(node: Binary, tags_of, aux) -> List[Tags]:
+        le, re_ = node.lhs.edge, node.rhs.edge
+        comparison = node.op in promql.COMPARISON_OPS
+        if le.kind == SCALAR and re_.kind == SCALAR:
+            tags_of(node.lhs), tags_of(node.rhs)
+            return []
+        if le.kind == SERIES and re_.kind == SERIES:
+            ltags, rtags = tags_of(node.lhs), tags_of(node.rhs)
+            matching = node.matching
+            many_side_right = bool(matching and matching.group_right)
+            if many_side_right:
+                many_tags, one_tags, swap = rtags, ltags, True
+            else:
+                many_tags, one_tags, swap = ltags, rtags, False
+            one_map: Dict[bytes, int] = {}
+            for j, t in enumerate(one_tags):
+                k = ex._match_key(t, matching)
+                if k in one_map:
+                    raise ex.QueryError(
+                        "many-to-many vector matching: duplicate series on "
+                        f"the 'one' side for key {k!r}")
+                one_map[k] = j
+            many_idx: List[int] = []
+            one_idx: List[int] = []
+            out_tags: List[Tags] = []
+            seen: Dict[bytes, int] = {}
+            for i, t in enumerate(many_tags):
+                j = one_map.get(ex._match_key(t, matching))
+                if j is None:
+                    continue
+                rt = ex._result_tags(t, one_tags[j], matching, comparison,
+                                     node.bool_mode)
+                k = rt.id()
+                if k in seen:
+                    raise ex.QueryError(
+                        "multiple matches for the same result labels")
+                seen[k] = i
+                many_idx.append(i)
+                one_idx.append(j)
+                out_tags.append(rt)
+            aux[id(node)] = {
+                "many_idx": np.asarray(many_idx, dtype=np.int32),
+                "one_idx": np.asarray(one_idx, dtype=np.int32),
+                "swap": swap,
+            }
+            return out_tags
+        # vector <op> scalar (either side)
+        vec = node.lhs if le.kind == SERIES else node.rhs
+        tags_of(node.lhs), tags_of(node.rhs)
+        base = node_tags[id(vec)]
+        if comparison and not node.bool_mode:
+            return list(base)
+        return [ex._strip_name(t) for t in base]
+
+    out_tags = tags_of(plan.root)
+    nodes = _preorder(plan.root, [])
+    # .get: InstantFunc's ScalarConst params are preorder nodes the tag
+    # walk never visits (they carry no series) — store them as empty.
+    tags_seq = tuple(node_tags.get(id(n), []) for n in nodes)
+    aux_seq = tuple(aux.get(id(n)) for n in nodes)
+    with _BIND_MEMO_LOCK:
+        _BIND_MEMO[memo_key] = (src_lists, tags_seq, aux_seq,
+                                plan.root.edge.kind)
+        while len(_BIND_MEMO) > _BIND_MEMO_MAX:
+            _BIND_MEMO.popitem(last=False)
+    return Bound(plan, params, fetches, slots, node_tags, aux, total,
+                 out_tags, plan.root.edge.kind)
+
+
+def lower_and_collect(ast: AstNode, params, lookback_ns: int
+                      ) -> Tuple[Optional[Plan], str, List[float]]:
+    """AST -> physical plan (or (None, reason, []) when any node falls
+    outside the compiled surface) plus the scalar slot VALUES (in slot
+    order) for binding."""
+    lw = _Lowerer(params, lookback_ns)
+    try:
+        root = lw.lower(ast)
+    except NotCompilable as e:
+        return None, str(e), []
+    fetches: List[Fetch] = []
+    _walk_fetches(root, fetches)
+    if not fetches:
+        return None, "scalar-only expression", []
+    values = []
+    for node in lw.slots:
+        if isinstance(node, NumberLiteral):
+            values.append(float(node.value))
+        elif isinstance(node, Unary) and isinstance(node.expr, NumberLiteral):
+            values.append(-node.expr.value)
+        else:  # unreachable: _slot only records constants
+            return None, "non-constant slot", []
+    root = _demote_exact(root, is_root=True)
+    fetches = []
+    _walk_fetches(root, fetches)
+    plan = Plan(root, params.steps, len(lw.slots), tuple(fetches),
+                _mesh_ok(root))
+    return plan, "", values
+
+
+def _demote_exact(node: PlanNode, is_root: bool) -> PlanNode:
+    """The exact counter-sum path finishes on the HOST (f64 baseline
+    mass), so only the ROOT aggregate may carry it; inner aggregates
+    collapse on device in f32 (documented divergence, same tolerance as
+    the pre-existing sharded-agg fast path)."""
+    if isinstance(node, Aggregate):
+        arg = _demote_exact(node.arg, False)
+        return Aggregate(node.op, arg, node.grouping, node.without,
+                         node.exact and is_root)
+    if isinstance(node, RangeFunc) or isinstance(node, Fetch) \
+            or isinstance(node, ScalarConst):
+        return node
+    if isinstance(node, InstantFunc):
+        return InstantFunc(node.func, _demote_exact(node.arg, False),
+                           node.params)
+    if isinstance(node, Binary):
+        return Binary(node.op, _demote_exact(node.lhs, False),
+                      _demote_exact(node.rhs, False), node.bool_mode,
+                      node.matching, node.swap)
+    return node
+
+
+# -------------------------------------------------------------- compile key
+
+
+def strip(node: PlanNode, fetch_index: Dict[Fetch, int]) -> PlanNode:
+    """The compile-key projection of a plan node: selectors (label
+    matchers, offsets) do not change the traced program, so Fetch nodes
+    keep only their physical geometry plus a positional identity (so two
+    DIFFERENT selectors with the same geometry stay distinct inputs while
+    one executable still serves every metric with the plan shape);
+    grouping labels and matching labels are bind-only and drop out."""
+    if isinstance(node, Fetch):
+        idx = fetch_index[node]
+        return Fetch(VectorSelector(b"%d" % idx), node.role, node.W,
+                     node.stride, node.wgrid_ns)
+    if isinstance(node, RangeFunc):
+        return RangeFunc(node.func, strip(node.arg, fetch_index),
+                         node.step_ns, node.range_ns, node.params)
+    if isinstance(node, InstantFunc):
+        return InstantFunc(node.func, strip(node.arg, fetch_index),
+                           node.params)
+    if isinstance(node, Aggregate):
+        return Aggregate(node.op, strip(node.arg, fetch_index), (),
+                         node.without, node.exact)
+    if isinstance(node, Binary):
+        return Binary(node.op, strip(node.lhs, fetch_index),
+                      strip(node.rhs, fetch_index), node.bool_mode, None,
+                      node.swap)
+    return node
+
+
+def next_bucket(n: int) -> int:
+    """Quarter-octave shape bucket: the smallest of {1, 1.25, 1.5, 1.75}
+    * 2^k >= n. Pure pow2 buckets waste up to 2x compute on the padded
+    lanes (10000 rows -> 16384); the quarter-octave grid caps the waste
+    at 14% for four executables per octave — the right trade for the
+    plan cache, whose entries are whole fused programs serving many
+    queries each."""
+    if n <= 3:
+        return max(1, n)
+    p = 1 << (int(n - 1).bit_length())      # pow2 >= n
+    half = p >> 1
+    for frac in (5, 6, 7):                   # 1.25, 1.5, 1.75 * (p/2)
+        cand = (half * frac) >> 2
+        if cand >= n:
+            return cand
+    return p

@@ -76,6 +76,17 @@ echo "== observability smoke (<10s; cross-process span tree, slow-query log, sel
 # matrix: tests/test_observability.py. Wall budget via OBS_SMOKE_BUDGET_S.
 JAX_PLATFORMS=cpu python scripts/obs_smoke.py --seed 7
 
+echo "== plan-compiler smoke (<5s; compiled-vs-oracle, 100% warm plan-cache hit, fallback exercised) =="
+# Whole-plan pjit query execution: the compiled route must agree with
+# the retained interpreter oracle (counter sums BIT-equal), every
+# compilable query must actually compile (no silent fallback), the warm
+# pass must be served 100% from the plan cache, and a subquery must fall
+# back cleanly. The 8-virtual-device mesh exercises the shard_map
+# collective fan-in. Full matrix: tests/test_plan_compile.py; bench:
+# promql_plan_agg. Wall budget via PLAN_SMOKE_BUDGET_S.
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  python scripts/plan_smoke.py
+
 echo "== test suite =="
 python -m pytest tests/ -x -q
 

@@ -1,0 +1,182 @@
+"""Plan-compiler smoke: the <5s check_all tier for whole-plan pjit
+query execution (query/plan.py -> parallel/compile.py). Asserts, not
+just times:
+
+  1. the compiled route agrees with the retained interpreter oracle
+     (Engine.execute_range_ref) on every query of a seeded corpus —
+     range functions, aggregations, elementwise math, binary ops, a
+     vector-vector match and a subquery — at the same FP tolerances
+     tests/test_plan_compile.py proves over its full 500+-case matrix,
+     with the counter sum BIT-equal (the f64 host-reduce contract);
+  2. every compilable corpus query really took the compiled route
+     (route counters, no silent interpreter fallback), and the second
+     pass is served 100% from the plan cache (zero misses, zero fresh
+     compiles);
+  3. the fallback path works: a deliberately non-compilable query
+     (subquery) stays on the interpreter and still matches the oracle.
+
+Usage: JAX_PLATFORMS=cpu python scripts/plan_smoke.py
+(an 8-virtual-device XLA_FLAGS mesh additionally exercises the
+shard_map collective fan-in route, as the check_all tier does)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from m3_tpu.query import Engine  # noqa: E402
+from m3_tpu.utils.instrument import ROOT  # noqa: E402
+
+S_NS = 1_000_000_000
+T0 = 1_700_000_000 * S_NS
+RES = 10 * S_NS
+NPTS = 180
+STEP = 30 * S_NS
+
+# Compilable corpus: every family the plan compiler lowers.
+COMPILED = [
+    "rate(m[5m])",
+    "increase(m[5m])",
+    "delta(m[5m])",
+    "avg_over_time(m[5m])",
+    "max_over_time(m[5m])",
+    "stddev_over_time(m[5m])",
+    "sum(m)",                               # exact counter-sum root
+    "sum by (host) (m)",                    # exact grouped counter-sum
+    "sum by (host) (rate(m[5m]))",
+    "max(rate(m[5m]))",
+    "abs(m)",
+    "clamp_min(rate(m[5m]), 0.1)",
+    "m * 2",
+    "rate(m[5m]) > 0.4",
+    "m * on(host, i) b",                    # vector-vector match
+    "sum(rate(m[5m])) > 100",
+]
+
+# Deliberately non-compilable: a subquery stays on the interpreter.
+FALLBACK = "max_over_time(rate(m[5m])[10m:1m])"
+
+
+class _Storage:
+    def __init__(self, series):
+        self._series = series
+
+    def fetch_raw(self, matchers, start_ns, end_ns):
+        out = {}
+        for sid, rec in self._series.items():
+            if all(m.matches(rec["tags"].get(m.name, b"")) for m in matchers):
+                out[sid] = rec
+        return out
+
+
+def make_storage(seed=11, n=96):
+    """Counters at 1e9+ magnitudes (the f64-exactness regime) plus a
+    small gauge metric sharing (host, i) labels for vector matching."""
+    rng = np.random.default_rng(seed)
+    t = T0 + np.arange(NPTS, dtype=np.int64) * RES
+    series = {}
+    for i in range(n):
+        host = b"h%d" % (i % 8)
+        v = 1e9 * (1 + i % 5) + np.cumsum(
+            rng.poisson(5.0, NPTS)).astype(np.float64)
+        tt = t
+        if i % 7 == 0:  # gappy rows exercise the NaN masks
+            keep = rng.random(NPTS) > 0.2
+            keep[0] = True
+            tt, v = t[keep], v[keep]
+        series[b"m-%d" % i] = {
+            "tags": {b"__name__": b"m", b"host": host, b"i": str(i).encode()},
+            "t": tt, "v": v}
+    for i in range(n // 4):
+        series[b"b-%d" % i] = {
+            "tags": {b"__name__": b"b", b"host": b"h%d" % (i % 8),
+                     b"i": str(i).encode()},
+            "t": t, "v": rng.normal(10.0, 3.0, NPTS)}
+    return _Storage(series)
+
+
+def assert_oracle(got, ref, query, exact=False):
+    gtags = [bytes(t.id()) for t in got.series_tags]
+    rtags = [bytes(t.id()) for t in ref.series_tags]
+    assert sorted(gtags) == sorted(rtags), f"{query}: series set diverged"
+    order = {k: i for i, k in enumerate(rtags)}
+    g = np.asarray(got.values)
+    r = np.asarray(ref.values)[[order[k] for k in gtags]]
+    if exact:
+        assert np.array_equal(g, r, equal_nan=True), (
+            f"{query}: compiled counter-sum lost f64 host-reduce exactness "
+            f"(max abs diff {np.nanmax(np.abs(g - r))})")
+        return
+    finite = r[np.isfinite(r)]
+    scale = float(np.abs(finite).max()) if finite.size else 1.0
+    np.testing.assert_allclose(g, r, rtol=2e-5, atol=max(1e-8, 1e-6 * scale),
+                               equal_nan=True, err_msg=query)
+
+
+def main() -> int:
+    t_start = time.perf_counter()
+    eng = Engine(make_storage())
+    start, end = T0 + 40 * RES, T0 + (NPTS - 1) * RES
+
+    # 1. compiled vs oracle, every corpus query routed compiled.
+    before = ROOT.snapshot()
+    for q in COMPILED:
+        got = eng.execute_range(q, start, end, STEP)
+        ref = eng.execute_range_ref(q, start, end, STEP)
+        assert_oracle(got, ref, q, exact=q in ("sum(m)", "sum by (host) (m)"))
+    pass1 = ROOT.snapshot()
+    executed = pass1.get("query.plan.executed", 0) \
+        - before.get("query.plan.executed", 0)
+    assert executed == len(COMPILED), (
+        f"only {executed}/{len(COMPILED)} corpus queries took the compiled "
+        "route (silent interpreter fallback)")
+
+    # 2. second pass: 100% plan-cache hit, zero fresh compiles.
+    for q in COMPILED:
+        got = eng.execute_range(q, start, end, STEP)
+        got.values
+    pass2 = ROOT.snapshot()
+    misses = pass2.get("telemetry.plan_cache.misses", 0) \
+        - pass1.get("telemetry.plan_cache.misses", 0)
+    hits = pass2.get("telemetry.plan_cache.hits", 0) \
+        - pass1.get("telemetry.plan_cache.hits", 0)
+    compiles = pass2.get("telemetry.plan_cache.compiles", 0) \
+        - pass1.get("telemetry.plan_cache.compiles", 0)
+    assert misses == 0 and compiles == 0, (
+        f"warm pass missed the plan cache ({misses} misses, "
+        f"{compiles} compiles)")
+    assert hits >= len(COMPILED), f"warm hit count {hits} < {len(COMPILED)}"
+
+    # 3. fallback: the subquery stays on the interpreter and matches.
+    got = eng.execute_range(FALLBACK, start, end, STEP)
+    ref = eng.execute_range_ref(FALLBACK, start, end, STEP)
+    assert_oracle(got, ref, FALLBACK)
+    pass3 = ROOT.snapshot()
+    assert pass3.get("query.plan.executed", 0) == \
+        pass2.get("query.plan.executed", 0), (
+        "the deliberately non-compilable query took the compiled route")
+
+    import jax
+
+    total_s = time.perf_counter() - t_start
+    print(f"PLAN SMOKE PASS: {len(COMPILED)} compiled-vs-oracle queries "
+          f"({executed} compiled route, counter-sum bit-exact), warm pass "
+          f"{hits} hits / 0 misses, fallback on {FALLBACK!r} OK, "
+          f"{len(jax.devices())} device(s), total {total_s:.1f}s")
+    # Nominal runtime is ~3s (one-time plan compiles dominate); the
+    # generous overridable ceiling catches a real complexity regression
+    # without turning host contention into a flaky tier failure.
+    budget_s = float(os.environ.get("PLAN_SMOKE_BUDGET_S", "60"))
+    assert total_s < budget_s, (
+        f"smoke tier took {total_s:.1f}s (> {budget_s:.0f}s budget)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,7 +17,8 @@ from m3_tpu.analysis.jax_rules import (ItemInLoopRule, JaxPurityRule,
                                        NonStaticJitCacheRule)
 from m3_tpu.analysis.lock_rules import HotLoopUnderLockRule, LockDisciplineRule
 from m3_tpu.analysis.hbm_rules import UnbudgetedDevicePutRule
-from m3_tpu.analysis.obs_rules import WallClockLatencyRule
+from m3_tpu.analysis.obs_rules import (HostSyncInPlanRule,
+                                       WallClockLatencyRule)
 from m3_tpu.analysis.overload_rules import UnboundedQueueRule
 from m3_tpu.analysis.retry_rules import (BroadExceptWireIORule,
                                          RawSleepRetryRule)
@@ -1367,6 +1368,91 @@ class TestObsRules:
         """
         assert lint(src, WallClockLatencyRule(),
                     "m3_tpu/storage/mod.py") == []
+
+
+class TestHostSyncInPlan:
+    # The pre-change per-op dispatch shape, transplanted into a lowering
+    # rule: dispatch a kernel, np.asarray the result to the host, feed
+    # the next operator — the round trip the whole-plan compiler removes.
+    PRE_CHANGE_DISPATCH = """
+        import numpy as np
+
+        def _lower_rangefunc(ctx, node):
+            out = ctx.kernel(ctx.grid)
+            host = np.asarray(out)        # per-op host round trip
+            return ctx.next_op(host)
+    """
+
+    def test_flags_pre_change_per_op_dispatch(self):
+        found = lint(self.PRE_CHANGE_DISPATCH, HostSyncInPlanRule(),
+                     "m3_tpu/parallel/compile.py")
+        assert rule_ids(found) == ["host-sync-in-plan"]
+        assert "np.asarray" in found[0].message
+
+    def test_flags_item_in_emit(self):
+        src = """
+            def _emit(ctx, node):
+                val = ctx.cache[id(node)]
+                if val.sum().item() > 0:   # traced-value host sync
+                    return val
+                return -val
+        """
+        found = lint(src, HostSyncInPlanRule(), "m3_tpu/parallel/compile.py")
+        assert rule_ids(found) == ["host-sync-in-plan"]
+        assert ".item()" in found[0].message
+
+    def test_flags_device_get_in_traced_body(self):
+        src = """
+            import jax
+
+            def _plan_executable(stripped, geom):
+                def body(fetch_flat, slots):
+                    mid = jax.device_get(fetch_flat[0])
+                    return mid + slots
+                return jax.jit(body)
+        """
+        found = lint(src, HostSyncInPlanRule(), "m3_tpu/parallel/compile.py")
+        assert rule_ids(found) == ["host-sync-in-plan"]
+
+    def test_flags_bare_from_import(self):
+        src = """
+            from numpy import asarray
+
+            def _lower_aggregate(ctx, node):
+                return asarray(ctx.cache[id(node)])
+        """
+        found = lint(src, HostSyncInPlanRule(), "m3_tpu/parallel/compile.py")
+        assert rule_ids(found) == ["host-sync-in-plan"]
+
+    def test_host_finish_in_execute_is_fine(self):
+        # execute() materializes AFTER the compiled program returns —
+        # the legitimate sync point, outside the lowering surface.
+        src = """
+            import numpy as np
+
+            def execute(bound, mesh):
+                root_val = dispatch(bound)
+                return np.asarray(root_val)[:4]
+        """
+        assert lint(src, HostSyncInPlanRule(),
+                    "m3_tpu/parallel/compile.py") == []
+
+    def test_other_parallel_modules_skipped(self):
+        found = lint(self.PRE_CHANGE_DISPATCH, HostSyncInPlanRule(),
+                     "m3_tpu/parallel/query.py")
+        assert found == []
+
+    def test_suppression_silences(self):
+        src = """
+            import numpy as np
+
+            def _lower_fetch(ctx, node):
+                # DELIBERATE: static bind-time constant, not a traced value
+                shape = np.asarray(node.shape)  # m3lint: disable=host-sync-in-plan
+                return ctx.fetch_ins[node][: shape[0]]
+        """
+        assert lint(src, HostSyncInPlanRule(),
+                    "m3_tpu/parallel/compile.py") == []
 
 
 class TestTreeGate:
