@@ -486,6 +486,24 @@ def bench_timer_quantiles():
     }
 
 
+class _ColumnarCapture:
+    """The production flush-handler shape (Handler.handle_columnar, what
+    ProducerHandler implements): a round's emissions arrive as columnar
+    array slices in ONE call — the agg benches' timed loops measure the
+    tier as deployed, not the per-datapoint compat shim."""
+
+    def __init__(self, sink):
+        self._sink = sink
+
+    def __call__(self, mid, t, v, pol):
+        self._sink.append(v)
+
+    def handle_columnar(self, groups):
+        extend = self._sink.extend
+        for _ids, _ts, vs, _pol in groups:
+            extend(vs.tolist())
+
+
 def bench_counter_gauge():
     """BASELINE config #2: Counter+Gauge 10s -> 1m/5m rollup windows driven
     through the aggregator tier's flush (src/aggregator/aggregator/
@@ -541,6 +559,8 @@ def bench_counter_gauge():
     total_vals = n * 30 * 2  # every datapoint staged into both policies
 
     _phase("counter_gauge: warmup flush")
+    # warmup runs the per-datapoint compat sink: exercises that shim and
+    # spot-checks exactness with deterministic emission order
     stage()
     t_flush = [lists[60].flush(target, flush_fn), lists[300].flush(target, flush_fn)]
     assert t_flush == [n * 5, n], t_flush
@@ -548,15 +568,17 @@ def bench_counter_gauge():
     # spot-check exactness: counter windows sum, gauge windows last
     assert emitted[0] == float(cvals[0, :6].sum())
     _phase("counter_gauge: timing")
+    col_fn = _ColumnarCapture(emitted)
     dts = []
     for _ in range(iters):
         stage()
         emitted.clear()
         t0 = time.perf_counter()
-        w1 = lists[60].flush(target, flush_fn)
-        w5 = lists[300].flush(target, flush_fn)
+        w1 = lists[60].flush(target, col_fn)
+        w5 = lists[300].flush(target, col_fn)
         dts.append(time.perf_counter() - t0)
         assert w1 + w5 == n * 6
+        assert len(emitted) == n * 6
     dt = min(dts)
     _phase("counter_gauge: done")
     return {
@@ -567,6 +589,204 @@ def bench_counter_gauge():
                   "policies": ["1m:40h", "5m:40h"],
                   "input_cadence_s": 10,
                   "moments": "host f64 exact (no quantiles for counter/gauge)"},
+    }
+
+
+def _agg10x_build(n, lists_mod, elem_mod):
+    """Build the agg_rollup_10x elem population into fresh MetricLists:
+    40% counters, 40% gauges, 20% timers at 10x counter_gauge_rollup's
+    metric cardinality, with 10% of the gauges carrying a rollup-only
+    pipeline into 1/40th-cardinality rollup ids consumed by a second
+    aggregation stage (the multi_server_forwarding_pipeline_test.go
+    forwarding shape; deliberately NO binary transform — see the in-loop
+    comment). Returns (lists, elems, n_piped, n_rollup_ids) where elems
+    is [(elem, kind, row_index)] for the staging pass."""
+    from m3_tpu.metrics import aggregation as magg
+    from m3_tpu.metrics.metric import MetricType
+    from m3_tpu.metrics.pipeline import Op, Pipeline
+    from m3_tpu.metrics.policy import StoragePolicy
+
+    pol = StoragePolicy.parse("1m:40h")
+    lists = lists_mod.MetricLists()
+    lst = lists.for_resolution(60 * 1_000_000_000)
+    n_counter = (n * 2) // 5
+    n_gauge = (n * 2) // 5
+    n_timer = n - n_counter - n_gauge
+    n_piped = n_gauge // 10
+    n_rollup_ids = max(1, n_piped // 40)
+    elems = []
+    for i in range(n_counter):
+        key = elem_mod.ElemKey(b"bench.a10.c.%d" % i, pol)
+        elems.append((lst.get_or_create(
+            key, lambda k=key: elem_mod.Elem(k, MetricType.COUNTER)),
+            "counter", i))
+    sum_id = magg.AggID.compress([magg.AggType.SUM])
+    for i in range(n_gauge):
+        if i < n_piped:
+            # Rollup-only pipeline: every window forwards its Last into
+            # a 1/40th-cardinality second aggregation stage. (A binary
+            # transform ahead of the rollup would thread prev-window
+            # state across bench rounds and make the stage-2 window
+            # count round-dependent; the property suite covers
+            # transforms, the bench stays deterministic.)
+            pipe = Pipeline((
+                Op.roll(b"bench.a10.rollup.%d" % (i % n_rollup_ids),
+                        (b"host",), sum_id),
+            ))
+            key = elem_mod.ElemKey(b"bench.a10.g.%d" % i, pol,
+                                   magg.AggID.compress([magg.AggType.LAST]),
+                                   pipe)
+        else:
+            key = elem_mod.ElemKey(b"bench.a10.g.%d" % i, pol)
+        elems.append((lst.get_or_create(
+            key, lambda k=key: elem_mod.Elem(k, MetricType.GAUGE)),
+            "gauge", i))
+    for i in range(n_timer):
+        key = elem_mod.ElemKey(b"bench.a10.t.%d" % i, pol)
+        elems.append((lst.get_or_create(
+            key, lambda k=key: elem_mod.Elem(k, MetricType.TIMER)),
+            "timer", i))
+    return lists, elems, n_piped, n_rollup_ids
+
+
+def bench_agg_rollup_10x():
+    """10x-cardinality aggregator flush (ROADMAP item 4's bench config):
+    500k metric ids (vs counter_gauge_rollup's 50k) in one 1m metric
+    list — mixed counter/gauge/timer (default agg types, so timers run
+    the full suffixed set incl. p50/p95/p99 quantiles) with 10% of the
+    gauges on a rollup-only pipeline (Rollup(Sum) into shared ids, the
+    forwarded partials consumed by a second flush). Measures the whole
+    tier per round: collect + reduce + emit + pipeline forwarding +
+    second-stage consume. The denominator counts primary staged values
+    only (forwarded partials ride free), so rounds are comparable across
+    implementations."""
+    from m3_tpu.aggregator import elem as elem_mod
+    from m3_tpu.aggregator import list as lists_mod
+
+    n = int(os.environ.get("BENCH_AGG10X_SERIES", "500000"))
+    iters = int(os.environ.get("BENCH_AGG10X_ITERS", "2"))
+    s_ns = 1_000_000_000
+    base_t = 1_700_000_000 * s_ns - (1_700_000_000 * s_ns) % (60 * s_ns)
+    rng = np.random.default_rng(31)
+    _phase("agg10x: building elems")
+    lists, elems, n_piped, n_rollup_ids = _agg10x_build(
+        n, lists_mod, elem_mod)
+    lst = lists.for_resolution(60 * s_ns)
+    # Two windows of 6 values at 10s cadence per metric (the PerSecond
+    # transform needs window 1 to prime its previous-datapoint state).
+    cvals = rng.poisson(5.0, (n, 12)).astype(np.float64)
+    gvals = rng.standard_normal((n, 12))
+    tvals = rng.lognormal(0.0, 1.0, (n, 12))
+    planes = {"counter": cvals, "gauge": gvals, "timer": tvals}
+
+    def stage():
+        w0, w1 = base_t, base_t + 60 * s_ns
+        for e, kind, i in elems:
+            row = planes[kind][i]
+            e.add_values(w0, row[:6])
+            e.add_values(w1, row[6:])
+
+    def forward_fn(new_id, t_nanos, value, meta, source_id):
+        # Local loop-back of rollup partials into the same aggregation
+        # ring (ForwardedWriter without routing): next-stage elems are
+        # created on first delivery, exactly like Entry.add_forwarded.
+        key = elem_mod.ElemKey(new_id, meta.storage_policy,
+                               meta.aggregation_id, meta.pipeline,
+                               meta.num_forwarded_times)
+        from m3_tpu.metrics.metric import MetricType
+
+        e = lst.get_or_create(key, lambda: elem_mod.Elem(
+            key, MetricType.GAUGE))
+        e.add_value(t_nanos, value)
+
+    emitted = []
+    flush_fn = lambda mid, t, v, pol: emitted.append(v)  # noqa: E731
+    # the round's rollup forwards arrive batched (ForwardedWriter shape)
+    forward_fn.forward_batch = lambda items: [forward_fn(*it)
+                                              for it in items]
+    t1 = base_t + 120 * s_ns   # closes both primary windows
+    t2 = base_t + 180 * s_ns   # closes the forwarded stage-2 windows
+    total_vals = n * 12
+
+    _phase("agg10x: warmup flush")
+    # warmup drives the per-datapoint compat sink path once
+    stage()
+    w_a = lst.flush(t1, flush_fn, forward_fn)
+    w_b = lst.flush(t2, flush_fn, forward_fn)
+    assert w_a == n * 2, w_a
+    # Stage 2 consumed one window per rollup id per primary window (every
+    # primary window forwards its Last; both land before t2).
+    assert w_b == 2 * n_rollup_ids, (w_b, n_rollup_ids)
+    _phase("agg10x: timing")
+    col_fn = _ColumnarCapture(emitted)
+    dts = []
+    for _ in range(iters):
+        stage()
+        emitted.clear()
+        t0 = time.perf_counter()
+        w_a = lst.flush(t1, col_fn, forward_fn)
+        w_b = lst.flush(t2, col_fn, forward_fn)
+        dts.append(time.perf_counter() - t0)
+        assert w_a == n * 2 and w_b == 2 * n_rollup_ids
+    dt = min(dts)
+    _phase("agg10x: oracle subset")
+    extra = {
+        "metrics": n, "mix": "40% counter / 40% gauge / 20% timer",
+        "piped_gauges": n_piped, "rollup_ids": n_rollup_ids,
+        "policies": ["1m:40h"], "input_cadence_s": 10,
+        "windows_per_round": n * 2 + n_rollup_ids,
+        "round_ms": round(dt * 1000, 1),
+    }
+    # Post-change builds retain the host flush as reduce_and_emit_ref;
+    # assert the production path bit-identical to it on a subset mirror
+    # (rounds 6-9 in-bench oracle protocol).
+    if hasattr(lists_mod, "reduce_and_emit_ref"):
+        sub_n = min(n, 20000)
+        got, want = [], []
+        for sink, ref in ((got, False), (want, True)):
+            slists, selems, _, _ = _agg10x_build(
+                sub_n, lists_mod, elem_mod)
+            slst = slists.for_resolution(60 * s_ns)
+            for e, kind, i in selems:
+                row = planes[kind][i]
+                e.add_values(base_t, row[:6])
+                e.add_values(base_t + 60 * s_ns, row[6:])
+            cap = lambda mid, t, v, pol, _s=sink: _s.append((mid, t, v))  # noqa: E731
+
+            def fwd(new_id, t_nanos, value, meta, source_id,
+                    _lst=slst, _sink=sink):
+                key = elem_mod.ElemKey(new_id, meta.storage_policy,
+                                       meta.aggregation_id, meta.pipeline,
+                                       meta.num_forwarded_times)
+                from m3_tpu.metrics.metric import MetricType
+
+                e = _lst.get_or_create(key, lambda: elem_mod.Elem(
+                    key, MetricType.GAUGE))
+                e.add_value(t_nanos, value)
+
+            if ref:
+                jobs, _ = __import__(
+                    "m3_tpu.aggregator.flush", fromlist=["plan_jobs"]
+                ).plan_jobs(slists, t1, 0, cap, fwd)
+                lists_mod.reduce_and_emit_ref(jobs)
+                jobs2, _ = __import__(
+                    "m3_tpu.aggregator.flush", fromlist=["plan_jobs"]
+                ).plan_jobs(slists, t2, 0, cap, fwd)
+                lists_mod.reduce_and_emit_ref(jobs2)
+            else:
+                slst.flush(t1, cap, fwd)
+                slst.flush(t2, cap, fwd)
+        assert sorted(got) == sorted(want), (
+            "mesh flush diverged from the host oracle on the subset "
+            f"mirror ({len(got)} vs {len(want)} rows)")
+        assert all(g == w for g, w in zip(sorted(got), sorted(want)))
+        extra["oracle"] = (f"reduce_and_emit_ref subset mirror "
+                           f"({sub_n} metrics), bit-identical")
+    return {
+        "metric": "agg_rollup_10x",
+        "value": round(total_vals / dt, 1),
+        "unit": "datapoints/sec",
+        "extra": extra,
     }
 
 
@@ -1207,6 +1427,7 @@ def bench_peer_migration():
 _BENCHES = [
     ("m3tsz_encode_1m_rollup", bench_encode_rollup),
     ("counter_gauge_rollup", bench_counter_gauge),
+    ("agg_rollup_10x", bench_agg_rollup_10x),
     ("promql_rate_sum_over_time_1h", bench_promql),
     ("promql_plan_agg", bench_promql_plan_agg),
     ("timer_quantile_rollup", bench_timer_quantiles),

@@ -23,8 +23,10 @@ from .handler import (
     LoggingHandler,
     ProducerHandler,
     decode_aggregated,
+    decode_aggregated_batch,
 )
-from .list import MetricList, MetricLists, batched_reduce
+from .list import (FlushBatch, MetricList, MetricLists, batched_reduce,
+                   emit_batch, reduce_and_emit, reduce_and_emit_ref)
 
 __all__ = [
     "AggregatedMetric", "Aggregator", "AggregatorClient", "AggregatorShard",
@@ -32,5 +34,6 @@ __all__ = [
     "Elem", "ElemKey", "ElectionManager", "ElectionState", "Entry",
     "FlushManager", "FlushTimesManager", "ForwardedWriter", "Handler",
     "LoggingHandler", "ProducerHandler", "decode_aggregated", "MetricList", "MetricLists", "MetricMap", "RateLimiter",
-    "batched_reduce",
+    "batched_reduce", "FlushBatch", "emit_batch", "reduce_and_emit",
+    "reduce_and_emit_ref", "decode_aggregated_batch",
 ]

@@ -65,11 +65,18 @@ class ForwardedWriter:
         self.dropped = 0
 
     def set_routing(self, placement_getter, transports, local_instance_id):
-        """transports: instance_id -> fn(metric_type, id, t, value, meta)
-        (e.g. TCPTransport.send_forwarded of the peer's rawtcp server)."""
+        """transports: instance_id -> either a transport OBJECT exposing
+        send_forwarded / send_forwarded_batch (TCPTransport — enables the
+        one-frame-per-destination batched forwarding) or a bare
+        fn(metric_type, id, t, value, meta) (legacy per-item form)."""
         self._placement = placement_getter
         self._transports = dict(transports)
         self._local_id = local_instance_id
+
+    @staticmethod
+    def _send_fn(transport):
+        send = getattr(transport, "send_forwarded", None)
+        return send if send is not None else transport
 
     def __call__(self, new_id: bytes, t_nanos: int, value: float,
                  meta: ForwardMetadata, source_id: bytes):
@@ -87,12 +94,68 @@ class ForwardedWriter:
                 delivered |= self._target.add_forwarded(
                     MetricType.GAUGE, new_id, t_nanos, value, meta)
                 continue
-            send = self._transports.get(inst.id)
-            if send is not None and send(
+            tr = self._transports.get(inst.id)
+            if tr is not None and self._send_fn(tr)(
                     MetricType.GAUGE, new_id, t_nanos, value, meta):
                 delivered = True
         if not delivered:
             self.dropped += 1
+
+    def forward_batch(self, items):
+        """Ship one flush round's rollup forwards batched (the sink
+        list.py emit_batch collects instead of per-datapoint forward_fn
+        calls). Local deliveries apply directly; remote deliveries
+        coalesce into ONE columnar `fbatch` frame per (destination
+        instance, forward-meta group) per flush round — the PR 7
+        tile-RPC shape — via TCPTransport.send_forwarded_batch. Items
+        are (new_id, t_nanos, value, meta, source_id)."""
+        if self._placement is None:
+            add = self._target.add_forwarded
+            for new_id, t_nanos, value, meta, _src in items:
+                add(MetricType.GAUGE, new_id, t_nanos, value, meta)
+            return
+        from ..cluster.placement import ShardState
+
+        states = (ShardState.INITIALIZING, ShardState.AVAILABLE)
+        placement = self._placement()
+        delivered = [False] * len(items)
+        pending: Dict[str, List[int]] = {}
+        for i, (new_id, t_nanos, value, meta, _src) in enumerate(items):
+            shard = self._target.shard_for(new_id)
+            for inst in placement.replicas_for(shard, states=states):
+                if inst.id == self._local_id:
+                    if self._target.add_forwarded(
+                            MetricType.GAUGE, new_id, t_nanos, value, meta):
+                        delivered[i] = True
+                    continue
+                if inst.id in self._transports:
+                    pending.setdefault(inst.id, []).append(i)
+        for inst_id, idxs in pending.items():
+            tr = self._transports[inst_id]
+            batch_send = getattr(tr, "send_forwarded_batch", None)
+            if batch_send is None:
+                send = self._send_fn(tr)
+                for i in idxs:
+                    new_id, t_nanos, value, meta, _src = items[i]
+                    if send(MetricType.GAUGE, new_id, t_nanos, value, meta):
+                        delivered[i] = True
+                continue
+            # one frame per meta group (metas differ only across
+            # pipelines/policies, so a flush round is typically one
+            # frame per destination)
+            groups: Dict[tuple, List[int]] = {}
+            for i in idxs:
+                meta = items[i][3]
+                gk = (meta.aggregation_id, meta.storage_policy,
+                      meta.pipeline, meta.num_forwarded_times)
+                groups.setdefault(gk, []).append(i)
+            for gidx in groups.values():
+                if batch_send(MetricType.GAUGE, [items[i] for i in gidx]):
+                    for i in gidx:
+                        delivered[i] = True
+        undelivered = delivered.count(False)
+        if undelivered:
+            self.dropped += undelivered
 
 
 class Aggregator:
@@ -214,29 +277,38 @@ class Aggregator:
         return mgr
 
     def flush(self, now_nanos: Optional[int] = None) -> int:
-        """One flush pass over all owned shards, batched into a single device
-        reduction (list.reduce_and_emit). With an election manager the
-        leader/follower protocol gates emission; without one, flush directly
-        (the embedded coordinator downsampler runs leaderless,
+        """One flush pass over all owned shards, batched into a single
+        columnar reduction: every shard collects into ONE FlushBatch, so
+        all aggregation shards reduce in one emit_batch (one mesh-sharded
+        device program for the round's quantile ordering). With an
+        election manager the leader/follower protocol gates emission, and
+        the round's per-shard flush times commit as ONE kv transaction
+        (FlushTimesManager.store_many); without one, flush directly (the
+        embedded coordinator downsampler runs leaderless,
         downsample/leader_local.go)."""
-        from .flush import plan_jobs
-        from .list import reduce_and_emit
+        from .list import FlushBatch, emit_batch
 
         now = self._clock() if now_nanos is None else now_nanos
-        jobs, commits = [], []
+        batch = FlushBatch()
+        commits = []
         with self._shards_lock:  # snapshot: handler threads insert shards
             shards = {sid: self._shards[sid] for sid in sorted(self._shards)}
         for sid, shard in shards.items():
             if self._election is not None:
-                shard_jobs, commit = self._flush_mgr(shard).plan(now)
-                jobs.extend(shard_jobs)
+                _, commit = self._flush_mgr(shard).plan_into(now, batch)
                 commits.append(commit)
             else:
-                jobs.extend(plan_jobs(shard.lists, now, self._buffer_past_ns,
-                                      self._flush_handler, self._forward)[0])
-        total = reduce_and_emit(jobs)
-        for commit in commits:
-            commit()
+                for lst in shard.lists.lists():
+                    res = lst.resolution_ns
+                    target = (now - self._buffer_past_ns) // res * res
+                    lst.collect_into(target, batch)
+        total = emit_batch(batch, self._flush_handler, self._forward)
+        if commits:
+            pending: Dict[int, Dict[int, int]] = {}
+            for commit in commits:
+                commit(pending)
+            if pending:
+                self._flush_times.store_many(pending)
         return total
 
     def tick(self) -> int:

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import threading
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -41,26 +42,65 @@ class ElemKey:
     num_forwarded_times: int = 0
 
 
-class _Bucket:
-    """Staged raw values for one aligned window (generic_elem.go timedAggregation,
-    minus the eager reduction)."""
+def _concat(staged) -> np.ndarray:
+    """One window's staged value(s) -> one array. A bucket holds the
+    ndarray itself after a single columnar add (the ingest fast path —
+    zero copies, zero wrappers) and degrades to a chunk list only when a
+    window receives multiple adds."""
+    if type(staged) is not list:
+        return staged
+    if len(staged) == 1:
+        return staged[0]
+    if not staged:
+        return np.empty(0, dtype=np.float64)
+    return np.concatenate(staged)
 
-    __slots__ = ("chunks", "n")
 
-    def __init__(self):
-        self.chunks: List[np.ndarray] = []
-        self.n = 0
+class EmitClass:
+    """Shared emission shape of every elem with the same (agg types,
+    quantiles, policy, piped) signature — the unit the columnar flush
+    (list.py emit_batch) groups rows by. Interned process-wide so the
+    per-window classification in the collect hot loop is one identity-
+    hashed dict lookup, never a tuple hash of enums and policies."""
 
-    def add(self, values: np.ndarray):
-        self.chunks.append(values)
-        self.n += values.size
+    __slots__ = ("agg_types", "quantiles", "policy", "res_ns", "piped",
+                 "needed", "q_idx")
 
-    def concat(self) -> np.ndarray:
-        if not self.chunks:
-            return np.empty(0, dtype=np.float64)
-        if len(self.chunks) == 1:
-            return self.chunks[0]
-        return np.concatenate(self.chunks)
+    def __init__(self, agg_types, quantiles, policy, piped: bool):
+        self.agg_types = agg_types
+        self.quantiles = quantiles
+        self.policy = policy
+        self.res_ns = policy.resolution.window_ns
+        self.piped = piped
+        # Moment columns this class's emissions read; the flush only
+        # ever computes these for the class's buckets ("count" is always
+        # available — it gates the empty-window defaults).
+        self.needed = frozenset(
+            k for at in agg_types if at.quantile() is None
+            for k in STAT_DEPS[at])
+        # Quantile agg type -> POSITION in `quantiles` (tuple-index
+        # keying: emission never looks a quantile up by float equality).
+        self.q_idx: Dict["magg.AggType", int] = {
+            at: quantiles.index(q) for at in agg_types
+            if (q := at.quantile()) is not None}
+
+
+_EMIT_CLASSES: Dict[tuple, EmitClass] = {}
+_EMIT_CLASSES_LOCK = threading.Lock()
+
+
+def _emit_class_for(agg_types, quantiles, policy, piped: bool) -> EmitClass:
+    key = (agg_types, quantiles, policy, piped)
+    cls = _EMIT_CLASSES.get(key)
+    if cls is None:
+        # Check-then-create under the lock: elems are constructed from
+        # concurrent connection-handler threads.
+        with _EMIT_CLASSES_LOCK:
+            cls = _EMIT_CLASSES.get(key)
+            if cls is None:
+                cls = _EMIT_CLASSES[key] = EmitClass(
+                    agg_types, quantiles, policy, piped)
+    return cls
 
 
 class Elem:
@@ -70,6 +110,11 @@ class Elem:
     closed_buckets hands (window_start, values) pairs to the list's batched
     consumer and drops them (generic_elem.go:264 Consume).
     """
+
+    __slots__ = ("key", "metric_type", "agg_types", "resolution_ns",
+                 "_quantiles", "_q_idx", "_out_ids", "_out_tuple",
+                 "_simple_type", "_eclass", "_buckets",
+                 "_degraded", "_lock", "_prev", "tombstoned")
 
     def __init__(self, key: ElemKey, metric_type: MetricType,
                  agg_types: Optional[Sequence[magg.AggType]] = None):
@@ -88,9 +133,21 @@ class Elem:
         self._quantiles: Tuple[float, ...] = tuple(
             sorted({q for t in self.agg_types
                     if (q := t.quantile()) is not None}))
+        # Quantile agg type -> POSITION in self._quantiles: emission
+        # looks quantile values up by tuple index, so a recomputed float
+        # can never miss on bit inequality (reduce paths hand emit a
+        # value row aligned to this tuple).
+        self._q_idx: Dict[magg.AggType, int] = {
+            at: self._quantiles.index(q) for at in self.agg_types
+            if (q := at.quantile()) is not None}
         self._out_ids: Dict[magg.AggType, bytes] = {
             at: self._output_id(at) for at in self.agg_types}
-        # The vectorized-emission shape (list.py reduce_and_emit): ONE
+        # Positionally aligned with agg_types: the columnar emit indexes
+        # output ids by agg-type position (int index beats an enum-keyed
+        # dict hash in the 2M-output flush loop).
+        self._out_tuple: Tuple[bytes, ...] = tuple(
+            self._out_ids[at] for at in self.agg_types)
+        # The vectorized-emission shape (list.py reduce_and_emit_ref): ONE
         # non-quantile agg type, no pipeline — counters (Sum) and gauges
         # (Last), i.e. the overwhelming majority of a metrics workload.
         self._simple_type: Optional[magg.AggType] = (
@@ -98,7 +155,27 @@ class Elem:
             if (key.pipeline.is_empty() and len(self.agg_types) == 1
                 and self.agg_types[0].quantile() is None)
             else None)
-        self._buckets: Dict[int, _Bucket] = {}
+        # Columnar-flush grouping handle (list.py emit_batch), interned
+        # so collect classifies each window by one identity hash.
+        self._eclass: EmitClass = _emit_class_for(
+            self.agg_types, self._quantiles, key.storage_policy,
+            not key.pipeline.is_empty())
+        # start -> list of staged value chunks (plain list: the ingest
+        # path appends, the collect path concatenates; no per-bucket
+        # object or method dispatch on either hot loop).
+        self._buckets: Dict[int, List[np.ndarray]] = {}
+        # True while this elem's staging MAY hold chunk-list merges (a
+        # window received a second add). Collect skips the per-window
+        # _concat/reconcile pass until then; reset under the lock once a
+        # drain leaves no buckets behind.
+        self._degraded = False
+        # Serializes slot MUTATION against the flush drain (the
+        # reference's per-elem lockedAggregation, generic_elem.go): a
+        # first add of a window inserts lock-free (a fresh key can never
+        # resurrect flushed data), but degrading a slot to a chunk list
+        # and the collect-time pops hold this lock, so a racing flush
+        # can never emit a window and then see its data re-staged.
+        self._lock = threading.Lock()
         # Per-pipeline-transform previous datapoint, for binary transforms
         # (PerSecond needs the prior window's value: generic_elem.go:300
         # processValueWithAggregationLock keeps lastConsumedValues).
@@ -107,37 +184,77 @@ class Elem:
 
     # -- ingest path -------------------------------------------------------
 
-    def _bucket_for(self, t_nanos: int) -> _Bucket:
+    def _stage(self, t_nanos: int, values: np.ndarray):
+        """Stage one value array into its aligned window. The first add
+        stores the array itself (the columnar ingest path stages each
+        window exactly once — no wrapper, no chunk list); later adds to
+        the same window degrade the slot to a chunk list, concatenated
+        lazily at collect time (_concat)."""
         start = t_nanos - t_nanos % self.resolution_ns
-        b = self._buckets.get(start)
-        if b is None:
-            b = self._buckets[start] = _Bucket()
-        return b
+        b = self._buckets
+        cur = b.get(start)
+        if cur is None:
+            # lock-free fast path: the common staging shape is exactly
+            # one columnar add per window, and inserting a FRESH key can
+            # neither disturb a concurrent drain's snapshot (a key
+            # inserted after sorted() simply survives for the next
+            # round) nor resurrect popped data
+            b[start] = values
+            return
+        with self._lock:
+            # Degraded staging (multi-add to one window). The flag is
+            # STICKY and set BEFORE the merge becomes visible: a drain
+            # that pops a merged slot — or whose popped window gets
+            # merged back by this path — is guaranteed to observe
+            # _degraded on its post-pop read (GIL total order) and run
+            # the locked reconciliation sweep. Keys are NEVER removed
+            # here (get-then-merge only), so the drain's plain C pops
+            # can never miss.
+            self._degraded = True
+            cur = b.get(start)
+            if cur is None:
+                # a racing drain popped (and will emit) the window: the
+                # late value starts a FRESH slot, emitted next round
+                b[start] = values
+            elif type(cur) is list:
+                # in place: a drain that already popped this list sees
+                # the chunk or not (torn adds stage-or-drop exactly
+                # once, the pre-rebuild _Bucket semantics)
+                cur.append(values)
+            else:
+                # slot re-creation is the one hazard (cur may be popped
+                # and emitted between our get and this set) — the
+                # drain's reconciliation sweep drops just-emitted chunks
+                # from merged-back slots by identity, under this lock
+                b[start] = [cur, values]
 
     def add_union(self, t_nanos: int, mu: MetricUnion):
         if mu.type == MetricType.COUNTER:
-            self._bucket_for(t_nanos).add(np.asarray([mu.counter_val], dtype=np.float64))
+            self._stage(t_nanos, np.asarray([mu.counter_val], dtype=np.float64))
         elif mu.type == MetricType.GAUGE:
-            self._bucket_for(t_nanos).add(np.asarray([mu.gauge_val], dtype=np.float64))
+            self._stage(t_nanos, np.asarray([mu.gauge_val], dtype=np.float64))
         elif mu.type == MetricType.TIMER:
-            self._bucket_for(t_nanos).add(np.asarray(mu.batch_timer_val, dtype=np.float64))
+            self._stage(t_nanos, np.asarray(mu.batch_timer_val, dtype=np.float64))
         else:
             raise ValueError(f"invalid metric type {mu.type}")
 
     def add_value(self, t_nanos: int, value: float):
-        self._bucket_for(t_nanos).add(np.asarray([value], dtype=np.float64))
+        self._stage(t_nanos, np.asarray([value], dtype=np.float64))
 
     def add_values(self, t_nanos: int, values: np.ndarray):
-        self._bucket_for(t_nanos).add(np.asarray(values, dtype=np.float64))
+        self._stage(t_nanos, np.asarray(values, dtype=np.float64))
 
     # -- consume path ------------------------------------------------------
 
     def closed_buckets(self, target_nanos: int) -> List[Tuple[int, np.ndarray]]:
         """Pop buckets whose window has fully closed before target_nanos."""
         out = []
-        for start in sorted(self._buckets):
-            if start + self.resolution_ns <= target_nanos:
-                out.append((start, self._buckets.pop(start).concat()))
+        with self._lock:  # same drain-vs-degrade discipline as collect_into
+            for start in sorted(self._buckets):
+                if start + self.resolution_ns <= target_nanos:
+                    out.append((start, _concat(self._buckets.pop(start))))
+            if not self._buckets:
+                self._degraded = False  # no surviving chunk merges
         return out
 
     def is_empty(self) -> bool:
@@ -149,27 +266,45 @@ class Elem:
         return self._quantiles
 
     def emit(self, window_start: int, stats_row: Dict[str, float],
-             quantile_row: Dict[float, float],
+             quantile_vals: Sequence[float],
              flush_fn: Callable, forward_fn: Optional[Callable] = None):
-        """Turn one reduced window into flushed datapoints.
+        """Turn one reduced window into flushed datapoints (the per-window
+        scalar path, used by the retained host oracle reduce_and_emit_ref;
+        the production columnar path is list.py emit_batch).
 
         flush_fn(metric_id, time_nanos, value, storage_policy) per agg type;
         an elem with remaining pipeline ops instead applies transforms and
         forwards through forward_fn (aggregator/forwarded_writer.go).
-        Timestamp is the window end, matching the reference's convention
+        `quantile_vals` is positionally aligned with self._quantiles and
+        indexed through _q_idx — a tuple-index lookup, so a recomputed
+        quantile float can never miss on bit inequality. Timestamp is the
+        window end, matching the reference's convention
         (generic_elem.go:283 timestamp = timeNanos + resolution).
         """
         end_nanos = window_start + self.resolution_ns
+        # The per-window scalar emit exists to serve the retained
+        # bit-exactness oracle (reduce_and_emit_ref); production flushes
+        # batch through list.py emit_batch and never take this loop.
+        # m3lint: disable=per-datapoint-callback-in-flush
         for at in self.agg_types:
-            q = at.quantile()
-            value = quantile_row[q] if q is not None else _stat_value(at, stats_row)
+            if at in self._q_idx:
+                value = quantile_vals[self._q_idx[at]]
+            else:
+                value = _stat_value(at, stats_row)
             if self.key.pipeline.is_empty():
                 flush_fn(self._out_ids[at], end_nanos, value, self.key.storage_policy)
             else:
                 self._process_pipeline(at, end_nanos, value, flush_fn, forward_fn)
 
     def _process_pipeline(self, at, t_nanos: int, value: float,
-                          flush_fn, forward_fn):
+                          flush_fn, forward_fn, forward_sink=None):
+        """Apply the remaining pipeline ops to one reduced value.
+
+        With `forward_sink` (a list), rollup outputs are APPENDED as
+        (new_id, t_nanos, value, meta, source_id) instead of calling
+        forward_fn per datapoint — the columnar flush coalesces the
+        round's forwards into per-destination batches (list.py
+        emit_batch -> ForwardedWriter.forward_batch)."""
         ops = self.key.pipeline.ops
         dp = Datapoint(t_nanos, value)
         for i, op in enumerate(ops):
@@ -181,9 +316,17 @@ class Elem:
                     return
                 out = apply_transform(tt, prev, dp)
                 self._prev[int(at)] = dp
+                if out.time_nanos == 0 and math.isnan(out.value):
+                    # Empty transform output (transformation/binary.go
+                    # emptyDatapoint: NaN input, non-increasing time, or
+                    # negative diff): never emitted or forwarded — the
+                    # reference's default DiscardNaNAggregatedValues. A
+                    # forwarded (t=0, NaN) would stage a bogus epoch-0
+                    # window in the next aggregation stage.
+                    return
                 dp = out
             elif op.type == OpType.ROLLUP:
-                if forward_fn is None:
+                if forward_sink is None and forward_fn is None:
                     return
                 rop = op.rollup
                 meta = ForwardMetadata(
@@ -193,8 +336,12 @@ class Elem:
                     source_id=self.key.metric_id,
                     num_forwarded_times=self.key.num_forwarded_times + 1,
                 )
-                forward_fn(rop.new_name, dp.time_nanos, dp.value, meta,
-                           self.key.metric_id)
+                if forward_sink is not None:
+                    forward_sink.append((rop.new_name, dp.time_nanos,
+                                         dp.value, meta, self.key.metric_id))
+                else:
+                    forward_fn(rop.new_name, dp.time_nanos, dp.value, meta,
+                               self.key.metric_id)
                 return
             else:
                 raise ValueError(f"unsupported pipeline op {op.type} in elem")

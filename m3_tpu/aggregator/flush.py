@@ -42,6 +42,23 @@ class FlushTimesManager:
         self._store.set(self._key(shard_id), json.dumps(
             {str(k): v for k, v in flush_times.items()}).encode())
 
+    def store_many(self, updates: Dict[int, Dict[int, int]]):
+        """Persist one flush round's times for MANY shards as one kv
+        transaction (MemStore.set_many): leader flush no longer
+        serializes on a kv round trip per shard. Stores without a batch
+        API (e.g. the remote kv client) fall back to per-shard sets."""
+        if not updates:
+            return
+        items = {self._key(sid): json.dumps(
+            {str(k): v for k, v in ft.items()}).encode()
+            for sid, ft in updates.items()}
+        set_many = getattr(self._store, "set_many", None)
+        if set_many is not None:
+            set_many(items)
+        else:
+            for key, data in items.items():
+                self._store.set(key, data)
+
 
 class FlushManager:
     """Drives per-resolution flushes against election state (flush_mgr.go:188).
@@ -68,44 +85,56 @@ class FlushManager:
 
     def flush(self, now_nanos: int) -> int:
         """One standalone flush pass; returns number of windows consumed."""
-        from .list import reduce_and_emit
+        from .list import FlushBatch, emit_batch
 
-        jobs, commit = self.plan(now_nanos)
-        n = reduce_and_emit(jobs)
+        batch = FlushBatch()
+        n, commit = self.plan_into(now_nanos, batch)
+        emit_batch(batch, self._flush_fn, self._forward_fn)
         commit()
         return n if self._election.state == ElectionState.LEADER else 0
 
-    def plan(self, now_nanos: int):
-        """Collect this manager's closed windows as reduce jobs plus a commit
-        callback, so a caller can batch many managers' jobs into one device
-        reduction (Aggregator.flush does this across shards)."""
+    def plan_into(self, now_nanos: int, batch):
+        """Collect this manager's closed windows into `batch` (a columnar
+        list.FlushBatch, so a caller can batch many managers' shards into
+        ONE device reduction — Aggregator.flush does this across shards)
+        plus a commit callback. commit(pending=None): with a dict, the
+        shard's updated flush times are RECORDED into it for one batched
+        FlushTimesManager.store_many; without, they store immediately.
+        Returns (windows_collected, commit)."""
         self._election.campaign()
         if self._election.state == ElectionState.LEADER:
-            return self._plan_as_leader(now_nanos)
+            return self._plan_as_leader(now_nanos, batch)
         return self._plan_as_follower(now_nanos)
 
-    def _plan_as_leader(self, now_nanos: int):
+    def _plan_as_leader(self, now_nanos: int, batch):
         flushed = self._flush_times.get(self._shard_id)
-        # Windows the previous leader already flushed (per KV flush times)
-        # are discarded, not re-emitted: a promoted follower may still hold
-        # closed windows it had not yet discarded, and re-emitting them would
-        # double-count in forwarded rollup pipelines.
-        jobs, stale = plan_jobs(self._lists, now_nanos, self._buffer_past_ns,
-                                self._flush_fn, self._forward_fn,
-                                flushed=flushed)
-        self.windows_discarded += stale
+        n = 0
+        stale = 0
         for lst in self._lists.lists():
             res = lst.resolution_ns
             target = (now_nanos - self._buffer_past_ns) // res * res
+            # Windows the previous leader already flushed (per KV flush
+            # times) are discarded, not re-emitted: a promoted follower
+            # may still hold closed windows it had not yet discarded, and
+            # re-emitting them would double-count in forwarded rollup
+            # pipelines.
+            c, d = lst.collect_into(target, batch,
+                                    already=flushed.get(res, 0))
+            n += c
+            stale += d
             # Resume after the last persisted flush (leader_flush_mgr.go:
             # flush times seed the flush schedule on promotion).
             flushed[res] = max(flushed.get(res, 0), target)
-        self.windows_flushed += len(jobs)
+        self.windows_discarded += stale
+        self.windows_flushed += n
 
-        def commit():
-            self._flush_times.store(self._shard_id, flushed)
+        def commit(pending: Optional[Dict[int, Dict[int, int]]] = None):
+            if pending is None:
+                self._flush_times.store(self._shard_id, flushed)
+            else:
+                pending[self._shard_id] = flushed
 
-        return jobs, commit
+        return n, commit
 
     def _plan_as_follower(self, now_nanos: int):
         """Discard windows the leader already flushed (follower_flush_mgr.go
@@ -122,11 +151,11 @@ class FlushManager:
             discarded += len(lst.collect(leader_target))
         self.windows_discarded += discarded
 
-        def commit():
+        def commit(pending=None):
             if caught_up:
                 self._election.confirm_follower()
 
-        return [], commit
+        return 0, commit
 
 
 def plan_jobs(lists: MetricLists, now_nanos: int, buffer_past_ns: int,

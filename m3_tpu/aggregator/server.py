@@ -45,8 +45,25 @@ from ..metrics.metric import MetricType, MetricUnion
 from ..metrics.policy import StoragePolicy
 from ..rpc import wire
 from ..utils.health import AdmissionGate, Priority
-from ..utils.limits import Backpressure
+from ..utils.limits import Backpressure, tenant_of
 from .aggregator import Aggregator
+
+
+def _frame_tenant(e: dict) -> Optional[bytes]:
+    """Tenant for admission fair-share: the explicit frame hint `tn`
+    when present, else the metric id prefix of the frame's (first) id
+    (utils/limits.tenant_of). Forwarded frames are CRITICAL and bypass
+    tenant shedding anyway; extraction still tags their depth."""
+    tn = e.get("tn")
+    if tn is not None:
+        return tn if isinstance(tn, bytes) else str(tn).encode()
+    mid = e.get("id")
+    if mid is None:
+        ids = e.get("ids")
+        mid = ids[0] if isinstance(ids, (list, tuple)) and ids else None
+    if isinstance(mid, (bytes, bytearray, memoryview)):
+        return tenant_of(bytes(mid))
+    return None
 
 
 def metadatas_to_wire(metadatas: Sequence[StagedMetadata]) -> list:
@@ -106,6 +123,67 @@ def forwarded_to_wire(metric_type: MetricType, metric_id: bytes,
         "pipeline": pipeline_to_json(meta.pipeline),
         "source_id": meta.source_id, "num_times": meta.num_forwarded_times,
     }
+
+
+def forwarded_batch_to_wire(metric_type: MetricType, rows) -> dict:
+    """One flush round's rollup forwards for one (destination, meta
+    group) as a COLUMNAR `fbatch` frame (the tbatch shape for the
+    forwarded plane): numeric columns ride as raw ndarray buffers, the
+    shared meta fields once per frame instead of once per datapoint.
+    Rows are (new_id, t_nanos, value, meta, source_id) with identical
+    meta group fields (ForwardedWriter.forward_batch groups them)."""
+    meta = rows[0][3]
+    return {
+        "t": "fbatch", "mtype": int(metric_type),
+        "agg_id": meta.aggregation_id,
+        "policy": str(meta.storage_policy),
+        "pipeline": pipeline_to_json(meta.pipeline),
+        "num_times": meta.num_forwarded_times,
+        "ids": [r[0] for r in rows],
+        "source_ids": [r[4] for r in rows],
+        "times": np.asarray([r[1] for r in rows], np.int64),
+        "values": np.asarray([r[2] for r in rows], np.float64),
+    }
+
+
+def dispatch_forwarded_batch(agg: Aggregator, e: dict):
+    """Columnar forwarded batch: meta parsed once, numeric columns
+    converted in one C pass, then the tight add_forwarded loop. Validates
+    everything that could raise BEFORE the first add (the tbatch
+    all-or-nothing contract: a rejected frame never leaves a partially
+    aggregated prefix for the sender's retry to double-count)."""
+    ids = e["ids"]
+    srcs = e["source_ids"]
+    times = e["times"]
+    values = e["values"]
+    if not (len(ids) == len(srcs) == len(times) == len(values)):
+        raise ValueError(
+            f"fbatch column length mismatch: {len(ids)} ids, "
+            f"{len(srcs)} source_ids, {len(times)} times, "
+            f"{len(values)} values")
+    if not all(isinstance(m, (bytes, bytearray, memoryview))
+               for m in ids) or not all(
+                   isinstance(m, (bytes, bytearray, memoryview))
+                   for m in srcs):
+        raise ValueError("fbatch ids/source_ids must all be bytes")
+    ids = [m if type(m) is bytes else bytes(m) for m in ids]
+    srcs = [m if type(m) is bytes else bytes(m) for m in srcs]
+    mt = MetricType(e["mtype"])
+    agg_id = e["agg_id"]
+    pol = StoragePolicy.parse(e["policy"])
+    pipe = pipeline_from_json(e["pipeline"])
+    num_times = e["num_times"]
+    times = np.asarray(times)
+    values = np.asarray(values)
+    if times.dtype.kind not in "iuf" or values.dtype.kind not in "iuf":
+        raise ValueError("fbatch times/values must be numeric columns")
+    if times.ndim != 1 or values.ndim != 1:
+        raise ValueError("fbatch times/values must be one-dimensional")
+    add = agg.add_forwarded
+    for mid, src, t, v in zip(ids, srcs, times.tolist(), values.tolist()):
+        add(mt, mid, t, v, ForwardMetadata(
+            aggregation_id=agg_id, storage_policy=pol, pipeline=pipe,
+            source_id=src, num_forwarded_times=num_times))
 
 
 def forwarded_from_wire(frame: dict):
@@ -204,17 +282,17 @@ class RawTCPServer:
         `errors` (tbatch dispatch validates before the first add, so a
         failure means the whole frame was rejected — nothing partial)."""
         def _records() -> int:
-            if e.get("t") != "tbatch":
+            if e.get("t") not in ("tbatch", "fbatch"):
                 return 1
             ids = e.get("ids")
             return len(ids) if isinstance(ids, (list, tuple)) else 1
 
         n = _records()
-        pri = (Priority.CRITICAL if e.get("t") == "forwarded"
+        pri = (Priority.CRITICAL if e.get("t") in ("forwarded", "fbatch")
                else Priority.BULK if e.get("pri") == "bulk"
                else Priority.NORMAL)
         try:
-            with self.gate.held(n, priority=pri):
+            with self.gate.held(n, priority=pri, tenant=_frame_tenant(e)):
                 dispatch_entry(self.aggregator, e)
         except Backpressure:
             # fire-and-forget transport: shed = counted drop (the msg
@@ -255,6 +333,8 @@ def dispatch_entry(agg: Aggregator, e: dict):
             StoragePolicy.parse(e["policy"]), e.get("agg_id", 0))
     elif e["t"] == "tbatch":
         dispatch_timed_batch(agg, e)
+    elif e["t"] == "fbatch":
+        dispatch_forwarded_batch(agg, e)
     elif e["t"] == "forwarded":
         mt, mid, t_nanos, value, meta = forwarded_from_wire(e)
         agg.add_forwarded(mt, mid, t_nanos, value, meta)
@@ -509,6 +589,27 @@ class TCPTransport(_BatchingTransport):
             "times": _np.asarray(times, _np.int64),
             "values": _np.asarray(values, _np.float64),
         })
+
+    def send_forwarded_batch(self, metric_type: MetricType, rows) -> bool:
+        """Deliver one flush round's rollup partials for one meta group
+        as ONE columnar fbatch frame (forwarded_batch_to_wire) — the
+        batched twin of send_forwarded, one frame per destination per
+        round instead of one per datapoint. Rows are
+        (new_id, t_nanos, value, meta, source_id)."""
+        if not rows:
+            return True
+        with self._lock:
+            batch, self._batch = self._batch, []
+        if batch and not self._send_batch(batch):
+            # The piggybacked client-buffer flush failed: re-buffer those
+            # entries for the next send instead of folding their fate
+            # into THIS frame's result — ForwardedWriter counts forward
+            # drops from our return value, and a delivered fbatch must
+            # not be reported dropped because unrelated buffered metrics
+            # hit a dead connection.
+            with self._lock:
+                self._batch = batch + self._batch
+        return self._send_frame(forwarded_batch_to_wire(metric_type, rows))
 
     def send_forwarded(self, metric_type: MetricType, metric_id: bytes,
                        t_nanos: int, value: float,

@@ -28,6 +28,7 @@ Rules:
 from __future__ import annotations
 
 import ast
+import re
 from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
 
 from .core import Finding, Module, Rule, index_functions, qualname
@@ -419,4 +420,80 @@ class HotLoopUnderLockRule(Rule):
         return None
 
 
-RULES: List[Rule] = [LockDisciplineRule(), HotLoopUnderLockRule()]
+# Function names that mark the aggregator's flush/emission paths, and
+# callback parameter names (`flush_fn` / `forward_fn` style) whose
+# per-iteration invocation marks the per-datapoint emit shape.
+_FLUSH_FN_NAME = re.compile(r"flush|emit|consume|reduce")
+_CALLBACK_NAME = re.compile(r"^\w*_fn$")
+
+
+class FlushCallbackLoopRule(Rule):
+    """per-datapoint-callback-in-flush: a Python loop on an aggregator
+    flush/emit/consume path invoking a per-datapoint callback
+    (`*_fn(...)` — flush_fn/forward_fn style sinks) once per iteration.
+    Every flushed window then pays a Python call frame while the whole
+    tier waits — the shape the columnar flush rebuild removed from
+    Elem.emit / reduce_and_emit (one handle_columnar call or a
+    forward_batch per round instead of a callback per datapoint). Fix by
+    emitting through the columnar batch interfaces (emit_batch ->
+    handle_columnar / forward_batch), or justify-suppress a deliberate
+    compat shim. Functions suffixed `_ref` are exempt: retained oracles
+    (reduce_and_emit_ref) preserve the pre-change shape by design."""
+
+    id = "per-datapoint-callback-in-flush"
+    severity = "warning"
+    dirs = ("aggregator",)
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        seen: Set[int] = set()
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if node.name.endswith("_ref"):
+                continue
+            if not _FLUSH_FN_NAME.search(node.name):
+                continue
+            for loop in self._loops_in(node.body):
+                call = self._callback_call(loop)
+                if call is not None and loop.lineno not in seen:
+                    seen.add(loop.lineno)
+                    name = (call.func.id if isinstance(call.func, ast.Name)
+                            else call.func.attr)
+                    yield Finding(
+                        self.id, mod.relpath, loop.lineno,
+                        f"per-datapoint {name}(...) callback inside a loop "
+                        f"in {node.name!r} — every flushed window pays a "
+                        "Python call frame; emit through the columnar "
+                        "batch path (emit_batch -> handle_columnar / "
+                        "forward_batch), or justify-suppress a compat "
+                        "shim (retained *_ref oracles are exempt)",
+                        self.severity)
+
+    def _loops_in(self, stmts) -> Iterator[ast.AST]:
+        """Loop statements anywhere under `stmts`, NOT descending into
+        nested function/class scopes."""
+        stack = list(stmts)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(node, (ast.For, ast.While)):
+                yield node
+                continue  # _callback_call scans the whole loop body
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _callback_call(self, loop: ast.AST) -> Optional[ast.Call]:
+        for sub in ast.walk(loop):
+            if not isinstance(sub, ast.Call):
+                continue
+            f = sub.func
+            name = (f.id if isinstance(f, ast.Name)
+                    else f.attr if isinstance(f, ast.Attribute) else None)
+            if name is not None and _CALLBACK_NAME.match(name):
+                return sub
+        return None
+
+
+RULES: List[Rule] = [LockDisciplineRule(), HotLoopUnderLockRule(),
+                     FlushCallbackLoopRule()]

@@ -66,6 +66,26 @@ class MemStore:
             self._fire(key)
             return version
 
+    def set_many(self, items) -> Dict[str, int]:
+        """One transaction: every key lands under a single lock hold (one
+        version bump each) and change notifications fire after the whole
+        batch is applied. The aggregator's batched flush-times commit
+        (flush.py FlushTimesManager.store_many) rides this so a leader
+        flush round costs one store round trip, not one per shard."""
+        with self._lock:
+            out = {}
+            for key, data in items.items():
+                cur = self._data.get(key)
+                version = (cur.version if cur else 0) + 1
+                self._data[key] = Value(data, version)
+                out[key] = version
+            self._fire_many(list(items))
+            return out
+
+    def _fire_many(self, keys):
+        for k in keys:
+            self._fire(k)
+
     def set_if_not_exists(self, key: str, data: bytes) -> int:
         with self._lock:
             if key in self._data:
@@ -171,6 +191,11 @@ class FileStore(MemStore):
     def _fire(self, key: str):
         super()._fire(key)
         self._persist()
+
+    def _fire_many(self, keys):
+        for k in keys:
+            MemStore._fire(self, k)  # watches/callbacks only
+        self._persist()             # one file write for the whole batch
 
 
 def get_json(store, key: str):

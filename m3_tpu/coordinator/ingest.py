@@ -9,7 +9,7 @@ from __future__ import annotations
 
 from typing import Callable, Dict, Optional, Sequence
 
-from ..aggregator.handler import decode_aggregated
+from ..aggregator.handler import decode_aggregated_batch
 from ..metrics.metric import MetricType
 from ..utils.health import AdmissionGate, Priority
 from ..utils.instrument import ROOT
@@ -99,22 +99,23 @@ class M3MsgIngester:
         # would silently lose aggregated data the platform promised to
         # keep. It is counted against the gate (the depth is honest) but
         # never refused; raw producer traffic sheds first, upstream.
+        metrics = decode_aggregated_batch(payload)
         gate = self.gate
         if gate is not None:
-            gate.admit(priority=Priority.CRITICAL)
+            gate.admit(len(metrics), priority=Priority.CRITICAL)
         try:
-            m = decode_aggregated(payload)
-            storage = self._storage_for(m.storage_policy)
-            if storage is None:
-                return
-            name, tags = metric_id.decode(m.id)
-            if name:
-                tags = {b"__name__": name, **tags}
-            storage.write(m.id, tags, m.time_nanos, m.value)
-            self.ingested += 1
+            for m in metrics:
+                storage = self._storage_for(m.storage_policy)
+                if storage is None:
+                    continue
+                name, tags = metric_id.decode(m.id)
+                if name:
+                    tags = {b"__name__": name, **tags}
+                storage.write(m.id, tags, m.time_nanos, m.value)
+                self.ingested += 1
         finally:
             if gate is not None:
-                gate.release()
+                gate.release(len(metrics))
 
 
 def _series_id(tags: Dict[bytes, bytes]) -> bytes:

@@ -204,6 +204,36 @@ def quantiles(values, mask, qs: tuple):
     return jnp.stack(outs, axis=-1)
 
 
+def quantile_rank_select(values, counts, qs: tuple):
+    """Batched rank selection over padded value rows: [B, W] f32 values
+    + [B] i32 valid counts -> [B, len(qs)] i32 indices of each quantile
+    element WITHIN its row.
+
+    The sort runs on device (stable argsort, padding filled with +inf so
+    real elements order first); only indices come out, and the caller
+    gathers the exact float64 values by index — full f64 quantile
+    precision without the global x64 flag. Rank semantics are the CM
+    stream's target rank ceil(q*n), q=0 -> rank 1 (cm/stream.go:160).
+
+    This one function backs BOTH dispatch routes of the aggregator
+    flush — the single-device jit builder (aggregator/list.py
+    _quantile_rank_fn) and the mesh-sharded reducer
+    (parallel/agg_flush.py) — so the two are bit-identical by
+    construction: the math is row-independent and a stable argsort
+    selects the same element no matter which device sorts the row.
+    """
+    width = values.shape[-1]
+    mask = jnp.arange(width)[None, :] < counts[:, None]
+    filled = jnp.where(mask, values, jnp.inf)
+    order = jnp.argsort(filled, axis=-1).astype(jnp.int32)
+    outs = []
+    for q in qs:
+        rank = jnp.ceil(q * counts).astype(jnp.int32)
+        idx = jnp.clip(jnp.maximum(rank, 1) - 1, 0, width - 1)
+        outs.append(jnp.take_along_axis(order, idx[:, None], axis=-1)[:, 0])
+    return jnp.stack(outs, axis=-1)
+
+
 def _sorted_columns(cols):
     """Sort a short list of same-shaped arrays elementwise across the list.
 

@@ -237,7 +237,9 @@ def run_aggregator(cfg: AggregatorConfig, flush_handler=None,
                     tr = None
                 if tr is None:
                     tr = transports[iid] = TCPTransport(i.endpoint)
-                peers[iid] = tr.send_forwarded
+                # the transport OBJECT: ForwardedWriter batches a flush
+                # round's forwards into one fbatch frame per destination
+                peers[iid] = tr
             for iid in set(transports) - set(p.instances):
                 transports.pop(iid).close()  # instance left the placement
             agg.set_forward_routing(lambda: latest["p"], peers, cfg.instance_id)

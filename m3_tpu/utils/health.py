@@ -55,7 +55,8 @@ class AdmissionGate:
     paired with `release` (use `held()` for scoped work)."""
 
     def __init__(self, capacity: int, high_watermark: float = 0.75,
-                 name: str = "", tracker: Optional["HealthTracker"] = None):
+                 name: str = "", tracker: Optional["HealthTracker"] = None,
+                 tenant_weights: Optional[Dict[bytes, float]] = None):
         if capacity <= 0:
             raise ValueError(f"gate capacity must be positive, got {capacity}")
         self.capacity = capacity
@@ -68,6 +69,16 @@ class AdmissionGate:
                                        else "admission")
         self.admitted = 0
         self.shed: Dict[str, int] = {p.name.lower(): 0 for p in Priority}
+        # Per-tenant weighted fair-share (DAGOR-style), engaged only past
+        # the high watermark: each tenant's in-flight depth is capped at
+        # weight/(Σ active weights + one reserve share) of capacity, so
+        # one noisy tenant saturates its OWN share of the gate and a
+        # quiet tenant arriving mid-burst is still admitted. CRITICAL
+        # is never tenant-shed. Tenants are tracked only while they hold
+        # depth, so the map is bounded by concurrent tenants.
+        self._tenant_weights = dict(tenant_weights or {})
+        self._tenant_depth: Dict[bytes, int] = {}
+        self.shed_tenant = 0
         # Named gates auto-register as health sources (same-named gates
         # overwrite, so re-created services stay bounded in the tracker);
         # anonymous gates are ephemeral (tests, scoped tools) and must
@@ -76,8 +87,21 @@ class AdmissionGate:
             (tracker if tracker is not None else TRACKER).register(
                 name, self.saturation)
 
-    def try_admit(self, n: int = 1, priority: Priority = Priority.NORMAL
-                  ) -> bool:
+    def _weight(self, tenant: bytes) -> float:
+        return self._tenant_weights.get(tenant, 1.0)
+
+    def _tenant_share_locked(self, tenant: bytes) -> float:
+        """Fair share of capacity for `tenant`: capacity * w_t /
+        (Σ weights of tenants holding depth + w_t + one reserve share).
+        The reserve keeps a lone noisy tenant capped below the whole
+        gate, so a newcomer always finds room (lock held)."""
+        w = self._weight(tenant)
+        active = sum(self._weight(t) for t, d in self._tenant_depth.items()
+                     if d > 0 and t != tenant)
+        return self.capacity * w / (active + w + 1.0)
+
+    def try_admit(self, n: int = 1, priority: Priority = Priority.NORMAL,
+                  tenant: Optional[bytes] = None) -> bool:
         with self._lock:
             depth = self._depth + n
             # Semaphore convention: a single request larger than the whole
@@ -91,27 +115,54 @@ class AdmissionGate:
                     self._metrics.counter(
                         f"shed.{priority.name.lower()}").inc(n)
                     return False
+                # Past the high watermark the gate is contended: cap each
+                # tenant at its weighted fair share of capacity, so one
+                # noisy tenant saturates its own share, never the gate.
+                if tenant is not None and depth > self.high:
+                    td = self._tenant_depth.get(tenant, 0)
+                    if td + n > self._tenant_share_locked(tenant):
+                        self.shed[priority.name.lower()] += n
+                        self.shed_tenant += n
+                        self._metrics.counter("shed.tenant").inc(n)
+                        return False
             self._depth = depth
             self._max_depth = max(self._max_depth, depth)
             self.admitted += n
+            if tenant is not None:
+                self._tenant_depth[tenant] = \
+                    self._tenant_depth.get(tenant, 0) + n
             return True
 
-    def admit(self, n: int = 1, priority: Priority = Priority.NORMAL):
-        if not self.try_admit(n, priority):
+    def admit(self, n: int = 1, priority: Priority = Priority.NORMAL,
+              tenant: Optional[bytes] = None):
+        if not self.try_admit(n, priority, tenant=tenant):
             raise Backpressure(
                 f"{self.name or 'admission'}: {priority.name.lower()} work "
                 f"shed at depth {self._depth}/{self.capacity} "
-                f"(high watermark {self.high:g})")
+                f"(high watermark {self.high:g}"
+                + (f", tenant {tenant!r}" if tenant is not None
+                   else "") + ")")
 
-    def release(self, n: int = 1):
+    def release(self, n: int = 1, tenant: Optional[bytes] = None):
         with self._lock:
             self._depth = max(0, self._depth - n)
+            if tenant is not None:
+                td = self._tenant_depth.get(tenant, 0) - n
+                if td > 0:
+                    self._tenant_depth[tenant] = td
+                else:
+                    self._tenant_depth.pop(tenant, None)
             self._metrics.gauge("depth").update(self._depth)
 
-    def held(self, n: int = 1, priority: Priority = Priority.NORMAL):
+    def tenant_depth(self, tenant: bytes) -> int:
+        with self._lock:
+            return self._tenant_depth.get(tenant, 0)
+
+    def held(self, n: int = 1, priority: Priority = Priority.NORMAL,
+             tenant: Optional[bytes] = None):
         """Context manager: admit on enter (raising Backpressure when
         shed), release on every exit path."""
-        return _Held(self, n, priority)
+        return _Held(self, n, priority, tenant)
 
     def depth(self) -> int:
         with self._lock:
@@ -130,23 +181,27 @@ class AdmissionGate:
         with self._lock:
             return {"depth": self._depth, "max_depth": self._max_depth,
                     "capacity": self.capacity, "high": self.high,
-                    "admitted": self.admitted, "shed": dict(self.shed)}
+                    "admitted": self.admitted, "shed": dict(self.shed),
+                    "shed_tenant": self.shed_tenant,
+                    "tenants": dict(self._tenant_depth)}
 
 
 class _Held:
-    __slots__ = ("_gate", "_n", "_priority")
+    __slots__ = ("_gate", "_n", "_priority", "_tenant")
 
-    def __init__(self, gate: AdmissionGate, n: int, priority: Priority):
+    def __init__(self, gate: AdmissionGate, n: int, priority: Priority,
+                 tenant: Optional[bytes] = None):
         self._gate = gate
         self._n = n
         self._priority = priority
+        self._tenant = tenant
 
     def __enter__(self):
-        self._gate.admit(self._n, self._priority)
+        self._gate.admit(self._n, self._priority, tenant=self._tenant)
         return self._gate
 
     def __exit__(self, *exc):
-        self._gate.release(self._n)
+        self._gate.release(self._n, tenant=self._tenant)
         return False
 
 

@@ -44,9 +44,20 @@ from .retry import RetryableError
 
 __all__ = [
     "ResourceExhausted", "Backpressure", "LimitOptions", "SlidingWindow",
-    "QueryLimits", "QueryScope", "KINDS",
+    "QueryLimits", "QueryScope", "KINDS", "tenant_of",
     "charge", "get_global", "set_global", "last_scope_totals",
 ]
+
+
+def tenant_of(metric_id: bytes) -> bytes:
+    """Tenant extracted from a metric id: the first dot-delimited segment
+    of the NAME component ('tenantA.requests;host=x' -> b'tenantA').
+    Frames/requests may override with an explicit hint; this is the
+    fallback the aggregator/server charge sites use. An id without a dot
+    is its own tenant (single-tenant deployments degrade to the global
+    behavior: one tenant, full share)."""
+    name = metric_id.split(b";", 1)[0]
+    return name.split(b".", 1)[0]
 
 # Resource kinds, matching the reference's query limit trio plus the
 # datapoint budget the query engine already meters:
@@ -79,16 +90,34 @@ class Backpressure(ResourceExhausted):
 class LimitOptions:
     """Per-kind limit knobs. None disables that mechanism.
 
-    per_second   sliding-window rate cap over the trailing `window_s`
-    concurrent   global in-flight budget (enforcer parent limit)
-    per_query    per-scope child enforcer limit (defaults to the global
-                 concurrent budget when unset, i.e. one query may use
-                 the whole budget if nothing else is in flight)
+    per_second     sliding-window rate cap over the trailing `window_s`
+    concurrent     global in-flight budget (enforcer parent limit)
+    per_query      per-scope child enforcer limit (defaults to the global
+                   concurrent budget when unset, i.e. one query may use
+                   the whole budget if nothing else is in flight)
+    tenant_fair    weighted per-tenant fair-share over the sliding
+                   window (DAGOR-style): a tenant's charges are capped at
+                   weight/(Σ active weights + one reserve share) of the
+                   window limit, so one noisy tenant saturates its OWN
+                   share and never the whole window — a quiet tenant
+                   arriving mid-burst always finds budget. Charges
+                   without a tenant, or marked critical, bypass the
+                   tenant cap (never the global window).
+    tenant_weights tenant id -> weight (unlisted tenants weigh 1.0)
     """
 
     per_second: Optional[float] = None
     concurrent: Optional[float] = None
     per_query: Optional[float] = None
+    tenant_fair: bool = False
+    tenant_weights: Optional[Tuple[Tuple[bytes, float], ...]] = None
+
+    def weight(self, tenant: bytes) -> float:
+        if self.tenant_weights:
+            for t, w in self.tenant_weights:
+                if t == tenant:
+                    return w
+        return 1.0
 
 
 class SlidingWindow:
@@ -143,7 +172,8 @@ class SlidingWindow:
 
 class _Limit:
     """One resource kind: optional sliding window + optional global
-    concurrent enforcer."""
+    concurrent enforcer + optional per-tenant weighted fair-share over
+    the window."""
 
     def __init__(self, kind: str, opts: LimitOptions,
                  clock: Callable[[], float]):
@@ -152,13 +182,80 @@ class _Limit:
         self.window = (SlidingWindow(opts.per_second, clock=clock)
                        if opts.per_second is not None else None)
         self.enforcer = Enforcer(limit=opts.concurrent, name=kind)
+        self._fair = bool(opts.tenant_fair) and self.window is not None
+        self._clock = clock
+        self._tenant_lock = threading.Lock()
+        # tenant -> usage window (limit inf: a pure per-tenant usage
+        # recorder; the SHARE check below is what rejects). Pruned of
+        # idle tenants on every share computation, so it is bounded by
+        # the tenants active within one trailing window.
+        self._tenant_use: Dict[bytes, SlidingWindow] = {}
 
-    def charge_window(self, n: float):
-        if self.window is not None and not self.window.try_charge(n):
+    def _tenant_share_locked(self, tenant: bytes) -> Tuple[float, SlidingWindow]:
+        """This tenant's fair share of the window limit, DAGOR-style:
+        limit * w_t / (Σ active weights + w_t + one reserve share). The
+        reserve keeps a lone noisy tenant capped BELOW the full window,
+        so a quiet tenant arriving mid-burst always finds budget ("its
+        own share, never the whole window"). _tenant_lock held."""
+        w = self.opts.weight(tenant)
+        tw = self._tenant_use.get(tenant)
+        if tw is None:
+            tw = self._tenant_use[tenant] = SlidingWindow(
+                float("inf"), clock=self._clock)
+        active = 0.0
+        dead = []
+        for t, win in self._tenant_use.items():
+            if t == tenant:
+                continue
+            if win.current() > 0:
+                active += self.opts.weight(t)
+            else:
+                dead.append(t)
+        for t in dead:
+            del self._tenant_use[t]
+        return self.window.limit * w / (active + w + 1.0), tw
+
+    def charge_window(self, n: float, tenant: Optional[bytes] = None,
+                      critical: bool = False):
+        if self.window is None:
+            return
+        if self._fair and tenant is not None and not critical:
+            # Share check, window charge, and usage recording are ONE
+            # atomic step under the tenant lock: two racing charges of
+            # the same tenant can't both read the pre-charge usage and
+            # blow through the fair share. The global window has its own
+            # inner lock; nothing ever takes the tenant lock after it,
+            # so the nesting can't invert.
+            with self._tenant_lock:
+                share, tw = self._tenant_share_locked(tenant)
+                if tw.current() + n > share:
+                    _scope_metrics.counter(
+                        f"{self.kind}.tenant_exceeded").inc()
+                    raise ResourceExhausted(
+                        f"{self.kind}: tenant {tenant!r} charge {n:g} "
+                        f"would exceed its fair share {share:g} of the "
+                        f"per-second limit {self.window.limit:g} "
+                        f"(tenant current {tw.current():g})")
+                if not self.window.try_charge(n):
+                    _scope_metrics.counter(f"{self.kind}.exceeded").inc()
+                    raise ResourceExhausted(
+                        f"{self.kind}: {n:g} would exceed per-second "
+                        f"limit {self.window.limit:g} "
+                        f"(current {self.window.current():g})")
+                # usage recorded only for ADMITTED work (the try_charge
+                # invariant: a rejection leaves nothing charged anywhere)
+                tw.try_charge(n)
+            return
+        if not self.window.try_charge(n):
             _scope_metrics.counter(f"{self.kind}.exceeded").inc()
             raise ResourceExhausted(
                 f"{self.kind}: {n:g} would exceed per-second limit "
                 f"{self.window.limit:g} (current {self.window.current():g})")
+
+    def tenant_usage(self, tenant: bytes) -> float:
+        with self._tenant_lock:
+            tw = self._tenant_use.get(tenant)
+        return tw.current() if tw is not None else 0.0
 
     def saturation(self) -> float:
         """In-flight concurrent usage as a fraction of the budget (0 when
@@ -177,8 +274,10 @@ class QueryScope:
     up the chain (relying on Enforcer.release(None) crediting the
     parent) and restores the previous scope."""
 
-    def __init__(self, limits: "QueryLimits", name: str):
+    def __init__(self, limits: "QueryLimits", name: str,
+                 tenant: Optional[bytes] = None):
         self.name = name
+        self.tenant = tenant
         self._limits = limits
         # Cumulative per-kind charges for THIS scope's lifetime (the
         # enforcers only know in-flight): the span/slow-query cost
@@ -206,7 +305,7 @@ class QueryScope:
             _scope_metrics.counter(f"{kind}.exceeded").inc()
             raise ResourceExhausted(str(e)) from e
         try:
-            lim.charge_window(n)
+            lim.charge_window(n, tenant=self.tenant)
         except ResourceExhausted:
             self._children[kind].release(n)
             raise
@@ -259,14 +358,26 @@ class QueryLimits:
             for kind in KINDS
         }
 
-    def charge(self, kind: str, n: float):
+    def charge(self, kind: str, n: float, tenant: Optional[bytes] = None,
+               critical: bool = False):
         """Global (scope-less) charge: sliding window only — concurrent
-        budgets need a release point, which only scopes have."""
-        self._limits[kind].charge_window(n)
+        budgets need a release point, which only scopes have. With a
+        `tenant` (and the kind configured tenant_fair), the charge is
+        additionally capped at the tenant's weighted fair share;
+        `critical` work bypasses the tenant cap (never the global
+        window)."""
+        self._limits[kind].charge_window(n, tenant=tenant,
+                                         critical=critical)
         _scope_metrics.counter(f"{kind}.charged").inc(int(n))
 
-    def scope(self, name: str = "query") -> QueryScope:
-        return QueryScope(self, name)
+    def scope(self, name: str = "query",
+              tenant: Optional[bytes] = None) -> QueryScope:
+        return QueryScope(self, name, tenant=tenant)
+
+    def tenant_usage(self, kind: str, tenant: bytes) -> float:
+        """This tenant's trailing-window usage for one kind (tests,
+        /debug introspection)."""
+        return self._limits[kind].tenant_usage(tenant)
 
     def enforcer(self, kind: str) -> Enforcer:
         return self._limits[kind].enforcer
@@ -327,14 +438,17 @@ def reset_last_totals():
     _TLS.last_totals = None
 
 
-def charge(kind: str, n: float):
+def charge(kind: str, n: float, tenant: Optional[bytes] = None,
+           critical: bool = False):
     """Charge-site entry point: the innermost thread-local QueryScope
     when one is installed (query executor / node RPC dispatch), else the
-    global registry's window. Raises ResourceExhausted on rejection."""
+    global registry's window. Raises ResourceExhausted on rejection.
+    `tenant`/`critical` feed the per-tenant fair-share cap on scope-less
+    charges (a scope carries its own tenant from construction)."""
     if n <= 0:
         return
     scope = getattr(_TLS, "scope", None)
     if scope is not None:
         scope.charge(kind, n)
     else:
-        _GLOBAL.charge(kind, n)
+        _GLOBAL.charge(kind, n, tenant=tenant, critical=critical)

@@ -87,6 +87,20 @@ echo "== plan-compiler smoke (<5s; compiled-vs-oracle, 100% warm plan-cache hit,
 JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   python scripts/plan_smoke.py
 
+echo "== aggregator smoke (<5s; mesh-vs-ref bit-equality, one-publish-per-destination forwarding, tenant fair-share) =="
+# The aggregator tier's columnar/mesh flush: the production path
+# (collect_into + emit_batch + mesh quantile ordering) must emit
+# BIT-identical rows to the retained host oracle (reduce_and_emit_ref)
+# with the mesh program proven dispatched, a flush round must ride ONE
+# publish per topic shard and ONE fbatch frame per (destination, meta
+# group), and the DAGOR-style tenant gate must shed the noisy tenant at
+# its share while quiet and CRITICAL traffic pass. Full matrix:
+# tests/test_agg_mesh.py + tests/test_overload.py; benches:
+# counter_gauge_rollup + agg_rollup_10x. Wall budget via
+# AGG_SMOKE_BUDGET_S.
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  python scripts/agg_smoke.py
+
 echo "== test suite =="
 python -m pytest tests/ -x -q
 

@@ -1,0 +1,414 @@
+"""Mesh-sharded aggregator flush: the columnar/mesh production path
+(list.py collect_into + emit_batch, parallel/agg_flush quantile
+ordering) must be BIT-identical to the retained host oracle
+(reduce_and_emit_ref) across counter/gauge/timer mixes, empty/NaN
+windows, and pipeline forwarding — plus the batched planes that ride
+the rebuild: per-destination forward batching, one-publish-per-shard
+columnar handling, and the one-transaction flush-times commit.
+
+The 8-virtual-device mesh route is exercised by scripts/agg_smoke.py
+and the agg benches (check_all runs them under
+--xla_force_host_platform_device_count=8); these tests prove the shared
+kernel's routes agree and the tier's semantics on any device count.
+"""
+
+import numpy as np
+import pytest
+
+from m3_tpu.aggregator import elem as elem_mod
+from m3_tpu.aggregator import list as list_mod
+from m3_tpu.aggregator.flush import FlushTimesManager, plan_jobs
+from m3_tpu.cluster import kv as cluster_kv
+from m3_tpu.metrics import aggregation as magg
+from m3_tpu.metrics.metric import MetricType
+from m3_tpu.metrics.pipeline import Op, Pipeline
+from m3_tpu.metrics.policy import StoragePolicy
+from m3_tpu.metrics.transformation import TransformType
+
+S = 1_000_000_000
+POL = StoragePolicy.parse("1m:40h")
+BASE = 1_700_000_000 * S - (1_700_000_000 * S) % (60 * S)
+
+
+def _build_population(seed: int, n: int = 240):
+    """Seeded mixed elem population: counters, gauges, timers (default
+    suffixed agg set incl. quantiles), explicit agg sets (stdev/mean/
+    sumsq/minmax), transform and rollup pipelines; windows with empty
+    and NaN values."""
+    rng = np.random.default_rng(seed)
+    lists = list_mod.MetricLists()
+    lst = lists.for_resolution(60 * S)
+    elems = []
+    for i in range(n):
+        kind = int(rng.integers(0, 6))
+        if kind == 0:
+            key = elem_mod.ElemKey(b"t.c.%d" % i, POL)
+            mt = MetricType.COUNTER
+        elif kind == 1:
+            key = elem_mod.ElemKey(b"t.g.%d" % i, POL)
+            mt = MetricType.GAUGE
+        elif kind == 2:
+            key = elem_mod.ElemKey(b"t.t.%d" % i, POL)
+            mt = MetricType.TIMER
+        elif kind == 3:
+            key = elem_mod.ElemKey(b"t.x.%d" % i, POL, magg.AggID.compress(
+                [magg.AggType.MEAN, magg.AggType.STDEV, magg.AggType.SUMSQ,
+                 magg.AggType.MIN, magg.AggType.MAX, magg.AggType.P99]))
+            mt = MetricType.TIMER
+        elif kind == 4:
+            # PerSecond transform then rollup: exercises prev-window
+            # state threading AND the forward plane
+            pipe = Pipeline((
+                Op.transform(TransformType.PERSECOND),
+                Op.roll(b"t.roll.%d" % (i % 5), (b"host",),
+                        magg.AggID.compress([magg.AggType.SUM])),
+            ))
+            key = elem_mod.ElemKey(
+                b"t.p.%d" % i, POL,
+                magg.AggID.compress([magg.AggType.LAST]), pipe)
+            mt = MetricType.GAUGE
+        else:
+            key = elem_mod.ElemKey(b"t.e.%d" % i, POL)
+            mt = MetricType.GAUGE
+        e = lst.get_or_create(key, lambda k=key, m=mt: elem_mod.Elem(k, m))
+        nw = int(rng.integers(1, 4))
+        for w in range(nw):
+            nv = int(rng.integers(0, 8)) if kind != 5 else 0  # kind 5: empty
+            vals = rng.lognormal(0, 1, nv)
+            if nv and rng.random() < 0.3:
+                vals[int(rng.integers(0, nv))] = np.nan
+            e.add_values(BASE + w * 60 * S, vals)
+        elems.append(e)
+    return lists, lst, elems
+
+
+def _run(lists, lst, use_ref: bool):
+    sink = []
+    cap = lambda mid, t, v, p, _s=sink: _s.append((mid, t, v, str(p)))  # noqa: E731
+
+    def fwd(new_id, t, v, meta, src, _s=sink):
+        _s.append((b"FWD:" + new_id, t, v,
+                   str(meta.storage_policy) + ":" + src.decode()))
+
+    target = BASE + 10 * 60 * S
+    if use_ref:
+        jobs, _ = plan_jobs(lists, target, 0, cap, fwd)
+        list_mod.reduce_and_emit_ref(jobs)
+    else:
+        lst.flush(target, cap, fwd)
+    return sink
+
+
+def _eq(a, b):
+    return a == b or (a[0] == b[0] and a[1] == b[1] and a[3] == b[3]
+                      and np.isnan(a[2]) and np.isnan(b[2]))
+
+
+@pytest.mark.parametrize("seed", range(16))
+def test_mesh_flush_bit_identical_to_ref(seed):
+    got = _run(*_build_population(seed)[:2], use_ref=False)
+    want = _run(*_build_population(seed)[:2], use_ref=True)
+    assert len(got) == len(want)
+    got_s, want_s = sorted(got, key=repr), sorted(want, key=repr)
+    for g, w in zip(got_s, want_s):
+        assert _eq(g, w), (seed, g, w)
+
+
+def test_transform_state_threads_across_flush_rounds():
+    """PerSecond's prev-window datapoint must thread identically through
+    the columnar path across SUCCESSIVE flushes (the stateful pipeline
+    path stays per-elem)."""
+    for use_ref in (False, True):
+        lists, lst, _ = _build_population(101)
+        sinks = []
+        for rnd in range(2):
+            # stage one more window per elem, then flush
+            for e in lst.elems():
+                e.add_values(BASE + (5 + rnd) * 60 * S,
+                             np.full(3, float(rnd + 1)))
+            sinks.append(_run(lists, lst, use_ref))
+        if use_ref:
+            want = sinks
+        else:
+            got = sinks
+    for g, w in zip(got, want):
+        assert sorted(g, key=repr) == pytest.approx(
+            sorted(w, key=repr), abs=0) or len(g) == len(w)
+        for a, b in zip(sorted(g, key=repr), sorted(w, key=repr)):
+            assert _eq(a, b)
+
+
+def test_forwarding_is_batched_and_window_ordered():
+    """emit_batch collects the round's rollup forwards into ONE
+    forward_batch call (when the sink supports it), with each elem's
+    windows in ascending time order (binary transforms depend on it)."""
+    lists, lst, _ = _build_population(7)
+
+    calls = []
+
+    class BatchSink:
+        def __call__(self, *a):
+            raise AssertionError("per-item forward must not be used")
+
+        def forward_batch(self, items):
+            calls.append(list(items))
+
+    n = lst.flush(BASE + 10 * 60 * S, lambda *a: None, BatchSink())
+    assert n > 0
+    assert len(calls) == 1  # one batch per flush round
+    per_elem = {}
+    for new_id, t, v, meta, src in calls[0]:
+        per_elem.setdefault((src, new_id), []).append(t)
+    assert per_elem, "population always includes rollup pipelines"
+    for times in per_elem.values():
+        assert times == sorted(times)
+
+
+def test_forward_batch_groups_per_destination():
+    """ForwardedWriter.forward_batch coalesces a round's forwards into
+    one send_forwarded_batch per (destination, meta group) and counts
+    undelivered items."""
+    from m3_tpu.aggregator.aggregator import Aggregator, ForwardedWriter
+    from m3_tpu.cluster.placement import (Instance, Placement,
+                                          ShardAssignment, ShardState)
+    from m3_tpu.metrics.metadata import ForwardMetadata
+
+    agg = Aggregator(num_shards=4)
+
+    class FakeTransport:
+        def __init__(self, ok=True):
+            self.frames = []
+            self.ok = ok
+
+        def send_forwarded(self, *a):
+            raise AssertionError("batched path must be used")
+
+        def send_forwarded_batch(self, metric_type, rows):
+            self.frames.append(list(rows))
+            return self.ok
+
+    inst_a = Instance("other", "e:1", shards={
+        s: ShardAssignment(s, ShardState.AVAILABLE) for s in range(4)})
+    placement = Placement({"other": inst_a}, num_shards=4, replica_factor=1)
+    tr = FakeTransport()
+    fw = ForwardedWriter(agg)
+    fw.set_routing(lambda: placement, {"other": tr}, "me")
+    meta = ForwardMetadata(0, POL, Pipeline(), b"src", 1)
+    items = [(b"roll.%d" % i, BASE, float(i), meta, b"src.%d" % i)
+             for i in range(8)]
+    fw.forward_batch(items)
+    assert len(tr.frames) == 1  # one frame per destination per meta group
+    assert sum(len(f) for f in tr.frames) == 8
+    assert fw.dropped == 0
+    # a failed frame counts every row dropped
+    tr2 = FakeTransport(ok=False)
+    fw.set_routing(lambda: placement, {"other": tr2}, "me")
+    fw.forward_batch(items[:3])
+    assert fw.dropped == 3
+
+
+def test_fbatch_wire_round_trip():
+    """forwarded_batch_to_wire -> codec -> dispatch_forwarded_batch
+    lands every partial, all-or-nothing on malformed columns."""
+    from m3_tpu.aggregator.aggregator import Aggregator
+    from m3_tpu.aggregator.server import (dispatch_forwarded_batch,
+                                          forwarded_batch_to_wire)
+    from m3_tpu.metrics.metadata import ForwardMetadata
+    from m3_tpu.rpc import wire
+
+    meta = ForwardMetadata(0, POL, Pipeline(), b"src", 1)
+    rows = [(b"r.%d" % i, BASE + i, float(i), meta, b"s.%d" % i)
+            for i in range(5)]
+    frame = wire.decode(wire.encode(
+        forwarded_batch_to_wire(MetricType.GAUGE, rows)))
+    agg = Aggregator(num_shards=4)
+    dispatch_forwarded_batch(agg, frame)
+    assert agg.num_entries() == 5
+    bad = dict(frame)
+    bad["values"] = np.asarray(bad["values"])[:2]
+    agg2 = Aggregator(num_shards=4)
+    with pytest.raises(ValueError):
+        dispatch_forwarded_batch(agg2, bad)
+    assert agg2.num_entries() == 0  # nothing partially applied
+
+
+def test_producer_handler_one_publish_per_shard():
+    """handle_columnar ships ONE publish per topic shard per flush
+    round; decode_aggregated_batch restores every datapoint."""
+    from m3_tpu.aggregator.handler import (ProducerHandler,
+                                           decode_aggregated_batch)
+
+    published = []
+
+    class FakeProducer:
+        def publish(self, shard, payload):
+            published.append((shard, payload))
+            return len(published)
+
+    h = ProducerHandler(FakeProducer(), num_shards=4)
+    ids = [b"m.%d" % i for i in range(64)]
+    times = np.arange(64, dtype=np.int64) + BASE
+    values = np.arange(64, dtype=np.float64) / 7.0
+    h.handle_columnar([(ids, times, values, POL)])
+    shards = {s for s, _ in published}
+    assert len(published) == len(shards) <= 4  # one publish per shard
+    assert h.publishes == len(published)
+    decoded = [m for _, p in published for m in decode_aggregated_batch(p)]
+    assert sorted(m.id for m in decoded) == sorted(ids)
+    by_id = {m.id: m for m in decoded}
+    for i, mid in enumerate(ids):
+        m = by_id[mid]
+        assert m.time_nanos == int(times[i])
+        assert m.value == float(values[i])
+        assert m.storage_policy == POL
+
+
+def test_flush_times_store_many_single_transaction():
+    """The round's flush times land as ONE kv set_many (one version bump
+    per key, readable via the unbatched get path)."""
+    store = cluster_kv.MemStore()
+    calls = {"set": 0, "set_many": 0}
+    orig_set, orig_many = store.set, store.set_many
+
+    def spy_set(key, data):
+        calls["set"] += 1
+        return orig_set(key, data)
+
+    def spy_many(items):
+        calls["set_many"] += 1
+        return orig_many(items)
+
+    store.set, store.set_many = spy_set, spy_many
+    mgr = FlushTimesManager(store, "ss-0")
+    mgr.store_many({sid: {60 * S: BASE + sid} for sid in range(8)})
+    assert calls == {"set": 0, "set_many": 1}
+    for sid in range(8):
+        assert mgr.get(sid) == {60 * S: BASE + sid}
+
+    class NoBatchStore:
+        """A store speaking only the unbatched kv surface (e.g. the
+        remote kv client): store_many must fall back to per-shard sets."""
+
+        def __init__(self):
+            self.sets = []
+
+        def set(self, key, data):
+            self.sets.append(key)
+            return 1
+
+    nb = NoBatchStore()
+    FlushTimesManager(nb, "ss-1").store_many({0: {60 * S: 1}, 1: {60 * S: 2}})
+    assert len(nb.sets) == 2
+
+
+def test_aggregator_flush_commits_flush_times_once():
+    """A managed multi-shard Aggregator.flush batches every shard's
+    flush-times into one store_many call."""
+    from m3_tpu.aggregator.aggregator import Aggregator
+    from m3_tpu.aggregator.election import ElectionManager
+    from m3_tpu.cluster.services import LeaderService
+
+    store = cluster_kv.MemStore()
+    many = []
+    orig = store.set_many
+    store.set_many = lambda items: (many.append(len(items)), orig(items))[1]
+    ftimes = FlushTimesManager(store, "ss")
+    from m3_tpu.aggregator.handler import CaptureHandler
+
+    cap = CaptureHandler()
+    clock = {"t": BASE}
+    leader = LeaderService(store, "agg-election", "i-0",
+                           lease_ttl_ns=3600 * S, clock=lambda: clock["t"])
+    election = ElectionManager(leader)
+    agg = Aggregator(num_shards=8, clock=lambda: clock["t"],
+                     flush_handler=cap, election=election,
+                     flush_times=ftimes,
+                     default_policies=(POL,))
+    for i in range(64):
+        agg.add_timed(MetricType.GAUGE, b"ten.m.%d" % i, BASE, float(i), POL)
+    clock["t"] = BASE + 2 * 60 * S
+    n = agg.flush()
+    assert n == 64
+    assert len(cap.metrics) == 64
+    assert len(many) == 1  # ONE kv transaction for the whole round
+    used_shards = {agg.shard_for(b"ten.m.%d" % i) for i in range(64)}
+    stored = {sid for sid in range(8) if ftimes.get(sid)}
+    assert stored == set(range(8)) or stored >= used_shards
+
+
+def test_quantile_routes_agree_and_exact_values():
+    """parallel/agg_flush.exact_quantile_values == the oracle's
+    _quantile_rows_for on ragged NaN-bearing buckets (shared kernel,
+    f64 host gather)."""
+    from m3_tpu.parallel import agg_flush
+
+    rng = np.random.default_rng(3)
+    buckets = []
+    for i in range(40):
+        nv = int(rng.integers(0, 12))
+        b = rng.lognormal(0, 1, nv)
+        if nv and rng.random() < 0.4:
+            b[int(rng.integers(0, nv))] = np.nan
+        buckets.append(b)
+    qs = (0.5, 0.95, 0.99)
+    counts = np.array([b.size for b in buckets], dtype=np.int64)
+    got = agg_flush.exact_quantile_values(buckets, counts, qs)
+    want_rows = list_mod._quantile_rows_for(buckets, qs)
+    for i, row in enumerate(want_rows):
+        for j, q in enumerate(qs):
+            w = row[q]
+            g = got[i, j]
+            assert g == w or (np.isnan(g) and np.isnan(w)), (i, q)
+
+
+def test_quantile_rows_keyed_by_tuple_index():
+    """MEDIAN and P50 share q=0.5: both must read the SAME position of
+    the elem's _quantiles tuple (index keying — a recomputed float can
+    never miss)."""
+    key = elem_mod.ElemKey(b"t.q", POL, magg.AggID.compress(
+        [magg.AggType.MEDIAN, magg.AggType.P50, magg.AggType.P99]))
+    e = elem_mod.Elem(key, MetricType.TIMER)
+    assert e._quantiles == (0.5, 0.99)
+    assert e._q_idx[magg.AggType.MEDIAN] == 0
+    assert e._q_idx[magg.AggType.P50] == 0
+    assert e._q_idx[magg.AggType.P99] == 1
+    out = []
+    e.emit(BASE, {k: 0.0 for k in list_mod._STAT_KEYS} | {"count": 3.0},
+           (41.5, 99.25), lambda mid, t, v, p: out.append((mid, v)))
+    got = {mid: v for mid, v in out}
+    assert got[b"t.q.median"] == 41.5
+    assert got[b"t.q.p50"] == 41.5
+    assert got[b"t.q.p99"] == 99.25
+
+
+def test_emit_class_interned_and_elem_staging():
+    """Elems with one emission signature share ONE interned EmitClass;
+    staging degrades (and recovers on an empty full drain) through
+    chunked and out-of-order adds."""
+    k1 = elem_mod.ElemKey(b"a.x", POL)
+    k2 = elem_mod.ElemKey(b"b.y", POL)
+    e1 = elem_mod.Elem(k1, MetricType.COUNTER)
+    e2 = elem_mod.Elem(k2, MetricType.COUNTER)
+    assert e1._eclass is e2._eclass
+    # multi-add chunking + out-of-order windows still flush exactly
+    e1.add_values(BASE + 60 * S, np.array([1.0, 2.0]))
+    e1.add_values(BASE, np.array([3.0]))          # out of order
+    e1.add_values(BASE + 60 * S, np.array([4.0]))  # chunked
+    assert e1._degraded
+    batch = list_mod.FlushBatch()
+    lst = list_mod.MetricList(60 * S)
+    lst._elems[k1] = e1
+    n, _ = lst.collect_into(BASE + 10 * 60 * S, batch)
+    assert n == 2
+    out = []
+    list_mod.emit_batch(batch, lambda mid, t, v, p: out.append((t, v)))
+    assert sorted(out) == [(BASE + 60 * S, 3.0), (BASE + 2 * 60 * S, 7.0)]
+    assert not e1._degraded and e1.is_empty()  # reset on empty drain
+    # a degraded elem keeps flushing exactly on later rounds
+    e1.add_values(BASE + 2 * 60 * S, np.array([5.0]))
+    batch2 = list_mod.FlushBatch()
+    n2, _ = lst.collect_into(BASE + 20 * 60 * S, batch2)
+    assert n2 == 1
+    out2 = []
+    list_mod.emit_batch(batch2, lambda mid, t, v, p: out2.append((t, v)))
+    assert out2 == [(BASE + 3 * 60 * S, 5.0)]

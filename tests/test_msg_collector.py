@@ -268,13 +268,13 @@ class TestProducerHandler:
     def test_flush_rides_m3msg_to_consumer(self):
         """aggregator flush -> ProducerHandler -> m3msg TCP -> consumer
         decode (the §3.4 handler.Handle -> m3msg -> coordinator hop)."""
-        from m3_tpu.aggregator import ProducerHandler, decode_aggregated
+        from m3_tpu.aggregator import ProducerHandler, decode_aggregated_batch
         from m3_tpu.metrics.metadata import Metadata, PipelineMetadata, StagedMetadata
         from m3_tpu.metrics.metric import MetricUnion
 
         received = []
         consumer = Consumer(
-            lambda shard, value: received.append(decode_aggregated(value))).start()
+            lambda shard, value: received.extend(decode_aggregated_batch(value))).start()
         try:
             topic = Topic("aggregated_metrics", 4, (ConsumerService("coord"),))
             p = one_instance_placement(consumer.endpoint)

@@ -868,3 +868,166 @@ class TestLoadGen:
         assert out.get("ValueError", 0) > 0
         assert report.throughput("p") == pytest.approx(
             out.get("ok", 0) / 0.3)
+
+
+# ------------------------------------------------- per-tenant fair share
+
+
+class TestTenantFairShareWindow:
+    """Per-tenant weighted fair-share over the sliding window
+    (utils/limits.py, DAGOR-style): one noisy tenant saturates its OWN
+    share of a kind's per-second budget, never the whole window."""
+
+    def _limits(self, per_second=100.0, weights=None):
+        t = [0.0]
+        lims = QueryLimits(
+            clock=lambda: t[0],
+            docs_matched=LimitOptions(
+                per_second=per_second, tenant_fair=True,
+                tenant_weights=weights))
+        return lims, t
+
+    def test_noisy_tenant_capped_at_its_share(self):
+        lims, _ = self._limits()
+        # Lone tenant's share: 100 * 1/(0 active + 1 + 1 reserve) = 50.
+        lims.charge("docs_matched", 50, tenant=b"noisy")
+        with pytest.raises(ResourceExhausted, match="fair share"):
+            lims.charge("docs_matched", 1, tenant=b"noisy")
+        assert lims.tenant_usage("docs_matched", b"noisy") == 50
+
+    def test_quiet_tenant_unaffected_by_noisy_burst(self):
+        lims, _ = self._limits()
+        lims.charge("docs_matched", 50, tenant=b"noisy")
+        with pytest.raises(ResourceExhausted):
+            lims.charge("docs_matched", 10, tenant=b"noisy")
+        # The noisy tenant consumed only ITS share: a quiet tenant
+        # arriving mid-burst still finds budget (share with one other
+        # active tenant: 100 * 1/(1 + 1 + 1) = 33.3).
+        lims.charge("docs_matched", 30, tenant=b"quiet")
+        assert lims.tenant_usage("docs_matched", b"quiet") == 30
+
+    def test_rejected_tenant_charge_leaves_nothing_charged(self):
+        lims, _ = self._limits()
+        lims.charge("docs_matched", 50, tenant=b"noisy")
+        before = lims.tenant_usage("docs_matched", b"noisy")
+        with pytest.raises(ResourceExhausted):
+            lims.charge("docs_matched", 25, tenant=b"noisy")
+        assert lims.tenant_usage("docs_matched", b"noisy") == before
+        # the global window was not charged either: an untenanted charge
+        # can still spend the remaining 50
+        lims.charge("docs_matched", 50)
+
+    def test_critical_bypasses_tenant_cap_never_the_window(self):
+        lims, _ = self._limits()
+        lims.charge("docs_matched", 50, tenant=b"noisy")
+        # CRITICAL work from the saturated tenant is not tenant-shed...
+        lims.charge("docs_matched", 40, tenant=b"noisy", critical=True)
+        # ...but the docs-matched WINDOW itself still applies to it.
+        with pytest.raises(ResourceExhausted):
+            lims.charge("docs_matched", 20, tenant=b"noisy", critical=True)
+
+    def test_weighted_tenants_split_proportionally(self):
+        lims, _ = self._limits(weights=((b"big", 3.0),))
+        # big alone: 100 * 3/(0 + 3 + 1) = 75; default-weight tenant
+        # alongside: 100 * 1/(3 + 1 + 1) = 20.
+        lims.charge("docs_matched", 75, tenant=b"big")
+        with pytest.raises(ResourceExhausted):
+            lims.charge("docs_matched", 1, tenant=b"big")
+        lims.charge("docs_matched", 20, tenant=b"small")
+        with pytest.raises(ResourceExhausted):
+            lims.charge("docs_matched", 1, tenant=b"small")
+
+    def test_idle_tenant_expires_and_share_recovers(self):
+        lims, t = self._limits()
+        lims.charge("docs_matched", 40, tenant=b"a")
+        lims.charge("docs_matched", 30, tenant=b"b")
+        with pytest.raises(ResourceExhausted):
+            lims.charge("docs_matched", 30, tenant=b"b")  # share is 33.3
+        t[0] += 1.1  # a's window usage fully expires
+        # with a idle, b is alone again: share back to 50
+        lims.charge("docs_matched", 20, tenant=b"b")
+        assert lims.tenant_usage("docs_matched", b"a") == 0
+
+    def test_untenanted_charges_see_only_the_global_window(self):
+        lims, _ = self._limits()
+        lims.charge("docs_matched", 90)
+        with pytest.raises(ResourceExhausted):
+            lims.charge("docs_matched", 20)
+
+    def test_scope_carries_tenant(self):
+        lims, _ = self._limits()
+        with lims.scope("q", tenant=b"noisy") as sc:
+            sc.charge("docs_matched", 50)
+            with pytest.raises(ResourceExhausted, match="fair share"):
+                sc.charge("docs_matched", 10)
+
+    def test_tenant_of_extraction(self):
+        from m3_tpu.utils.limits import tenant_of
+
+        assert tenant_of(b"acme.requests.count;host=x") == b"acme"
+        assert tenant_of(b"acme.requests") == b"acme"
+        # an id without a dot is its own tenant (single-tenant degrade)
+        assert tenant_of(b"requests;host=x") == b"requests"
+        assert tenant_of(b"bare") == b"bare"
+
+
+class TestTenantFairShareGate:
+    """Per-tenant fair-share on the ingest AdmissionGate
+    (utils/health.py): engaged only past the high watermark, CRITICAL
+    never tenant-shed."""
+
+    def _gate(self, capacity=8, high=0.5, weights=None):
+        return AdmissionGate(capacity, high_watermark=high,
+                             tracker=HealthTracker(),
+                             tenant_weights=weights)
+
+    def test_noisy_tenant_sheds_at_its_share(self):
+        g = self._gate()  # capacity 8, high watermark 4
+        # below the watermark the tenant cap is not engaged
+        assert g.try_admit(4, Priority.NORMAL, tenant=b"noisy")
+        # past it, a lone tenant's share is 8 * 1/(0 + 1 + 1) = 4
+        assert not g.try_admit(1, Priority.NORMAL, tenant=b"noisy")
+        assert g.shed_tenant == 1
+        assert g.stats()["tenants"] == {b"noisy": 4}
+
+    def test_quiet_tenant_still_admitted_past_watermark(self):
+        g = self._gate()
+        g.admit(4, Priority.NORMAL, tenant=b"noisy")
+        # quiet tenant mid-burst: share 8 * 1/(1 + 1 + 1) = 2.67
+        assert g.try_admit(2, Priority.NORMAL, tenant=b"quiet")
+        assert not g.try_admit(1, Priority.NORMAL, tenant=b"quiet")
+        assert g.depth() == 6
+
+    def test_critical_never_tenant_shed(self):
+        g = self._gate()
+        g.admit(4, Priority.NORMAL, tenant=b"noisy")
+        assert not g.try_admit(1, Priority.NORMAL, tenant=b"noisy")
+        assert g.try_admit(1, Priority.CRITICAL, tenant=b"noisy")
+        assert g.shed["critical"] == 0
+
+    def test_release_clears_tenant_depth(self):
+        g = self._gate()
+        g.admit(4, Priority.NORMAL, tenant=b"noisy")
+        g.release(4, tenant=b"noisy")
+        assert g.stats()["tenants"] == {}
+        assert g.try_admit(4, Priority.NORMAL, tenant=b"noisy")
+
+    def test_weighted_tenant_gets_bigger_share(self):
+        g = self._gate(weights={b"big": 3.0})
+        # big alone past the watermark: share 8 * 3/(0 + 3 + 1) = 6
+        assert g.try_admit(4, Priority.NORMAL, tenant=b"big")
+        assert g.try_admit(2, Priority.NORMAL, tenant=b"big")
+        assert not g.try_admit(1, Priority.NORMAL, tenant=b"big")
+
+    def test_untenanted_admits_unchanged_by_fairness(self):
+        g = self._gate()
+        g.admit(4, Priority.NORMAL, tenant=b"noisy")
+        # untenanted NORMAL work is still bounded only by capacity
+        assert g.try_admit(4, Priority.NORMAL)
+        assert not g.try_admit(1, Priority.NORMAL)
+
+    def test_backpressure_message_names_tenant(self):
+        g = self._gate()
+        g.admit(4, Priority.NORMAL, tenant=b"noisy")
+        with pytest.raises(Backpressure, match="tenant b'noisy'"):
+            g.admit(1, Priority.NORMAL, tenant=b"noisy")

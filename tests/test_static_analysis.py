@@ -15,7 +15,9 @@ from m3_tpu.analysis.cache_rules import (CacheKeyBufferRule,
                                          CacheMethodBufferKeyRule)
 from m3_tpu.analysis.jax_rules import (ItemInLoopRule, JaxPurityRule,
                                        NonStaticJitCacheRule)
-from m3_tpu.analysis.lock_rules import HotLoopUnderLockRule, LockDisciplineRule
+from m3_tpu.analysis.lock_rules import (FlushCallbackLoopRule,
+                                        HotLoopUnderLockRule,
+                                        LockDisciplineRule)
 from m3_tpu.analysis.hbm_rules import UnbudgetedDevicePutRule
 from m3_tpu.analysis.obs_rules import (HostSyncInPlanRule,
                                        WallClockLatencyRule)
@@ -1467,3 +1469,102 @@ class TestTreeGate:
         assert findings == [], f"m3lint findings on the tree:\n{rendered}"
         # the suppression mechanism is in real use (documented sites)
         assert suppressed >= 1
+
+
+class TestFlushCallbackLoop:
+    """per-datapoint-callback-in-flush: loops on the aggregator
+    flush/emit paths invoking a per-datapoint `*_fn(...)` callback —
+    the shape the columnar flush rebuild removed from Elem.emit
+    (retained `*_ref` oracles are exempt by design)."""
+
+    # The seeded true positive: the EXACT pre-columnar Elem.emit loop.
+    PRE_CHANGE_ELEM_EMIT = """
+        class Elem:
+            def emit(self, window_start, stats_row, quantile_row,
+                     flush_fn, forward_fn=None):
+                end_nanos = window_start + self.resolution_ns
+                for at in self.agg_types:
+                    q = at.quantile()
+                    value = quantile_row[q] if q is not None else \\
+                        _stat_value(at, stats_row)
+                    if self.key.pipeline.is_empty():
+                        flush_fn(self._out_ids[at], end_nanos, value,
+                                 self.key.storage_policy)
+                    else:
+                        self._process_pipeline(at, end_nanos, value,
+                                               flush_fn, forward_fn)
+    """
+
+    def test_flags_the_pre_change_elem_emit_loop(self):
+        found = lint(self.PRE_CHANGE_ELEM_EMIT, FlushCallbackLoopRule(),
+                     "m3_tpu/aggregator/elem.py")
+        assert rule_ids(found) == ["per-datapoint-callback-in-flush"]
+        assert "flush_fn" in found[0].message
+
+    def test_flags_forward_fn_loop_and_attribute_form(self):
+        src = """
+            def reduce_and_emit(jobs):
+                for elem, start, vals, flush_fn, forward_fn in jobs:
+                    forward_fn(elem.out_id, start, vals)
+
+            class FlushManager:
+                def flush(self, windows):
+                    while windows:
+                        w = windows.pop()
+                        self._flush_fn(w.id, w.end, w.value, w.policy)
+        """
+        found = lint(src, FlushCallbackLoopRule(), "m3_tpu/aggregator/x.py")
+        assert rule_ids(found) == ["per-datapoint-callback-in-flush"] * 2
+
+    def test_ref_oracle_functions_exempt(self):
+        src = """
+            def reduce_and_emit_ref(jobs):
+                for elem, start, vals, flush_fn, forward_fn in jobs:
+                    flush_fn(elem.out_id, start, vals, elem.policy)
+        """
+        assert lint(src, FlushCallbackLoopRule(),
+                    "m3_tpu/aggregator/list.py") == []
+
+    def test_columnar_emit_and_map_shim_pass(self):
+        # The post-rebuild shape: one columnar handler call per round,
+        # per-datapoint compat driven by map (callback as ARGUMENT, not
+        # a per-iteration call) — neither is the flagged loop shape.
+        src = """
+            def emit_batch(batch, flush_fn):
+                for cls, rows in batch.classes.items():
+                    ids = [e.out_id for e in rows.elems]
+                    hb = getattr(flush_fn, "handle_columnar", None)
+                    if hb is not None:
+                        hb([(ids, rows.ends, rows.vals, cls.policy)])
+                    else:
+                        drain(map(flush_fn, ids, rows.ends, rows.vals))
+        """
+        assert lint(src, FlushCallbackLoopRule(),
+                    "m3_tpu/aggregator/list.py") == []
+
+    def test_non_flush_functions_and_other_dirs_not_scanned(self):
+        src = """
+            def route(items, send_fn):
+                for it in items:
+                    send_fn(it)
+        """
+        assert lint(src, FlushCallbackLoopRule(),
+                    "m3_tpu/aggregator/client.py") == []
+        flush_src = """
+            def flush(items, flush_fn):
+                for it in items:
+                    flush_fn(it)
+        """
+        assert lint(flush_src, FlushCallbackLoopRule(),
+                    "m3_tpu/storage/shard.py") == []
+
+    def test_suppression(self):
+        src = """
+            def flush(items, flush_fn):
+                # compat shim for plain-callable sinks
+                # m3lint: disable=per-datapoint-callback-in-flush
+                for it in items:
+                    flush_fn(it)
+        """
+        assert lint(src, FlushCallbackLoopRule(),
+                    "m3_tpu/aggregator/list.py") == []
