@@ -1424,6 +1424,140 @@ def bench_peer_migration():
     }
 
 
+def bench_bootstrap_replay():
+    """Config #12: crash recovery to serving-ready (series/sec through
+    BootstrapProcess over a kill -9 shaped data dir), the path a node
+    takes back from death: complete flushed filesets for the old block
+    (filesystem bootstrapper), the newest snapshot fileset for the warm
+    block (commitlog bootstrapper's snapshot phase), and chunked WAL
+    replay on top — exactly what run_dbnode replays after a hard kill.
+
+    Build: one Database (8 shards, index off) writes N series into a
+    flushed 2h block, then N series x a few points into the NEXT block
+    which is snapshotted (Mediator.snapshot) and WAL-logged across
+    several checksummed chunks, then the process state is ABANDONED
+    without close() — on-disk state identical to SIGKILL (the commit
+    log is flushed per wave, as WRITE_WAIT would have). The measurement
+    is the full bootstrap wall time on a fresh db, series/sec to
+    serving-ready — fileset decode, snapshot install, and WAL replay
+    all included, exactly what an operator waits on after kill -9.
+
+    The pre-change baseline is the per-entry path (one (ns, id, t,
+    value) tuple per replayed WAL entry, per-row registry get_or_create
+    + per-row buffer writes on the snapshot install), so vs_baseline
+    measures the columnar recovery rebuild directly — same protocol as
+    rounds 6-9. Post-change the bench additionally asserts the batched
+    replay bit-identical to the retained per-entry oracle."""
+    import shutil
+    import tempfile
+
+    from m3_tpu.parallel.sharding import ShardSet
+    from m3_tpu.persist import commitlog as cl
+    from m3_tpu.persist.fs import PersistManager
+    from m3_tpu.storage import bootstrap as bs_mod
+    from m3_tpu.storage.bootstrap import BootstrapContext, BootstrapProcess
+    from m3_tpu.storage.database import Database
+    from m3_tpu.storage.mediator import Mediator
+    from m3_tpu.storage.namespace import NamespaceOptions
+    from m3_tpu.utils import xtime
+
+    n_series = int(os.environ.get("BENCH_BOOT_SERIES", "100000"))
+    wal_waves = int(os.environ.get("BENCH_BOOT_WAL_WAVES", "4"))
+    iters = int(os.environ.get("BENCH_BOOT_ITERS", "2"))
+    num_shards = 8
+    ns_name = b"bench"
+    block_ns = 2 * xtime.HOUR
+    t0 = (1_700_000_000 * 1_000_000_000 // block_ns) * block_ns
+    now = {"t": t0}
+    root = tempfile.mkdtemp(prefix="bench_boot_")
+    ns_opts = NamespaceOptions(index_enabled=False)
+
+    try:
+        _phase(f"bootstrap_replay: seeding dir ({n_series} series)")
+        log = cl.CommitLog(os.path.join(root, "commitlog"))
+        db = Database(ShardSet(num_shards), commitlog=log,
+                      clock=lambda: now["t"])
+        db.ensure_namespace(ns_name, ns_opts)
+        pm = PersistManager(os.path.join(root, "data"))
+        ids = [b"boot-%07d" % i for i in range(n_series)]
+        rng = np.random.default_rng(83)
+        # Old block: sealed + flushed (filesystem bootstrapper's input).
+        now["t"] = t0 + xtime.MINUTE
+        db.write_batch(ns_name, ids, np.full(n_series, t0, np.int64),
+                       rng.standard_normal(n_series))
+        now["t"] = t0 + block_ns + 11 * xtime.MINUTE
+        db.tick()
+        assert db.flush(pm) >= num_shards
+        # Warm block: several WAL chunk waves + one snapshot of the lot.
+        bs1 = t0 + block_ns
+        step = block_ns // (wal_waves + 2)
+        for wv in range(wal_waves):
+            ts_w = bs1 + wv * step + 11 * xtime.MINUTE + 12 * xtime.MINUTE
+            now["t"] = ts_w
+            db.write_batch(ns_name, ids, np.full(n_series, ts_w, np.int64),
+                           rng.standard_normal(n_series))
+            log.flush()  # one checksummed chunk per wave (WRITE_WAIT shape)
+        Mediator(db, pm).snapshot(now["t"])
+        # Abandon without close(): on-disk state == SIGKILL.
+
+        def recover() -> Database:
+            fresh = Database(ShardSet(num_shards), clock=lambda: now["t"])
+            fresh.ensure_namespace(ns_name, ns_opts)
+            proc = BootstrapProcess(
+                chain=("filesystem", "commitlog"),
+                ctx=BootstrapContext(
+                    persist=pm, commitlog_dir=os.path.join(root, "commitlog"),
+                    shard_lookup=fresh.shard_set.lookup))
+            proc.run(fresh, now_ns=now["t"])
+            return fresh
+
+        _phase("bootstrap_replay: warm pass")
+        db2 = recover()
+        got = sum(s.num_series()
+                  for s in db2.namespace(ns_name).shards.values())
+        assert got == n_series, f"recovered {got}/{n_series} series"
+        sample = ids[n_series // 2]
+        t_new, v_new = db2.read(ns_name, sample, 0, now["t"] + block_ns)
+        t_old, v_old = db.read(ns_name, sample, 0, now["t"] + block_ns)
+        assert np.array_equal(t_new, t_old) and np.array_equal(v_new, v_old), \
+            "recovered series diverged from the pre-kill db"
+
+        _phase(f"bootstrap_replay: timing ({iters} iters)")
+        dts = []
+        for _ in range(iters):
+            t1 = time.perf_counter()
+            recover()
+            dts.append(time.perf_counter() - t1)
+        sps = n_series / min(dts)
+
+        extra = {
+            "series": n_series, "wal_waves": wal_waves,
+            "shards": num_shards, "iters": iters,
+            "restart_s": round(min(dts), 3),
+        }
+        # Oracle split (post-change only): the batched chunk replay must
+        # be bit-identical to the retained per-entry reference iterator.
+        if hasattr(cl, "replay_ref"):
+            ref = list(cl.replay_ref(os.path.join(root, "commitlog")))
+            new = [(ns, sid, int(t), float(v))
+                   for b in cl.replay_batches(os.path.join(root, "commitlog"))
+                   for ns, sid, t, v in zip(b.namespaces, b.ids,
+                                            b.t_ns, b.values)]
+            assert new == ref, "batched replay diverged from per-entry oracle"
+            extra["oracle_entries_checked"] = len(ref)
+        if hasattr(bs_mod, "load_snapshots_ref"):
+            extra["snapshot_install"] = "batched_tiles"
+        _phase("bootstrap_replay: done")
+        return {
+            "metric": "bootstrap_replay",
+            "value": round(sps, 1),
+            "unit": "series/sec",
+            "extra": extra,
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 _BENCHES = [
     ("m3tsz_encode_1m_rollup", bench_encode_rollup),
     ("counter_gauge_rollup", bench_counter_gauge),
@@ -1436,6 +1570,7 @@ _BENCHES = [
     ("write_path_ingest", bench_write_path_ingest),
     ("hot_set_read", bench_hot_set_read),
     ("peer_migration", bench_peer_migration),
+    ("bootstrap_replay", bench_bootstrap_replay),
 ]
 
 

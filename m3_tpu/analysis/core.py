@@ -243,7 +243,8 @@ def index_functions(mod: Module) -> Dict[str, ast.FunctionDef]:
 
 def _registry() -> List[Rule]:
     from . import (batch_rules, cache_rules, hbm_rules, jax_rules,
-                   lock_rules, obs_rules, overload_rules, retry_rules)
+                   lock_rules, obs_rules, overload_rules, replay_rules,
+                   retry_rules)
 
     return [
         *cache_rules.RULES,
@@ -254,6 +255,7 @@ def _registry() -> List[Rule]:
         *overload_rules.RULES,
         *hbm_rules.RULES,
         *obs_rules.RULES,
+        *replay_rules.RULES,
     ]
 
 

@@ -32,6 +32,7 @@ import numpy as np
 from ..storage.block import SealedBlock
 from ..utils import xtime
 from ..utils.bloom import BloomFilter
+from ..utils.checksum import adler32_rows
 
 INFO_FILE = "info.json"
 DATA_FILE = "data.bin"
@@ -68,7 +69,8 @@ class FilesetWriter:
         self.root = root
 
     def write(self, namespace: bytes, shard: int, blk: SealedBlock, registry,
-              snapshot_version: Optional[int] = None) -> str:
+              snapshot_version: Optional[int] = None,
+              wal_position: Optional[Tuple[int, int]] = None) -> str:
         d = fileset_dir(self.root, namespace, shard, blk.block_start, snapshot_version)
         tmp = d + ".tmp"
         os.makedirs(tmp, exist_ok=True)
@@ -78,18 +80,19 @@ class FilesetWriter:
             f.write(words.tobytes())
 
         # Index entries sorted by series id (the write path buffers and sorts,
-        # write.go WriteAll) with per-row data checksums.
+        # write.go WriteAll) with per-row data checksums — one vectorized
+        # adler pass over the whole codeword matrix, not a per-row loop.
         ids = [registry.id_of(int(si)) for si in blk.series_indices]
         order = sorted(range(len(ids)), key=lambda i: ids[i])
         bloom = BloomFilter.for_capacity(len(ids))
         bloom.add_batch([ids[i] for i in order])
+        row_sums = adler32_rows(words) if len(ids) else np.zeros(0, np.int64)
         index_offsets: List[Tuple[bytes, int]] = []
         with open(os.path.join(tmp, INDEX_FILE), "wb") as f:
             for i in order:
-                row_bytes = words[i].tobytes()
                 entry = _IDX_HEADER.pack(
                     len(ids[i]), i, int(blk.nbits[i]), int(blk.npoints[i]),
-                    zlib.adler32(row_bytes),
+                    int(row_sums[i]),
                 )
                 index_offsets.append((ids[i], f.tell()))
                 f.write(entry)
@@ -113,6 +116,11 @@ class FilesetWriter:
             "snapshot_version": snapshot_version,
             "volume_type": "snapshot" if snapshot_version is not None else "flush",
         }
+        if wal_position is not None:
+            # Chunk-aligned commit log position taken BEFORE the snapshot
+            # read: recovery replays only WAL chunks past it (everything
+            # earlier is provably inside this snapshot).
+            info["wal_position"] = [int(wal_position[0]), int(wal_position[1])]
         with open(os.path.join(tmp, INFO_FILE), "w") as f:
             json.dump(info, f)
 
@@ -178,6 +186,48 @@ class FilesetReader:
             shape=(self.info["num_series"], self.info["max_words"]),
         )
         self.entries = list(self._read_index())
+
+    def wal_position(self) -> Optional[Tuple[int, int]]:
+        """The commit log position recorded at snapshot time, or None
+        (flush filesets, and snapshots from before the field existed)."""
+        pos = self.info.get("wal_position")
+        return (int(pos[0]), int(pos[1])) if pos else None
+
+    def row_checksums(self) -> np.ndarray:
+        """adler32 of every data row, int64 [S] — one vectorized pass
+        over the whole codeword matrix (utils.checksum.adler32_rows)."""
+        if not self.info["num_series"]:
+            return np.zeros(0, np.int64)
+        return adler32_rows(np.asarray(self._words))
+
+    def verify_rows(self):
+        """Row-granular verification, vectorized over the whole fileset:
+        every index entry's recorded adler must match its data row, and
+        the bloom filter must be exactly the one the writer would build
+        over these ids (a divergent bloom silently turns Seeker lookups
+        into false negatives — reads that miss durable data). Raises
+        IOError naming the first divergence; the digest chain
+        (construction-time verify=True) covers whole-file rot, this
+        covers per-row attribution and index/data cross-wiring."""
+        sums = self.row_checksums()
+        if self.entries:
+            rows = np.fromiter((e.row for e in self.entries), np.int64,
+                               count=len(self.entries))
+            want = np.fromiter((e.checksum for e in self.entries), np.int64,
+                               count=len(self.entries))
+            if rows.min(initial=0) < 0 or rows.max(initial=-1) >= len(sums):
+                raise IOError(f"index entry row out of range in {self.path}")
+            bad = np.flatnonzero(sums[rows] != want)
+            if len(bad):
+                e = self.entries[int(bad[0])]
+                raise IOError(
+                    f"row checksum mismatch for {e.id!r} (row {e.row}) "
+                    f"in {self.path}")
+        bloom = BloomFilter.for_capacity(len(self.entries))
+        bloom.add_batch([e.id for e in self.entries])
+        with open(os.path.join(self.path, BLOOM_FILE), "rb") as f:
+            if f.read() != bloom.tobytes():
+                raise IOError(f"bloom filter diverges from ids in {self.path}")
 
     def _read_index(self) -> Iterator[IndexEntry]:
         with open(os.path.join(self.path, INDEX_FILE), "rb") as f:
@@ -258,8 +308,11 @@ class PersistManager:
         return self.writer.write(namespace, shard, blk, registry)
 
     def write_snapshot(self, namespace: bytes, shard: int, blk: SealedBlock, registry,
-                       version: int) -> str:
-        return self.writer.write(namespace, shard, blk, registry, snapshot_version=version)
+                       version: int,
+                       wal_position: Optional[Tuple[int, int]] = None) -> str:
+        return self.writer.write(namespace, shard, blk, registry,
+                                 snapshot_version=version,
+                                 wal_position=wal_position)
 
     def list_filesets(self, namespace: bytes, shard: int) -> List[Tuple[int, str]]:
         """Complete flush filesets for a shard: [(block_start, path)]."""
@@ -268,7 +321,10 @@ class PersistManager:
         if not os.path.isdir(d):
             return out
         for name in os.listdir(d):
-            if name.startswith("fileset-"):
+            # '.tmp' staging dirs are mid-write crash residue (a SIGKILL
+            # between the checkpoint write and os.replace): never a
+            # servable fileset, and their suffix isn't a block start.
+            if name.startswith("fileset-") and not name.endswith(".tmp"):
                 path = os.path.join(d, name)
                 if fileset_complete(path):
                     out.append((int(name.split("-")[-1]), path))
@@ -281,7 +337,7 @@ class PersistManager:
         if not os.path.isdir(d):
             return out
         for name in os.listdir(d):
-            if name.startswith("snapshot-"):
+            if name.startswith("snapshot-") and not name.endswith(".tmp"):
                 path = os.path.join(d, name)
                 if fileset_complete(path):
                     _, version, block_start = name.split("-")

@@ -26,6 +26,11 @@ class NamespaceConfig:
     retention: str = "48h"
     block_size: str = "2h"
     index_enabled: bool = True
+    # Mutable-buffer acceptance window (bufferPast/bufferFuture in the
+    # reference's namespace options); small values let integration
+    # drills seal blocks in seconds instead of hours.
+    buffer_past: str = "10m"
+    buffer_future: str = "2m"
 
     @property
     def retention_ns(self) -> int:
@@ -34,6 +39,14 @@ class NamespaceConfig:
     @property
     def block_size_ns(self) -> int:
         return parse_duration_ns(self.block_size)
+
+    @property
+    def buffer_past_ns(self) -> int:
+        return parse_duration_ns(self.buffer_past)
+
+    @property
+    def buffer_future_ns(self) -> int:
+        return parse_duration_ns(self.buffer_future)
 
 
 @dataclasses.dataclass
@@ -47,6 +60,17 @@ class DBNodeConfig:
     namespaces: List[NamespaceConfig] = dataclasses.field(
         default_factory=lambda: [NamespaceConfig()])
     commitlog_enabled: bool = True
+    # "write_behind" (flush-interval durability) or "write_wait" (every
+    # write fsynced before its ack — the zero-acked-loss contract the
+    # kill -9 drill asserts; commit_log.go:241 strategies).
+    commitlog_strategy: str = "write_behind"
+    # Run the bootstrap chain (filesystem -> commitlog) over data_dir on
+    # startup instead of starting empty: the cold-restart path. Off by
+    # default to preserve the fresh-start embedded uses.
+    bootstrap_enabled: bool = False
+    # Background mediator cadence (tick -> flush -> snapshot -> cleanup,
+    # mediator.go ongoingTick); empty disables the background thread.
+    tick_interval: str = ""
     kv_path: str = ""          # FileStore path; empty = in-memory
     kv_endpoint: str = ""      # networked KV service; overrides kv_path
     coordinator: Optional["CoordinatorConfig"] = None  # embedded mode

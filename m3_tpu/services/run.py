@@ -85,12 +85,18 @@ class DBNodeHandle:
     lock: Optional[object] = None
     httpjson: Optional[object] = None
     ns_watch: Optional[object] = None
+    mediator: Optional[object] = None
+    bootstrap_results: Optional[dict] = None
 
     @property
     def endpoint(self) -> str:
         return self.server.endpoint
 
     def close(self):
+        if self.mediator is not None:
+            # Stop the background flush/snapshot loop BEFORE teardown so
+            # a mid-close tick never races the listeners going away.
+            self.mediator.stop()
         if self.ns_watch is not None:
             self.ns_watch.stop()
         if self.coordinator is not None:
@@ -109,23 +115,56 @@ class DBNodeHandle:
 
 
 def run_dbnode(cfg: DBNodeConfig, clock=None) -> DBNodeHandle:
-    """dbnode/server/server.go Run: config -> db -> listeners."""
+    """dbnode/server/server.go Run: config -> db -> bootstrap ->
+    listeners. With bootstrap_enabled the node replays its own data dir
+    (filesystem filesets -> commitlog snapshots + WAL) BEFORE the
+    listeners open — the cold-restart path the kill -9 drill exercises;
+    serving-ready is printed with the bootstrap wall time."""
     os.makedirs(cfg.data_dir, exist_ok=True)
     # One process per data dir (x/lockfile; server.go takes it on startup).
     from ..utils.lockfile import Lockfile
 
     lock = Lockfile(os.path.join(cfg.data_dir, "node.lock")).acquire()
+    commitlog_dir = os.path.join(cfg.data_dir, "commitlog")
     commitlog = None
     if cfg.commitlog_enabled:
-        commitlog = CommitLog(os.path.join(cfg.data_dir, "commitlog"))
+        from ..persist.commitlog import Strategy
+
+        commitlog = CommitLog(
+            commitlog_dir, strategy=Strategy(cfg.commitlog_strategy))
     db = Database(ShardSet(cfg.num_shards), commitlog=commitlog, clock=clock)
     for ns_cfg in cfg.namespaces:
         db.ensure_namespace(
             ns_cfg.name.encode(),
             NamespaceOptions(retention_ns=ns_cfg.retention_ns,
                              block_size_ns=ns_cfg.block_size_ns,
+                             buffer_past_ns=ns_cfg.buffer_past_ns,
+                             buffer_future_ns=ns_cfg.buffer_future_ns,
                              index_enabled=ns_cfg.index_enabled))
-    db.mark_bootstrapped()
+    persist = PersistManager(os.path.join(cfg.data_dir, "data"))
+    boot_results = None
+    if cfg.bootstrap_enabled:
+        from ..storage.bootstrap import BootstrapContext, BootstrapProcess
+
+        t0 = time.perf_counter()
+        proc = BootstrapProcess(
+            chain=("filesystem", "commitlog", "uninitialized_topology"),
+            ctx=BootstrapContext(
+                persist=persist,
+                commitlog_dir=commitlog_dir if cfg.commitlog_enabled else None,
+                shard_lookup=db.shard_set.lookup))
+        boot_results = proc.run(db)
+        n_series = sum(
+            sh.num_series()
+            for ns in db.namespaces.values() for sh in ns.shards.values())
+        notes = [n for r in boot_results.values() for n in r.notes]
+        print(f"dbnode serving-ready bootstrap_s="
+              f"{time.perf_counter() - t0:.3f} series={n_series} "
+              f"notes={len(notes)}", flush=True)
+        for note in notes:
+            print(f"dbnode bootstrap note: {note}", flush=True)
+    else:
+        db.mark_bootstrapped()
     host, port = _host_port(cfg.listen_address)
     service = NodeService(db)
     server = NodeServer(service, host=host, port=port).start()
@@ -135,7 +174,6 @@ def run_dbnode(cfg: DBNodeConfig, clock=None) -> DBNodeHandle:
 
         hhost, hport = _host_port(cfg.http_listen_address)
         httpjson = HTTPJSONServer(service, host=hhost, port=hport).start()
-    persist = PersistManager(os.path.join(cfg.data_dir, "data"))
     kv = _kv_store(cfg.kv_path, cfg.kv_endpoint)
     # KV-watched namespace registry: namespaces added to KV (by admins or
     # peers) bootstrap and serve without restart (namespace_watch.go).
@@ -153,8 +191,14 @@ def run_dbnode(cfg: DBNodeConfig, clock=None) -> DBNodeHandle:
             create_namespace=lambda name, retention_ns:
                 ns_watch.add(name, retention_ns),
             self_scrape_interval_s=cfg.coordinator.self_scrape_interval_s)
+    mediator = None
+    if cfg.tick_interval:
+        from ..storage.mediator import Mediator
+
+        mediator = Mediator(db, persist).start(
+            interval_s=parse_duration_ns(cfg.tick_interval) / 1e9)
     return DBNodeHandle(db, server, persist, coordinator, kv, lock, httpjson,
-                        ns_watch)
+                        ns_watch, mediator, boot_results)
 
 
 @dataclasses.dataclass

@@ -20,6 +20,7 @@ import numpy as np
 from ..ops import tsz
 from ..parallel import ingest as par_ingest
 from ..utils import xtime
+from ..utils.checksum import adler32_rows
 from ..utils.instrument import ROOT
 from . import block_cache
 
@@ -85,9 +86,8 @@ class SealedBlock:
         every cycle."""
         sums = getattr(self, "_row_sums", None)
         if sums is None:
-            w = np.ascontiguousarray(self.words)
-            sums = np.fromiter((zlib.adler32(r.tobytes()) for r in w),
-                               np.int64, count=len(w))
+            sums = adler32_rows(self.words) if len(self.words) \
+                else np.zeros(0, np.int64)
             sums.setflags(write=False)
             self._row_sums = sums
         return sums

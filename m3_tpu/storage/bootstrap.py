@@ -15,6 +15,7 @@ cmd/services/m3dbnode/config/bootstrap.go:115-160).
 from __future__ import annotations
 
 import dataclasses
+import logging
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -22,6 +23,7 @@ import numpy as np
 from ..persist import commitlog as cl
 from ..persist.fs import FilesetReader, PersistManager
 from ..utils import tracing, xtime
+from ..utils.hashing import hash_batch
 from ..utils.instrument import ROOT
 from ..utils.retry import Deadline
 from .block import SealedBlock
@@ -30,6 +32,11 @@ from .timerange import ShardTimeRanges, intersect, normalize, overlaps, subtract
 # Peer-bootstrap observability: typed peer failures and partial coverage
 # count here instead of disappearing into except/continue.
 _PEER_BOOT_METRICS = ROOT.sub_scope("bootstrap.peers")
+# Commitlog-bootstrap observability: a skipped WAL replay (no shard
+# lookup on a partial shard set) means acked data was LEFT ON DISK —
+# counted, logged, and surfaced on the BootstrapResult, never silent.
+_CL_BOOT_METRICS = ROOT.sub_scope("bootstrap.commitlog")
+_LOG = logging.getLogger("m3_tpu.storage.bootstrap")
 
 
 @dataclasses.dataclass
@@ -49,11 +56,14 @@ class BootstrapContext:
 @dataclasses.dataclass
 class BootstrapResult:
     """Per-namespace outcome: what each bootstrapper claimed and what was
-    left unfulfilled (bootstrap/result pkg)."""
+    left unfulfilled (bootstrap/result pkg). `notes` carries operator-
+    facing anomalies a claim can't express — e.g. the commitlog
+    bootstrapper claiming ranges while having SKIPPED WAL replay."""
 
     requested: ShardTimeRanges
     claimed: Dict[str, ShardTimeRanges] = dataclasses.field(default_factory=dict)
     unfulfilled: Optional[ShardTimeRanges] = None
+    notes: List[str] = dataclasses.field(default_factory=list)
 
 
 class Bootstrapper:
@@ -90,24 +100,292 @@ class FilesystemBootstrapper(Bootstrapper):
                 if not overlaps(shard_ranges.ranges(shard_id), bs, bs + bsz):
                     continue
                 try:
-                    blk, ids = FilesetReader(path).to_block()
+                    reader = FilesetReader(path)
+                    reader.verify_rows()
+                    blk, ids = reader.to_block()
                 except (IOError, FileNotFoundError):
                     continue
-                remap = np.array(
-                    [shard.registry.get_or_create(sid)[0] for sid in ids], np.int32
-                )
-                shard.load_block(blk, remap)
+                with shard.write_lock:
+                    remap, _created = shard.registry.get_or_create_batch(ids)
+                shard.load_block(blk, np.asarray(remap, np.int32))
                 claimed.add(shard_id, bs, bs + bsz)
         return claimed
 
 
+def load_snapshots(ns, shard_ranges, ctx) -> Dict[int, Dict[int, Optional[Tuple[int, int]]]]:
+    """Install the newest snapshot fileset per (shard, block) as a
+    sealed (series x time) tile: digest chain already verified at
+    reader construction, row adlers + bloom verified in one vectorized
+    pass, registry resolution ONE batch per fileset, and the encoded
+    codeword matrix installed directly via load_block — no per-row
+    decode, no per-row registry probe (the apply_peer_tiles shape).
+    WAL entries replayed on top land in the mutable buffer; when the
+    window seals, Shard._tick_locked folds them in via merge_same_start.
+
+    Returns {shard_id: {block_start: wal_position-or-None}} — the
+    chunk-aligned commit log positions the snapshots were cut at, so
+    WAL replay can skip chunks the snapshot provably contains."""
+    from .shard import FlushState
+
+    positions: Dict[int, Dict[int, Optional[Tuple[int, int]]]] = {}
+    bsz = ns.opts.block_size_ns
+    for shard_id in shard_ranges.shards():
+        shard = ns.shards.get(shard_id)
+        if shard is None:
+            continue
+        newest: Dict[int, Tuple[int, str]] = {}
+        for bs, version, path in ctx.persist.list_snapshots(ns.name, shard_id):
+            if not overlaps(shard_ranges.ranges(shard_id), bs, bs + bsz):
+                continue
+            if bs not in newest or version > newest[bs][0]:
+                newest[bs] = (version, path)
+        for bs, (_v, path) in newest.items():
+            try:
+                reader = FilesetReader(path)
+                reader.verify_rows()
+                blk, ids = reader.to_block()
+            except (IOError, FileNotFoundError):
+                continue
+            with shard.write_lock:
+                remap, _created = shard.registry.get_or_create_batch(ids)
+            # NOT_STARTED: a snapshot is not a durable flush — the
+            # rebuilt block must stay on the flush schedule.
+            shard.load_block(blk, np.asarray(remap, np.int32),
+                             flush_state=FlushState.NOT_STARTED)
+            positions.setdefault(shard_id, {})[bs] = reader.wal_position()
+    return positions
+
+
+def load_snapshots_ref(ns, shard_ranges, ctx):
+    """The pre-batching per-row snapshot install, retained verbatim as
+    the equivalence ORACLE (tests/test_durability.py asserts the tile
+    install read- and registry-identical to this): per-row registry
+    get_or_create, one buffer write per series row. Never used on the
+    recovery path."""
+    bsz = ns.opts.block_size_ns
+    for shard_id in shard_ranges.shards():
+        shard = ns.shards.get(shard_id)
+        if shard is None:
+            continue
+        newest: Dict[int, Tuple[int, str]] = {}
+        for bs, version, path in ctx.persist.list_snapshots(ns.name, shard_id):
+            if not overlaps(shard_ranges.ranges(shard_id), bs, bs + bsz):
+                continue
+            if bs not in newest or version > newest[bs][0]:
+                newest[bs] = (version, path)
+        for bs, (_v, path) in newest.items():
+            try:
+                blk, ids = FilesetReader(path).to_block()
+            except (IOError, FileNotFoundError):
+                continue
+            ts, vals, npoints = blk.read_all()
+            for row, sid in enumerate(ids):
+                idx, _ = shard.registry.get_or_create(sid)
+                n = int(npoints[row])
+                shard.buffer.write_batch(
+                    np.full(n, idx, np.int32),
+                    np.asarray(ts[row, :n], np.int64),
+                    np.asarray(vals[row, :n], np.float64),
+                )
+
+
+def replay_wal(ns, shard_ranges, ctx,
+               snap_positions: Optional[Dict[int, Dict[int, Optional[Tuple[int, int]]]]] = None,
+               ) -> bool:
+    """Columnar WAL replay (iterator.go replay, batched): consume
+    `commitlog.replay_batches` chunk-at-a-time, route each chunk's
+    surviving rows to shards in one vectorized murmur pass
+    (hash_batch), and apply ONE registry batch-resolve + ONE columnar
+    buffer append per shard per chunk — no per-entry host loop. Chunks
+    wholly at-or-before a snapshot's recorded WAL position skip that
+    snapshot's block (their entries are provably inside the installed
+    tile). Returns False when replay was SKIPPED because no shard
+    lookup exists for a partial shard set (the caller surfaces it).
+
+    Called once per NAMESPACE by the chain, so a K-namespace node pays
+    K streaming decode passes over the shared WAL; K is the configured
+    namespace count (typically 1-2) and each pass stays chunk-bounded
+    in memory — the trade keeps the bootstrapper contract (per-ns
+    claim/remainder) instead of threading cross-namespace state through
+    the chain."""
+    lookup = ctx.shard_lookup
+    murmur_n = None
+    lookup_batch = None
+    if lookup is None:
+        # Fallback only valid when this node owns the FULL contiguous
+        # shard space (single-node): murmur3 % N matches the cluster
+        # routing. Otherwise skip replay rather than misroute.
+        if ns.shards and len(ns.shards) == max(ns.shards) + 1:
+            murmur_n = len(ns.shards)
+        else:
+            return False
+    else:
+        # A bound ShardSet.lookup routes whole columns through its
+        # sibling lookup_batch (vectorized murmur) instead of one scalar
+        # hash per entry.
+        lookup_batch = getattr(getattr(lookup, "__self__", None),
+                               "lookup_batch", None)
+    bsz = ns.opts.block_size_ns
+    snap_positions = snap_positions or {}
+    route_cache: Dict[bytes, int] = {}
+    # Per-shard ids whose tags are already resolved (indexed or known
+    # tagged): persists across the whole replay stream so each series
+    # pays its tag probe ONCE, not once per chunk.
+    tags_resolved: Dict[int, set] = {}
+    for batch in cl.replay_batches(ctx.commitlog_dir):
+        sel = batch.namespaces == ns.name
+        if not sel.any():
+            continue
+        ids = batch.ids[sel]
+        ts = batch.t_ns[sel]
+        vs = batch.values[sel]
+        tgs = batch.tags[sel] if batch.tags is not None else None
+        # Untagged chunks (raw-id writers, benches) skip the whole tag/
+        # index recovery plane — one cheap scan here instead of a
+        # per-shard per-entry pass below.
+        if tgs is not None and all(t is None for t in tgs):
+            tgs = None
+        if murmur_n is not None:
+            shard_ids = (hash_batch(ids) % np.uint32(murmur_n)).astype(np.int64)
+        elif lookup_batch is not None:
+            shard_ids = np.asarray(lookup_batch(ids), np.int64)
+        else:
+            # Arbitrary caller-provided lookup: memoized per distinct id
+            # (the id set is far smaller than the entry stream).
+            shard_ids = np.empty(len(ids), np.int64)
+            get = route_cache.get
+            for i, sid in enumerate(ids):
+                r = get(sid)
+                if r is None:
+                    r = route_cache[sid] = lookup(sid)
+                shard_ids[i] = r
+        for raw_shard in np.unique(shard_ids):
+            shard_id = int(raw_shard)
+            if shard_id not in shard_ranges.m:
+                continue
+            shard = ns.shards.get(shard_id)
+            if shard is None:
+                continue
+            m = shard_ids == raw_shard
+            ids_shard = ids[m]
+            tgs_shard = tgs[m] if tgs is not None else None
+            # Index recovery is DECOUPLED from the data filters below: a
+            # series installed untagged from a snapshot tile (or whose
+            # chunks the snapshot position-skip drops) still needs its
+            # WAL-carried tags to rebuild the reverse-index document —
+            # without them, recovered data is unreachable by query.
+            fresh: List[Tuple[bytes, dict, int]] = []
+            if tgs_shard is not None:
+                seen = tags_resolved.setdefault(shard_id, set())
+                reg = shard.registry
+                for sid, tg in zip(ids_shard, tgs_shard):
+                    if tg is None or sid in seen:
+                        continue
+                    seen.add(sid)
+                    idx = reg.get(sid)
+                    if idx is not None and reg.tags_of(idx) is None:
+                        reg.ensure_tags(idx, tg)
+                        fresh.append((sid, tg, int(idx)))
+            tss = ts[m]
+            keep = np.zeros(len(tss), bool)
+            for s, e in shard_ranges.ranges(shard_id):
+                keep |= (tss >= s) & (tss < e)
+            pos_map = snap_positions.get(shard_id)
+            if pos_map and keep.any():
+                starts = tss - tss % bsz
+                for bs, pos in pos_map.items():
+                    if batch.before(pos):
+                        keep &= starts != bs
+            if keep.any():
+                ids_kept = ids_shard[keep].tolist()
+                tags_kept = (tgs_shard[keep].tolist()
+                             if tgs_shard is not None else None)
+                with shard.write_lock:
+                    sidx, created = shard.registry.get_or_create_batch_tagged(
+                        ids_kept, tags_kept)
+                    shard.buffer.write_batch(
+                        np.asarray(sidx, np.int32), tss[keep], vs[m][keep])
+                if tags_kept is not None:
+                    # Tags come from the REGISTRY after resolution, not
+                    # from the created position: a series first seen
+                    # untagged whose tagged entry lands later in the
+                    # SAME chunk had its tags backfilled inside the
+                    # batch call — the hook must still fire for it.
+                    reg = shard.registry
+                    seen = tags_resolved.setdefault(shard_id, set())
+                    for j in created:
+                        tg = reg.tags_of(int(sidx[j]))
+                        if tg is not None:
+                            fresh.append((ids_kept[j], tg, int(sidx[j])))
+                            seen.add(ids_kept[j])
+            if fresh:
+                # Same hook wiring as the write path's insert-queue
+                # drain: ONE batched reverse-index insert per shard per
+                # chunk, outside the shard lock.
+                if shard.on_new_series_batch is not None:
+                    shard.on_new_series_batch(fresh)
+                elif shard.on_new_series is not None:
+                    for sid, tg, ix in fresh:
+                        shard.on_new_series(sid, tg, ix)
+    return True
+
+
+def replay_wal_ref(ns, shard_ranges, ctx) -> bool:
+    """The pre-batching per-entry WAL replay, retained verbatim as the
+    bit-identity ORACLE (tests/test_durability.py asserts replay_wal
+    leaves buffer columns and registries bit-identical to this): one
+    (ns, id, t, value) tuple at a time over the per-entry iterator,
+    per-entry shard routing and filtering, per-entry registry resolve.
+    Never used on the recovery path."""
+    batch: Dict[int, List[Tuple[bytes, int, float]]] = {}
+    lookup = ctx.shard_lookup
+    if lookup is None:
+        if ns.shards and len(ns.shards) == max(ns.shards) + 1:
+            n = len(ns.shards)
+            lookup = lambda sid: _murmur_shard(sid, n)  # noqa: E731
+        else:
+            return False
+    for entry_ns, sid, t_ns, value in cl.replay_ref(ctx.commitlog_dir):
+        if entry_ns != ns.name:
+            continue
+        shard_id = lookup(sid)
+        if shard_id not in shard_ranges.m:
+            continue
+        if not overlaps(shard_ranges.ranges(shard_id), t_ns, t_ns + 1):
+            continue
+        batch.setdefault(shard_id, []).append((sid, t_ns, value))
+    for shard_id, entries in batch.items():
+        shard = ns.shards.get(shard_id)
+        if shard is None:
+            continue
+        sidx = np.empty(len(entries), np.int32)
+        for i, (sid, _t, _v) in enumerate(entries):
+            sidx[i], _ = shard.registry.get_or_create(sid)
+        shard.buffer.write_batch(
+            sidx,
+            np.array([t for _s, t, _v in entries], np.int64),
+            np.array([v for _s, _t, v in entries], np.float64),
+        )
+    return True
+
+
 class CommitlogBootstrapper(Bootstrapper):
-    """bootstrapper/commitlog: load the newest snapshot per block, then
-    replay WAL entries on top; claims ALL requested ranges (the commit log
-    cannot prove absence of data, matching the reference's source which
-    marks everything fulfilled)."""
+    """bootstrapper/commitlog: install the newest snapshot fileset per
+    block as a sealed columnar tile, then replay the WAL tail on top as
+    chunk batches; claims ALL requested ranges (the commit log cannot
+    prove absence of data, matching the reference's source which marks
+    everything fulfilled). A replay skipped for want of shard routing
+    is counted (`bootstrap.commitlog` replay_skipped), logged, and
+    surfaced on the BootstrapResult notes."""
 
     name = "commitlog"
+
+    def __init__(self):
+        self.notes: List[str] = []
+
+    def pop_notes(self) -> List[str]:
+        notes, self.notes = self.notes, []
+        return notes
 
     def bootstrap(self, ns, shard_ranges, ctx):
         claimed = ShardTimeRanges()
@@ -115,67 +393,21 @@ class CommitlogBootstrapper(Bootstrapper):
             # No durability sources configured: claim nothing so the chain
             # falls through to peers/uninitialized.
             return claimed
-        bsz = ns.opts.block_size_ns
         # Snapshots first (newest version per block start).
+        snap_positions = None
         if ctx.persist is not None:
-            for shard_id in shard_ranges.shards():
-                shard = ns.shards.get(shard_id)
-                if shard is None:
-                    continue
-                newest: Dict[int, Tuple[int, str]] = {}
-                for bs, version, path in ctx.persist.list_snapshots(ns.name, shard_id):
-                    if not overlaps(shard_ranges.ranges(shard_id), bs, bs + bsz):
-                        continue
-                    if bs not in newest or version > newest[bs][0]:
-                        newest[bs] = (version, path)
-                for bs, (_v, path) in newest.items():
-                    try:
-                        blk, ids = FilesetReader(path).to_block()
-                    except (IOError, FileNotFoundError):
-                        continue
-                    ts, vals, npoints = blk.read_all()
-                    for row, sid in enumerate(ids):
-                        idx, _ = shard.registry.get_or_create(sid)
-                        n = int(npoints[row])
-                        shard.buffer.write_batch(
-                            np.full(n, idx, np.int32),
-                            np.asarray(ts[row, :n], np.int64),
-                            np.asarray(vals[row, :n], np.float64),
-                        )
-        # WAL replay on top (iterator.go replay).
+            snap_positions = load_snapshots(ns, shard_ranges, ctx)
+        # WAL replay on top (iterator.go replay, columnar).
         if ctx.commitlog_dir is not None:
-            batch: Dict[int, List[Tuple[bytes, int, float]]] = {}
-            lookup = ctx.shard_lookup
-            if lookup is None:
-                # Fallback only valid when this node owns the FULL contiguous
-                # shard space (single-node): murmur3 % N matches the cluster
-                # routing. Otherwise skip replay rather than misroute.
-                if ns.shards and len(ns.shards) == max(ns.shards) + 1:
-                    n = len(ns.shards)
-                    lookup = lambda sid: _murmur_shard(sid, n)  # noqa: E731
-                else:
-                    lookup = None
-            for entry_ns, sid, t_ns, value in cl.replay(ctx.commitlog_dir) if lookup else ():
-                if entry_ns != ns.name:
-                    continue
-                shard_id = lookup(sid)
-                if shard_id not in shard_ranges.m:
-                    continue
-                if not overlaps(shard_ranges.ranges(shard_id), t_ns, t_ns + 1):
-                    continue
-                batch.setdefault(shard_id, []).append((sid, t_ns, value))
-            for shard_id, entries in batch.items():
-                shard = ns.shards.get(shard_id)
-                if shard is None:
-                    continue
-                sidx = np.empty(len(entries), np.int32)
-                for i, (sid, _t, _v) in enumerate(entries):
-                    sidx[i], _ = shard.registry.get_or_create(sid)
-                shard.buffer.write_batch(
-                    sidx,
-                    np.array([t for _s, t, _v in entries], np.int64),
-                    np.array([v for _s, _t, v in entries], np.float64),
-                )
+            if not replay_wal(ns, shard_ranges, ctx, snap_positions):
+                _CL_BOOT_METRICS.counter("replay_skipped").inc()
+                note = (f"commitlog: WAL replay SKIPPED for namespace "
+                        f"{ns.name!r}: no shard_lookup and this node's "
+                        f"shard set is not the full contiguous space — "
+                        f"acked data may remain unreplayed on disk at "
+                        f"{ctx.commitlog_dir}")
+                _LOG.warning(note)
+                self.notes.append(note)
         for shard_id in shard_ranges.shards():
             for s, e in shard_ranges.ranges(shard_id):
                 claimed.add(shard_id, s, e)
@@ -503,6 +735,11 @@ class BootstrapProcess:
                     break
                 claimed = b.bootstrap(ns, remaining, self.ctx)
                 result.claimed[b.name] = claimed
+                pop_notes = getattr(b, "pop_notes", None)
+                if pop_notes is not None:
+                    # Anomalies the claim can't express (e.g. a skipped
+                    # WAL replay) ride the result to the operator.
+                    result.notes.extend(pop_notes())
                 remaining = remaining.subtract(claimed)
             result.unfulfilled = remaining
             results[name] = result

@@ -116,7 +116,7 @@ class Database:
             ns.shard_for(shard_id).write(series_id, t_ns, value, now, tags,
                                          priority=priority)
         if self.commitlog is not None and ns.opts.writes_to_commitlog:
-            self.commitlog.write(namespace, series_id, t_ns, value)
+            self.commitlog.write(namespace, series_id, t_ns, value, tags)
 
     def write_batch(self, namespace: bytes, ids: Sequence[bytes], ts, vals,
                     tags: Optional[Sequence[Optional[dict]]] = None,
@@ -172,10 +172,12 @@ class Database:
             if applied is not None and applied.any():
                 self.commitlog.write_batch(
                     namespace, ids_arr[applied].tolist(), ts[applied],
-                    vals[applied])
+                    vals[applied],
+                    tags_arr[applied].tolist() if tags_arr is not None
+                    else None)
             raise
         if log:
-            self.commitlog.write_batch(namespace, ids, ts, vals)
+            self.commitlog.write_batch(namespace, ids, ts, vals, tags)
 
     # ------------------------------------------------------------------- read
 

@@ -14,6 +14,7 @@ import dataclasses
 import os
 import shutil
 import threading
+import time
 from typing import Dict, Optional
 
 from ..persist.fs import PersistManager
@@ -34,6 +35,7 @@ class Mediator:
         self.persist = persist
         self.opts = opts
         self._snapshot_version = 0
+        self._version_seeded = False
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self.last_stats: Dict[str, int] = {}
@@ -53,22 +55,70 @@ class Mediator:
 
     def snapshot(self, now_ns: int) -> int:
         """Persist warm (still-mutable) buckets as snapshot filesets
-        (storage/flush.go snapshot state; persist/fs snapshot volumes)."""
+        (storage/flush.go snapshot state; persist/fs snapshot volumes).
+
+        The commit log position is recorded ONCE, before any buffer is
+        read: every WAL entry durable at-or-before it is provably
+        visible to the buffer reads below, so recovery replays only the
+        WAL tail past the position — the conservative overlap window
+        dedups at read/seal, never loses. Sync writes land in the
+        buffer BEFORE their commit log append; ASYNC new-series writes
+        (write_new_series_async) sit in the insert queue with their WAL
+        append already durable, so every queue is drained between
+        taking the position and reading buffers — an entry whose chunk
+        is at-or-before the position was enqueued before position() ran
+        and therefore lands in the buffer the snapshot reads."""
+        if not self._version_seeded:
+            # Resume ABOVE any version already on disk: after a restart
+            # a counter reset to 1 would lose every new snapshot to the
+            # pre-kill generation's higher versions at cleanup.
+            self._version_seeded = True
+            for ns in list(self.db.namespaces.values()):
+                for shard_id in ns.shards:
+                    for _bs, version, _p in self.persist.list_snapshots(
+                            ns.name, shard_id):
+                        self._snapshot_version = max(
+                            self._snapshot_version, version)
         self._snapshot_version += 1
         version = self._snapshot_version
+        wal_position = None
+        commitlog = getattr(self.db, "commitlog", None)
+        if commitlog is not None:
+            try:
+                wal_position = commitlog.position()
+            except ValueError:
+                wal_position = None  # closed log: snapshot without one
+        if wal_position is not None:
+            for ns in list(self.db.namespaces.values()):
+                for shard in ns.shards.values():
+                    shard.insert_queue.drain()
         count = 0
         for ns in list(self.db.namespaces.values()):
             if not ns.opts.snapshot_enabled:
                 continue
             for shard in ns.shards.values():
                 for bs in sorted(shard.buffer.buckets):
+                    if bs in shard.blocks:
+                        # The block start already has a sealed
+                        # representation (a snapshot-recovered tile, or
+                        # a seal racing a late drain): the BUFFER's
+                        # content alone is a partial view, and a
+                        # snapshot of it would record a WAL position
+                        # claiming coverage of chunks whose data lives
+                        # only in the block — a later restart would
+                        # position-skip them and lose acked writes.
+                        # These buckets stay WAL-replayable instead
+                        # (the pre-existing snapshot, if any, remains
+                        # the newest for this block start).
+                        continue
                     dense = shard.buffer.snapshot(bs)
                     if dense is None:
                         continue
                     series, tdense, vdense, npoints = dense
                     blk = encode_block(bs, series, tdense, vdense, npoints)
                     self.persist.write_snapshot(ns.name, shard.shard_id, blk,
-                                                shard.registry, version)
+                                                shard.registry, version,
+                                                wal_position=wal_position)
                     count += 1
         return count
 
@@ -79,6 +129,17 @@ class Mediator:
         for ns in list(self.db.namespaces.values()):
             cutoff = now_ns - ns.opts.retention_ns
             for shard_id in ns.shards:
+                shard_dir = os.path.join(self.persist.root, ns.name.decode(),
+                                         f"shard-{shard_id:05d}")
+                if os.path.isdir(shard_dir):
+                    for name in os.listdir(shard_dir):
+                        if name.endswith(".tmp"):
+                            # Mid-write crash residue (SIGKILL between
+                            # the checkpoint write and os.replace):
+                            # never servable, never auto-replaced.
+                            shutil.rmtree(os.path.join(shard_dir, name),
+                                          ignore_errors=True)
+                            removed += 1
                 shard_removed = 0
                 for bs, path in self.persist.list_filesets(ns.name, shard_id):
                     if bs + ns.opts.block_size_ns <= cutoff:
@@ -100,6 +161,47 @@ class Mediator:
                     if stale:
                         shutil.rmtree(path, ignore_errors=True)
                         removed += 1
+        removed += self._trim_commitlog()
+        return removed
+
+    def _trim_commitlog(self) -> int:
+        """Delete commit log files that can no longer contribute to any
+        bootstrap (cleanup.go's commit log cleanup): a non-active file
+        last written more than max-retention-plus-slack of WALL time ago
+        holds only entries whose data timestamps (bounded by the
+        acceptance window around their write time) are past every
+        namespace's retention — replay would range-filter every one.
+        Without this the WAL grows without bound and every restart
+        replays history that can never be served."""
+        commitlog = getattr(self.db, "commitlog", None)
+        if commitlog is None:
+            return 0
+        namespaces = list(self.db.namespaces.values())
+        retention = max((ns.opts.retention_ns for ns in namespaces),
+                        default=0)
+        if not retention:
+            return 0
+        # An entry written at file-mtime M carries a data timestamp of
+        # at most M + buffer_future, so the slack must cover the widest
+        # configured future window (plus an hour of margin) — a fixed
+        # slack would delete still-in-retention entries under a large
+        # buffer_future.
+        slack = max((ns.opts.buffer_future_ns for ns in namespaces),
+                    default=0) + xtime.HOUR
+        # Wall clock, not the db clock: file mtimes are wall time (a
+        # test driving a fake clock simply never trims — safe).
+        horizon = time.time_ns() - retention - slack
+        active = commitlog.active_file()
+        removed = 0
+        for path in commitlog.files():
+            if path == active:
+                continue
+            try:
+                if os.stat(path).st_mtime_ns < horizon:
+                    os.remove(path)
+                    removed += 1
+            except OSError:
+                continue
         return removed
 
     # ------------------------------------------------------------- background

@@ -368,16 +368,38 @@ class Shard:
         t = np.concatenate(parts_t)
         v = np.concatenate(parts_v)
         order = np.argsort(t, kind="stable")
-        return t[order], v[order]
+        t, v = t[order], v[order]
+        if len(t) > 1 and (t[:-1] == t[1:]).any():
+            # A sealed block and the mutable buffer can briefly cover the
+            # same (series, timestamp): a snapshot-recovered block with
+            # the WAL tail replayed on top (the conservative chunk-window
+            # overlap), or a write racing a seal before the same-start
+            # merge folds it in. Last-arrival wins, matching the buffer's
+            # own drain dedup — parts append blocks-then-buffer and the
+            # sort is stable, so keeping the final duplicate keeps the
+            # buffer's (newer) value.
+            keep = np.concatenate([t[:-1] != t[1:], [True]])
+            t, v = t[keep], v[keep]
+        return t, v
 
     # ------------------------------------------------------- flush/bootstrap
 
     def flushable(self, now_ns: int) -> List[int]:
-        """Sealed blocks not yet durably flushed."""
+        """COLD sealed blocks not yet durably flushed. The writability
+        gate matters for recovery: a snapshot-recovered tile installed
+        for a still-warm window (load_block NOT_STARTED) must not flush
+        yet — a tile-only fileset would make the next restart's
+        filesystem bootstrapper claim the whole block range and
+        range-filter the WAL tail out of replay, silently dropping
+        acked writes. Blocks sealed by tick are past this gate by
+        construction (sealable() uses the same bound)."""
         with self.write_lock:
             return sorted(
                 bs for bs, st in self.flush_states.items()
-                if st in (FlushState.NOT_STARTED, FlushState.FAILED) and bs in self.blocks
+                if st in (FlushState.NOT_STARTED, FlushState.FAILED)
+                and bs in self.blocks
+                and bs + self.opts.block_size_ns + self.opts.buffer_past_ns
+                <= now_ns
             )
 
     def mark_flushed(self, block_start: int, ok: bool = True):
@@ -406,11 +428,17 @@ class Shard:
                 evicted += 1
         return evicted
 
-    def load_block(self, blk: SealedBlock, remap: Optional[np.ndarray] = None):
+    def load_block(self, blk: SealedBlock, remap: Optional[np.ndarray] = None,
+                   flush_state: FlushState = FlushState.SUCCESS):
         """Install a bootstrapped/streamed block (bootstrap result merge).
 
         `remap` translates the block's series indices into this registry's
-        (peer blocks arrive with the remote's indices)."""
+        (peer blocks arrive with the remote's indices). `flush_state` is
+        the durability state the install implies: peer-streamed blocks
+        are durable on the donor (SUCCESS, the default); a block rebuilt
+        from a SNAPSHOT fileset is NOT durably flushed — NOT_STARTED
+        keeps it on the flush schedule so the snapshot+WAL copy stops
+        being its only durable form."""
         if remap is not None:
             blk = dataclasses.replace(blk, series_indices=remap.astype(np.int32))
             order = np.argsort(blk.series_indices)
@@ -423,7 +451,7 @@ class Shard:
             if old is not None:
                 block_cache.get_cache().invalidate_block(old)
             self.blocks[blk.block_start] = blk
-            self.flush_states.setdefault(blk.block_start, FlushState.SUCCESS)
+            self.flush_states.setdefault(blk.block_start, flush_state)
 
     def num_series(self) -> int:
         return len(self.registry)

@@ -43,14 +43,24 @@ choice.
 from __future__ import annotations
 
 import dataclasses
+import os
 import random
+import shutil
+import signal
+import struct
+import subprocess
+import sys
+import tempfile
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
+import yaml
 
 from ..client.session import Session, SessionOptions
-from ..cluster.placement import ShardState
+from ..cluster.placement import Instance, ShardState, initial_placement
+from ..cluster.topology import StaticTopology
 from ..storage.bootstrap import BootstrapContext, BootstrapProcess
 from ..storage.repair import DatabaseRepairer, RepairOptions
 from ..utils import xtime
@@ -60,7 +70,8 @@ from .faultnet import FaultPlan
 from .loadgen import LoadGen, LoadReport, LoadSchedule, Phase
 
 __all__ = ["ChurnScenarioOptions", "ChurnScenario", "ScenarioResult",
-           "WriteLedger"]
+           "WriteLedger", "KillRestartOptions", "KillRestartScenario",
+           "KillRestartResult"]
 
 # Outcome type names that mean "the server deliberately shed this"
 # (Backpressure subclasses ResourceExhausted and rides the wire as the
@@ -516,3 +527,435 @@ class ChurnScenario:
         self.session.close()
         self.admin_session.close()
         self.cluster.close()
+
+
+# ---------------------------------------------------------------------------
+# kill -9 disaster drill
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class KillRestartOptions:
+    """One seeded kill -9 drill: a REAL dbnode child process under
+    seeded open-loop load, SIGKILLed mid-run, restarted over the same
+    data dir, bootstrap replayed, zero-acked-loss verified.
+
+    Variants:
+      base       one kill/restart cycle; the snapshot-recovered block
+                 and the replayed WAL tail merge at the first seal.
+      migration  two namespaces share the one commit log; the load
+                 migrates series mid-stream; replay must keep them
+                 isolated per namespace.
+      backfill   after the restart an out-of-order backfill wave lands
+                 inside the recovered (still-writable) window — the
+                 live buffer rides merge_same_start over the
+                 snapshot-recovered sealed tile at the next seal —
+                 then a SECOND kill/restart proves the merged block +
+                 rotated WAL still serve every acked point."""
+
+    seed: int = 7
+    variant: str = "base"            # base | migration | backfill
+    n_series: int = 48
+    num_shards: int = 4
+    block_size: str = "2s"
+    buffer_past: str = "8s"
+    buffer_future: str = "120s"
+    tick_interval: str = "0.1s"
+    base_rate: float = 150.0
+    load_duration_s: float = 1.2
+    # SIGKILL lands at a seeded fraction of the load window: early kills
+    # die mid-commitlog-stream, late kills die with the mediator
+    # mid-flush/snapshot (it runs every tick_interval).
+    kill_window: Tuple[float, float] = (0.35, 0.8)
+    restart_budget_s: float = 30.0
+    # Deterministic fault injection on top of the random-phase kill: a
+    # torn half-chunk appended to the WAL tail (what a power cut tears)
+    # and an incomplete checkpoint-less fileset (what a mid-flush kill
+    # leaves). Replay must drop both cleanly.
+    inject_torn_tail: bool = True
+    inject_torn_fileset: bool = True
+    session_timeout_s: float = 3.0
+    data_dir: Optional[str] = None
+
+
+@dataclasses.dataclass
+class KillRestartResult:
+    report: Optional[LoadReport]
+    acked_points: int
+    verified_points: int
+    restart_walls_s: List[float]
+    bootstrap_s: List[float]
+    recovered_series: List[int]
+    torn_tail_bytes: int = 0
+    backfill_points: int = 0
+
+
+class KillRestartScenario:
+    """Crash-safety drill over a real `python -m m3_tpu.services dbnode`
+    child (WRITE_WAIT commit log, background mediator, bootstrap chain
+    on startup): every quorum-acked write must be served after a SIGKILL
+    and cold restart, the restart must be serving-ready within a bound,
+    torn tail chunks and checkpoint-less filesets must be dropped
+    cleanly, and nothing the node serves may be fabricated (every
+    fetched point must be a write this drill attempted)."""
+
+    NS = b"default"
+    NS_MIG = b"migrated"
+
+    def __init__(self, opts: KillRestartOptions = KillRestartOptions()):
+        self.opts = opts
+        self.dir = opts.data_dir or tempfile.mkdtemp(prefix="killdrill-")
+        self._owns_dir = opts.data_dir is None
+        self._rng = random.Random(f"kill-restart/{opts.seed}")
+        self.ids = [b"kd-%04d" % i for i in range(opts.n_series)]
+        self.ledger = WriteLedger(time.time_ns())
+        # Every ALLOCATED write (acked or not): the fabrication check —
+        # anything the node serves must appear here with this value.
+        self._attempted: Dict[Tuple[bytes, bytes, int], float] = {}
+        self._ns_of: Dict[bytes, bytes] = {}
+        self._migrated = threading.Event()
+        self._proc: Optional[subprocess.Popen] = None
+        self._child_log: List[str] = []
+        self.result = KillRestartResult(None, 0, 0, [], [], [])
+        self._cfg_path = self._write_config()
+
+    # ------------------------------------------------------------- lifecycle
+
+    def _window_strs(self) -> Tuple[str, str]:
+        """(block_size, buffer_past) for this variant. The backfill
+        variant needs the recovered block start to stay inside the
+        acceptance window across TWO child spawns plus the backfill
+        wave (~6s nominal, more under load), so its defaults widen —
+        explicit non-default options always win."""
+        o = self.opts
+        if o.variant == "backfill":
+            cls = KillRestartOptions
+            block = "3s" if o.block_size == cls.block_size else o.block_size
+            past = "15s" if o.buffer_past == cls.buffer_past else o.buffer_past
+            return block, past
+        return o.block_size, o.buffer_past
+
+    def _write_config(self) -> str:
+        o = self.opts
+        block_size, buffer_past = self._window_strs()
+        ns = {"retention": "48h", "block_size": block_size,
+              "buffer_past": buffer_past, "buffer_future": o.buffer_future,
+              "index_enabled": False}
+        namespaces = [dict(ns, name="default")]
+        if o.variant == "migration":
+            namespaces.append(dict(ns, name="migrated"))
+        cfg = {
+            "data_dir": self.dir,
+            "listen_address": "127.0.0.1:0",
+            "num_shards": o.num_shards,
+            "commitlog_enabled": True,
+            "commitlog_strategy": "write_wait",
+            "bootstrap_enabled": True,
+            "tick_interval": o.tick_interval,
+            "namespaces": namespaces,
+        }
+        path = os.path.join(self.dir, "dbnode.yml")
+        with open(path, "w") as f:
+            yaml.safe_dump(cfg, f)
+        return path
+
+    def _spawn(self) -> Tuple[str, float]:
+        """Start a dbnode child over the drill's data dir; returns
+        (endpoint, wall seconds from exec to listening) and records the
+        child-reported bootstrap time."""
+        import m3_tpu
+
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(m3_tpu.__file__)))
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        # Persist kernel compiles across child generations (and runs):
+        # the drill asserts serving behavior, not XLA compilation — a
+        # cold child otherwise pays multi-second encode/decode compiles
+        # that can stall reads past the session timeout (churn_smoke
+        # persists its cache the same way).
+        env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                       os.path.join(repo_root, ".jax_cache"))
+        env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+        t0 = time.perf_counter()
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "m3_tpu.services", "dbnode",
+             "-f", self._cfg_path],
+            cwd=repo_root, env=env, text=True,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        self._proc = proc
+        # The reader runs on its own thread (and keeps draining for the
+        # child's lifetime so it can't block on a full pipe): a child
+        # that hangs BEFORE printing anything must still fail the drill
+        # within the deadline, not block a blocking readline forever.
+        ready = threading.Event()
+        state: Dict[str, str] = {}
+
+        def _read():
+            for line in proc.stdout:
+                self._child_log.append(line.rstrip())
+                if line.startswith("dbnode serving-ready"):
+                    fields = dict(kv.split("=") for kv in line.split()[2:])
+                    self.result.bootstrap_s.append(float(fields["bootstrap_s"]))
+                    self.result.recovered_series.append(int(fields["series"]))
+                if "dbnode listening on" in line and "endpoint" not in state:
+                    state["endpoint"] = line.rsplit(" ", 1)[-1].strip()
+                    ready.set()
+            ready.set()  # EOF: the child died before becoming ready
+
+        threading.Thread(target=_read, daemon=True).start()
+        ready.wait(timeout=max(60.0, self.opts.restart_budget_s))
+        endpoint = state.get("endpoint")
+        if endpoint is None:
+            self._kill()
+            raise RuntimeError(
+                "dbnode child never became ready; log:\n" +
+                "\n".join(self._child_log[-20:]))
+        wall = time.perf_counter() - t0
+        self.result.restart_walls_s.append(wall)
+        return endpoint, wall
+
+    def _session(self, endpoint: str,
+                 timeout_s: Optional[float] = None) -> Session:
+        placement = initial_placement(
+            [Instance(id="node0", endpoint=endpoint)],
+            self.opts.num_shards, 1)
+        return Session(StaticTopology(placement), SessionOptions(
+            timeout_s=timeout_s or self.opts.session_timeout_s,
+            retry=RetryOptions(max_attempts=2, initial_backoff_s=0.02)))
+
+    def _kill(self):
+        proc = self._proc
+        if proc is None or proc.poll() is not None:
+            return
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=30)
+
+    # ------------------------------------------------------------------ load
+
+    def _write_one(self, session: Session, sid: bytes, ns: bytes,
+                   t_ns: Optional[int] = None, value: Optional[float] = None):
+        if t_ns is None:
+            t_ns, value = self.ledger.next_write(sid)
+        self._attempted[(ns, sid, t_ns)] = value
+        self._ns_of[sid] = ns
+        session.write(ns, sid, t_ns, value)
+        # Only reached on ack: the ledger records EXACTLY what the node
+        # owes the verifier after restart.
+        self.ledger.ack(sid, t_ns, value)
+
+    def _fire_factory(self, session: Session):
+        def fire(kind: str):
+            rng = random.Random()  # content only; schedule is seeded
+            sid = self.ids[rng.randrange(len(self.ids))]
+            ns = self.NS
+            if self.opts.variant == "migration" and self._migrated.is_set():
+                # Mid-stream namespace migration: the same series pool
+                # continues under the new namespace, so one WAL file
+                # interleaves both and replay must route per namespace.
+                sid = b"mig-" + sid
+                ns = self.NS_MIG
+            self._write_one(session, sid, ns)
+        return fire
+
+    def _run_load_and_kill(self, session: Session):
+        o = self.opts
+        lo, hi = o.kill_window
+        kill_at = o.load_duration_s * (lo + (hi - lo) * self._rng.random())
+        if o.variant == "migration":
+            migrate_at = kill_at * 0.5
+            threading.Timer(migrate_at, self._migrated.set).start()
+        killer = threading.Timer(kill_at, self._kill)
+        killer.daemon = True
+        killer.start()
+        gen = LoadGen(LoadSchedule(
+            seed=o.seed, base_rate=o.base_rate,
+            phases=(Phase("drill", o.load_duration_s, 1.0),),
+            kinds=(("write", 1.0),)))
+        self.result.report = gen.run(
+            self._fire_factory(session),
+            join_timeout_s=max(30.0, 10 * o.load_duration_s))
+        killer.join(timeout=30)
+
+    # ------------------------------------------------------ fault injection
+
+    def _inject_faults(self) -> int:
+        """Deterministic crash residue on top of whatever the SIGKILL
+        left: a torn half-chunk on the WAL tail (header promises more
+        bytes than exist) and a checkpoint-less snapshot fileset."""
+        torn = 0
+        cl_dir = os.path.join(self.dir, "commitlog")
+        if self.opts.inject_torn_tail and os.path.isdir(cl_dir):
+            files = sorted(f for f in os.listdir(cl_dir)
+                           if f.startswith("commitlog-"))
+            if files:
+                junk = bytes(self._rng.getrandbits(8) for _ in range(24))
+                with open(os.path.join(cl_dir, files[-1]), "ab") as f:
+                    # Claims 512 payload bytes, delivers 24: exactly the
+                    # shape a power cut mid-write leaves.
+                    f.write(struct.pack("<II", 512, 0xDEAD) + junk)
+                torn = 8 + len(junk)
+        if self.opts.inject_torn_fileset:
+            d = os.path.join(self.dir, "data", "default", "shard-00000",
+                             "snapshot-999-0")
+            os.makedirs(d, exist_ok=True)
+            with open(os.path.join(d, "data.bin"), "wb") as f:
+                f.write(b"\x00" * 64)  # no checkpoint.json: incomplete
+        self.result.torn_tail_bytes += torn
+        return torn
+
+    # ------------------------------------------------------------- backfill
+
+    def _backfill(self, session: Session):
+        """Out-of-order backfill into the recovered, still-writable
+        window: timestamps interleave the pre-kill points (older than
+        anything the ledger allocated since restart), written in
+        seeded-shuffled order. They land in the mutable buffer BESIDE
+        the snapshot-recovered sealed tile for the same block start, so
+        the next seal rides merge_same_start."""
+        from ..query.promql import parse_duration_ns
+
+        o = self.opts
+        n = max(8, o.n_series // 2)
+        # Anchor between the pre-kill points (ledger timestamps are
+        # whole microseconds; +500ns offsets at unique 2us steps
+        # interleave without ever colliding), but never behind the
+        # acceptance window — on a machine slow enough that the
+        # restarts ate most of buffer_past, the wave shifts forward
+        # instead of being rejected.
+        _block, past = self._window_strs()
+        floor = time.time_ns() - parse_duration_ns(past) + 2 * xtime.SECOND
+        # Round the floor UP to the ledger's whole-microsecond grid so
+        # the +500ns offsets below can never collide with a pre-kill
+        # ledger timestamp even on the slow-machine path.
+        micro = xtime.Unit.MICROSECOND.nanos
+        floor = -(-floor // micro) * micro
+        anchor = max(self.ledger.base_t_ns, floor)
+        slots = []
+        for i in range(n):
+            sid = self.ids[self._rng.randrange(len(self.ids))]
+            _t, value = self.ledger.next_write(sid)
+            t_ns = anchor + i * 2 * xtime.Unit.MICROSECOND.nanos + 500
+            slots.append((sid, t_ns, value))
+        self._rng.shuffle(slots)  # out of order on the wire
+        for sid, t_ns, value in slots:
+            self._write_one(session, sid, self.NS, t_ns, value)
+        self.result.backfill_points = len(slots)
+
+    def _wait_for_seal_flush(self, timeout_s: float = 30.0) -> bool:
+        """Wait until the mediator has sealed + flushed the drilled
+        block (a flush fileset appears for namespace `default`): the
+        moment the same-start merge of snapshot tile + live buffer has
+        happened and become durable."""
+        from ..persist.fs import fileset_complete
+
+        root = os.path.join(self.dir, "data", "default")
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if os.path.isdir(root):
+                for shard_dir in os.listdir(root):
+                    d = os.path.join(root, shard_dir)
+                    # COMPLETE filesets only: the first kill can leave
+                    # 'fileset-*.tmp' staging residue that must not
+                    # count as the post-backfill flush.
+                    if any(f.startswith("fileset-")
+                           and not f.endswith(".tmp")
+                           and fileset_complete(os.path.join(d, f))
+                           for f in os.listdir(d)):
+                        return True
+            time.sleep(0.2)
+        return False
+
+    # ------------------------------------------------------------------- run
+
+    def run(self) -> KillRestartResult:
+        o = self.opts
+        endpoint, _ = self._spawn()
+        session = self._session(endpoint)
+        try:
+            self._run_load_and_kill(session)
+        finally:
+            session.close()
+        self._kill()  # idempotent: ensure death even if the timer misfired
+        self._inject_faults()
+
+        # Post-restart sessions verify and backfill: a generous timeout
+        # rides out any residual first-compile stall in a cold child
+        # (the load session above stays tight so killed-midair writes
+        # drain fast instead of piling up).
+        verify_timeout = max(15.0, o.session_timeout_s)
+        endpoint, _ = self._spawn()
+        session = self._session(endpoint, timeout_s=verify_timeout)
+        try:
+            if o.variant == "backfill":
+                self._backfill(session)
+                sealed = self._wait_for_seal_flush()
+                assert sealed, "drilled block never sealed+flushed after " \
+                    "backfill (mediator stuck?)"
+                self._kill()
+                self._inject_faults()
+                endpoint, _ = self._spawn()
+                session.close()
+                session = self._session(endpoint, timeout_s=verify_timeout)
+            self._verify_session = session
+        except Exception:
+            session.close()
+            raise
+        return self.result
+
+    # ---------------------------------------------------------------- verify
+
+    def verify(self, result: KillRestartResult) -> KillRestartResult:
+        o = self.opts
+        session = self._verify_session
+        acked = self.ledger.acked()
+        result.acked_points = sum(len(p) for p in acked.values())
+        assert result.acked_points > 0, \
+            "drill acked nothing — load never reached the node"
+        end_ns = self.ledger.base_t_ns + 10 * xtime.MINUTE
+        verified = 0
+        for sid, points in sorted(acked.items()):
+            ns = self._ns_of[sid]
+            t, v = session.fetch(ns, sid, 0, end_ns)
+            got = dict(zip(t.tolist(), v.tolist()))
+            for t_ns, value in points:
+                assert got.get(t_ns) == value, \
+                    (f"ACKED write lost after kill -9 restart: ns={ns!r} "
+                     f"{sid!r} t={t_ns} v={value} (fetched {len(got)} pts)")
+                verified += 1
+            # Fabrication check (torn tail / corrupt chunks must never
+            # surface as data): every served point is one we attempted.
+            for t_ns, value in got.items():
+                want = self._attempted.get((ns, sid, int(t_ns)))
+                assert want == value, \
+                    (f"node served a point this drill never wrote: "
+                     f"ns={ns!r} {sid!r} t={t_ns} v={value} (want {want})")
+            if o.variant == "migration" and ns == self.NS_MIG:
+                t2, _v2 = session.fetch(self.NS, sid, 0, end_ns)
+                assert len(t2) == 0, \
+                    f"migrated series {sid!r} leaked into {self.NS!r}"
+        result.verified_points = verified
+        for wall in result.restart_walls_s[1:]:
+            assert wall <= o.restart_budget_s, \
+                (f"restart-to-serving-ready {wall:.2f}s exceeded budget "
+                 f"{o.restart_budget_s}s")
+        for bs in result.bootstrap_s[1:]:
+            assert bs <= o.restart_budget_s, \
+                f"bootstrap {bs:.2f}s exceeded budget {o.restart_budget_s}s"
+        assert result.recovered_series[1:], "no restart recorded"
+        return result
+
+    def close(self):
+        try:
+            if self._proc is not None and self._proc.poll() is None:
+                self._proc.terminate()
+                try:
+                    self._proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    self._proc.kill()
+        finally:
+            s = getattr(self, "_verify_session", None)
+            if s is not None:
+                s.close()
+            if self._owns_dir:
+                shutil.rmtree(self.dir, ignore_errors=True)

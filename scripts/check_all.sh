@@ -67,6 +67,16 @@ echo "== churn smoke (SLO-under-churn: chaos + placement churn + concurrent repa
 # persisted to .jax_cache for later runs).
 JAX_PLATFORMS=cpu python scripts/churn_smoke.py --seed 7
 
+echo "== restart smoke (<10s; kill -9 a real dbnode mid-flush, restart, zero acked loss + bounded serving-ready) =="
+# Crash-safe columnar recovery: a REAL dbnode child under seeded load
+# is SIGKILLed mid-window (mediator flushing/snapshotting every 100ms),
+# torn WAL tail + checkpoint-less fileset injected, restarted — every
+# acked write must be served, nothing fabricated, restart bounded. Full
+# matrix: tests/test_durability.py (+ migration/backfill variants);
+# campaign: scripts/fuzz_durability.py; bench: bootstrap_replay. Wall
+# budget via RESTART_SMOKE_BUDGET_S.
+JAX_PLATFORMS=cpu python scripts/restart_smoke.py --seed 7
+
 echo "== observability smoke (<10s; cross-process span tree, slow-query log, self-scrape PromQL round trip, jit telemetry) =="
 # The tracing / /debug / self-scrape plane: one 2-node clustered run
 # asserting a client->coordinator->dbnode span tree (>=3 hops, grafted

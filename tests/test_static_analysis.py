@@ -22,6 +22,7 @@ from m3_tpu.analysis.hbm_rules import UnbudgetedDevicePutRule
 from m3_tpu.analysis.obs_rules import (HostSyncInPlanRule,
                                        WallClockLatencyRule)
 from m3_tpu.analysis.overload_rules import UnboundedQueueRule
+from m3_tpu.analysis.replay_rules import PerEntryReplayRule
 from m3_tpu.analysis.retry_rules import (BroadExceptWireIORule,
                                          RawSleepRetryRule)
 
@@ -1568,3 +1569,95 @@ class TestFlushCallbackLoop:
         """
         assert lint(src, FlushCallbackLoopRule(),
                     "m3_tpu/aggregator/list.py") == []
+
+
+class TestPerEntryReplay:
+    """per-entry-replay: per-row registry/buffer loops on the recovery
+    data plane (storage/bootstrap.py, persist/commitlog.py,
+    persist/fs.py); `_ref`-named oracles exempt."""
+
+    PATH = "m3_tpu/storage/bootstrap.py"
+
+    def test_flags_pre_change_snapshot_install_loop(self):
+        # the EXACT pre-change CommitlogBootstrapper shape: per-row
+        # get_or_create + per-row write_batch(np.full(...)) — the
+        # seeded positive this rule exists to keep out of the tree
+        src = """
+            import numpy as np
+
+            def load_snapshots(shard, ids, ts, vals, npoints):
+                for row, sid in enumerate(ids):
+                    idx, _ = shard.registry.get_or_create(sid)
+                    n = int(npoints[row])
+                    shard.buffer.write_batch(
+                        np.full(n, idx, np.int32),
+                        np.asarray(ts[row, :n], np.int64),
+                        np.asarray(vals[row, :n], np.float64),
+                    )
+        """
+        found = lint(src, PerEntryReplayRule(), self.PATH)
+        assert rule_ids(found) == ["per-entry-replay"] * 2
+        assert "get_or_create" in found[0].message
+        assert "np.full" in found[1].message
+
+    def test_flags_per_row_remap_comprehension(self):
+        # the pre-change FilesystemBootstrapper remap: one registry
+        # probe per row inside a listcomp
+        src = """
+            import numpy as np
+
+            def bootstrap(shard, blk, ids):
+                remap = np.array(
+                    [shard.registry.get_or_create(sid)[0] for sid in ids],
+                    np.int32)
+                shard.load_block(blk, remap)
+        """
+        found = lint(src, PerEntryReplayRule(), self.PATH)
+        assert rule_ids(found) == ["per-entry-replay"]
+
+    def test_ref_oracles_exempt(self):
+        src = """
+            import numpy as np
+
+            def load_snapshots_ref(shard, ids, npoints, ts, vals):
+                for row, sid in enumerate(ids):
+                    idx, _ = shard.registry.get_or_create(sid)
+                    shard.buffer.write_batch(
+                        np.full(int(npoints[row]), idx, np.int32),
+                        ts[row], vals[row])
+        """
+        assert lint(src, PerEntryReplayRule(), self.PATH) == []
+
+    def test_batched_paths_pass(self):
+        src = """
+            import numpy as np
+
+            def load_snapshots(shard, blk, ids, batches):
+                remap, _created = shard.registry.get_or_create_batch(ids)
+                shard.load_block(blk, np.asarray(remap, np.int32))
+                for b in batches:
+                    sidx, _ = shard.registry.get_or_create_batch(
+                        b.ids.tolist())
+                    shard.buffer.write_batch(
+                        np.asarray(sidx, np.int32), b.t_ns, b.values)
+        """
+        assert lint(src, PerEntryReplayRule(), self.PATH) == []
+
+    def test_out_of_scope_modules_pass(self):
+        src = """
+            def write(shard, sid):
+                for s in [sid]:
+                    shard.registry.get_or_create(s)
+        """
+        assert lint(src, PerEntryReplayRule(), "m3_tpu/storage/shard.py") == []
+        assert lint(src, PerEntryReplayRule(), "m3_tpu/aggregator/map.py") == []
+
+    def test_suppression(self):
+        src = """
+            def cold_path(shard, ids):
+                # one-off admin repair tool, not the recovery plane
+                # m3lint: disable=per-entry-replay
+                for sid in ids:
+                    shard.registry.get_or_create(sid)
+        """
+        assert lint(src, PerEntryReplayRule(), self.PATH) == []
