@@ -25,6 +25,14 @@ __version__ = "0.1.0"
 
 import os as _os
 
+if _os.environ.get("M3_TPU_LOCKDEP", "") not in ("", "0"):
+    # Runtime lock-order witness (utils/lockdep.py): must install BEFORE
+    # any m3_tpu module allocates a lock, so the package init is the
+    # one place early enough. Opt-in — costs nothing when unset.
+    from .utils import lockdep as _lockdep
+
+    _lockdep.install()
+
 if _os.environ.get("M3_TPU_JAX_PLATFORM"):
     # Hard platform override (e.g. "cpu" for hermetic service runs/CI).
     # The env var JAX_PLATFORMS alone does not stop out-of-tree plugin

@@ -243,8 +243,8 @@ def index_functions(mod: Module) -> Dict[str, ast.FunctionDef]:
 
 def _registry() -> List[Rule]:
     from . import (batch_rules, cache_rules, hbm_rules, jax_rules,
-                   lock_rules, obs_rules, overload_rules, replay_rules,
-                   retry_rules)
+                   lifecycle_rules, lock_rules, obs_rules, overload_rules,
+                   replay_rules, retry_rules)
 
     return [
         *cache_rules.RULES,
@@ -256,11 +256,21 @@ def _registry() -> List[Rule]:
         *hbm_rules.RULES,
         *obs_rules.RULES,
         *replay_rules.RULES,
+        *lifecycle_rules.RULES,
     ]
 
 
 def all_rules() -> List[Rule]:
     return _registry()
+
+
+def program_registry() -> List:
+    """Whole-program rules: run ONCE per tree walk over the
+    ProgramIndex (never per module, never in a --jobs worker)."""
+    from . import callgraph, jax_rules
+
+    return [callgraph.CrossModuleLockOrderRule(),
+            jax_rules.CrossModuleTaintRule()]
 
 
 def _iter_files(paths: Sequence[str]) -> Iterator[Tuple[pathlib.Path, str]]:
@@ -292,15 +302,48 @@ def iter_modules(paths: Sequence[str]) -> Iterator[Module]:
 
 
 def run_module(mod: Module, rules: Optional[Iterable[Rule]] = None,
+               timings: Optional[Dict[str, float]] = None,
                ) -> Tuple[List[Finding], int]:
-    """(non-suppressed findings, suppressed count) for one module."""
+    """(non-suppressed findings, suppressed count) for one module.
+    With `timings`, per-rule wall time accumulates into it keyed by
+    rule id (the CLI's --stats source)."""
+    import time as _time
+
     findings: List[Finding] = []
     suppressed = 0
     for rule in (rules if rules is not None else _registry()):
-        if not rule.applies(mod):
-            continue
-        for f in rule.check(mod):
-            if mod.suppressed(f):
+        t0 = _time.perf_counter() if timings is not None else 0.0
+        if rule.applies(mod):
+            for f in rule.check(mod):
+                if mod.suppressed(f):
+                    suppressed += 1
+                else:
+                    findings.append(f)
+        if timings is not None:
+            timings[rule.id] = timings.get(rule.id, 0.0) + \
+                (_time.perf_counter() - t0)
+    return findings, suppressed
+
+
+def run_program(modules: Sequence[Module], program_rules=None,
+                ) -> Tuple[List[Finding], int]:
+    """(non-suppressed findings, suppressed count) from the whole-program
+    rules over an already-parsed module set. Suppressions are honored
+    against the module each finding is attributed to."""
+    from .callgraph import ProgramIndex
+
+    rules = list(program_rules) if program_rules is not None \
+        else program_registry()
+    if not rules:
+        return [], 0
+    index = ProgramIndex(modules)
+    by_relpath = {m.relpath: m for m in modules}
+    findings: List[Finding] = []
+    suppressed = 0
+    for rule in rules:
+        for f in rule.check_program(index):
+            mod = by_relpath.get(f.path)
+            if mod is not None and mod.suppressed(f):
                 suppressed += 1
             else:
                 findings.append(f)
@@ -308,12 +351,16 @@ def run_module(mod: Module, rules: Optional[Iterable[Rule]] = None,
 
 
 def run_paths(paths: Sequence[str], rules: Optional[Iterable[Rule]] = None,
+              program_rules=None,
               ) -> Tuple[List[Finding], int, int]:
-    """(findings, suppressed count, module count) across a file tree.
-    Unparseable files surface as a finding (the tree gate must not skip
-    them silently)."""
+    """(findings, suppressed count, module count) across a file tree:
+    every per-module rule on each file, then the whole-program rules
+    (cross-module lock graph, cross-module taint) once over the full
+    index. Unparseable files surface as a finding (the tree gate must
+    not skip them silently)."""
     rules = list(rules) if rules is not None else _registry()
     findings: List[Finding] = []
+    modules: List[Module] = []
     suppressed = nmods = 0
     for f, rel in _iter_files(paths):
         try:
@@ -327,8 +374,12 @@ def run_paths(paths: Sequence[str], rules: Optional[Iterable[Rule]] = None,
                                     f"file not readable: {e}"))
             continue
         nmods += 1
+        modules.append(mod)
         got, sup = run_module(mod, rules)
         findings.extend(got)
         suppressed += sup
+    got, sup = run_program(modules, program_rules)
+    findings.extend(got)
+    suppressed += sup
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings, suppressed, nmods

@@ -175,6 +175,11 @@ class _TaintVisitor:
         self.local_funcs = local_funcs
         self.violations: List[Tuple[str, ast.AST, str]] = []
         self.calls_out: List[Tuple[str, Set[str]]] = []
+        # tainted calls to names NOT defined in this module — resolved
+        # cross-module by CrossModuleTaintRule over the ProgramIndex:
+        # (dotted name, per-positional taint, per-keyword taint, line)
+        self.ext_calls: List[Tuple[str, List[bool], Dict[str, bool],
+                                   int]] = []
 
     # -- taint queries ----------------------------------------------------
 
@@ -340,6 +345,13 @@ class _TaintVisitor:
                     hit.add(k)
             if hit:
                 self.calls_out.append((q, hit))
+        elif q and any_tainted and q.split(".")[0] not in _NUMPY_ALIASES \
+                and q.split(".")[0] not in ("jnp", "jax", "lax"):
+            # candidate CROSS-MODULE propagation: an imported helper
+            # called with tracers (resolution happens over the
+            # ProgramIndex; unresolvable names simply drop out)
+            self.ext_calls.append((q, args_tainted, kw_tainted,
+                                   call.lineno))
 
 
 class JaxPurityRule(Rule):
@@ -436,6 +448,108 @@ class ItemInLoopRule(Rule):
                         f".{node.func.attr}() inside a Python loop — one "
                         "device sync per element; batch the transfer "
                         "(np.asarray once) outside the loop")
+
+
+class CrossModuleTaintRule:
+    """jax purity ACROSS modules (a ProgramRule — see callgraph.py):
+    when a traced function calls a helper IMPORTED from another module
+    with tracer-carrying arguments, the callee runs under trace too —
+    its Python branches, host numpy, and `.item()` syncs fail exactly
+    like same-module ones, but the per-module pass cannot see them.
+    This rule resolves every tainted external call over the
+    ProgramIndex and re-runs the taint pass inside the callee's own
+    module with precisely the parameters that carry tracers. Callees
+    that are themselves jitted in their home module are skipped — the
+    per-module pass already covers them."""
+
+    id = "jax-purity"
+    severity = "error"
+
+    _MAX_HOPS = 3  # cross-module hops a tracer is followed through
+
+    def check_program(self, program) -> Iterator[Finding]:
+        emitted: Set[Tuple[str, str, int, str]] = set()
+        # ONE worklist spanning modules: (module dotted, fn node,
+        # tainted params, provenance, cross-module hops). Taint flows
+        # through same-module helpers (calls_out) and keeps going
+        # through imported ones (ext_calls) — jitted f -> B.h -> h's
+        # local helper g must reach g. Findings are yielded only for
+        # nodes reached through >=1 cross-module hop; everything
+        # same-module belongs to the per-module JaxPurityRule.
+        seen: Dict[int, Set[str]] = {}
+        work: List[Tuple[str, ast.AST, Set[str], str, int]] = []
+        for dotted, mod in sorted(program.modules.items()):
+            if "jax" not in mod.imports:
+                continue
+            for fn, static in find_traced(mod).values():
+                params = {a.arg for a in func_params(fn)}
+                work.append((dotted, fn, params - static, "", 0))
+        while work:
+            dotted, fn, tainted, prov, hops = work.pop()
+            mod = program.modules[dotted]
+            prev = seen.get(id(fn))
+            if prev is not None and tainted <= prev:
+                continue
+            seen[id(fn)] = (prev or set()) | tainted
+            funcs = index_functions(mod)
+            v = _TaintVisitor(mod, fn, tainted, funcs)
+            v.run()
+            if prov:
+                for rule_id, node, msg in v.violations:
+                    vline = getattr(node, "lineno", fn.lineno)
+                    key = (rule_id, mod.relpath, vline, msg)
+                    if key in emitted:
+                        continue
+                    emitted.add(key)
+                    yield Finding(rule_id, mod.relpath, vline,
+                                  f"{msg} [{prov}]", self.severity)
+            for callee, hit in v.calls_out:
+                if funcs[callee] is not fn:
+                    work.append((dotted, funcs[callee], hit, prov, hops))
+            if hops >= self._MAX_HOPS:
+                continue
+            for q, args_t, kw_t, line in v.ext_calls:
+                nxt = self._resolve_ext(program, dotted, q, args_t, kw_t)
+                if nxt is None:
+                    continue
+                callee_dotted, callee_fn, hit = nxt
+                new_prov = prov or (
+                    "reached under trace via cross-module call from "
+                    f"{mod.relpath}:{line} in jitted {fn.name!r}")
+                work.append((callee_dotted, callee_fn, hit, new_prov,
+                             hops + 1))
+
+    def _resolve_ext(self, program, dotted, q, args_t, kw_t):
+        r = program.resolve(dotted, q)
+        if not r or r[0] != "func":
+            return None
+        fi = program.functions[r[1]]
+        if fi.module == dotted or fi.module not in program.modules:
+            return None
+        callee_mod = program.modules[fi.module]
+        if id(fi.node) in find_traced(callee_mod):
+            return None  # jitted at home: per-module pass covers it
+        names = [a.arg for a in func_params(fi.node)]
+        if names and names[0] in ("self", "cls"):
+            names = names[1:]
+            # unbound call through the class (`Helper.compute(h, x)`):
+            # the first positional argument IS the receiver — drop it so
+            # positional taint lines up with the stripped param list
+            head = q.rsplit(".", 1)[0] if "." in q else None
+            if head and fi.cls is not None:
+                hr = program.resolve(dotted, head)
+                if hr and hr[0] == "class":
+                    args_t = args_t[1:]
+        hit: Set[str] = set()
+        for i, t in enumerate(args_t):
+            if t and i < len(names):
+                hit.add(names[i])
+        for k, t in kw_t.items():
+            if t and k in names:
+                hit.add(k)
+        if not hit:
+            return None
+        return fi.module, fi.node, hit
 
 
 RULES: List[Rule] = [JaxPurityRule(), NonStaticJitCacheRule(),

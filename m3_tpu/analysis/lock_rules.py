@@ -180,7 +180,9 @@ class _MethodFacts:
                     key = _attr_key(item.context_expr)
                     name = key.split(".")[-1]
                     self.acquires.setdefault(name, stmt.lineno)
-                    for h, _hk in held:
+                    # `with a, b:` acquires sequentially: earlier items
+                    # of this statement are held when later ones acquire
+                    for h, _hk in [*held, *newly]:
                         self.edges.append((h, name, stmt.lineno))
                     newly.append((name, kind))
                 self._walk(stmt.body, held + newly)
@@ -274,7 +276,11 @@ class LockDisciplineRule(Rule):
 
     id = "lock-discipline"  # umbrella; findings carry specific ids
     severity = "error"
-    dirs = ("storage", "cluster", "msg", "aggregator", "persist")
+    # parallel/ and query/ joined in PR 12: the plan compiler's
+    # compile-cache locks and the remote-storage exchange lock are
+    # exactly the locks the multi-host mesh work is about to contend
+    dirs = ("storage", "cluster", "msg", "aggregator", "persist",
+            "parallel", "query")
 
     def check(self, mod: Module) -> Iterator[Finding]:
         model = _LockModel(mod)

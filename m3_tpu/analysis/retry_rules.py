@@ -48,6 +48,13 @@ _PEER_IO = {
 _PEER_IO_SCOPES = {
     ("storage", "bootstrap.py"), ("storage", "repair.py"),
 }
+# PR 12 scope widening: in parallel/ and query/ the remote-exchange
+# fan-in calls are wire I/O one hop removed the same way the
+# peer-streaming session calls are — a broad except around them eats
+# the typed transport classification (RetryableError / BreakerOpen /
+# DeadlineExceeded) the retrier/breaker layer classifies on.
+_PEER_IO_DIRS = ("parallel", "query")
+_PEER_IO_EXTRA = {"_exchange", "_exchange_locked", "fetch_remote"}
 
 
 def _is_exempt(mod: Module) -> bool:
@@ -114,14 +121,51 @@ class BroadExceptWireIORule(Rule):
     def _is_broad(self, handler: ast.ExceptHandler) -> bool:
         t = handler.type
         if t is None:
-            return True  # bare except
-        names = []
-        if isinstance(t, ast.Tuple):
-            names = [qualname(e) for e in t.elts]
+            pass  # bare except: broad
         else:
-            names = [qualname(t)]
-        return any(n is not None and n.split(".")[-1] in _BROAD
-                   for n in names)
+            names = [qualname(e) for e in t.elts] \
+                if isinstance(t, ast.Tuple) else [qualname(t)]
+            if not any(n is not None and n.split(".")[-1] in _BROAD
+                       for n in names):
+                return False
+        # A broad handler that re-raises on EVERY path FORWARDS the
+        # original exception — the typed classification reaches the
+        # retrier/breaker layer intact (the settle-the-grant-then-raise
+        # shape in query/remote.py). The exemption requires the bare
+        # `raise` to be unconditional: any return/break/continue or
+        # exception-replacing raise elsewhere in the handler means some
+        # path still swallows the classification.
+        if handler.body and isinstance(handler.body[-1], ast.Raise) \
+                and handler.body[-1].exc is None:
+            if self._handler_escapes(handler.body[:-1], in_loop=False):
+                return True
+            return False
+        return True
+
+    def _handler_escapes(self, stmts, in_loop: bool) -> bool:
+        """A statement that leaves the handler before the final bare
+        raise: return anywhere, break/continue NOT bound to a loop
+        inside the handler itself, or an exception-replacing raise."""
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, ast.Return):
+                return True
+            if isinstance(stmt, (ast.Break, ast.Continue)) and not in_loop:
+                return True  # targets a loop OUTSIDE the handler
+            if isinstance(stmt, ast.Raise) and stmt.exc is not None:
+                return True
+            loops_here = isinstance(stmt, (ast.For, ast.While))
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, attr, None)
+                if sub and self._handler_escapes(
+                        sub, in_loop or loops_here):
+                    return True
+            for h in getattr(stmt, "handlers", []) or []:
+                if self._handler_escapes(h.body, in_loop):
+                    return True
+        return False
 
     def _wire_calls(self, try_node: ast.Try,
                     peer_io: bool = False) -> List[Tuple[str, int]]:
@@ -141,7 +185,8 @@ class BroadExceptWireIORule(Rule):
                     if parts[-1] in _WIRE_IO and \
                             (len(parts) == 1 or parts[-2] == "wire"):
                         out.append((parts[-1], sub.lineno))
-                    elif peer_io and parts[-1] in _PEER_IO:
+                    elif peer_io and (parts[-1] in _PEER_IO
+                                      or parts[-1] in _PEER_IO_EXTRA):
                         out.append((parts[-1], sub.lineno))
             stack.extend(ast.iter_child_nodes(sub))
         return out
@@ -149,7 +194,8 @@ class BroadExceptWireIORule(Rule):
     def check(self, mod: Module) -> Iterator[Finding]:
         if _is_exempt(mod):
             return
-        peer_io = tuple(mod.scope_parts[-2:]) in _PEER_IO_SCOPES
+        peer_io = tuple(mod.scope_parts[-2:]) in _PEER_IO_SCOPES or \
+            any(d in mod.scope_parts for d in _PEER_IO_DIRS)
         for node in ast.walk(mod.tree):
             if not isinstance(node, ast.Try):
                 continue

@@ -1,0 +1,222 @@
+#!/usr/bin/env python
+"""lockdep_check: verify runtime-witnessed lock graphs against the
+static cross-module lock model.
+
+Usage: python scripts/lockdep_check.py <dump-dir-or-files...>
+
+Loads every lockdep JSON dump (one per witnessed process — smoke runs
+that fork children produce several), unions the witnessed
+acquisition-order graphs, and asserts the check_all lockdep tier's two
+contracts:
+
+  1. ZERO witnessed cycles — no execution took two locks in an order
+     that closes a loop anywhere in the fleet of processes.
+  2. CONSISTENCY — every witnessed edge is present in the STATIC
+     cross-module lock graph (analysis/callgraph.py over m3_tpu/), or
+     explicitly reconciled in m3_tpu/analysis/lockdep_reconcile.txt
+     with a reason. A witnessed edge the static model cannot derive
+     means the analyzer's receiver typing has a hole — the
+     reconciliation file is the honest ledger of those holes, reviewed
+     like suppressions.
+
+The static comparison is closed transitively on the static side
+(static A->B->C admits a witnessed A->C: the witness records only the
+innermost held lock, the analyzer records every held pair), and
+hierarchy self-edges (same name, different objects — parent/child
+Enforcer chains) match static self-edges the same way.
+
+Exit status: 0 green; 1 on consistency misses; 2 on witnessed cycles.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+RECONCILE = REPO / "m3_tpu" / "analysis" / "lockdep_reconcile.txt"
+
+
+def load_dumps(paths):
+    files = []
+    for p in paths:
+        pp = pathlib.Path(p)
+        if pp.is_dir():
+            files.extend(sorted(pp.glob("lockdep-*.json")))
+        else:
+            files.append(pp)
+    dumps = []
+    for f in files:
+        with open(f, encoding="utf-8") as fh:
+            dumps.append((str(f), json.load(fh)))
+    return dumps
+
+
+def load_reconcile():
+    """{(from, to): reason} from the checked-in reconciliation ledger."""
+    out = {}
+    if not RECONCILE.exists():
+        return out
+    for raw in RECONCILE.read_text(encoding="utf-8").splitlines():
+        line = raw.split("#", 1)[0].strip()
+        reason = raw.split("#", 1)[1].strip() if "#" in raw else ""
+        if not line:
+            continue
+        if "->" not in line:
+            continue
+        a, b = (s.strip() for s in line.split("->", 1))
+        out[(a, b)] = reason
+    return out
+
+
+def static_graph():
+    from m3_tpu.analysis.callgraph import ProgramIndex
+    from m3_tpu.analysis.core import iter_modules
+
+    idx = ProgramIndex(list(iter_modules([str(REPO / "m3_tpu")])))
+    edges = set(idx.lock_edges())
+    # transitive closure: the witness records (innermost held -> new),
+    # the static graph records every (held -> acquired) pair, so a
+    # witnessed A->C may be static A->B->C
+    adj = {}
+    for a, b in edges:
+        adj.setdefault(a, set()).add(b)
+    closed = set(edges)
+    changed = True
+    while changed:
+        changed = False
+        for a in list(adj):
+            for b in list(adj.get(a, ())):
+                for c in adj.get(b, ()):
+                    if (a, c) not in closed:
+                        closed.add((a, c))
+                        adj.setdefault(a, set()).add(c)
+                        changed = True
+    return closed, idx.lock_kinds()
+
+
+def _union_cycle(witnessed):
+    """A cycle over the UNION of all witnessed edges (self-edges
+    exempt), or None. Returns one witnessed cycle path for the report."""
+    adj = {}
+    for a, b in witnessed:
+        if a != b:
+            adj.setdefault(a, set()).add(b)
+    WHITE, GREY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in adj}
+
+    def dfs(start):
+        stack = [(start, iter(sorted(adj.get(start, ()))))]
+        path = [start]
+        color[start] = GREY
+        while stack:
+            node, it = stack[-1]
+            for nxt in it:
+                if color.get(nxt, WHITE) == GREY:
+                    return path[path.index(nxt):] + [nxt]
+                if color.get(nxt, WHITE) == WHITE:
+                    color[nxt] = GREY
+                    path.append(nxt)
+                    stack.append((nxt, iter(sorted(adj.get(nxt, ())))))
+                    break
+            else:
+                color[node] = BLACK
+                path.pop()
+                stack.pop()
+        return None
+
+    for n in sorted(adj):
+        if color[n] == WHITE:
+            got = dfs(n)
+            if got is not None:
+                return got
+    return None
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("paths", nargs="+",
+                    help="lockdep dump directories or files")
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    dumps = load_dumps(args.paths)
+    if not dumps:
+        print("lockdep_check: NO dumps found — the witness never ran "
+              "(M3_TPU_LOCKDEP not set, or the run crashed before exit)")
+        return 1
+
+    witnessed = {}
+    cycles = []
+    nodes = 0
+    for src, d in dumps:
+        nodes = max(nodes, len(d.get("nodes", {})))
+        for c in d.get("cycles", []):
+            cycles.append((src, c))
+        for e in d.get("edges", []):
+            key = (e["from"], e["to"])
+            cur = witnessed.setdefault(
+                key, {"count": 0, "blocked": 0, "site": e.get("site", "?")})
+            cur["count"] += e.get("count", 1)
+            cur["blocked"] += e.get("blocked", 0)
+
+    print(f"lockdep_check: {len(dumps)} dump(s), {nodes} witnessed "
+          f"lock(s), {len(witnessed)} edge(s), "
+          f"{sum(v['blocked'] for v in witnessed.values())} contended "
+          "acquisition(s)")
+
+    # "zero cycles anywhere in the fleet": the per-process online lists
+    # catch intra-process cycles, but an ABBA split ACROSS processes
+    # (write smoke witnesses A->B, churn smoke witnesses B->A) closes
+    # only in the union — check it too. Same-name hierarchy self-edges
+    # stay exempt, as in the online detector.
+    union_cycle = _union_cycle(witnessed)
+    if union_cycle is not None:
+        cycles.append(("union-of-dumps", union_cycle))
+
+    if cycles:
+        print(f"FAIL: {len(cycles)} witnessed lock cycle(s):")
+        for src, c in cycles:
+            print(f"  {' -> '.join(c)}   [{src}]")
+        return 2
+
+    static, kinds = static_graph()
+    reconcile = load_reconcile()
+    misses = []
+    for (a, b), info in sorted(witnessed.items()):
+        if (a, b) in static:
+            continue
+        if (a, b) in reconcile:
+            continue
+        misses.append((a, b, info))
+    used = [k for k in reconcile if k in witnessed]
+    if args.verbose:
+        for (a, b), info in sorted(witnessed.items()):
+            mark = "static" if (a, b) in static else \
+                "reconciled" if (a, b) in reconcile else "MISS"
+            print(f"  {a} -> {b}  x{info['count']} "
+                  f"(blocked {info['blocked']}, {info['site']}) [{mark}]")
+
+    if misses:
+        print(f"FAIL: {len(misses)} witnessed edge(s) absent from the "
+              "static lock graph and not reconciled — either improve "
+              "analysis/callgraph.py's typing or add the edge to "
+              f"{RECONCILE.relative_to(REPO)} with a reason:")
+        for a, b, info in misses:
+            print(f"  {a} -> {b}   # first seen {info['site']}, "
+                  f"x{info['count']}")
+        return 1
+
+    print(f"lockdep_check: GREEN — zero cycles, every witnessed edge "
+          f"in the static graph ({len(witnessed) - len(used)}) or "
+          f"reconciled ({len(used)})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

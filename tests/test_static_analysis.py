@@ -542,9 +542,15 @@ class TestLockDiscipline:
                 with _lock:
                     time.sleep(1)
         """
-        mod = Module.from_source(textwrap.dedent(src), "m3_tpu/query/mod.py")
+        # query/ and parallel/ JOINED the scope in PR 12 (the plan
+        # compiler's caches and the remote-storage exchange lock are the
+        # locks the multi-host mesh work will contend); metrics/ stays out
+        mod = Module.from_source(textwrap.dedent(src), "m3_tpu/metrics/mod.py")
         rule = LockDisciplineRule()
         assert not rule.applies(mod)
+        for now_in in ("m3_tpu/query/mod.py", "m3_tpu/parallel/mod.py"):
+            assert rule.applies(
+                Module.from_source(textwrap.dedent(src), now_in))
 
 
 class TestBatchPartialIngest:
@@ -637,9 +643,9 @@ class TestBatchPartialIngest:
         src = "import threading\n"
         rule = LockDisciplineRule()
         assert not rule.applies(
-            Module.from_source(src, "/tmp/msg/proj/m3_tpu/query/x.py"))
+            Module.from_source(src, "/tmp/msg/proj/m3_tpu/metrics/x.py"))
         assert rule.applies(
-            Module.from_source(src, "/tmp/query/proj/m3_tpu/msg/x.py"))
+            Module.from_source(src, "/tmp/metrics/proj/m3_tpu/msg/x.py"))
 
     def test_no_contract_no_finding(self):
         # zip loops without a validate-then-iterate contract (no
@@ -923,10 +929,10 @@ class TestRetryRules:
                              "m3_tpu/storage/repair.py")) == \
             ["broad-except-wire-io"]
 
-    def test_peer_streaming_scope_is_bootstrap_and_repair_only(self):
-        # the same shape elsewhere (e.g. a query-layer helper) is out of
-        # this extension's scope — only the peer-replication data plane
-        # carries the typed PEER_SKIP_ERRORS contract
+    def test_peer_streaming_scope_covers_query_and_parallel(self):
+        # PR 12 widened the peer-I/O treatment to query/ and parallel/
+        # (remote fan-ins are wire I/O one hop removed there too); the
+        # same shape in e.g. coordinator/ stays out of this extension
         src = """
             def mirror(session, ns):
                 try:
@@ -935,8 +941,64 @@ class TestRetryRules:
                 except Exception:
                     return {}
         """
+        found = lint(src, BroadExceptWireIORule(), "m3_tpu/query/mod.py")
+        assert rule_ids(found) == ["broad-except-wire-io"]
         assert lint(src, BroadExceptWireIORule(),
-                    "m3_tpu/query/mod.py") == []
+                    "m3_tpu/coordinator/mod.py") == []
+
+    def test_broad_handler_with_bare_reraise_is_exempt(self):
+        # settle-the-grant-then-raise (query/remote._exchange): a broad
+        # handler ending in a bare re-raise FORWARDS the typed
+        # classification — nothing is eaten
+        src = """
+            from . import wire
+
+            def exchange(sock, req):
+                try:
+                    wire.write_frame(sock, req)
+                except BaseException:
+                    req["breaker"].record_failure()
+                    raise
+        """
+        assert lint(src, BroadExceptWireIORule(),
+                    "m3_tpu/rpc/mod.py") == []
+
+    def test_broad_handler_with_escaping_branch_still_flags(self):
+        # the bare-raise exemption requires forwarding on EVERY path: an
+        # early return inside the handler swallows the classification
+        src = """
+            from . import wire
+
+            def exchange(sock, req, transient):
+                try:
+                    wire.write_frame(sock, req)
+                except Exception:
+                    if transient:
+                        return None
+                    raise
+        """
+        found = lint(src, BroadExceptWireIORule(), "m3_tpu/rpc/mod.py")
+        assert rule_ids(found) == ["broad-except-wire-io"]
+
+    def test_loop_local_break_does_not_void_the_reraise_exemption(self):
+        # break/continue bound to a loop INSIDE the handler never leave
+        # the handler — the final bare raise still runs on every path
+        src = """
+            from . import wire
+
+            def exchange(sock, req, attempts):
+                try:
+                    wire.write_frame(sock, req)
+                except Exception:
+                    for a in attempts:
+                        if a.stale():
+                            continue
+                        a.cancel()
+                        break
+                    raise
+        """
+        assert lint(src, BroadExceptWireIORule(),
+                    "m3_tpu/rpc/mod.py") == []
 
     def test_typed_peer_skip_set_is_fine_in_bootstrap(self):
         # the post-fix shape: typed classification, counted skip
@@ -1661,3 +1723,722 @@ class TestPerEntryReplay:
                     shard.registry.get_or_create(sid)
         """
         assert lint(src, PerEntryReplayRule(), self.PATH) == []
+
+
+# ===================================================================
+# PR 12: whole-program analysis — callgraph, lifecycle dataflow,
+# cross-module lock order, cross-module taint, seeded PR 4/6/8 shapes
+# ===================================================================
+
+from m3_tpu.analysis.callgraph import (CrossModuleLockOrderRule,  # noqa: E402
+                                       ProgramIndex)
+from m3_tpu.analysis.jax_rules import CrossModuleTaintRule  # noqa: E402
+from m3_tpu.analysis.lifecycle_rules import (FinalizerUnderLockRule,  # noqa: E402
+                                             LifecycleRule,
+                                             ReleaseNoneParentLeakRule)
+
+
+class TestCallGraphIndex:
+    """ProgramIndex: import/alias resolution, receiver typing from
+    __init__ assignments, return-type chaining, the global lock graph's
+    Class.attr identities."""
+
+    SRCS = {
+        "m3_tpu/utils/widget.py": """
+            import threading
+
+            class Widget:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def poke(self) -> int:
+                    with self._lock:
+                        self.n += 1
+                        return self.n
+
+
+            def make_widget() -> Widget:
+                return Widget()
+
+
+            SHARED = Widget()
+        """,
+        "m3_tpu/storage/holder.py": """
+            import threading
+            from ..utils import widget
+            from ..utils.widget import Widget as W, make_widget, SHARED
+
+            class Holder:
+                def __init__(self):
+                    self._mu = threading.Lock()
+                    self.direct = W()
+                    self.via_mod = widget.Widget()
+                    self.via_fn = make_widget()
+
+                def run(self):
+                    with self._mu:
+                        self.direct.poke()
+
+                def run_global(self):
+                    with self._mu:
+                        SHARED.poke()
+        """,
+    }
+
+    def _index(self):
+        return ProgramIndex.from_sources({
+            rel: textwrap.dedent(src) for rel, src in self.SRCS.items()})
+
+    def test_import_alias_and_symbol_resolution(self):
+        idx = self._index()
+        h = "m3_tpu.storage.holder"
+        assert idx.resolve(h, "W") == ("class", "m3_tpu.utils.widget.Widget")
+        assert idx.resolve(h, "widget.Widget") == (
+            "class", "m3_tpu.utils.widget.Widget")
+        assert idx.resolve(h, "make_widget") == (
+            "func", "m3_tpu.utils.widget.make_widget")
+        assert idx.resolve(h, "widget")[0] == "module"
+
+    def test_receiver_typing_from_init_assignments(self):
+        idx = self._index()
+        holder = idx.classes["m3_tpu.storage.holder.Holder"]
+        w = "m3_tpu.utils.widget.Widget"
+        # ctor by alias, ctor through a module alias, and a typed
+        # factory return all land on the same class
+        assert holder.attr_types["direct"] == w
+        assert holder.attr_types["via_mod"] == w
+        assert holder.attr_types["via_fn"] == w
+
+    def test_module_global_singleton_typing(self):
+        idx = self._index()
+        assert idx.global_types["m3_tpu.utils.widget.SHARED"] == \
+            "m3_tpu.utils.widget.Widget"
+
+    def test_cross_module_lock_edges_use_class_attr_identity(self):
+        idx = self._index()
+        edges = idx.lock_edges()
+        # Holder.run holds Holder._mu and calls Widget.poke, which
+        # acquires Widget._lock — in ANOTHER module
+        assert ("Holder._mu", "Widget._lock") in edges
+        # the module-global singleton path resolves identically
+        path, _line, via = edges[("Holder._mu", "Widget._lock")]
+        assert path == "m3_tpu/storage/holder.py"
+        assert via.endswith("Widget.poke")
+
+    def test_lock_kinds(self):
+        idx = self._index()
+        kinds = idx.lock_kinds()
+        assert kinds["Widget._lock"] == "lock"
+        assert kinds["Holder._mu"] == "lock"
+
+    def test_condition_over_lock_aliases_to_wrapped_identity(self):
+        # self._cv = Condition(self._mu): acquisitions through the
+        # condition ARE acquisitions of _mu — the runtime witness sees
+        # _mu's proxy, so the static identity must match
+        srcs = {
+            "m3_tpu/storage/cv.py": """
+                import threading
+
+                class Waiter:
+                    def __init__(self):
+                        self._outer = threading.Lock()
+                        self._mu = threading.Lock()
+                        self._cv = threading.Condition(self._mu)
+
+                    def run(self):
+                        with self._outer:
+                            with self._cv:
+                                pass
+            """,
+        }
+        idx = ProgramIndex.from_sources(
+            {rel: textwrap.dedent(s) for rel, s in srcs.items()})
+        edges = idx.lock_edges()
+        assert ("Waiter._outer", "Waiter._mu") in edges
+        assert not any(b == "Waiter._cv" for _a, b in edges)
+
+    def test_sibling_with_items_record_an_edge(self):
+        # `with a, b:` acquires sequentially — the witness records a->b,
+        # so the static graph must too (ABBA written this way included)
+        srcs = {
+            "m3_tpu/storage/sib.py": """
+                import threading
+
+                class Pair:
+                    def __init__(self):
+                        self._a = threading.Lock()
+                        self._b = threading.Lock()
+
+                    def both(self):
+                        with self._a, self._b:
+                            pass
+            """,
+        }
+        idx = ProgramIndex.from_sources(
+            {rel: textwrap.dedent(s) for rel, s in srcs.items()})
+        assert ("Pair._a", "Pair._b") in idx.lock_edges()
+
+
+class TestCrossModuleLockOrder:
+    """The PR 6 contract shape: tenant-lock -> budget-lock in storage/,
+    budget-lock -> tenant-lock in utils/ — invisible per-module,
+    detected on the program-wide graph."""
+
+    SRCS = {
+        "m3_tpu/utils/budget.py": """
+            import threading
+            from ..storage.tile_cache import TileCache
+
+            class Budget:
+                def __init__(self, tenant: TileCache):
+                    self._lock = threading.Lock()
+                    self.tenant = tenant
+
+                def reclaim(self):
+                    with self._lock:
+                        self.tenant.evict_one()
+        """,
+        "m3_tpu/storage/tile_cache.py": """
+            import threading
+
+            class TileCache:
+                def __init__(self, budget):
+                    self._lock = threading.Lock()
+                    self.budget = budget
+
+                def put(self, k, v):
+                    with self._lock:
+                        self.budget.reclaim()
+
+                def evict_one(self):
+                    with self._lock:
+                        return 1
+        """,
+    }
+
+    def _index(self, extra=None):
+        srcs = {rel: textwrap.dedent(s)
+                for rel, s in {**self.SRCS, **(extra or {})}.items()}
+        return ProgramIndex.from_sources(srcs)
+
+    def test_cross_module_abba_detected(self):
+        idx = self._index()
+        # wire the one dynamic hop (budget param is untyped on the
+        # storage side) the way the real PR 6 code types it
+        idx.classes["m3_tpu.storage.tile_cache.TileCache"].attr_types[
+            "budget"] = "m3_tpu.utils.budget.Budget"
+        found = list(CrossModuleLockOrderRule().check_program(idx))
+        inv = [f for f in found if "inversion" in f.message]
+        assert inv, [f.render() for f in found]
+        msg = inv[0].message
+        assert "TileCache._lock" in msg and "Budget._lock" in msg
+        # both files are named so the reviewer sees the full loop
+        assert "utils/budget.py" in msg or "tile_cache" in inv[0].path
+
+    def test_one_consistent_order_is_clean(self):
+        # budget never calls back into the tenant -> one global order
+        extra = {
+            "m3_tpu/utils/budget.py": """
+                import threading
+
+                class Budget:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def reclaim(self):
+                        with self._lock:
+                            return 0
+            """,
+        }
+        idx = self._index(extra)
+        idx.classes["m3_tpu.storage.tile_cache.TileCache"].attr_types[
+            "budget"] = "m3_tpu.utils.budget.Budget"
+        assert list(CrossModuleLockOrderRule().check_program(idx)) == []
+
+
+class TestCrossModuleTaint:
+    """A jitted kernel calling an imported helper with a traced value:
+    the callee's Python branch is a trace error the per-module pass
+    cannot see."""
+
+    SRCS = {
+        "m3_tpu/ops/kernel.py": """
+            import jax
+            import jax.numpy as jnp
+            from .helpers import clamp
+
+            @jax.jit
+            def step(x):
+                return clamp(x) + 1
+        """,
+        "m3_tpu/ops/helpers.py": """
+            def clamp(v):
+                if v > 0:
+                    return v
+                return 0
+        """,
+    }
+
+    def _run(self, srcs):
+        idx = ProgramIndex.from_sources(
+            {rel: textwrap.dedent(s) for rel, s in srcs.items()})
+        return list(CrossModuleTaintRule().check_program(idx))
+
+    def test_tainted_branch_in_imported_helper_flags(self):
+        found = self._run(self.SRCS)
+        assert [f.rule for f in found] == ["jax-traced-branch"]
+        assert found[0].path == "m3_tpu/ops/helpers.py"
+        assert "cross-module call from m3_tpu/ops/kernel.py" in \
+            found[0].message
+
+    def test_untainted_cross_module_call_is_clean(self):
+        srcs = dict(self.SRCS)
+        srcs["m3_tpu/ops/kernel.py"] = """
+            import jax
+            from .helpers import clamp
+
+            @jax.jit
+            def step(x, n: int):
+                _ = clamp(7)
+                return x + 1
+        """
+        assert self._run(srcs) == []
+
+    def test_callee_jitted_at_home_left_to_per_module_pass(self):
+        srcs = dict(self.SRCS)
+        srcs["m3_tpu/ops/helpers.py"] = """
+            import jax
+
+            @jax.jit
+            def clamp(v):
+                if v > 0:
+                    return v
+                return 0
+        """
+        # the per-module JaxPurityRule owns this finding; the program
+        # rule must not double-report it
+        assert self._run(srcs) == []
+
+    def test_taint_transitive_helper_reaches_imported_module(self):
+        # jitted f -> local helper g -> imported h(tracer): the external
+        # call leaves the module one hop BELOW the traced function
+        srcs = {
+            "m3_tpu/ops/kernel.py": """
+                import jax
+                from .helpers import clamp
+
+                def _local(y):
+                    return clamp(y)
+
+                @jax.jit
+                def step(x):
+                    return _local(x) + 1
+            """,
+            "m3_tpu/ops/helpers.py": """
+                def clamp(v):
+                    if v > 0:
+                        return v
+                    return 0
+            """,
+        }
+        found = self._run(srcs)
+        assert [f.rule for f in found] == ["jax-traced-branch"]
+        assert found[0].path == "m3_tpu/ops/helpers.py"
+
+    def test_taint_continues_into_callee_local_helpers(self):
+        # jitted f -> imported h(tracer) -> h's SAME-module helper g:
+        # the tracer keeps flowing after the cross-module hop
+        srcs = {
+            "m3_tpu/ops/kernel.py": """
+                import jax
+                from .helpers import outer
+
+                @jax.jit
+                def step(x):
+                    return outer(x) + 1
+            """,
+            "m3_tpu/ops/helpers.py": """
+                def _inner(w):
+                    if w > 0:
+                        return w
+                    return 0
+
+                def outer(v):
+                    return _inner(v)
+            """,
+        }
+        found = self._run(srcs)
+        assert [f.rule for f in found] == ["jax-traced-branch"]
+        assert found[0].path == "m3_tpu/ops/helpers.py"
+        assert "_inner" in found[0].message or found[0].line
+
+
+class TestLifecycleRule:
+    """Path-sensitive paired-op balance: gate admit/release, breaker
+    allow/settle, spans — every path including the exceptional ones."""
+
+    REL = "m3_tpu/coordinator/mod.py"
+
+    def test_admit_without_exception_protection_flags(self):
+        src = """
+            def ingest(self, payload):
+                metrics = decode(payload)
+                self.gate.admit(len(metrics))
+                for m in metrics:
+                    self.storage.write(m)
+                self.gate.release(len(metrics))
+        """
+        found = lint(src, LifecycleRule(), self.REL)
+        assert rule_ids(found) == ["lifecycle-exception-leak"]
+        assert "gate-admit" in found[0].message
+
+    def test_try_finally_release_is_balanced(self):
+        src = """
+            def ingest(self, payload):
+                metrics = decode(payload)
+                self.gate.admit(len(metrics))
+                try:
+                    for m in metrics:
+                        self.storage.write(m)
+                finally:
+                    self.gate.release(len(metrics))
+        """
+        assert lint(src, LifecycleRule(), self.REL) == []
+
+    def test_guard_conditioned_admit_release_mirror_is_balanced(self):
+        # the coordinator M3MsgIngester shape: admit under a None-guard,
+        # release mirror-guarded in the finally
+        src = """
+            def consume(self, payload):
+                metrics = decode(payload)
+                gate = self.gate
+                if gate is not None:
+                    gate.admit(len(metrics))
+                try:
+                    for m in metrics:
+                        self.storage.write(m)
+                finally:
+                    if gate is not None:
+                        gate.release(len(metrics))
+        """
+        assert lint(src, LifecycleRule(), self.REL) == []
+
+    def test_held_context_form_is_balanced(self):
+        src = """
+            def handle(self, n):
+                with self.gate.held(n):
+                    self.storage.write(n)
+        """
+        assert lint(src, LifecycleRule(), self.REL) == []
+
+    def test_breaker_allow_early_return_leaks(self):
+        src = """
+            def call_once(self):
+                if not self.breaker.allow():
+                    raise BreakerOpen("shed")
+                resp = self.do_io()
+                self.breaker.record_success()
+                return resp
+        """
+        found = lint(src, LifecycleRule(), "m3_tpu/client/mod.py")
+        assert rule_ids(found) == ["lifecycle-exception-leak"]
+        assert "breaker-allow" in found[0].message
+
+    def test_guard_with_explicit_else_branch_is_balanced(self):
+        # the grant lives in the ELSE of the negated guard
+        src = """
+            def call_once(self):
+                if not self.breaker.allow():
+                    raise BreakerOpen("shed")
+                else:
+                    try:
+                        resp = self.do_io()
+                    except BaseException:
+                        self.breaker.record_failure()
+                        raise
+                    self.breaker.record_success()
+                    return resp
+        """
+        assert lint(src, LifecycleRule(), "m3_tpu/client/mod.py") == []
+
+    def test_canonical_settle_every_exit_is_balanced(self):
+        src = """
+            def call_once(self):
+                if not self.breaker.allow():
+                    raise BreakerOpen("shed")
+                try:
+                    resp = self.do_io()
+                except BaseException:
+                    self.breaker.record_failure()
+                    raise
+                self.breaker.record_success()
+                return resp
+        """
+        assert lint(src, LifecycleRule(), "m3_tpu/client/mod.py") == []
+
+    def test_settle_through_local_closure_and_callee_handoff(self):
+        # the client/session.py shape: a local `record` closure settles
+        # through self._record, and the grant is handed to the callee
+        src = """
+            def call_once(self):
+                if not self.breaker.allow():
+                    raise BreakerOpen("shed")
+                recorded = [False]
+
+                def record(ok):
+                    if not recorded[0]:
+                        recorded[0] = True
+                        self._record(ok)
+
+                try:
+                    return self._on_conn(record)
+                except BaseException:
+                    record(False)
+                    raise
+
+            def _record(self, ok):
+                if ok:
+                    self.breaker.record_success()
+                else:
+                    self.breaker.record_failure()
+        """
+        assert lint(src, LifecycleRule(), "m3_tpu/client/mod.py") == []
+
+    def test_cross_method_protocol_is_exempt(self):
+        # the insert-queue shape: admit on insert, release on drain
+        src = """
+            class Queue:
+                def insert(self, group):
+                    self.gate.admit(len(group))
+                    self._pending.append(group)
+
+                def _drain(self):
+                    n = self._apply()
+                    self.gate.release(n)
+        """
+        assert lint(src, LifecycleRule(), "m3_tpu/storage/mod.py") == []
+
+    def test_scope_owned_receiver_is_exempt(self):
+        # the query-executor shape: the charge bills a thread-locally
+        # installed enforcer whose OWNER releases in its finally
+        src = """
+            def _fetch(self, sel):
+                series = self.storage.fetch_raw(sel)
+                enforcer = getattr(self._local, "enforcer", None)
+                if enforcer is not None:
+                    enforcer.add(len(series))
+                return series
+        """
+        assert lint(src, LifecycleRule(), "m3_tpu/query/mod.py") == []
+
+    def test_return_of_handle_is_a_legal_transfer(self):
+        src = """
+            def open_scope(self, n):
+                self.gate.admit(n)
+                return self.gate
+        """
+        assert lint(src, LifecycleRule(), self.REL) == []
+
+
+class TestSpanUnfinished:
+    """The PR 8 straggler-replica shape: a manually-entered span left
+    open on the early-quorum return path."""
+
+    def test_straggler_early_return_flags(self):
+        src = """
+            from m3_tpu.utils import tracing
+
+            def fanout(self, hosts):
+                sp = tracing.TRACER.span("replica.fanout")
+                sp.__enter__()
+                for h in hosts:
+                    self.submit(h)
+                    if self.quorum_met():
+                        return
+                sp.__exit__(None, None, None)
+        """
+        found = lint(src, LifecycleRule(), "m3_tpu/client/mod.py")
+        assert rule_ids(found) == ["span-unfinished"]
+        assert "straggler" in found[0].message
+
+    def test_with_form_is_balanced(self):
+        src = """
+            from m3_tpu.utils import tracing
+
+            def fanout(self, hosts):
+                with tracing.TRACER.span("replica.fanout") as sp:
+                    for h in hosts:
+                        self.submit(h)
+                        if self.quorum_met():
+                            return
+        """
+        assert lint(src, LifecycleRule(), "m3_tpu/client/mod.py") == []
+
+    def test_enter_with_try_finally_exit_is_balanced(self):
+        src = """
+            from m3_tpu.utils import tracing
+
+            def fanout(self, hosts):
+                sp = tracing.TRACER.span("replica.fanout")
+                sp.__enter__()
+                try:
+                    for h in hosts:
+                        self.submit(h)
+                        if self.quorum_met():
+                            return
+                finally:
+                    sp.__exit__(None, None, None)
+        """
+        assert lint(src, LifecycleRule(), "m3_tpu/client/mod.py") == []
+
+
+class TestReleaseNoneParentLeak:
+    """The historical PR 4 Enforcer.release(None) leak, reintroduced."""
+
+    PRE_FIX = """
+        class Enforcer:
+            def __init__(self, limit=None, parent=None):
+                self.parent = parent
+                self._current = 0.0
+
+            def release(self, cost=None):
+                with self._lock:
+                    if cost is None:
+                        self._current = 0.0
+                    else:
+                        self._current -= cost
+                if self.parent is not None and cost:
+                    self.parent.release(cost)
+    """
+
+    def test_flags_the_pre_fix_enforcer_shape(self):
+        found = lint(self.PRE_FIX, ReleaseNoneParentLeakRule(),
+                     "m3_tpu/utils/mycost.py")
+        assert rule_ids(found) == ["release-none-parent-leak"]
+        assert "truthiness" in found[0].message or \
+            "maybe-None" in found[0].message
+
+    def test_flags_forwarding_the_raw_param(self):
+        src = """
+            class Enforcer:
+                def __init__(self, parent=None):
+                    self.parent = parent
+
+                def release(self, cost=None):
+                    self._current -= cost or self._current
+                    if self.parent is not None:
+                        self.parent.release(cost)
+        """
+        found = lint(src, ReleaseNoneParentLeakRule(), "m3_tpu/utils/c.py")
+        assert rule_ids(found) == ["release-none-parent-leak"]
+
+    def test_fixed_captured_amount_shape_is_clean(self):
+        src = """
+            class Enforcer:
+                def __init__(self, parent=None):
+                    self.parent = parent
+                    self._current = 0.0
+
+                def release(self, cost=None):
+                    with self._lock:
+                        released = self._current if cost is None else cost
+                        self._current -= released
+                    if self.parent is not None and released:
+                        self.parent.release(released)
+        """
+        assert lint(src, ReleaseNoneParentLeakRule(),
+                    "m3_tpu/utils/c.py") == []
+
+
+class TestFinalizerUnderLock:
+    """The PR 6 HBMBudget shape: a weakref.finalize callback acquiring
+    the budget lock — a latent self-deadlock at any bytecode boundary."""
+
+    def test_flags_locking_finalizer(self):
+        src = """
+            import threading
+            import weakref
+
+            class Budget:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._transient = 0
+
+                def _release_transient(self, n):
+                    with self._lock:
+                        self._transient -= n
+
+                def device_put(self, dev, n):
+                    weakref.finalize(dev, self._release_transient, n)
+        """
+        found = lint(src, FinalizerUnderLockRule(), "m3_tpu/utils/b.py")
+        assert rule_ids(found) == ["finalizer-under-lock"]
+        assert "_release_transient" in found[0].message
+
+    def test_flags_one_call_level_deep(self):
+        src = """
+            import threading
+            import weakref
+
+            class Budget:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def _locked_sub(self, n):
+                    with self._lock:
+                        return n
+
+                def _release(self, n):
+                    self._locked_sub(n)
+
+                def device_put(self, dev, n):
+                    weakref.finalize(dev, self._release, n)
+        """
+        found = lint(src, FinalizerUnderLockRule(), "m3_tpu/utils/b.py")
+        assert rule_ids(found) == ["finalizer-under-lock"]
+
+    def test_lock_free_append_drain_pattern_is_clean(self):
+        src = """
+            import threading
+            import weakref
+
+            class Budget:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._released = []
+
+                def _release_transient(self, n):
+                    self._released.append(n)
+
+                def usage(self):
+                    with self._lock:
+                        while self._released:
+                            self._transient -= self._released.pop()
+
+                def device_put(self, dev, n):
+                    weakref.finalize(dev, self._release_transient, n)
+        """
+        assert lint(src, FinalizerUnderLockRule(), "m3_tpu/utils/b.py") == []
+
+
+class TestNewFamiliesTreeGate:
+    """Zero-findings gate for ONLY the PR 12 families — isolates a
+    regression in these rules from the umbrella TestTreeGate."""
+
+    def test_tree_clean_under_lifecycle_families(self):
+        rules = [LifecycleRule(), ReleaseNoneParentLeakRule(),
+                 FinalizerUnderLockRule()]
+        findings, _sup, nmods = run_paths(
+            [str(REPO / "m3_tpu")], rules, program_rules=[])
+        assert nmods > 100
+        rendered = "\n".join(f.render() for f in findings)
+        assert findings == [], f"lifecycle findings on the tree:\n{rendered}"
+
+    def test_tree_clean_under_program_rules(self):
+        from m3_tpu.analysis.core import iter_modules, run_program
+
+        mods = list(iter_modules([str(REPO / "m3_tpu")]))
+        findings, _sup = run_program(mods)
+        rendered = "\n".join(f.render() for f in findings)
+        assert findings == [], f"program findings on the tree:\n{rendered}"
