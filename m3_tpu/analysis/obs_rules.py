@@ -15,6 +15,25 @@ Rules:
                        wall-clock call or a name/attribute assigned from
                        one — i.e. an elapsed computation.
 
+  unbounded-telemetry-tag
+                       an unbounded value riding into the instrument
+                       registry as a metric identity — a `sub_scope()`
+                       tag value or counter/gauge/histogram/timer NAME
+                       derived from a raw query string or similar
+                       user-controlled text. Every distinct tag value
+                       mints a NEW registry entry forever (Scope keys
+                       are never evicted) and a new self-scraped series,
+                       so tagging by query text converts one dashboard's
+                       traffic into unbounded registry growth + series
+                       cardinality. Tag values must come from CLOSED
+                       sets (the `plan.FallbackReason` enum values, kind
+                       strings, builder names). The rule flags scope
+                       calls whose argument interpolates an identifier
+                       from the unbounded vocabulary (query/expr/
+                       selector/pattern/...), passes such an identifier
+                       bare, or binds a tag KEYWORD named like one to a
+                       non-literal value.
+
   host-sync-in-plan    a host synchronization (`np.asarray`,
                        `jax.device_get`, `.item()`) inside the whole-plan
                        compiler's lowering surface (parallel/compile.py's
@@ -187,4 +206,82 @@ class HostSyncInPlanRule(Rule):
                     "program returns")
 
 
-RULES: List[Rule] = [WallClockLatencyRule(), HostSyncInPlanRule()]
+# Identifiers whose value domain is user-controlled text (a PromQL
+# query, a selector, a regexp pattern): interpolated into a metric name
+# or passed as a tag value they mint unbounded registry entries.
+_UNBOUNDED_IDENTS = frozenset({
+    "query", "q", "qs", "expr", "expression", "promql", "selector", "sel",
+    "sql", "pattern", "target", "query_str", "query_string", "raw_query",
+})
+
+# Scope-call method names that mint registry identities.
+_SCOPE_METHODS = frozenset({"counter", "gauge", "histogram", "timer"})
+
+
+class UnboundedTelemetryTagRule(Rule):
+    """unbounded-telemetry-tag: a raw query string (or similar unbounded
+    value) used as a scope tag value or metric name."""
+
+    id = "unbounded-telemetry-tag"
+    severity = "error"
+    dirs = None  # the instrument registry is process-wide; gate everywhere
+
+    @staticmethod
+    def _unbounded_ident(expr: ast.AST) -> Optional[str]:
+        """The first unbounded-vocabulary identifier appearing anywhere
+        inside `expr` (f-string pieces, concatenations, str()/format()
+        arguments, attribute chains), or None."""
+        for node in ast.walk(expr):
+            name = None
+            if isinstance(node, ast.Name):
+                name = node.id
+            elif isinstance(node, ast.Attribute):
+                name = node.attr
+            if name and name.lower() in _UNBOUNDED_IDENTS:
+                return name
+        return None
+
+    def _check_value(self, mod: Module, call: ast.Call, expr: ast.AST,
+                     what: str) -> Iterator[Finding]:
+        ident = self._unbounded_ident(expr)
+        if ident is None:
+            return
+        yield self.finding(
+            mod, call,
+            f"{what} derives from `{ident}` — an unbounded value minting "
+            "a new instrument-registry entry (and self-scraped series) "
+            "per distinct value; tag values and metric names must come "
+            "from closed sets (e.g. the plan.FallbackReason enum values)")
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or \
+                    not isinstance(node.func, ast.Attribute):
+                continue
+            method = node.func.attr
+            if method == "sub_scope":
+                # positional name pieces + keyword TAG values
+                for arg in node.args:
+                    yield from self._check_value(
+                        mod, node, arg, "sub_scope() name")
+                for kw in node.keywords:
+                    if kw.arg is None:
+                        continue
+                    if kw.arg.lower() in _UNBOUNDED_IDENTS and \
+                            not isinstance(kw.value, ast.Constant):
+                        yield self.finding(
+                            mod, node,
+                            f"sub_scope() tag `{kw.arg}=` binds a "
+                            "non-literal value under an unbounded-domain "
+                            "key — a raw query/selector as a tag value "
+                            "mints one registry entry per distinct query")
+                        continue
+                    yield from self._check_value(
+                        mod, node, kw.value, f"sub_scope() tag `{kw.arg}`")
+            elif method in _SCOPE_METHODS and node.args:
+                yield from self._check_value(
+                    mod, node, node.args[0], f"{method}() metric name")
+
+
+RULES: List[Rule] = [WallClockLatencyRule(), HostSyncInPlanRule(),
+                     UnboundedTelemetryTagRule()]

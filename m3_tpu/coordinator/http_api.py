@@ -62,6 +62,7 @@ class HTTPApi:
             ("GET", r"/api/v1/graphite/find", self.graphite_find),
             ("GET", r"/routes", self.list_routes),
             ("GET", r"/debug/vars", self.debug_vars),
+            ("GET", r"/debug/explain", self.debug_explain),
             ("GET", r"/debug/traces", self.debug_traces),
             ("GET", r"/debug/pprof/profile", self.debug_profile),
             ("GET", r"/debug/pprof/goroutine", self.debug_stacks),
@@ -153,29 +154,111 @@ class HTTPApi:
         return RawResponse("text/plain; charset=utf-8",
                            tracing.thread_stacks().encode())
 
+    def debug_explain(self, req) -> dict:
+        """Query EXPLAIN/ANALYZE (`?query=...&start=&end=&step=`): the
+        static plan tree — per node: kind, sharding annotation, compiled
+        vs interpreter route, typed fallback reason (query/explain.py).
+        `&analyze=true` additionally EXECUTES the query under an ANALYZE
+        context and returns per-stage wall times (bind, device program
+        per shape bucket, result materialization), cache events, and the
+        route the execution actually took."""
+        from ..query import explain as qexplain
+        from ..query.executor import QueryParams
+
+        q = req.param("query")
+        now = time.time()
+        start = _parse_time(req.param("start", str(now - 3600)))
+        end = _parse_time(req.param("end", str(now)))
+        step = _parse_step(req.param("step", "30"))
+        try:
+            ast = promql.parse(q)
+        except promql.ParseError as e:
+            raise HTTPError(400, f"bad query: {e}")
+        params = QueryParams(start, end, step)
+        out = qexplain.explain(ast, params, self.engine.lookback_ns,
+                               query=q)
+        if _flag(req, "analyze"):
+            with qexplain.analyzing() as actx:
+                block = self.engine.execute_range(q, start, end, step,
+                                                  ast=ast)
+                np.asarray(block.values)  # materialize under the context
+            out["analyze"] = actx.to_dict()
+            out["executed"] = self.engine.last_route()
+        return out
+
+    def _explain_beside_data(self, q, ast, start, end, step, actx) -> dict:
+        """The `?explain=true` payload riding beside query results
+        (Prometheus-stats style): the static plan tree plus the route
+        the execution ACTUALLY took (below-floor shows up here even
+        though the static tree says compilable)."""
+        from ..query import explain as qexplain
+        from ..query.executor import QueryParams
+
+        out = qexplain.explain(ast, QueryParams(start, end, step),
+                               self.engine.lookback_ns, query=q)
+        out["executed"] = self.engine.last_route()
+        if actx is not None:
+            out["analyze"] = actx.to_dict()
+        return out
+
     def query_range(self, req) -> dict:
         q = req.param("query")
         start = _parse_time(req.param("start"))
         end = _parse_time(req.param("end"))
         step = _parse_step(req.param("step"))
-        block = self.engine.execute_range(q, start, end, step)
-        return _prom_matrix(block)
+        if not _flag(req, "explain"):
+            block = self.engine.execute_range(q, start, end, step)
+            return _prom_matrix(block)
+        ast = promql.parse(q)
+        actx = None
+        if _flag(req, "analyze"):
+            from ..query import explain as qexplain
+
+            with qexplain.analyzing() as actx:
+                block = self.engine.execute_range(q, start, end, step,
+                                                  ast=ast)
+                np.asarray(block.values)
+        else:
+            block = self.engine.execute_range(q, start, end, step, ast=ast)
+        out = _prom_matrix(block)
+        out["data"]["explain"] = self._explain_beside_data(
+            q, ast, start, end, step, actx)
+        return out
 
     def query_instant(self, req) -> dict:
         q = req.param("query")
         t = _parse_time(req.param("time", str(time.time())))
         # ONE parse serves both the type check and the evaluation.
         ast = promql.parse(q)
-        block = self.engine.execute_instant(q, t, ast=ast)
-        if promql.is_scalar_node(ast):
-            # prom instant queries of scalar-typed expressions return
-            # resultType "scalar" (range queries still matrix-ize them)
-            v = block.values[0][-1] if block.n_series else float("nan")
-            return {"status": "success",
-                    "data": {"resultType": "scalar",
-                             "result": [block.meta.times()[-1] / S,
-                                        _prom_sample_value(v)]}}
-        return _prom_vector(block)
+        explain_flag = _flag(req, "explain")
+        actx = None
+
+        def run():
+            block = self.engine.execute_instant(q, t, ast=ast)
+            if promql.is_scalar_node(ast):
+                # prom instant queries of scalar-typed expressions return
+                # resultType "scalar" (range queries still matrix-ize
+                # them)
+                v = block.values[0][-1] if block.n_series else float("nan")
+                return {"status": "success",
+                        "data": {"resultType": "scalar",
+                                 "result": [block.meta.times()[-1] / S,
+                                            _prom_sample_value(v)]}}
+            return _prom_vector(block)
+
+        if explain_flag and _flag(req, "analyze"):
+            from ..query import explain as qexplain
+
+            # Serialization happens inside the context so the result
+            # materialization stage records (same as query_range).
+            with qexplain.analyzing() as actx:
+                out = run()
+        else:
+            out = run()
+        if explain_flag:
+            out["data"]["explain"] = self._explain_beside_data(
+                q, ast, t, t, 1_000_000_000, actx)
+        return out
 
     def _fetch_for_match(self, req):
         matchers = []
@@ -525,6 +608,10 @@ class HTTPError(Exception):
 
 
 # ---------------------------------------------------------------- helpers
+
+def _flag(req, name: str) -> bool:
+    return req.param(name, "").lower() in ("true", "1")
+
 
 def _trace_header_ctx(header: Optional[str]):
     """SpanContext from an "X-M3-Trace: <trace_id>:<span_id>" header, or

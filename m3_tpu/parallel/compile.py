@@ -56,6 +56,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import telemetry
 from ..ops import temporal
+from ..query import explain as qexplain
 from ..query import plan as qplan
 from ..query import promql
 from ..query.plan import (
@@ -68,7 +69,16 @@ _F32 = jnp.float32
 
 class PlanFallback(Exception):
     """The bound plan can't execute compiled (shape pathology, missing
-    backend feature); the executor falls back to the interpreter."""
+    backend feature); the executor falls back to the interpreter.
+    Carries a typed `FallbackReason` (default BACKEND_GAP) so the
+    telemetry/EXPLAIN taxonomy covers compile-time bail-outs too."""
+
+    def __init__(self, detail: str = "",
+                 reason: "qplan.FallbackReason" = None):
+        self.reason = reason or qplan.FallbackReason.BACKEND_GAP
+        self.detail = detail
+        super().__init__(f"{self.reason.value}: {detail}" if detail
+                         else self.reason.value)
 
 
 # --------------------------------------------------------------- geometry
@@ -544,6 +554,14 @@ def _plan_executable(stripped: PlanNode, geom: Geometry,
 # -------------------------------------------------------------- execution
 
 
+def _bucket_sig(geom: Geometry) -> str:
+    """Compact shape-bucket label for ANALYZE device stages: padded rows
+    per fetch x padded steps @ shard count — a closed set (quarter-octave
+    buckets), safe as a stage-name suffix."""
+    rows = "+".join(str(s) for s in geom.s_pads) or "0"
+    return f"s{rows}xt{geom.t_pad}@{geom.n_shard}"
+
+
 @functools.lru_cache(maxsize=256)
 def _compile_sig(root: PlanNode, fetches: Tuple[Fetch, ...]):
     """Matcher-stripped compile key + per-fetch staged-input kinds for a
@@ -613,11 +631,28 @@ def execute(bound: "qplan.Bound", mesh: Optional[Mesh]):
     if sharded:
         telemetry.mesh_dispatch("plan", cells=int(bound.total_cells))
 
-    t0 = time.perf_counter() if missed else 0.0
+    # ANALYZE: with a context active the dispatch synchronizes so the
+    # stage records the true program wall (keyed by shape bucket); off,
+    # the cost is this one thread-local read and the async pipeline is
+    # untouched (obs_overhead_guard's ANALYZE section enforces it).
+    actx = qexplain.current()
+    sync = missed or actx is not None
+    t0 = time.perf_counter() if sync else 0.0
     root_val, extras = fn(tuple(fetch_flat), tuple(aux_flat), slots)
-    if missed:
+    if sync:
         (root_val, extras) = jax.block_until_ready((root_val, extras))
-        telemetry.plan_compile_recorded(time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        if missed:
+            telemetry.plan_compile_recorded(dt)
+        if actx is not None:
+            # A cache miss's first invocation fuses trace+XLA compile
+            # with the execution — name the stage so a one-time compile
+            # can't be misread as steady-state program wall.
+            name = f"device_program[{_bucket_sig(geom)}]"
+            if missed:
+                name += "+compile"
+                actx.event("plan_cache_miss")
+            actx.add(name, dt)
 
     # --- host finish
     steps = plan.steps
@@ -639,23 +674,33 @@ def execute(bound: "qplan.Bound", mesh: Optional[Mesh]):
         temporal._copy_async(s_dev, cnt_dev)
 
         def fetch():
+            t0 = time.perf_counter() if actx is not None else 0.0
             s = np.asarray(s_dev, dtype=np.float64)[:n_rows, :steps]
             cnt = np.asarray(cnt_dev, dtype=np.float64)[:n_rows, :steps]
             telemetry.count_d2h(result_bytes)
             if root.exact:
                 s = s + _exact_base_contrib(bound, root, n_rows, steps)
             out = s / np.maximum(cnt, 1) if root.op == "avg" else s
-            return np.where(cnt > 0, out, np.nan)
+            result = np.where(cnt > 0, out, np.nan)
+            if actx is not None:
+                actx.add("result_materialize", time.perf_counter() - t0)
+                actx.event("d2h_bytes", result_bytes)
+            return result
 
         return None, bound.out_tags, fetch
 
     temporal._copy_async(root_val)
 
     def fetch():
+        t0 = time.perf_counter() if actx is not None else 0.0
         telemetry.count_d2h(result_bytes)
         # f32, like the per-op interpreter path's result planes: the
         # padded [rows_pad, t_pad] plane is sliced, not up-converted.
-        return np.asarray(root_val)[:n_rows, :steps]
+        result = np.asarray(root_val)[:n_rows, :steps]
+        if actx is not None:
+            actx.add("result_materialize", time.perf_counter() - t0)
+            actx.event("d2h_bytes", result_bytes)
+        return result
 
     return None, bound.out_tags, fetch
 

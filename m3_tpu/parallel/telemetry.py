@@ -166,6 +166,23 @@ def plan_compile_recorded(seconds: float):
     tracing.count_cost("plan_compile")
 
 
+# ---------------------------------------------------------- plan fallbacks
+
+_PLAN_FALLBACK = _SCOPE.sub_scope("plan_fallback")
+
+
+def plan_fallback(reason: str):
+    """One query that missed the compiled whole-plan route, tagged with
+    its typed `query.plan.FallbackReason` VALUE (a closed set — raw
+    query strings or other unbounded values must never ride as tag
+    values; m3lint's `unbounded-telemetry-tag` rule gates it). The
+    reason-tagged counters are the fallback taxonomy /debug/vars, the
+    self-scrape pipeline and scripts/coverage_report.py read."""
+    _SCOPE.sub_scope("plan_fallback", reason=reason).counter("count").inc()
+    _PLAN_FALLBACK.counter("total").inc()
+    tracing.count_cost("plan_fallback")
+
+
 # ------------------------------------------------------------ transfers
 
 

@@ -21,6 +21,8 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
 import numpy as np
 
 from ..ops import series_agg, temporal
+from . import corpus as qcorpus
+from . import explain as qexplain
 from . import promql
 from ..utils import limits as xlimits
 from ..utils.retry import DeadlineExceeded
@@ -234,13 +236,18 @@ class Engine:
         timer = ROOT.timer("query.latency_s")
         sp = span("query.execute_range", query=query)
         # A failure before this query's scope runs must not inherit the
-        # previous query's totals on this reused serving thread.
+        # previous query's totals on this reused serving thread — same
+        # for the plan-route record (slow-ring + corpus attribution).
         xlimits.reset_last_totals()
+        self._local.route_info = None
         t0 = time.perf_counter_ns()
         # Slow-query accounting: typed sheds record regardless of
         # duration; completed queries record past the threshold, with
         # cost attribution from the span (QueryScope exit annotates it)
-        # or, unsampled, the thread-local last-scope totals.
+        # or, unsampled, the thread-local last-scope totals. Every entry
+        # carries the plan route + typed fallback reason so a slow
+        # interpreted query tells the operator WHY it missed the
+        # compiled path.
         try:
             with timer, sp:
                 result = self._execute_range(query, start_ns, end_ns,
@@ -250,24 +257,34 @@ class Engine:
             SLOW_QUERIES.maybe("query", query, time.perf_counter_ns() - t0,
                                costs=xlimits.last_scope_totals(),
                                reason="limit-shed",
+                               route=self.last_route(),
                                trace_id=sp.trace_id or None)
             raise
         except DeadlineExceeded:
             SLOW_QUERIES.maybe("query", query, time.perf_counter_ns() - t0,
                                costs=xlimits.last_scope_totals(),
                                reason="deadline",
+                               route=self.last_route(),
                                trace_id=sp.trace_id or None)
             raise
         from ..utils import tracing
 
-        SLOW_QUERIES.maybe("query", query, time.perf_counter_ns() - t0,
+        duration_ns = time.perf_counter_ns() - t0
+        SLOW_QUERIES.maybe("query", query, duration_ns,
                            # Lazy SUBTREE rollup: cache events accrue on
                            # child/grafted spans, and only entries that
                            # actually record pay the walk.
                            costs=((lambda: tracing.collect_costs(sp))
                                   if sp.sampled
                                   else xlimits.last_scope_totals()),
+                           route=self.last_route(),
                            trace_id=sp.trace_id or None)
+        # Opt-in corpus sampler (query/corpus.py): one module-global
+        # read when no recorder is configured. Sampled queries
+        # materialize the lazy result inside the hook so recorded
+        # latency includes the d2h transfer (symmetric with the eager
+        # interpreter route).
+        qcorpus.maybe_record(query, self.last_route(), result, t0, step_ns)
         return result
 
     def _execute_range(self, query: str, start_ns: int, end_ns: int,
@@ -333,31 +350,62 @@ class Engine:
                 out = self._try_plan(node, params)
                 if out is not None:
                     return out
-                return self._eval(node, params)
+                return self._eval_interp(node, params)
             finally:
                 self._local.sel_overlay = None
-        return self._eval(node, params)
+        # Plan route off entirely (env kill switch / execute_range_ref):
+        # recorded for the slow-ring/corpus surfaces, no span tag (only
+        # real plan ATTEMPTS tag their route, as before).
+        from . import plan as qplan
+
+        self._local.route_info = {
+            "route": "interpreter",
+            "fallback_reason": qplan.FallbackReason.DISABLED.value,
+            "fallback_detail": "plan route disabled",
+        }
+        return self._eval_interp(node, params)
+
+    def _eval_interp(self, node: Node, params: QueryParams) -> Value:
+        """Interpreter evaluation, staged under ANALYZE when a context
+        is active (one thread-local read otherwise)."""
+        actx = qexplain.current()
+        if actx is None:
+            return self._eval(node, params)
+        with actx.stage("interpreter_eval"):
+            return self._eval(node, params)
 
     def _try_plan(self, node: Node, params: QueryParams) -> Optional[Value]:
+        from ..parallel import telemetry
         from ..utils.instrument import ROOT
         from . import plan as qplan
 
-        plan, reason, slot_values = qplan.lower_and_collect(
+        plan, err, slot_values = qplan.lower_and_collect(
             node, params, self.lookback_ns)
         if plan is None:
-            self._tag_route("interpreter", reason)
+            telemetry.plan_fallback(err.reason.value)
+            self._set_route("interpreter", err.reason.value, str(err))
             return None
         # bind() fetches + grids every selector through the SAME cached
         # selector paths the interpreter uses and runs the host tag
         # algebra; QueryError (matching violations) carries the
-        # interpreter's exact semantics and propagates.
-        bound = qplan.bind(plan, self, params, slot_values)
+        # interpreter's exact semantics and propagates. Under ANALYZE
+        # the bind (fetch + host tag algebra) is its own stage.
+        actx = qexplain.current()
+        if actx is None:
+            bound = qplan.bind(plan, self, params, slot_values)
+        else:
+            with actx.stage("bind"):
+                bound = qplan.bind(plan, self, params, slot_values)
         if bound.total_cells < qplan.PLAN_MIN_CELLS:
             # Tiny queries keep the interpreter's exact-f64 finishes; the
             # grids just fetched stay warm in the grid cache, so the
             # fallback evaluation below re-reads them for free.
             ROOT.counter("query.plan.below_floor").inc()
-            self._tag_route("interpreter", "below-plan-floor")
+            telemetry.plan_fallback(qplan.FallbackReason.BELOW_FLOOR.value)
+            self._set_route("interpreter",
+                            qplan.FallbackReason.BELOW_FLOOR.value,
+                            f"{bound.total_cells} cells < "
+                            f"{qplan.PLAN_MIN_CELLS} floor")
             return None
         from ..parallel import compile as pcompile
 
@@ -365,25 +413,42 @@ class Engine:
             values, tags, fetch = pcompile.execute(bound, self.mesh)
         except pcompile.PlanFallback as e:
             ROOT.counter("query.plan.fallback").inc()
-            self._tag_route("interpreter", str(e))
+            reason = getattr(e, "reason", qplan.FallbackReason.BACKEND_GAP)
+            telemetry.plan_fallback(reason.value)
+            self._set_route("interpreter", reason.value, str(e))
             return None
         ROOT.counter("query.plan.executed").inc()
-        self._tag_route("plan", "")
+        self._set_route("compiled", "", "")
         if fetch is None:
             return values          # [steps] scalar; _to_block wraps it
         from .block import LazyBlock
 
         return LazyBlock(params.meta(), tags, fetch)
 
-    @staticmethod
-    def _tag_route(route: str, reason: str) -> None:
+    def _set_route(self, route: str, reason: str, detail: str) -> None:
+        """Record the route decision: span tags (route "plan" for the
+        compiled path, the historical tag vocabulary) + the thread-local
+        route record `last_route()` reads (the slow ring, the corpus
+        sampler and the ?explain=true HTTP surface)."""
         from ..utils import tracing
 
+        self._local.route_info = {
+            "route": route,
+            "fallback_reason": reason or None,
+            "fallback_detail": detail or None,
+        }
         cur = getattr(tracing.TRACER._local, "current", None)
         if cur is not None:
-            cur.set_tag("route", route)
+            cur.set_tag("route", "plan" if route == "compiled" else route)
             if reason:
                 cur.set_tag("plan_fallback", reason)
+
+    def last_route(self) -> Optional[dict]:
+        """The route record of this THREAD's most recent query: route
+        ("compiled"/"interpreter"), typed fallback_reason (a
+        `plan.FallbackReason` value) and a human detail — None when no
+        query ran on this thread yet."""
+        return getattr(self._local, "route_info", None)
 
     def _eval(self, node: Node, params: QueryParams) -> Value:
         if isinstance(node, NumberLiteral):
@@ -526,13 +591,18 @@ class Engine:
 
         key = (promql.selector_matchers(sel),
                meta.start_ns, meta.step_ns, meta.steps, lookback_ns)
+        actx = qexplain.current()
         hit = self._grid_cache.get(key, series)
         if hit is not None:
             ROOT.counter("query.grid_cache.hit").inc()
             tracing.count_cost("grid_cache_hit")
+            if actx is not None:
+                actx.event("grid_cache_hit")
             return hit
         ROOT.counter("query.grid_cache.miss").inc()
         tracing.count_cost("grid_cache_miss")
+        if actx is not None:
+            actx.event("grid_cache_miss")
         tags_list, values = consolidate_series(series, meta, lookback_ns)
         self._grid_cache.put(key, series, tags_list, values)
         return tags_list, values

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import enum
 import math
 import os
 import threading
@@ -189,9 +190,52 @@ class Plan:
     mesh_ok: bool
 
 
+class FallbackReason(enum.Enum):
+    """The catalogued reasons a query misses the compiled whole-plan
+    route. Every `NotCompilable` raise site names one (enforced by
+    tests/test_explain.py's raise-site scan — free-form strings cannot
+    creep back in), the executor counts each fallback reason-tagged in
+    instrument scope `telemetry.plan_fallback` (visible in /debug/vars,
+    the self-scrape pipeline and the slow-query ring), and EXPLAIN
+    (`query/explain.py`) annotates the failing plan node with it. The
+    values are a CLOSED set: they ride as telemetry tag values, where an
+    unbounded value (a raw query string) would explode the metric
+    registry — m3lint's `unbounded-telemetry-tag` rule gates that."""
+
+    SUBQUERY = "subquery"                      # range func over expr[r:s]
+    MATRIX_SELECTOR = "matrix-selector"        # bare m[5m] outside a func
+    AT_MODIFIER = "at-modifier"                # @-pinned selector
+    SELECTOR_SHAPE = "selector-shape"          # range func w/o matrix arg
+    UNSUPPORTED_NODE = "unsupported-node"      # AST node kind not lowered
+    UNSUPPORTED_FUNC = "unsupported-func"      # irate/idelta/absent/...
+    UNSUPPORTED_AGG = "unsupported-agg"        # topk/quantile/stddev/...
+    AGG_OVER_SCALAR = "agg-over-scalar"        # sum(2) — type error shape
+    SET_OP = "set-op"                          # and / or / unless
+    F64_ARITH = "f64-arith"                    # % / ^ need f64 granularity
+    ABS_COMPARISON = "abs-comparison"          # compare on 1e9+ f32 plane
+    GROUP_MATCHING = "group-matching"          # group_left / group_right
+    NON_CONSTANT_PARAM = "non-constant-param"  # clamp(m, x) etc.
+    SCALAR_ONLY = "scalar-only"                # no selector in the plan
+    BELOW_FLOOR = "below-floor"                # total cells < PLAN_MIN_CELLS
+    BACKEND_GAP = "backend-gap"                # compile-time PlanFallback
+    DISABLED = "disabled"                      # plan route off (env/ref)
+
+
 class NotCompilable(Exception):
     """Raised during lowering when a node falls outside the compiled
-    surface; the executor falls back to the per-node interpreter."""
+    surface; the executor falls back to the per-node interpreter.
+
+    Carries a typed `reason` (FallbackReason — the bounded taxonomy the
+    telemetry/EXPLAIN surfaces consume), a free-form `detail` for humans,
+    and the AST `node` that raised (EXPLAIN pins the reason onto it)."""
+
+    def __init__(self, reason: FallbackReason, detail: str = "",
+                 node=None):
+        self.reason = reason
+        self.detail = detail
+        self.node = node
+        super().__init__(f"{reason.value}: {detail}" if detail
+                         else reason.value)
 
 
 # Range functions with fully-traceable device bodies (ops/temporal math).
@@ -271,8 +315,12 @@ class _Lowerer:
             inner = self.lower(node.expr)
             return InstantFunc("neg", inner)
         if isinstance(node, VectorSelector):
-            if node.range_ns or node.at_ns is not None:
-                raise NotCompilable("bare matrix selector / @-modifier")
+            if node.at_ns is not None:
+                raise NotCompilable(FallbackReason.AT_MODIFIER,
+                                    "@-pinned selector", node)
+            if node.range_ns:
+                raise NotCompilable(FallbackReason.MATRIX_SELECTOR,
+                                    "bare matrix selector", node)
             return Fetch(node, "instant", 1, 1, p.step_ns)
         if isinstance(node, Call):
             return self._lower_call(node)
@@ -280,18 +328,27 @@ class _Lowerer:
             return self._lower_aggregation(node)
         if isinstance(node, BinaryOp):
             return self._lower_binary(node)
-        raise NotCompilable(type(node).__name__)
+        raise NotCompilable(FallbackReason.UNSUPPORTED_NODE,
+                            type(node).__name__, node)
 
     def _lower_call(self, node: Call) -> PlanNode:
         f = node.func
         if f in RANGE_FUNCS:
             sels = [a for a in node.args
                     if isinstance(a, (VectorSelector, Subquery))]
-            if not sels or not isinstance(sels[-1], VectorSelector):
-                raise NotCompilable(f"{f} over subquery")
+            if sels and isinstance(sels[-1], Subquery):
+                raise NotCompilable(FallbackReason.SUBQUERY,
+                                    f"{f} over subquery", node)
+            if not sels:
+                raise NotCompilable(FallbackReason.SELECTOR_SHAPE,
+                                    f"{f} without a matrix selector", node)
             sel = sels[-1]
-            if not sel.range_ns or sel.at_ns is not None:
-                raise NotCompilable(f"{f} selector shape")
+            if sel.at_ns is not None:
+                raise NotCompilable(FallbackReason.AT_MODIFIER,
+                                    f"{f} over @-pinned selector", node)
+            if not sel.range_ns:
+                raise NotCompilable(FallbackReason.SELECTOR_SHAPE,
+                                    f"{f} over an instant selector", node)
             p = self.params
             wgrid = math.gcd(p.step_ns, sel.range_ns)
             W = sel.range_ns // wgrid
@@ -306,28 +363,34 @@ class _Lowerer:
             return RangeFunc(f, fetch, wgrid, sel.range_ns, params)
         if f in MATH_FUNCS:
             if not node.args:
-                raise NotCompilable(f"{f} with no args")
+                raise NotCompilable(FallbackReason.SELECTOR_SHAPE,
+                                    f"{f} with no args", node)
             arg = self.lower(node.args[0])
             for a in node.args[1:]:
                 self._const(a)  # only constant params compile
             extra = tuple(self._slot(a) for a in node.args[1:])
             return InstantFunc(f, arg, extra)
-        raise NotCompilable(f"function {f}")
+        raise NotCompilable(FallbackReason.UNSUPPORTED_FUNC,
+                            f"function {f}", node)
 
     def _lower_aggregation(self, node: Aggregation) -> PlanNode:
         if node.op not in AGG_OPS:
-            raise NotCompilable(f"aggregation {node.op}")
+            raise NotCompilable(FallbackReason.UNSUPPORTED_AGG,
+                                f"aggregation {node.op}", node)
         arg = self.lower(node.expr)
         if arg.edge.kind != SERIES:
-            raise NotCompilable("aggregation over scalar")
+            raise NotCompilable(FallbackReason.AGG_OVER_SCALAR,
+                                f"{node.op} over a scalar operand", node)
         exact = isinstance(arg, Fetch) and node.op in ("sum", "avg")
         return Aggregate(node.op, arg, node.grouping, node.without, exact)
 
     def _lower_binary(self, node: BinaryOp) -> PlanNode:
         if node.op in promql.SET_OPS:
-            raise NotCompilable(f"set op {node.op}")
+            raise NotCompilable(FallbackReason.SET_OP,
+                                f"set op {node.op}", node)
         if node.op not in ARITH_OPS and node.op not in promql.COMPARISON_OPS:
-            raise NotCompilable(f"f64-sensitive arithmetic {node.op}")
+            raise NotCompilable(FallbackReason.F64_ARITH,
+                                f"f64-sensitive arithmetic {node.op}", node)
         lhs = self.lower(node.lhs)
         rhs = self.lower(node.rhs)
         if node.op in promql.COMPARISON_OPS and (
@@ -341,12 +404,14 @@ class _Lowerer:
             # Difference-space planes (rate/delta) are f32 in BOTH
             # routes, so those comparisons stay compiled.
             raise NotCompilable(
+                FallbackReason.ABS_COMPARISON,
                 "comparison over an absolute-magnitude plane (f64 "
-                "granularity)")
+                "granularity)", node)
         if lhs.edge.kind == SERIES and rhs.edge.kind == SERIES:
             m = node.matching
             if m is not None and (m.group_left or m.group_right):
-                raise NotCompilable("group_left/group_right matching")
+                raise NotCompilable(FallbackReason.GROUP_MATCHING,
+                                    "group_left/group_right matching", node)
         swap = bool(node.matching and node.matching.group_right)
         return Binary(node.op, lhs, rhs, node.bool_mode, node.matching,
                       swap)
@@ -357,7 +422,8 @@ class _Lowerer:
             return float(node.value)
         if isinstance(node, Unary) and isinstance(node.expr, NumberLiteral):
             return -node.expr.value
-        raise NotCompilable("non-constant parameter")
+        raise NotCompilable(FallbackReason.NON_CONSTANT_PARAM,
+                            "non-constant parameter", node)
 
 
 def _walk_fetches(node: PlanNode, out: List[Fetch]):
@@ -599,19 +665,22 @@ def bind(plan: Plan, engine, params,
 
 
 def lower_and_collect(ast: AstNode, params, lookback_ns: int
-                      ) -> Tuple[Optional[Plan], str, List[float]]:
-    """AST -> physical plan (or (None, reason, []) when any node falls
-    outside the compiled surface) plus the scalar slot VALUES (in slot
-    order) for binding."""
+                      ) -> Tuple[Optional[Plan], Optional[NotCompilable],
+                                 List[float]]:
+    """AST -> physical plan (or (None, NotCompilable, []) when any node
+    falls outside the compiled surface — the error carries the typed
+    FallbackReason plus the AST node that raised) plus the scalar slot
+    VALUES (in slot order) for binding."""
     lw = _Lowerer(params, lookback_ns)
     try:
         root = lw.lower(ast)
     except NotCompilable as e:
-        return None, str(e), []
+        return None, e, []
     fetches: List[Fetch] = []
     _walk_fetches(root, fetches)
     if not fetches:
-        return None, "scalar-only expression", []
+        return None, NotCompilable(FallbackReason.SCALAR_ONLY,
+                                   "scalar-only expression", ast), []
     values = []
     for node in lw.slots:
         if isinstance(node, NumberLiteral):
@@ -619,13 +688,14 @@ def lower_and_collect(ast: AstNode, params, lookback_ns: int
         elif isinstance(node, Unary) and isinstance(node.expr, NumberLiteral):
             values.append(-node.expr.value)
         else:  # unreachable: _slot only records constants
-            return None, "non-constant slot", []
+            return None, NotCompilable(FallbackReason.NON_CONSTANT_PARAM,
+                                       "non-constant slot", node), []
     root = _demote_exact(root, is_root=True)
     fetches = []
     _walk_fetches(root, fetches)
     plan = Plan(root, params.steps, len(lw.slots), tuple(fetches),
                 _mesh_ok(root))
-    return plan, "", values
+    return plan, None, values
 
 
 def _demote_exact(node: PlanNode, is_root: bool) -> PlanNode:

@@ -358,7 +358,8 @@ class SlowQueryLog:
         self._ring = collections.deque(maxlen=maxlen)
 
     def record(self, kind: str, name: str, duration_ns: int, reason: str,
-               costs: Optional[dict] = None, trace_id: Optional[int] = None):
+               costs: Optional[dict] = None, trace_id: Optional[int] = None,
+               route: Optional[dict] = None):
         entry = {
             "kind": kind,
             "name": name,
@@ -368,12 +369,19 @@ class SlowQueryLog:
         }
         if trace_id:
             entry["trace_id"] = trace_id
+        if route:
+            # The executor's route record: a slow INTERPRETED query's
+            # entry says WHY it missed the compiled path (typed
+            # plan.FallbackReason value), not just that it was slow.
+            entry["route"] = route.get("route")
+            if route.get("fallback_reason"):
+                entry["plan_fallback"] = route["fallback_reason"]
         with self._lock:
             self._ring.append(entry)
 
     def maybe(self, kind: str, name: str, duration_ns: int,
               costs=None, trace_id: Optional[int] = None,
-              reason: Optional[str] = None):
+              reason: Optional[str] = None, route: Optional[dict] = None):
         """Record when `reason` is a typed failure (always) or the
         duration crosses the threshold (reason inferred: cold-cache when
         the costs show cache misses, else slow). `costs` may be a dict
@@ -387,7 +395,8 @@ class SlowQueryLog:
         if reason is None:
             reason = "cold-cache" if costs and any(
                 costs.get(k) for k in self._COLD_KEYS) else "slow"
-        self.record(kind, name, duration_ns, reason, costs, trace_id)
+        self.record(kind, name, duration_ns, reason, costs, trace_id,
+                    route=route)
 
     def entries(self) -> List[dict]:
         with self._lock:

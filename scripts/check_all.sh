@@ -123,6 +123,19 @@ echo "== plan-compiler smoke (<5s; compiled-vs-oracle, 100% warm plan-cache hit,
 JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   python scripts/plan_smoke.py
 
+echo "== explain smoke (<5s; EXPLAIN route round-trip via /debug/explain, ?explain=true + ANALYZE stages beside data, mini-corpus coverage) =="
+# The query observatory: a compiled query and a subquery fallback must
+# round-trip GET /debug/explain with correct per-node routes (typed
+# FallbackReason pinned on the raising node), ?explain=true must ride
+# the explain payload beside the PromQL data with ANALYZE stage wall
+# times, the reason-tagged telemetry.plan_fallback counters must move,
+# and a recorded mini-corpus must yield a coverage number whose
+# per-reason counts sum to the total (the scripts/coverage_report.py
+# contract). Full matrix: tests/test_explain.py +
+# tests/test_plan_compile.py::TestExplainCorpus. Wall budget via
+# EXPLAIN_SMOKE_BUDGET_S.
+JAX_PLATFORMS=cpu python scripts/explain_smoke.py
+
 echo "== aggregator smoke (<5s; mesh-vs-ref bit-equality, one-publish-per-destination forwarding, tenant fair-share) =="
 # The aggregator tier's columnar/mesh flush: the production path
 # (collect_into + emit_batch + mesh quantile ordering) must emit

@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Compiled-path coverage report over a recorded query corpus — THE
+measurement ROADMAP item 4 gates on ("≥80% of a recorded dashboard
+query corpus taking the compiled path").
+
+Reads one or more JSONL corpus files written by the opt-in sampler
+(`m3_tpu/query/corpus.py`, enabled with M3_TPU_QUERY_CORPUS=<path>),
+then prints:
+
+  * RECORDED coverage: the fraction of queries that actually took the
+    compiled route in production (below-floor and disabled included),
+    with per-reason fallback counts that sum to the total;
+  * STRUCTURAL coverage: each unique normalized shape re-lowered
+    through query/plan.py — what the coverage WOULD be if every query
+    cleared the data-size floor. The gap between the two separates
+    "lowering work needed" from "traffic is just small".
+
+Usage: python scripts/coverage_report.py corpus.jsonl [more.jsonl ...]
+Exit codes: 0 on a consistent report, 2 on an empty corpus, 1 when the
+per-reason counts fail to sum to the total (an internal invariant).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main(argv) -> int:
+    if not argv:
+        print(__doc__)
+        return 2
+
+    from m3_tpu.query import corpus as qcorpus
+
+    records = []
+    for path in argv:
+        got = qcorpus.read_corpus(path)
+        print(f"corpus {path}: {len(got)} record(s)")
+        records.extend(got)
+    if not records:
+        print("no records — record a corpus with M3_TPU_QUERY_CORPUS=<path>")
+        return 2
+
+    cov = qcorpus.coverage(records)
+    print()
+    print(f"queries:             {cov['total']}")
+    print(f"unique shapes:       {cov['shapes']}")
+    print(f"compiled (recorded): {cov['compiled']}  "
+          f"coverage {cov['coverage']:.1%}")
+    print("fallbacks by reason (recorded):")
+    for reason, n in cov["fallbacks"].items():
+        print(f"  {reason:24s} {n}")
+    print(f"compiled (structural replay): {cov['structural_compiled']}  "
+          f"coverage {cov['structural_coverage']:.1%}")
+    if cov["structural_fallbacks"]:
+        print("fallbacks by reason (structural):")
+        for reason, n in cov["structural_fallbacks"].items():
+            print(f"  {reason:24s} {n}")
+
+    # Invariant the acceptance criterion pins: compiled + per-reason
+    # fallbacks account for EVERY query, both viewpoints.
+    rec_sum = cov["compiled"] + sum(cov["fallbacks"].values())
+    struct_sum = cov["structural_compiled"] + \
+        sum(cov["structural_fallbacks"].values())
+    if rec_sum != cov["total"] or struct_sum != cov["total"]:
+        print(f"INCONSISTENT: recorded {rec_sum} / structural "
+              f"{struct_sum} != total {cov['total']}")
+        return 1
+    print(f"\nconsistent: per-reason counts sum to {cov['total']} queries")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""EXPLAIN/ANALYZE smoke: the <5s check_all tier for the query
+observatory (query/explain.py + query/corpus.py + the coordinator
+/debug/explain surface). Asserts, not just times:
+
+  1. a compiled query and a subquery fallback both round-trip through
+     GET /debug/explain with the correct routes — the compiled one's
+     every node reports "compiled", the fallback carries the typed
+     reason ("subquery") pinned on the raising node;
+  2. `?explain=true` on the PromQL read API rides the explain payload
+     BESIDE the data (Prometheus-stats style) with the route the
+     execution actually took, and `&analyze=true` returns per-stage
+     wall times (bind + a device_program shape bucket);
+  3. a recorded mini-corpus (the opt-in sampler over a mixed
+     compiled/fallback query list) yields a coverage number whose
+     per-reason fallback counts sum to the total — the
+     scripts/coverage_report.py contract;
+  4. the reason-tagged telemetry.plan_fallback counters moved.
+
+Usage: JAX_PLATFORMS=cpu python scripts/explain_smoke.py
+Env: EXPLAIN_SMOKE_BUDGET_S (default 60) wall budget, house pattern.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+import urllib.parse
+import urllib.request
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+S_NS = 1_000_000_000
+T0 = 1_700_000_000 * S_NS
+RES = 10 * S_NS
+NPTS = 200
+STEP = 30 * S_NS
+
+
+class _Storage:
+    def __init__(self, n=96):
+        t = T0 + np.arange(NPTS, dtype=np.int64) * RES
+        self.series = {}
+        for i in range(n):
+            self.series[b"m%d" % i] = {
+                "tags": {b"__name__": b"m", b"host": b"h%d" % (i % 6),
+                         b"i": str(i).encode()},
+                "t": t, "v": 1e9 * (1 + i % 4) + np.cumsum(
+                    np.full(NPTS, 5.0)) + i}
+
+    def fetch_raw(self, matchers, start_ns, end_ns):
+        return {sid: rec for sid, rec in self.series.items()
+                if all(m.matches(rec["tags"].get(m.name, b""))
+                       for m in matchers)}
+
+
+def _get(url):
+    with urllib.request.urlopen(url) as resp:
+        return json.loads(resp.read())
+
+
+def main() -> int:
+    t_start = time.perf_counter()
+
+    from m3_tpu.coordinator.http_api import HTTPApi
+    from m3_tpu.query import Engine
+    from m3_tpu.query import corpus as qcorpus
+    from m3_tpu.query import explain as qexplain
+    from m3_tpu.utils.instrument import ROOT
+
+    eng = Engine(_Storage())
+    api = HTTPApi(eng).serve()
+    start, end = (T0 + 40 * RES) / S_NS, (T0 + (NPTS - 1) * RES) / S_NS
+    base = {"start": start, "end": end, "step": "30"}
+
+    def url(path, **params):
+        return f"{api.endpoint}{path}?" + urllib.parse.urlencode(
+            {**base, **params})
+
+    try:
+        # 1. compiled round trip: every node compiled.
+        compiled_q = "sum by (host) (rate(m[5m]))"
+        out = _get(url("/debug/explain", query=compiled_q))
+        assert out["route"] == "compiled", out
+        nodes = list(qexplain.walk(out["root"]))
+        assert all(n["route"] == "compiled" for n in nodes), nodes
+        assert {n["node"] for n in nodes} == \
+            {"Aggregate", "RangeFunc", "Fetch"}
+
+        # 1b. subquery fallback round trip: typed reason on the node.
+        fb_q = "max_over_time(rate(m[5m])[10m:1m])"
+        out = _get(url("/debug/explain", query=fb_q))
+        assert out["route"] == "interpreter", out
+        assert out["fallback_reason"] == "subquery", out
+        culprits = [n for n in qexplain.walk(out["root"]) if "reason" in n]
+        assert culprits and culprits[0]["reason"] == "subquery"
+
+        # 2. ?explain=true beside the data + ANALYZE stage timings.
+        before = ROOT.snapshot()
+        out = _get(url("/api/v1/query_range", query=compiled_q,
+                       explain="true", analyze="true"))
+        assert out["status"] == "success" and out["data"]["result"]
+        exp = out["data"]["explain"]
+        assert exp["executed"]["route"] == "compiled", exp["executed"]
+        stages = exp["analyze"]["stages_ms"]
+        assert "bind" in stages, stages
+        assert any(k.startswith("device_program[") for k in stages), stages
+        assert exp["analyze"]["events"].get("d2h_bytes", 0) > 0
+
+        out = _get(url("/api/v1/query_range", query=fb_q, explain="true"))
+        exp = out["data"]["explain"]
+        assert exp["executed"]["route"] == "interpreter"
+        assert exp["executed"]["fallback_reason"] == "subquery"
+
+        # 4. the reason-tagged fallback counter moved.
+        after = ROOT.snapshot()
+        key = "telemetry.plan_fallback.count{reason=subquery}"
+        assert after.get(key, 0) > before.get(key, 0), \
+            "plan_fallback{reason=subquery} did not count"
+
+        # 3. mini-corpus -> coverage number, counts sum to total.
+        mixed = [compiled_q, "sum(m)", "rate(m[5m])", "m * 2",
+                 fb_q, "topk(3, m)", "m > 2e9", "m % 7"]
+        with tempfile.TemporaryDirectory() as td:
+            path = os.path.join(td, "corpus.jsonl")
+            qcorpus.install(qcorpus.CorpusRecorder(path, sample=1.0))
+            try:
+                for q in mixed:
+                    _get(url("/api/v1/query_range", query=q))
+            finally:
+                qcorpus.install(None)
+            records = qcorpus.read_corpus(path)
+            assert len(records) == len(mixed), \
+                f"{len(records)}/{len(mixed)} queries recorded"
+            cov = qcorpus.coverage(records)
+            assert cov["total"] == len(mixed)
+            assert cov["compiled"] + sum(cov["fallbacks"].values()) \
+                == cov["total"], cov
+            assert cov["structural_compiled"] + \
+                sum(cov["structural_fallbacks"].values()) == cov["total"]
+            assert cov["compiled"] == 4, cov   # the 4 compilable queries
+            assert set(cov["fallbacks"]) == \
+                {"subquery", "unsupported-agg", "abs-comparison",
+                 "f64-arith"}, cov
+    finally:
+        api.close()
+
+    total_s = time.perf_counter() - t_start
+    print(f"EXPLAIN SMOKE PASS: compiled + subquery routes round-trip "
+          f"/debug/explain, ?explain=true rides beside data with ANALYZE "
+          f"stages, {len(mixed)}-query mini-corpus coverage "
+          f"{cov['coverage']:.0%} ({cov['compiled']}/{cov['total']} "
+          f"compiled, reasons {sorted(cov['fallbacks'])}), "
+          f"total {total_s:.1f}s")
+    budget_s = float(os.environ.get("EXPLAIN_SMOKE_BUDGET_S", "60"))
+    assert total_s < budget_s, (
+        f"smoke tier took {total_s:.1f}s (> {budget_s:.0f}s budget)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

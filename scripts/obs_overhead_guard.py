@@ -20,9 +20,20 @@ Protocol:
     (default 3%), and ON >= the recorded bench_baseline.json floor
     (the acceptance criterion's "vs recorded baselines").
 
+ANALYZE section (PERF.md round 15): the query observatory's ANALYZE
+hooks (query/explain.py — bind stage, device dispatch, result
+materialization, grid-cache events) must be free when disabled.
+Interleaves BYPASS (hooks monkeypatched out — the no-hook comparator)
+vs OFF (shipped dormant hooks) vs ON (active context) on
+promql_plan_agg and index_fetch_tagged: dormant within
+ANALYZE_GUARD_MAX_REGRESSION (default 1%) of no-hook, active within
+ANALYZE_GUARD_ON_MAX_REGRESSION (default 10%) as a pathology backstop,
+and ANALYZE-off above the recorded floors.
+
 Usage: python scripts/obs_overhead_guard.py
-Env: OBS_GUARD_REPS, OBS_GUARD_MAX_REGRESSION, the benches' own
-BENCH_WRITE_*/BENCH_INDEX_* knobs.
+Env: OBS_GUARD_REPS, OBS_GUARD_MAX_REGRESSION, ANALYZE_GUARD_REPS,
+ANALYZE_GUARD_MAX_REGRESSION, ANALYZE_GUARD_ON_MAX_REGRESSION, the
+benches' own BENCH_WRITE_*/BENCH_INDEX_*/BENCH_PLAN_* knobs.
 """
 
 from __future__ import annotations
@@ -105,9 +116,92 @@ def main() -> int:
           {"steady_dps": off_w["steady_dps"]},
           {"steady_dps": on_w["steady_dps"]}, "write_path_ingest_steady")
 
+    # ---- ANALYZE instrumentation (query/explain.py): the hooks on the
+    # query path (bind stage, device dispatch, result materialization,
+    # grid-cache events) must be FREE when no ANALYZE context is active.
+    # Methodology: interleave BYPASS (qexplain.current monkeypatched to
+    # a constant None — the pre-change no-hook code, to within one
+    # C-level call) against OFF (the shipped dormant hooks, production
+    # default), per-metric best; dormant must stay within
+    # ANALYZE_GUARD_MAX_REGRESSION (default 1%) of bypassed on BOTH
+    # promql_plan_agg (hooks live here) and index_fetch_tagged (no hooks
+    # on that path — proves no accidental coupling). An ACTIVE context
+    # additionally runs at a loose bound (default 10%) as a pathology
+    # backstop, with its stage table printed.
+    from m3_tpu.query import explain as qexplain
+
+    areps = int(os.environ.get("ANALYZE_GUARD_REPS", "2"))
+    a_max = float(os.environ.get("ANALYZE_GUARD_MAX_REGRESSION", "0.01"))
+    a_on_max = float(
+        os.environ.get("ANALYZE_GUARD_ON_MAX_REGRESSION", "0.10"))
+
+    def analyze_series(fn, extract):
+        """(best_bypass, best_off, best_on, last_on_stages): best dicts
+        of metric -> value per mode, plus the last ON rep's recorded
+        stage table (printed so a failing ON bound is localizable).
+        One unmeasured warmup run first (the first invocation pays
+        one-time compiles — without it, whichever mode runs first eats
+        the skew); then interleaved reps, best per mode."""
+        best = ({}, {}, {})
+        on_stages = {}
+        real = qexplain.current
+        fn()  # warmup: compiles + allocator steady state
+        for _ in range(areps):
+            for mode in (0, 1, 2):
+                if mode == 0:
+                    qexplain.current = lambda: None
+                try:
+                    if mode == 2:
+                        with qexplain.analyzing() as actx:
+                            vals = extract(fn())
+                        on_stages = actx.to_dict()
+                    else:
+                        vals = extract(fn())
+                finally:
+                    qexplain.current = real
+                for k, v in vals.items():
+                    best[mode][k] = max(best[mode].get(k, 0.0), v)
+        return best, on_stages
+
+    def analyze_guard(label, bypass, off, on, floor_key):
+        for metric, byp_v in bypass.items():
+            off_v, on_v = off[metric], on[metric]
+            ratio = off_v / byp_v if byp_v else 1.0
+            check(f"{label}.{metric} ANALYZE-off within {a_max:.0%} of "
+                  "no-hook", ratio >= 1.0 - a_max,
+                  f"bypass={byp_v:.1f} off={off_v:.1f} ratio={ratio:.3f}")
+            on_ratio = on_v / byp_v if byp_v else 1.0
+            check(f"{label}.{metric} ANALYZE-on within {a_on_max:.0%}",
+                  on_ratio >= 1.0 - a_on_max,
+                  f"on={on_v:.1f} ratio={on_ratio:.3f}")
+        floor = baselines.get(floor_key)
+        head = next(iter(off.values()))
+        if floor:
+            check(f"{label} ANALYZE-off beats recorded baseline",
+                  head >= floor, f"off={head:.1f} floor={floor:.1f}")
+
+    print("== promql_plan_agg (ANALYZE off vs no-hook vs on) ==")
+    (p_bypass, p_off, p_on), p_stages = analyze_series(
+        bench.bench_promql_plan_agg,
+        lambda r: {"dps": float(r["value"])})
+    analyze_guard("promql_plan_agg", p_bypass, p_off, p_on,
+                  "promql_plan_agg")
+    print(f"  ON-mode stage table: {json.dumps(p_stages)}")
+
+    print("== index_fetch_tagged (ANALYZE off vs no-hook vs on) ==")
+    (i_bypass, i_off, i_on), _ = analyze_series(
+        bench.bench_index_fetch_tagged,
+        lambda r: {"warm_qps": float(r["value"])})
+    analyze_guard("index_fetch_tagged", i_bypass, i_off, i_on,
+                  "index_fetch_tagged")
+
     out = {
         "index_fetch_tagged": {"off": off, "on": on},
         "write_path_ingest": {"off": off_w, "on": on_w},
+        "analyze_promql_plan_agg": {
+            "bypass": p_bypass, "off": p_off, "on": p_on},
+        "analyze_index_fetch_tagged": {
+            "bypass": i_bypass, "off": i_off, "on": i_on},
     }
     print(json.dumps(out, indent=1))
     print(f"obs overhead guard: {len(failures)} failure(s)")

@@ -1,0 +1,490 @@
+"""Query EXPLAIN/ANALYZE observatory tests: the typed fallback taxonomy
+(every `NotCompilable` raise site uses a catalogued `FallbackReason`;
+reason-tagged `telemetry.plan_fallback` counters), the EXPLAIN plan tree
+(per-node kind/sharding/route, the failing node pinned with its exact
+reason), the ANALYZE instrumented execution mode (stage wall times with
+zero cost when disabled), the slow-query ring's route/fallback fields,
+the opt-in corpus recorder + coverage computation, and the coordinator
+HTTP surfaces (/debug/explain, ?explain=true beside data)."""
+
+import ast as pyast
+import inspect
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+from m3_tpu.query import Engine, promql
+from m3_tpu.query import corpus as qcorpus
+from m3_tpu.query import explain as qexplain
+from m3_tpu.query import plan as qplan
+from m3_tpu.query.executor import DEFAULT_LOOKBACK_NS, QueryParams
+from m3_tpu.query.plan import FallbackReason
+from m3_tpu.utils.instrument import ROOT
+from m3_tpu.utils.tracing import SLOW_QUERIES
+
+S = 1_000_000_000
+T0 = 1_700_000_000 * S
+RES = 10 * S
+NPTS = 200
+STEP = 30 * S
+START, END = T0 + 40 * RES, T0 + (NPTS - 1) * RES
+
+PARAMS = QueryParams(START, END, STEP)
+
+
+class MemStorage:
+    def __init__(self, n=64):
+        t = T0 + np.arange(NPTS, dtype=np.int64) * RES
+        self.series = {}
+        for i in range(n):
+            self.series[b"m%d" % i] = {
+                "tags": {b"__name__": b"m", b"host": b"h%d" % (i % 4),
+                         b"i": str(i).encode()},
+                "t": t,
+                "v": 1e9 * (1 + i % 3) + np.cumsum(
+                    np.full(NPTS, 3.0)) + i}
+        for i in range(n // 4):
+            self.series[b"b%d" % i] = {
+                "tags": {b"__name__": b"b", b"host": b"h%d" % (i % 4),
+                         b"i": str(i).encode()},
+                "t": t, "v": np.full(NPTS, 10.0) + i}
+
+    def fetch_raw(self, matchers, start_ns, end_ns):
+        out = {}
+        for sid, rec in self.series.items():
+            if all(m.matches(rec["tags"].get(m.name, b"")) for m in matchers):
+                out[sid] = rec
+        return out
+
+
+@pytest.fixture
+def no_floor(monkeypatch):
+    monkeypatch.setattr(qplan, "PLAN_MIN_CELLS", 1)
+
+
+def _explain(q):
+    return qexplain.explain(promql.parse(q), PARAMS, DEFAULT_LOOKBACK_NS,
+                            query=q)
+
+
+# ------------------------------------------------------- fallback taxonomy
+
+
+class TestFallbackTaxonomy:
+    def test_every_raise_site_uses_catalogued_reason(self):
+        """Satellite: no free-form NotCompilable strings can creep back
+        in — every construction in query/plan.py passes a FallbackReason
+        attribute as its first argument."""
+        src = inspect.getsource(qplan)
+        tree = pyast.parse(src)
+        checked = 0
+        for node in pyast.walk(tree):
+            if not (isinstance(node, pyast.Call)
+                    and isinstance(node.func, pyast.Name)
+                    and node.func.id == "NotCompilable"):
+                continue
+            # The class definition's super().__init__ body is not a Call
+            # to NotCompilable, so every match here is a raise/construct
+            # site.
+            assert node.args, "NotCompilable() constructed with no reason"
+            first = node.args[0]
+            assert isinstance(first, pyast.Attribute) and \
+                isinstance(first.value, pyast.Name) and \
+                first.value.id == "FallbackReason", (
+                    f"line {node.lineno}: NotCompilable first arg is not "
+                    "a FallbackReason attribute — free-form reason "
+                    "strings are banned")
+            assert first.attr in FallbackReason.__members__, (
+                f"line {node.lineno}: unknown reason {first.attr}")
+            checked += 1
+        assert checked >= 12, f"only {checked} sites scanned"
+
+    def test_reasons_match_expected_per_query(self):
+        expected = {
+            "topk(3, m)": FallbackReason.UNSUPPORTED_AGG,
+            "quantile(0.5, m)": FallbackReason.UNSUPPORTED_AGG,
+            "irate(m[5m])": FallbackReason.UNSUPPORTED_FUNC,
+            "timestamp(m)": FallbackReason.UNSUPPORTED_FUNC,
+            "max_over_time(rate(m[5m])[10m:1m])": FallbackReason.SUBQUERY,
+            "m and b": FallbackReason.SET_OP,
+            "m % 7": FallbackReason.F64_ARITH,
+            "m > 2e9": FallbackReason.ABS_COMPARISON,
+            "m * on(host) group_left b": FallbackReason.GROUP_MATCHING,
+            "m[5m]": FallbackReason.MATRIX_SELECTOR,
+            "m @ 100": FallbackReason.AT_MODIFIER,
+            "2 + 2": FallbackReason.SCALAR_ONLY,
+            "clamp_min(m, scalar(b))": FallbackReason.NON_CONSTANT_PARAM,
+        }
+        for q, want in expected.items():
+            plan, err, _ = qplan.lower_and_collect(
+                promql.parse(q), PARAMS, DEFAULT_LOOKBACK_NS)
+            assert plan is None, q
+            assert err.reason is want, f"{q}: {err.reason} != {want}"
+
+    def test_telemetry_counts_reason_tagged(self, no_floor):
+        eng = Engine(MemStorage())
+        before = ROOT.snapshot()
+        eng.execute_range("topk(3, m)", START, END, STEP)
+        after = ROOT.snapshot()
+        key = "telemetry.plan_fallback.count{reason=unsupported-agg}"
+        assert after.get(key, 0) - before.get(key, 0) == 1
+        assert after.get("telemetry.plan_fallback.total", 0) \
+            - before.get("telemetry.plan_fallback.total", 0) == 1
+
+    def test_below_floor_counted(self):
+        eng = Engine(MemStorage(n=2))
+        before = ROOT.snapshot()
+        eng.execute_range("sum(rate(m[5m]))", START, END, STEP).values
+        after = ROOT.snapshot()
+        key = "telemetry.plan_fallback.count{reason=below-floor}"
+        assert after.get(key, 0) - before.get(key, 0) == 1
+        assert eng.last_route()["fallback_reason"] == "below-floor"
+
+    def test_plan_fallback_exception_carries_backend_gap(self):
+        from m3_tpu.parallel.compile import PlanFallback
+
+        e = PlanFallback("weird shape")
+        assert e.reason is FallbackReason.BACKEND_GAP
+        assert "backend-gap" in str(e)
+
+
+# ----------------------------------------------------------------- EXPLAIN
+
+
+class TestExplainTree:
+    def test_compiled_tree_nodes_and_sharding(self):
+        out = _explain("sum by (host) (rate(m[5m]))")
+        assert out["route"] == "compiled"
+        assert out["fallback_reason"] is None
+        assert out["mesh_ok"] is True
+        nodes = list(qexplain.walk(out["root"]))
+        kinds = [n["node"] for n in nodes]
+        assert kinds == ["Aggregate", "RangeFunc", "Fetch"]
+        assert all(n["route"] == "compiled" for n in nodes)
+        # The aggregate's output replicates; the fetch rows shard.
+        assert nodes[0]["sharding"] == qplan.REPLICATED
+        assert nodes[2]["sharding"] == qplan.SHARDED
+        assert nodes[2]["kind"] == qplan.SERIES
+
+    def test_vv_match_not_mesh_ok(self):
+        out = _explain("m * on(host, i) b")
+        assert out["route"] == "compiled"
+        assert out["mesh_ok"] is False
+
+    def test_output_stable(self):
+        for q in ("sum by (host) (rate(m[5m]))", "topk(3, m)"):
+            assert _explain(q) == _explain(q)
+
+    def test_fallback_tree_pins_reason_on_raising_node(self):
+        out = _explain("sum(topk(3, m))")
+        assert out["route"] == "interpreter"
+        assert out["fallback_reason"] == "unsupported-agg"
+        nodes = list(qexplain.walk(out["root"]))
+        assert all(n["route"] == "interpreter" for n in nodes)
+        culprits = [n for n in nodes if "reason" in n]
+        assert len(culprits) == 1
+        assert culprits[0]["node"] == "Aggregation"
+        assert culprits[0]["detail"] == "topk"
+        assert culprits[0]["reason"] == "unsupported-agg"
+
+    def test_fallback_reason_matches_lowering(self):
+        for q in ("irate(m[5m])", "m and b", "m > 2e9",
+                  "max_over_time(rate(m[5m])[10m:1m])"):
+            out = _explain(q)
+            _, err, _ = qplan.lower_and_collect(
+                promql.parse(q), PARAMS, DEFAULT_LOOKBACK_NS)
+            assert out["fallback_reason"] == err.reason.value, q
+
+
+# ---------------------------------------------------------------- slow ring
+
+
+class TestSlowRingRoute:
+    def test_slow_interpreted_query_records_fallback_reason(
+            self, monkeypatch, no_floor):
+        """Satellite regression: a slow interpreted query's ring entry
+        carries the plan fallback reason (pre-change only the span had
+        the route tag — the ring gave the operator no WHY)."""
+        monkeypatch.setattr(SLOW_QUERIES, "threshold_ns", 0)
+        eng = Engine(MemStorage())
+        SLOW_QUERIES.clear()
+        eng.execute_range("topk(3, m)", START, END, STEP)
+        entries = [e for e in SLOW_QUERIES.entries()
+                   if e["name"] == "topk(3, m)"]
+        assert entries, "slow entry missing"
+        assert entries[-1]["route"] == "interpreter"
+        assert entries[-1]["plan_fallback"] == "unsupported-agg"
+
+    def test_compiled_entry_has_route_no_fallback(self, monkeypatch,
+                                                  no_floor):
+        monkeypatch.setattr(SLOW_QUERIES, "threshold_ns", 0)
+        eng = Engine(MemStorage())
+        SLOW_QUERIES.clear()
+        eng.execute_range("sum by (host) (rate(m[5m]))", START, END,
+                          STEP).values
+        entries = [e for e in SLOW_QUERIES.entries()
+                   if e["name"] == "sum by (host) (rate(m[5m]))"]
+        assert entries[-1]["route"] == "compiled"
+        assert "plan_fallback" not in entries[-1]
+
+
+# ----------------------------------------------------------------- ANALYZE
+
+
+class TestAnalyze:
+    def test_plan_route_stages(self, no_floor):
+        eng = Engine(MemStorage())
+        with qexplain.analyzing() as actx:
+            eng.execute_range("sum by (host) (rate(m[5m]))", START, END,
+                              STEP).values
+        d = actx.to_dict()
+        assert "bind" in d["stages_ms"]
+        dev = [k for k in d["stages_ms"] if k.startswith("device_program[")]
+        assert dev, d["stages_ms"]
+        assert "result_materialize" in d["stages_ms"]
+        assert d["events"].get("d2h_bytes", 0) > 0
+        assert d["events"].get("grid_cache_miss", 0) \
+            + d["events"].get("grid_cache_hit", 0) >= 1
+
+    def test_interpreter_route_stage(self, no_floor):
+        eng = Engine(MemStorage())
+        with qexplain.analyzing() as actx:
+            eng.execute_range("topk(3, m)", START, END, STEP)
+        assert "interpreter_eval" in actx.to_dict()["stages_ms"]
+
+    def test_disabled_is_inert(self, no_floor):
+        assert qexplain.current() is None
+        eng = Engine(MemStorage())
+        eng.execute_range("sum(m)", START, END, STEP).values
+        assert qexplain.current() is None
+
+    def test_context_restores_previous(self):
+        with qexplain.analyzing() as outer:
+            with qexplain.analyzing() as inner:
+                assert qexplain.current() is inner
+            assert qexplain.current() is outer
+        assert qexplain.current() is None
+
+
+# ------------------------------------------------------------------ corpus
+
+
+class TestCorpusNormalize:
+    def test_label_values_and_literals_stripped(self):
+        shape = qcorpus.normalize(
+            'sum by (host) (rate(http_req{job="secret-app",'
+            'inst=~"prod-.*"}[5m])) > 99.5')
+        assert "secret-app" not in shape and "prod-" not in shape
+        assert "99.5" not in shape
+        assert "job=" in shape and "inst=~" in shape  # names survive
+        assert "[300s]" in shape                      # durations survive
+
+    def test_normalized_shape_preserves_route(self):
+        queries = [
+            "sum by (host) (rate(m[5m]))", "topk(3, m)",
+            "max_over_time(rate(m[5m])[10m:1m])", "m > 2e9", "m and b",
+            'rate(m{host="h1"}[7m])', "clamp(m, 10, 60)",
+            "m * on(host, i) b", "histogram_quantile(0.9, m)",
+            "sum(m offset 5m)", "quantile_over_time(0.9, m[5m])",
+        ]
+        for q in queries:
+            shape = qcorpus.normalize(q)
+            p1, e1, _ = qplan.lower_and_collect(
+                promql.parse(q), PARAMS, DEFAULT_LOOKBACK_NS)
+            p2, e2, _ = qplan.lower_and_collect(
+                promql.parse(shape), PARAMS, DEFAULT_LOOKBACK_NS)
+            assert (p1 is None) == (p2 is None), (q, shape)
+            if p1 is None:
+                assert e1.reason is e2.reason, (q, shape)
+
+    def test_normalize_idempotent(self):
+        for q in ("sum by (host) (rate(m[5m]))", "(m) > (1)",
+                  "topk (1, m)"):
+            once = qcorpus.normalize(q)
+            assert qcorpus.normalize(once) == once
+
+
+class TestCorpusRecorder:
+    def test_bounded_and_counts(self, tmp_path):
+        path = str(tmp_path / "corpus.jsonl")
+        rec = qcorpus.CorpusRecorder(path, sample=1.0, max_records=3)
+        for i in range(5):
+            rec.record("sum(m)", route="compiled", series=i)
+        assert rec.written == 3 and rec.dropped == 2
+        assert len(qcorpus.read_corpus(path)) == 3
+        # A restart counts the existing lines against the bound.
+        rec2 = qcorpus.CorpusRecorder(path, sample=1.0, max_records=3)
+        assert rec2.record("sum(m)") is False
+        assert rec2.dropped == 1
+
+    def test_sample_zero_records_nothing(self, tmp_path):
+        path = str(tmp_path / "c.jsonl")
+        rec = qcorpus.CorpusRecorder(path, sample=0.0)
+        assert rec.record("sum(m)") is False
+        assert not os.path.exists(path)
+
+    def test_unparseable_query_counts_error_not_raise(self, tmp_path):
+        rec = qcorpus.CorpusRecorder(str(tmp_path / "c.jsonl"), sample=1.0)
+        assert rec.record("sum(((") is False
+        assert rec.errors == 1
+
+    def test_executor_integration_and_coverage(self, tmp_path, no_floor):
+        path = str(tmp_path / "corpus.jsonl")
+        qcorpus.install(qcorpus.CorpusRecorder(path, sample=1.0))
+        try:
+            eng = Engine(MemStorage())
+            for q in ("sum by (host) (rate(m[5m]))", "topk(3, m)",
+                      "sum(m)", "m > 2e9", "sum by (host) (rate(m[5m]))"):
+                eng.execute_range(q, START, END, STEP).values
+        finally:
+            qcorpus.install(None)
+        records = qcorpus.read_corpus(path)
+        assert len(records) == 5
+        cov = qcorpus.coverage(records)
+        assert cov["total"] == 5
+        assert cov["compiled"] == 3
+        assert cov["fallbacks"] == {"unsupported-agg": 1,
+                                    "abs-comparison": 1}
+        assert cov["compiled"] + sum(cov["fallbacks"].values()) == 5
+        assert cov["structural_compiled"] == 3
+        # Latency + series counts recorded per query.
+        assert all(r["latency_ms"] >= 0 for r in records)
+        assert any(r["series"] > 0 for r in records)
+
+    def test_env_opt_in(self, tmp_path, monkeypatch, no_floor):
+        path = str(tmp_path / "env.jsonl")
+        monkeypatch.setenv("M3_TPU_QUERY_CORPUS", path)
+        monkeypatch.setenv("M3_TPU_CORPUS_SAMPLE", "1.0")
+        monkeypatch.setattr(qcorpus, "_RECORDER", None)
+        monkeypatch.setattr(qcorpus, "_RESOLVED", False)
+        try:
+            eng = Engine(MemStorage())
+            eng.execute_range("sum(m)", START, END, STEP).values
+        finally:
+            qcorpus.install(None)
+        assert len(qcorpus.read_corpus(path)) == 1
+
+    def test_maybe_record_materializes_lazy_result(self, tmp_path):
+        """Review regression: a sampled compiled query's lazy result
+        materializes INSIDE the hook, so recorded latency includes the
+        d2h transfer — symmetric with the eager interpreter route."""
+        import time as _time
+
+        path = str(tmp_path / "lazy.jsonl")
+        qcorpus.install(qcorpus.CorpusRecorder(path, sample=1.0))
+        touched = {}
+
+        class FakeLazy:
+            series_tags = [object(), object()]
+
+            @property
+            def values(self):
+                touched["materialized"] = True
+                return np.zeros((2, 1))
+
+        try:
+            qcorpus.maybe_record("sum(m)", {"route": "compiled"},
+                                 FakeLazy(), _time.perf_counter_ns(),
+                                 30 * S)
+        finally:
+            qcorpus.install(None)
+        assert touched.get("materialized")
+        recs = qcorpus.read_corpus(path)
+        assert len(recs) == 1 and recs[0]["series"] == 2
+
+    def test_corrupt_lines_skipped(self, tmp_path):
+        path = str(tmp_path / "c.jsonl")
+        with open(path, "w") as f:
+            f.write(json.dumps({"shape": "sum(m)", "route": "compiled"})
+                    + "\n")
+            f.write("{torn line\n")
+        assert len(qcorpus.read_corpus(path)) == 1
+
+
+# ------------------------------------------------------------ HTTP surface
+
+
+def _get(url):
+    with urllib.request.urlopen(url) as resp:
+        return json.loads(resp.read())
+
+
+@pytest.fixture
+def api(no_floor):
+    from m3_tpu.coordinator.http_api import HTTPApi
+
+    api = HTTPApi(Engine(MemStorage())).serve()
+    yield api
+    api.close()
+
+
+class TestExplainHTTP:
+    def _url(self, api, q, **extra):
+        from urllib.parse import urlencode
+
+        params = {"query": q, "start": START / S, "end": END / S,
+                  "step": "30", **extra}
+        return f"{api.endpoint}/debug/explain?{urlencode(params)}"
+
+    def test_debug_explain_compiled(self, api):
+        out = _get(self._url(api, "sum by (host) (rate(m[5m]))"))
+        assert out["route"] == "compiled"
+        assert out["root"]["node"] == "Aggregate"
+        assert all(n["route"] == "compiled"
+                   for n in qexplain.walk(out["root"]))
+
+    def test_debug_explain_fallback_reason(self, api):
+        out = _get(self._url(api, "max_over_time(rate(m[5m])[10m:1m])"))
+        assert out["route"] == "interpreter"
+        assert out["fallback_reason"] == "subquery"
+        culprits = [n for n in qexplain.walk(out["root"]) if "reason" in n]
+        assert culprits and culprits[0]["reason"] == "subquery"
+
+    def test_debug_explain_analyze_executes(self, api):
+        out = _get(self._url(api, "sum by (host) (rate(m[5m]))",
+                             analyze="true"))
+        assert out["executed"]["route"] == "compiled"
+        assert "bind" in out["analyze"]["stages_ms"]
+        assert any(k.startswith("device_program[")
+                   for k in out["analyze"]["stages_ms"])
+
+    def test_debug_explain_bad_query_400(self, api):
+        import urllib.error
+
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(self._url(api, "sum((("))
+        assert exc.value.code == 400
+
+    def test_query_range_explain_beside_data(self, api):
+        from urllib.parse import urlencode
+
+        params = {"query": "sum by (host) (rate(m[5m]))",
+                  "start": START / S, "end": END / S, "step": "30",
+                  "explain": "true"}
+        out = _get(f"{api.endpoint}/api/v1/query_range?{urlencode(params)}")
+        assert out["status"] == "success"
+        assert out["data"]["result"], "data must still ride the response"
+        exp = out["data"]["explain"]
+        assert exp["route"] == "compiled"
+        assert exp["executed"]["route"] == "compiled"
+
+    def test_query_instant_explain_analyze(self, api):
+        from urllib.parse import urlencode
+
+        params = {"query": "sum by (host) (rate(m[5m]))",
+                  "time": END / S, "explain": "true", "analyze": "true"}
+        out = _get(f"{api.endpoint}/api/v1/query?{urlencode(params)}")
+        exp = out["data"]["explain"]
+        assert exp["route"] == "compiled"
+        assert "stages_ms" in exp["analyze"]
+
+    def test_query_range_without_flag_unchanged(self, api):
+        from urllib.parse import urlencode
+
+        params = {"query": "sum(m)", "start": START / S, "end": END / S,
+                  "step": "30"}
+        out = _get(f"{api.endpoint}/api/v1/query_range?{urlencode(params)}")
+        assert "explain" not in out["data"]

@@ -332,3 +332,51 @@ class TestLazyMaterialization:
         assert vals.shape == (len(blk.series_tags), blk.meta.steps)
         ref = eng.execute_range_ref("rate(m[5m])", START, END, STEP)
         assert_matches_oracle(blk, ref, "rate(m[5m])")
+
+
+class TestExplainCorpus:
+    """EXPLAIN over the full compiled-vs-oracle property corpus:
+    compiled queries render compiled on every node, fallback queries
+    report the EXACT typed reason the lowering raised, and the output is
+    stable (query/explain.py)."""
+
+    def _explain(self, q):
+        from m3_tpu.query import explain as qexplain
+        from m3_tpu.query import promql
+        from m3_tpu.query.executor import DEFAULT_LOOKBACK_NS, QueryParams
+
+        params = QueryParams(START, END, STEP)
+        return qexplain.explain(promql.parse(q), params,
+                                DEFAULT_LOOKBACK_NS, query=q)
+
+    def test_compiled_queries_every_node_compiled(self):
+        from m3_tpu.query import explain as qexplain
+
+        for q in COMPILED_QUERIES:
+            out = self._explain(q)
+            assert out["route"] == "compiled", q
+            assert out["fallback_reason"] is None, q
+            for n in qexplain.walk(out["root"]):
+                assert n["route"] == "compiled", (q, n)
+                assert n["sharding"] in (qplan.SHARDED, qplan.REPLICATED)
+                assert n["kind"] in (qplan.SERIES, qplan.SCALAR)
+            assert out == self._explain(q), f"{q}: output not stable"
+
+    def test_fallback_queries_report_exact_lowering_reason(self):
+        from m3_tpu.query import explain as qexplain
+        from m3_tpu.query import promql
+        from m3_tpu.query.executor import DEFAULT_LOOKBACK_NS, QueryParams
+
+        params = QueryParams(START, END, STEP)
+        for q in FALLBACK_QUERIES:
+            out = self._explain(q)
+            assert out["route"] == "interpreter", q
+            _, err, _ = qplan.lower_and_collect(
+                promql.parse(q), params, DEFAULT_LOOKBACK_NS)
+            assert out["fallback_reason"] == err.reason.value, q
+            nodes = list(qexplain.walk(out["root"]))
+            assert all(n["route"] == "interpreter" for n in nodes), q
+            culprits = [n for n in nodes if n.get("reason")]
+            assert culprits, f"{q}: no node carries the reason"
+            assert culprits[0]["reason"] == err.reason.value, q
+            assert out == self._explain(q), f"{q}: output not stable"

@@ -20,6 +20,7 @@ from m3_tpu.analysis.lock_rules import (FlushCallbackLoopRule,
                                         LockDisciplineRule)
 from m3_tpu.analysis.hbm_rules import UnbudgetedDevicePutRule
 from m3_tpu.analysis.obs_rules import (HostSyncInPlanRule,
+                                       UnboundedTelemetryTagRule,
                                        WallClockLatencyRule)
 from m3_tpu.analysis.overload_rules import UnboundedQueueRule
 from m3_tpu.analysis.replay_rules import PerEntryReplayRule
@@ -1518,6 +1519,120 @@ class TestHostSyncInPlan:
         """
         assert lint(src, HostSyncInPlanRule(),
                     "m3_tpu/parallel/compile.py") == []
+
+
+class TestUnboundedTelemetryTag:
+    # The seeded positive: the explain work's easy mistake — tagging the
+    # plan-fallback counter with the raw query string mints one registry
+    # entry (and one self-scraped series) per distinct query, forever.
+    SEEDED_POSITIVE = """
+        from m3_tpu.utils.instrument import ROOT
+
+        def record_fallback(query, reason):
+            ROOT.sub_scope("plan_fallback", query=query).counter("n").inc()
+    """
+
+    def test_flags_seeded_positive_query_tag(self):
+        found = lint(self.SEEDED_POSITIVE, UnboundedTelemetryTagRule(),
+                     "m3_tpu/query/mod.py")
+        assert rule_ids(found) == ["unbounded-telemetry-tag"]
+        assert "query" in found[0].message
+
+    def test_flags_fstring_metric_name(self):
+        src = """
+            from m3_tpu.utils.instrument import ROOT
+
+            def count(expr):
+                ROOT.counter(f"fallback.{expr}").inc()
+        """
+        found = lint(src, UnboundedTelemetryTagRule(), "m3_tpu/query/mod.py")
+        assert rule_ids(found) == ["unbounded-telemetry-tag"]
+
+    def test_flags_str_wrapped_selector_tag_value(self):
+        src = """
+            from m3_tpu.utils.instrument import ROOT
+
+            def record(selector):
+                scope = ROOT.sub_scope("fetch", kind=str(selector))
+                scope.counter("n").inc()
+        """
+        found = lint(src, UnboundedTelemetryTagRule(), "m3_tpu/query/mod.py")
+        assert rule_ids(found) == ["unbounded-telemetry-tag"]
+
+    def test_flags_percent_format_sub_scope_name(self):
+        src = """
+            from m3_tpu.utils.instrument import ROOT
+
+            def record(pattern):
+                ROOT.sub_scope("regexp.%s" % pattern).counter("n").inc()
+        """
+        found = lint(src, UnboundedTelemetryTagRule(), "m3_tpu/index/mod.py")
+        assert rule_ids(found) == ["unbounded-telemetry-tag"]
+
+    def test_closed_set_enum_value_is_fine(self):
+        # The shipped shape: the FallbackReason enum VALUE is a closed
+        # set — `reason` is not in the unbounded vocabulary.
+        src = """
+            from m3_tpu.utils.instrument import ROOT
+
+            def plan_fallback(reason):
+                ROOT.sub_scope("plan_fallback",
+                               reason=reason).counter("count").inc()
+        """
+        assert lint(src, UnboundedTelemetryTagRule(),
+                    "m3_tpu/parallel/mod.py") == []
+
+    def test_bounded_builder_and_kind_interpolations_are_fine(self):
+        # telemetry.py / limits.py house shapes: builder names, limit
+        # kinds, admission-gate names — all closed sets.
+        src = """
+            from m3_tpu.utils.instrument import ROOT
+
+            def jit_builder(name, kind):
+                ROOT.sub_scope("jit", builder=name).counter("hits").inc()
+                ROOT.counter(f"{kind}.exceeded").inc()
+                ROOT.sub_scope(f"admission.{name}").gauge("depth")
+        """
+        assert lint(src, UnboundedTelemetryTagRule(),
+                    "m3_tpu/utils/mod.py") == []
+
+    def test_literal_names_and_tags_are_fine(self):
+        src = """
+            from m3_tpu.utils.instrument import ROOT
+
+            SCOPE = ROOT.sub_scope("telemetry")
+
+            def count():
+                SCOPE.sub_scope("mesh", kernel="flush").counter("n").inc()
+                SCOPE.histogram("compile_s", (0.1, 1.0)).record(0.5)
+        """
+        assert lint(src, UnboundedTelemetryTagRule(),
+                    "m3_tpu/parallel/mod.py") == []
+
+    def test_non_scope_calls_ignored(self):
+        # dict.get / collections.Counter / unrelated .counter-free calls
+        # never match; only scope-method shapes do.
+        src = """
+            import collections
+
+            def tally(query, counts):
+                c = collections.Counter(query)
+                counts.update(query=query)
+                return c
+        """
+        assert lint(src, UnboundedTelemetryTagRule(),
+                    "m3_tpu/query/mod.py") == []
+
+    def test_suppression_silences(self):
+        src = """
+            from m3_tpu.utils.instrument import ROOT
+
+            def record(query):
+                # DELIBERATE: test-only registry, cleared per run
+                ROOT.sub_scope("t", query=query).counter("n").inc()  # m3lint: disable=unbounded-telemetry-tag
+        """
+        assert lint(src, UnboundedTelemetryTagRule(),
+                    "m3_tpu/query/mod.py") == []
 
 
 class TestTreeGate:
