@@ -1558,6 +1558,147 @@ def bench_bootstrap_replay():
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_query_serve_e2e():
+    """Round 16: the full serving stack, HTTP request in -> response bytes
+    out (coordinator/http_api over the query engine), on a 10k-series
+    dashboard mix — two fat-matrix shapes whose response is the whole
+    [series x steps] plane, one grouped aggregation, and one instant
+    vector. This measures the RESULT plane end to end: engine execution
+    (compiled route), result materialization, and Prometheus JSON
+    serialization, which pre-change is a per-series host loop (one python
+    dict + one np.format_float_positional call per sample) downstream of
+    a fully compiled query — bench r05 measured ~8.39 MB wire result per
+    query pair with result materialization a tracked d2h choke point.
+
+    The pre-change baseline is that per-series renderer, so vs_baseline
+    measures the columnar result-frame rebuild directly — same protocol
+    as rounds 6-13. Post-change the bench additionally asserts the
+    columnar response bytes BYTE-IDENTICAL to the retained per-series
+    oracle (`render_result_ref`) for every shape in the mix."""
+    import urllib.request
+
+    from m3_tpu.coordinator.http_api import HTTPApi
+    from m3_tpu.query import Engine
+
+    n = int(os.environ.get("BENCH_SERVE_SERIES", "10000"))
+    hosts = int(os.environ.get("BENCH_SERVE_HOSTS", "200"))
+    iters = int(os.environ.get("BENCH_SERVE_ITERS", "6"))
+    s_ns = 1_000_000_000
+    npts = 240  # 40min @ 10s
+    rng = np.random.default_rng(61)
+    t = (1_700_000_000 * s_ns + np.arange(npts, dtype=np.int64) * 10 * s_ns)
+    vals = np.cumsum(rng.poisson(5.0, (n, npts)), axis=1).astype(np.float64)
+    vals += 1e9 * (1 + np.arange(n)[:, None] % 4)  # counter magnitudes
+
+    series = {}
+    for i in range(n):
+        host = b"host-%03d" % (i % hosts)
+        series[b"bench_requests{i=%d}" % i] = {
+            "tags": {b"__name__": b"bench_requests", b"host": host,
+                     b"i": str(i).encode()},
+            "t": t, "v": vals[i],
+        }
+
+    class _Storage:
+        def fetch_raw(self, matchers, start_ns, end_ns):
+            return series
+
+    api = HTTPApi(Engine(_Storage())).serve()
+    start_s = t[60] / s_ns
+    end_s = t[-1] / s_ns
+    from urllib.parse import urlencode
+
+    def rq(params, path="/api/v1/query_range"):
+        url = f"{api.endpoint}{path}?{urlencode(params)}"
+        with urllib.request.urlopen(url) as resp:
+            return resp.read()
+
+    mix = [
+        ("rate_matrix", dict(query="rate(bench_requests[5m])",
+                             start=start_s, end=end_s, step="30")),
+        ("max_over_time_matrix",
+         dict(query="max_over_time(bench_requests[10m])",
+              start=start_s, end=end_s, step="30")),
+        ("sum_by_host", dict(query="sum by (host) (rate(bench_requests[5m]))",
+                             start=start_s, end=end_s, step="30")),
+        ("instant_vector", None),  # /api/v1/query below
+    ]
+
+    def one(name):
+        for nm, params in mix:
+            if nm != name:
+                continue
+            if params is None:
+                return rq(dict(query="sum by (host) (bench_requests)",
+                               time=end_s), path="/api/v1/query")
+            return rq(params)
+
+    try:
+        _phase("query_serve_e2e: warmup (plan compiles)")
+        sizes = {}
+        for name, _ in mix:
+            sizes[name] = len(one(name))
+
+        # Post-change: the columnar frame must be byte-identical to the
+        # retained per-series oracle for every shape in the mix.
+        oracle = None
+        try:
+            from m3_tpu.query import render as qrender
+            oracle = qrender
+        except ImportError:
+            pass
+        if oracle is not None:
+            eng = api.engine
+            for name, params in mix:
+                if params is None:
+                    blk = eng.execute_instant(
+                        "sum by (host) (bench_requests)", int(end_s * s_ns))
+                    ref = oracle.render_result_ref(blk, instant=True)
+                else:
+                    blk = eng.execute_range(
+                        params["query"], int(params["start"] * s_ns),
+                        int(params["end"] * s_ns), 30 * s_ns)
+                    ref = oracle.render_result_ref(blk)
+                got = one(name)
+                assert got == ref, (
+                    f"{name}: columnar response diverged from "
+                    f"render_result_ref ({len(got)} vs {len(ref)} bytes)")
+
+        _phase(f"query_serve_e2e: steady state ({iters} rounds)")
+        walls = {name: [] for name, _ in mix}
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            for name, _ in mix:
+                t1 = time.perf_counter()
+                one(name)
+                walls[name].append(time.perf_counter() - t1)
+        total = time.perf_counter() - t0
+        _phase("query_serve_e2e: done")
+        nreq = iters * len(mix)
+        per_shape = {
+            name: {"p50_ms": round(float(np.percentile(w, 50)) * 1000, 2),
+                   "p99_ms": round(float(np.percentile(w, 99)) * 1000, 2),
+                   "bytes": sizes[name]}
+            for name, w in walls.items()
+        }
+        return {
+            "metric": "query_serve_e2e",
+            "value": round(nreq / total, 2),
+            "unit": "responses/sec",
+            "extra": {
+                "series": n, "hosts": hosts, "points_per_series": npts,
+                "mix": [name for name, _ in mix],
+                "requests": nreq,
+                "per_shape": per_shape,
+                "wire_bytes_per_round": sum(sizes.values()),
+                "oracle": ("render_result_ref byte-identity per shape"
+                           if oracle is not None else None),
+            },
+        }
+    finally:
+        api.close()
+
+
 _BENCHES = [
     ("m3tsz_encode_1m_rollup", bench_encode_rollup),
     ("counter_gauge_rollup", bench_counter_gauge),
@@ -1571,6 +1712,7 @@ _BENCHES = [
     ("hot_set_read", bench_hot_set_read),
     ("peer_migration", bench_peer_migration),
     ("bootstrap_replay", bench_bootstrap_replay),
+    ("query_serve_e2e", bench_query_serve_e2e),
 ]
 
 

@@ -244,7 +244,7 @@ def index_functions(mod: Module) -> Dict[str, ast.FunctionDef]:
 def _registry() -> List[Rule]:
     from . import (batch_rules, cache_rules, hbm_rules, jax_rules,
                    lifecycle_rules, lock_rules, obs_rules, overload_rules,
-                   replay_rules, retry_rules)
+                   render_rules, replay_rules, retry_rules)
 
     return [
         *cache_rules.RULES,
@@ -256,6 +256,7 @@ def _registry() -> List[Rule]:
         *hbm_rules.RULES,
         *obs_rules.RULES,
         *replay_rules.RULES,
+        *render_rules.RULES,
         *lifecycle_rules.RULES,
     ]
 

@@ -1,6 +1,6 @@
 """Smart replicating client (reference: src/dbnode/client)."""
 
-from .decode import ConflictStrategy, decode_segment_groups, merge_replica_points, series_points
+from .decode import ConflictStrategy, decode_segment_groups, merge_replica_points
 from .session import (
     ConsistencyError,
     HostClient,
@@ -18,5 +18,4 @@ __all__ = [
     "SessionOptions",
     "decode_segment_groups",
     "merge_replica_points",
-    "series_points",
 ]

@@ -160,11 +160,8 @@ def merge_replica_points(
     return uniq, picked
 
 
-def series_points(result_entry: dict,
-                  strategy: ConflictStrategy = ConflictStrategy.LAST_PUSHED
-                  ) -> Tuple[np.ndarray, np.ndarray]:
-    """Decode one fetch_tagged wire entry (segments + buffer) to points."""
-    decoded = decode_segment_groups(result_entry.get("segments", []))
-    ts_parts = [t for t, _ in decoded] + [result_entry.get("buf_t", np.zeros(0, np.int64))]
-    vs_parts = [v for _, v in decoded] + [result_entry.get("buf_v", np.zeros(0, np.float64))]
-    return merge_replica_points(ts_parts, vs_parts, strategy)
+# (series_points, the per-series segments+buffer decoder, retired in
+# round 16: fetch_tagged frames are columnar — tiles + one buffer
+# sidecar — decoded by Session._columnar_points via decode_tile.
+# decode_segment_groups stays: the bootstrap path still stacks wire
+# segments by geometry.)

@@ -41,7 +41,7 @@ from ..utils.retry import (
     Retrier,
     RetryOptions,
 )
-from .decode import ConflictStrategy, merge_replica_points, series_points
+from .decode import ConflictStrategy, merge_replica_points
 
 
 class ConsistencyError(Exception):
@@ -687,9 +687,9 @@ class Session:
                 f"need {required} per shard): {errs}")
         merged: Dict[bytes, dict] = {}
         for r in results:
-            for entry in r["series"]:
+            for entry, (t, v) in zip(r["series"],
+                                     self._columnar_points(r)):
                 sid = entry["id"]
-                t, v = series_points(entry, self.opts.conflict_strategy)
                 cur = merged.get(sid)
                 if cur is None:
                     merged[sid] = {"tags": entry["tags"], "t": t, "v": v}
@@ -700,6 +700,41 @@ class Session:
                         [cur["t"], t], [cur["v"], v], self.opts.conflict_strategy
                     )
         return merged
+
+    def _columnar_points(self, r: dict) -> List[tuple]:
+        """Per-series (t, v) from one host's COLUMNAR fetch_tagged frame:
+        each sealed-block tile decodes in ONE batched kernel call
+        (decode.decode_tile — the wire twin of peer streaming's block
+        tiles) and scatters row slices to its series; the buffer sidecar
+        contributes offset-sliced views of the concatenated columns.
+        Order per series is sealed blocks (ascending start) then the
+        mutable buffer — the same precedence the per-series segment path
+        had, so LAST_PUSHED conflict resolution is unchanged."""
+        from .decode import decode_tile
+
+        n = len(r["series"])
+        parts_t: List[list] = [[] for _ in range(n)]
+        parts_v: List[list] = [[] for _ in range(n)]
+        for tile in sorted(r.get("tiles", ()), key=lambda d: d["bs"]):
+            ts, vs = decode_tile(tile["words"], tile["npoints"],
+                                 int(tile["window"]),
+                                 int(tile["time_unit"]))
+            npts = np.asarray(tile["npoints"]).tolist()
+            for j, pos in enumerate(np.asarray(tile["rows"]).tolist()):
+                k = npts[j]
+                parts_t[pos].append(ts[j, :k])
+                parts_v[pos].append(vs[j, :k])
+        bufs = r.get("bufs")
+        if bufs is not None:
+            offs = np.asarray(bufs["offs"]).tolist()
+            bt, bv = bufs["t"], bufs["v"]
+            for j in range(n):
+                if offs[j + 1] > offs[j]:
+                    parts_t[j].append(bt[offs[j]:offs[j + 1]])
+                    parts_v[j].append(bv[offs[j]:offs[j + 1]])
+        strategy = self.opts.conflict_strategy
+        return [merge_replica_points(parts_t[j], parts_v[j], strategy)
+                for j in range(n)]
 
     def aggregate(self, ns: bytes, query, start_ns: int, end_ns: int,
                   name_only: bool = False, field_filter=(),

@@ -22,6 +22,7 @@ import numpy as np
 
 from ..metrics.metric import MetricType
 from ..query import METRIC_NAME, Engine
+from ..query import render as qrender
 from ..query.block import Block
 from ..query.model import Matcher, MatchType
 from ..query import promql
@@ -201,14 +202,18 @@ class HTTPApi:
             out["analyze"] = actx.to_dict()
         return out
 
-    def query_range(self, req) -> dict:
+    def query_range(self, req):
         q = req.param("query")
         start = _parse_time(req.param("start"))
         end = _parse_time(req.param("end"))
         step = _parse_step(req.param("step"))
         if not _flag(req, "explain"):
+            # Columnar result frame: response bytes render straight from
+            # the value matrix — no per-series dicts on the path
+            # (query/render.py; byte-identical to render_result_ref).
             block = self.engine.execute_range(q, start, end, step)
-            return _prom_matrix(block)
+            return RawResponse("application/json",
+                               qrender.prom_matrix_bytes(block))
         ast = promql.parse(q)
         actx = None
         if _flag(req, "analyze"):
@@ -225,7 +230,7 @@ class HTTPApi:
             q, ast, start, end, step, actx)
         return out
 
-    def query_instant(self, req) -> dict:
+    def query_instant(self, req):
         q = req.param("query")
         t = _parse_time(req.param("time", str(time.time())))
         # ONE parse serves both the type check and the evaluation.
@@ -233,7 +238,7 @@ class HTTPApi:
         explain_flag = _flag(req, "explain")
         actx = None
 
-        def run():
+        def run(columnar: bool):
             block = self.engine.execute_instant(q, t, ast=ast)
             if promql.is_scalar_node(ast):
                 # prom instant queries of scalar-typed expressions return
@@ -244,18 +249,25 @@ class HTTPApi:
                         "data": {"resultType": "scalar",
                                  "result": [block.meta.times()[-1] / S,
                                             _prom_sample_value(v)]}}
+            if columnar:
+                # Columnar result frame (query/render.py) — the explain
+                # payload rides beside the data only on the dict path.
+                return RawResponse("application/json",
+                                   qrender.prom_vector_bytes(block))
             return _prom_vector(block)
 
-        if explain_flag and _flag(req, "analyze"):
+        if not explain_flag:
+            return run(True)
+        if _flag(req, "analyze"):
             from ..query import explain as qexplain
 
             # Serialization happens inside the context so the result
             # materialization stage records (same as query_range).
             with qexplain.analyzing() as actx:
-                out = run()
+                out = run(False)
         else:
-            out = run()
-        if explain_flag:
+            out = run(False)
+        if isinstance(out, dict) and "data" in out:
             out["data"]["explain"] = self._explain_beside_data(
                 q, ast, t, t, 1_000_000_000, actx)
         return out
@@ -440,7 +452,12 @@ class HTTPApi:
         step = _parse_step(req.param("step", "10"))
         eng = GraphiteEngine(self.engine.storage, step_ns=step)
         out = []
-        for target in req.params_all("target"):
+        # JUSTIFIED suppression: graphite-web's /render contract IS a
+        # list of per-target dicts with [value, time] pairs — there is
+        # no columnar wire shape to render into, and the graphite compat
+        # path serves low-volume dashboards (the Prometheus read API is
+        # the hot result plane, columnar via query/render.py).
+        for target in req.params_all("target"):  # m3lint: disable=per-series-result-dict
             block = eng.render(target, start, end, step)
             times = block.meta.times() / S
             for tags, row in zip(block.series_tags, block.values):
@@ -673,44 +690,11 @@ def _parse_series_matchers(expr: str) -> Tuple[Matcher, ...]:
     return tuple(out)
 
 
-def _prom_sample_value(v: float) -> str:
-    if math.isnan(v):
-        return "NaN"
-    if math.isinf(v):
-        return "+Inf" if v > 0 else "-Inf"
-    # Go strconv.FormatFloat(v, 'f', -1)-style: shortest POSITIONAL
-    # round-trip decimal — no trailing .0 on integers and no scientific
-    # notation at any magnitude ("100000000000000000000", "0.0000001") —
-    # what prometheus emits and strict clients byte-compare against.
-    return np.format_float_positional(float(v), unique=True, trim="-")
-
-
-def _metric_labels(tags) -> Dict[str, str]:
-    return {k.decode(): v.decode() for k, v in tags.pairs}
-
-
-def _prom_matrix(block: Block) -> dict:
-    times = block.meta.times() / S
-    result = []
-    for tags, row in zip(block.series_tags, block.values):
-        finite = np.isfinite(row)
-        if not finite.any():
-            continue
-        values = [[float(t), _prom_sample_value(v)]
-                  for t, v, ok in zip(times, row, finite) if ok]
-        result.append({"metric": _metric_labels(tags), "values": values})
-    return {"status": "success",
-            "data": {"resultType": "matrix", "result": result}}
-
-
-def _prom_vector(block: Block) -> dict:
-    t = block.meta.times()[-1] / S
-    result = []
-    for tags, row in zip(block.series_tags, block.values):
-        v = row[-1]
-        if not math.isfinite(v):
-            continue
-        result.append({"metric": _metric_labels(tags),
-                       "value": [float(t), _prom_sample_value(v)]})
-    return {"status": "success",
-            "data": {"resultType": "vector", "result": result}}
+# The per-series renderers moved to query/render.py: the `_ref` forms
+# are retained verbatim there as the byte-identity oracle for the
+# columnar frames; the explain-beside-data paths still serve them (the
+# payload mutates the dict before serialization).
+_prom_sample_value = qrender.prom_sample_value
+_metric_labels = qrender._metric_labels
+_prom_matrix = qrender.prom_matrix_ref
+_prom_vector = qrender.prom_vector_ref

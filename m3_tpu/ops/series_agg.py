@@ -136,6 +136,79 @@ def topk_mask(values: np.ndarray, group_ids: np.ndarray, n_groups: int,
     return keep
 
 
+# ------------------------------------------------- packed rank selection
+#
+# Traced group-packed twins of topk_mask / grouped_quantile for the
+# whole-plan compiler (parallel/compile.py): bind() packs each group's
+# rows contiguously into a [G_pad, Smax_pad] permutation (original row
+# order within the group, -1 padding), the device sorts along the packed
+# axis, and only the plan's value planes move — the same sort-select
+# shape as ops/aggregation.quantile_rank_select, generalized from rows
+# of timer values to cross-series aggregation groups per step.
+
+
+def packed_gather_math(values, perm, g_pad: int, smax_pad: int):
+    """[S_pad, T] plane + flat perm [G_pad*Smax_pad] -> packed
+    [G_pad, Smax_pad, T] volume with NaN at unused slots."""
+    import jax.numpy as jnp
+
+    valid = (perm >= 0)[:, None]
+    packed = values[jnp.maximum(perm, 0)]
+    packed = jnp.where(valid, packed, jnp.nan)
+    return packed.reshape(g_pad, smax_pad, values.shape[-1])
+
+
+def packed_topk_keep_math(packed_hi, packed_lo, k, largest: bool):
+    """Per-step membership mask in packed space: True where the slot's
+    value is among its group's k best at that step (ties broken by slot
+    order — original row order within the group, the same stable-argsort
+    tie-break as topk_mask).
+
+    Membership is DISCRETE, so ranking must not lose to f32 granularity:
+    callers pass the value as an exact double-f32 split (hi = f32(v),
+    lo = f32(v - hi) — zeros when the plane is f32-native; |lo| <
+    ulp(hi)/2, so v-order IS lexicographic (hi, lo)-order), and the
+    rank comes from a two-pass stable sort — secondary key lo first,
+    then primary key hi — which is exactly the interpreter's f64 sort
+    for every value the split round-trips. Sorting hi alone would let
+    sub-ulp counter differences (64 at 1e9) scramble the surviving
+    series set."""
+    import jax.numpy as jnp
+
+    finite = jnp.isfinite(packed_hi)
+    s = -1.0 if largest else 1.0   # -v = (-hi) + (-lo): exact either way
+    hi_key = jnp.where(finite, s * packed_hi, jnp.inf)
+    lo_key = jnp.where(finite, s * packed_lo, 0.0)
+    order1 = jnp.argsort(lo_key, axis=1, stable=True)
+    hi_by_lo = jnp.take_along_axis(hi_key, order1, axis=1)
+    order2 = jnp.argsort(hi_by_lo, axis=1, stable=True)
+    order = jnp.take_along_axis(order1, order2, axis=1)
+    ranks = jnp.argsort(order, axis=1)
+    return (ranks < k) & finite
+
+
+def packed_quantile_math(packed, q):
+    """promql quantile() over the packed volume: linearly-interpolated
+    quantile at rank q*(n-1) across each group's slots, per step
+    (grouped_quantile's np.nanquantile semantics, on device)."""
+    import jax.numpy as jnp
+
+    smax = packed.shape[1]
+    finite = jnp.isfinite(packed)
+    cnt = finite.sum(axis=1)
+    s = jnp.sort(jnp.where(finite, packed, jnp.inf), axis=1)
+    pos = q * (cnt - 1).astype(jnp.float32)
+    lo = jnp.clip(jnp.floor(pos).astype(jnp.int32), 0, smax - 1)
+    hi = jnp.clip(lo + 1, 0, smax - 1)
+    frac = pos - lo.astype(jnp.float32)
+    zs = jnp.where(jnp.isfinite(s), s, 0.0)
+    v_lo = jnp.take_along_axis(zs, lo[:, None, :], axis=1)[:, 0, :]
+    v_hi_raw = jnp.take_along_axis(zs, hi[:, None, :], axis=1)[:, 0, :]
+    v_hi = jnp.where(hi < cnt, v_hi_raw, v_lo)
+    out = v_lo + (v_hi - v_lo) * frac
+    return jnp.where(cnt > 0, out, jnp.nan)
+
+
 def count_values(values: np.ndarray, group_ids: np.ndarray,
                  n_groups: int) -> dict:
     """promql count_values(): per (group, step, value) counts; returns

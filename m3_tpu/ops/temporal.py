@@ -1006,3 +1006,101 @@ def holt_winters(grid: np.ndarray, W: int, sf: float, tf: float,
     return np.asarray(
         _holt_winters_fn(W, float(sf), float(tf), stride)(resid)
     ) + base[:, None]
+
+
+# --------------------------------------------------- traced input preps
+#
+# Traced twins of the HOST preps (center / rate_inputs) for planes that
+# only exist ON DEVICE — the whole-plan compiler's subquery lowering
+# evaluates an inner expression in-trace and re-windows its output, so
+# the prep can't round-trip to the host (that per-op dispatch is exactly
+# what the compiler removes; m3lint host-sync-in-plan gates it). The
+# host versions stay the exact-f64 path for staged selector grids; these
+# run at the plane's own f32 precision, which is why the plan lowering
+# only admits them over difference-space planes (rate outputs and the
+# like) — query/plan.py bails with F64_ARITH on absolute-magnitude
+# composite subquery planes.
+
+
+def center_math(plane):
+    """Traced center(): (residual, per-row baseline = first finite value).
+    The baseline choice is arbitrary (every consumer adds it back or is
+    shift-invariant), so f32 costs nothing beyond the plane's own f32."""
+    finite = jnp.isfinite(plane)
+    idx = jnp.argmax(finite, axis=-1)
+    has = finite.any(axis=-1)
+    first = jnp.take_along_axis(jnp.where(finite, plane, 0.0),
+                                idx[..., None], axis=-1)[..., 0]
+    base = jnp.where(has, first, 0.0)
+    return plane - base[..., None], base
+
+
+def rate_inputs_math(plane, is_counter: bool):
+    """Traced rate_inputs(): (adj, finite, grid32) with the same per-cell
+    semantics as _host_diff_grid — adj[i] = v[i] - prev_valid, a counter
+    reset (d < 0) contributes v[i] itself, cells with no previous valid
+    sample (and invalid cells) contribute 0."""
+    finite = jnp.isfinite(plane)
+    T = plane.shape[-1]
+    idx = jnp.where(finite, jnp.arange(T, dtype=jnp.int32), -1)
+    run = jax.lax.associative_scan(jnp.maximum, idx, axis=-1)
+    prev_run = jnp.concatenate(
+        [jnp.full(run.shape[:-1] + (1,), -1, run.dtype), run[..., :-1]],
+        axis=-1)
+    z = jnp.where(finite, plane, 0.0)
+    prev_val = jnp.take_along_axis(z, jnp.clip(prev_run, 0, T - 1), axis=-1)
+    d = z - prev_val
+    if is_counter:
+        adj = jnp.where(d < 0, z, d)
+    else:
+        adj = d
+    adj = jnp.where(finite & (prev_run >= 0), adj, 0.0)
+    return adj, finite, z
+
+
+def instant_math(resid, grid32, *, W: int, step_s: float, is_rate: bool,
+                 stride: int = 1):
+    """Traced irate()/idelta() (temporal/rate.go irateFn): last two valid
+    samples per window. Differences compute in RESIDUAL space (exact for
+    the small consecutive deltas even at 1e9 counter magnitudes — the
+    same decomposition the staged rate path uses); only a counter
+    reset's restart value reads the absolute f32 plane, where post-reset
+    values are small. The reset COMPARE is residual-space too
+    (shift-invariant, so it agrees with the interpreter's f64 compare
+    wherever the residuals are exact)."""
+    mvol = _window_volume(jnp.isfinite(resid), W, stride)
+    Wr = jnp.arange(W)
+    last_i = jnp.where(mvol, Wr, -1).max(axis=-1)
+    prev_i = jnp.where(mvol & (Wr < last_i[..., None]), Wr, -1).max(axis=-1)
+    ok = prev_i >= 0
+    rvol = _window_volume(jnp.where(jnp.isfinite(resid), resid, 0.0), W,
+                          stride)
+    r_last = _take_w(rvol, last_i)
+    r_prev = _take_w(rvol, prev_i)
+    if not is_rate:
+        return jnp.where(ok, r_last - r_prev, jnp.nan)
+    gvol = _window_volume(grid32, W, stride)
+    g_last = _take_w(gvol, last_i)
+    dv = jnp.where(r_last < r_prev, g_last, r_last - r_prev)
+    dt = (last_i - prev_i).astype(_F32) * step_s
+    return jnp.where(ok, dv / jnp.where(ok, dt, 1.0), jnp.nan)
+
+
+def quantile_ot_math(resid, base32, *, W: int, q: float, stride: int = 1):
+    """Traced quantile_over_time(): promql's linearly-interpolated window
+    quantile at rank q*(n-1), computed in residual space (quantiles are
+    shift-equivariant) with the per-row baseline added back — the fully
+    on-device form of _quantile_idx_fn + the host's exact-f64 gather."""
+    vol = _window_volume(resid, W, stride)
+    mask = jnp.isfinite(vol)
+    cnt = mask.sum(axis=-1)
+    order = jnp.argsort(jnp.where(mask, vol, jnp.inf), axis=-1)
+    pos = q * (cnt - 1).astype(_F32)
+    lo = jnp.clip(jnp.floor(pos).astype(jnp.int32), 0, W - 1)
+    hi = jnp.clip(lo + 1, 0, W - 1)
+    frac = pos - lo.astype(_F32)
+    zvol = jnp.where(mask, vol, 0.0)
+    v_lo = _take_w(zvol, _take_w(order, lo))
+    v_hi = jnp.where(hi < cnt, _take_w(zvol, _take_w(order, hi)), v_lo)
+    out = v_lo + (v_hi - v_lo) * frac + base32[..., None]
+    return jnp.where(cnt > 0, out, jnp.nan)

@@ -55,13 +55,13 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import telemetry
-from ..ops import temporal
+from ..ops import series_agg, temporal
 from ..query import explain as qexplain
 from ..query import plan as qplan
 from ..query import promql
 from ..query.plan import (
     Aggregate, Binary, Fetch, InstantFunc, Plan, PlanNode, RangeFunc,
-    ScalarConst, SERIES, SCALAR, _preorder,
+    RankAgg, ScalarConst, SubqueryFunc, SERIES, SCALAR, _preorder,
 )
 
 _F32 = jnp.float32
@@ -88,12 +88,17 @@ class PlanFallback(Exception):
 class Geometry:
     """Static shape signature of one compiled executable: pow2 row/time
     buckets per fetch, group buckets per aggregate, row buckets per
-    vector-vector binary (aggregate/binary entries in plan preorder)."""
+    vector-vector binary, inner-grid widths per subquery, and
+    (group, group-size) buckets per rank aggregation — each entry
+    aligned to its node kind's plan-preorder occurrence order."""
 
     t_pad: int                       # padded output steps
     s_pads: Tuple[int, ...]          # per plan.fetches entry
+    f_exts: Tuple[int, ...]          # staged grid width per fetches entry
     g_pads: Tuple[int, ...]          # per Aggregate node, preorder
     r_pads: Tuple[int, ...]          # per vv Binary node, preorder
+    sub_pads: Tuple[int, ...]        # per SubqueryFunc node, preorder
+    rank_pads: Tuple[Tuple[int, int], ...]  # per RankAgg: (g_pad, smax_pad)
     n_shard: int                     # 1 = single-device
 
 
@@ -112,18 +117,77 @@ def _row_bucket(s: int, n_shard: int) -> int:
     return n_shard * qplan.next_bucket(per_dev)
 
 
+def _widths(root: PlanNode, t_pad: int,
+            sub_pads: Optional[Tuple[int, ...]] = None
+            ) -> Tuple[Dict[int, int], Tuple[int, ...]]:
+    """Per-node padded TIME width: t_pad outside subqueries; inside a
+    SubqueryFunc, the inner resolution grid's padded width — long enough
+    that contiguous strided windows cover every padded output step
+    (shared mode), or the bucketed inner-grid length (packed mode, where
+    the bind-time column map does the indexing). With `sub_pads` given
+    (trace time, on the stripped plan whose inner_steps is zeroed) the
+    recorded Geometry widths are consumed instead of recomputed."""
+    width_of: Dict[int, int] = {}
+    pads_out: List[int] = []
+    it = iter(sub_pads) if sub_pads is not None else None
+
+    def walk(n: PlanNode, w: int):
+        width_of[id(n)] = w
+        if isinstance(n, SubqueryFunc):
+            if it is not None:
+                w_in = next(it)
+            elif n.packed:
+                w_in = qplan.next_bucket(max(n.inner_steps, 1))
+            else:
+                w_in = (w - 1) * n.stride + n.W
+            pads_out.append(w_in)
+            walk(n.arg, w_in)
+            return
+        for fld in dataclasses.fields(n):
+            v = getattr(n, fld.name)
+            if isinstance(v, PlanNode):
+                walk(v, w)
+            elif isinstance(v, tuple):
+                for item in v:
+                    if isinstance(item, PlanNode):
+                        walk(item, w)
+
+    walk(root, t_pad)
+    return width_of, tuple(pads_out)
+
+
+def _fetch_exts(root: PlanNode, width_of: Dict[int, int],
+                fetches: Tuple[Fetch, ...]) -> Tuple[int, ...]:
+    """Staged grid width per fetches entry: the max extended-grid length
+    any occurrence of that (equality-keyed) fetch needs in its time
+    context — consumers slice down to their own need."""
+    need: Dict[Fetch, int] = {}
+    for n in _preorder(root, []):
+        if isinstance(n, Fetch):
+            ext = _ext_len(n, width_of[id(n)])
+            need[n] = max(need.get(n, 0), ext)
+    return tuple(need[f] for f in fetches)
+
+
 def geometry_for(bound: "qplan.Bound", n_shard: int) -> Geometry:
     plan = bound.plan
     t_pad = qplan.next_bucket(plan.steps)
     s_pads = tuple(_row_bucket(bound.fetches[f].grid.shape[0], n_shard)
                    for f in plan.fetches)
+    width_of, sub_pads = _widths(plan.root, t_pad)
+    f_exts = _fetch_exts(plan.root, width_of, plan.fetches)
     nodes: List[PlanNode] = []
     _preorder(plan.root, nodes)
     g_pads = tuple(qplan.next_bucket(max(1, bound.aux[id(n)]["n_groups"]))
                    for n in nodes if isinstance(n, Aggregate))
     r_pads = tuple(qplan.next_bucket(max(1, len(bound.aux[id(n)]["many_idx"])))
                    for n in nodes if _is_vv(n))
-    return Geometry(t_pad, s_pads, g_pads, r_pads, n_shard)
+    rank_pads = tuple(
+        (qplan.next_bucket(max(1, bound.aux[id(n)]["n_groups"])),
+         qplan.next_bucket(max(1, bound.aux[id(n)]["smax"])))
+        for n in nodes if isinstance(n, RankAgg))
+    return Geometry(t_pad, s_pads, f_exts, g_pads, r_pads, sub_pads,
+                    rank_pads, n_shard)
 
 
 # ---------------------------------------------------------- input staging
@@ -134,8 +198,30 @@ def geometry_for(bound: "qplan.Bound", n_shard: int) -> Geometry:
 #   rated: (adj, finite)           delta
 #   resid: (resid, base32)         *_over_time / regression / exact sums
 #   value: (value32,)              elementwise / binary / min-max-count
-_KIND_ARITY = {"ratec": 3, "rated": 2, "resid": 2, "value": 1}
+#   value2: (hi, lo)               exact double-f32 split (topk ranking)
+_KIND_ARITY = {"ratec": 3, "rated": 2, "resid": 2, "value": 1, "value2": 2}
 _RATE_COUNTER = frozenset({"rate", "increase"})
+
+
+def _consumer_kinds(consumer: Optional[PlanNode]) -> Tuple[str, ...]:
+    """Which staged-input kinds one consumer reads off a direct Fetch."""
+    if isinstance(consumer, (RangeFunc, SubqueryFunc)):
+        f = consumer.func
+        if f in ("rate", "increase", "delta"):
+            return ("ratec",) if f in _RATE_COUNTER else ("rated",)
+        if f in ("irate", "idelta"):
+            # residual-space diffs + the absolute plane for the counter
+            # reset branch (temporal.instant_math)
+            return ("resid", "value")
+        return ("resid",)
+    if isinstance(consumer, Aggregate) and consumer.exact:
+        return ("resid",)
+    if isinstance(consumer, RankAgg) and consumer.op != "quantile":
+        # topk/bottomk MEMBERSHIP is discrete: rank on the exact
+        # double-f32 split so sub-ulp counter differences don't scramble
+        # the surviving series set (series_agg.packed_topk_keep_math).
+        return ("value2",)
+    return ("value",)
 
 
 def fetch_kinds(root: PlanNode) -> Dict[Fetch, Tuple[str, ...]]:
@@ -145,17 +231,7 @@ def fetch_kinds(root: PlanNode) -> Dict[Fetch, Tuple[str, ...]]:
 
     def walk(node: PlanNode, consumer: Optional[PlanNode]):
         if isinstance(node, Fetch):
-            if isinstance(consumer, RangeFunc):
-                if consumer.func in ("rate", "increase", "delta"):
-                    kind = ("ratec" if consumer.func in _RATE_COUNTER
-                            else "rated")
-                else:
-                    kind = "resid"
-            elif isinstance(consumer, Aggregate) and consumer.exact:
-                kind = "resid"
-            else:
-                kind = "value"
-            kinds.setdefault(node, set()).add(kind)
+            kinds.setdefault(node, set()).update(_consumer_kinds(consumer))
             return
         for fld in dataclasses.fields(node):
             v = getattr(node, fld.name)
@@ -170,14 +246,17 @@ def fetch_kinds(root: PlanNode) -> Dict[Fetch, Tuple[str, ...]]:
     return {f: tuple(sorted(ks)) for f, ks in kinds.items()}
 
 
-def _ext_len(f: Fetch, t_pad: int) -> int:
-    """Padded extended-grid length for a fetch: long enough that the
-    strided window output covers t_pad columns. Every output step j <
-    real steps reads window cells [j*stride, j*stride + W) — real cells
-    only, so end-padding is exact."""
+def _ext_len(f: Fetch, width: int) -> int:
+    """Padded extended-grid length for a fetch in a `width`-wide time
+    context: long enough that the strided window output covers every
+    padded column. Every output step j < real steps reads window cells
+    [j*stride, j*stride + W) — real cells only, so end-padding is
+    exact. Staged widths are Geometry.f_exts = the max of this over a
+    fetch's occurrences (via _fetch_exts); consumers slice down to
+    their own need."""
     if f.role == "instant":
-        return t_pad
-    return (t_pad - 1) * f.stride + f.W
+        return width
+    return (width - 1) * f.stride + f.W
 
 
 def _pad_grid(grid: np.ndarray, s_pad: int, ext_pad: int) -> np.ndarray:
@@ -212,6 +291,14 @@ def _stage_fetch(bf: "qplan.BoundFetch", kinds: Tuple[str, ...],
             elif kind == "resid":
                 resid, base = temporal.center(gp)
                 arrs += [resid, base.astype(np.float32)]
+            elif kind == "value2":
+                # Exact double-f32 split of the f64 grid: hi + lo
+                # round-trips the value to ~2e-4 absolute, and the lo
+                # plane is what makes compiled topk ranking faithful to
+                # the interpreter's f64 sort at counter magnitudes.
+                hi = gp.astype(np.float32)
+                lo = (gp - hi.astype(np.float64)).astype(np.float32)
+                arrs += [hi, lo]
             else:  # "value"
                 arrs.append(gp.astype(np.float32))
         if mesh is not None:
@@ -263,7 +350,8 @@ _BIN_JNP = {
 
 class _Ctx:
     """Trace-time emission context: staged inputs per fetch, bind-time
-    index arrays per node path, scalar slots, mesh-axis state."""
+    index arrays per node path, scalar slots, per-node time widths,
+    mesh-axis state."""
 
     def __init__(self, plan: Plan, geom: Geometry, fetch_ins, aux_ins,
                  slots, sharded: bool):
@@ -280,56 +368,210 @@ class _Ctx:
         self.g_pad_of = dict(zip(
             (id(n) for n in nodes if isinstance(n, Aggregate)),
             geom.g_pads))
+        self.rank_pads_of = dict(zip(
+            (id(n) for n in nodes if isinstance(n, RankAgg)),
+            geom.rank_pads))
+        self.width_of, _ = _widths(plan.root, geom.t_pad, geom.sub_pads)
         self.root_agg: Optional[tuple] = None   # (s, cnt) for sum/avg root
 
 
 def _lower_fetch(ctx: _Ctx, node: Fetch):
     """A bare selector consumed as values: the absolute f32 plane,
-    sliced to the padded output grid."""
+    sliced to this occurrence's padded grid width."""
     (value,) = ctx.fetch_ins[node]["value"]
-    return value[:, :ctx.geom.t_pad]
+    return value[:, :ctx.width_of[id(node)]]
+
+
+def _range_body(ctx: _Ctx, f: str, ins: Dict[str, tuple], *, W: int,
+                stride: int, step_s: float, range_s: float,
+                params: Tuple[float, ...]):
+    """The shared windowed-kernel ladder: one range function over
+    prepared inputs (`ins` maps kind -> arrays already sliced/gathered
+    to the window layout). Serves both RangeFunc (host-staged selector
+    inputs) and SubqueryFunc (inner-plane inputs, possibly packed)."""
+    if f in ("rate", "increase", "delta"):
+        adj, finite = ins["diff"][0], ins["diff"][1]
+        grid32 = ins["diff"][2] if f in _RATE_COUNTER else None
+        return temporal.rate_math(
+            adj, finite, grid32, W=W, step_s=step_s, range_s=range_s,
+            is_counter=f in _RATE_COUNTER, is_rate=f == "rate",
+            stride=stride)
+    if f in ("irate", "idelta"):
+        resid, grid32 = ins["instant"]
+        return temporal.instant_math(
+            resid, grid32, W=W, step_s=step_s, is_rate=f == "irate",
+            stride=stride)
+    resid, base32 = ins["resid"]
+    if f == "quantile_over_time":
+        return temporal.quantile_ot_math(resid, base32, W=W,
+                                         q=float(params[0]), stride=stride)
+    if f.endswith("_over_time"):
+        return temporal.over_time_math(
+            resid, base32, W=W, kind=f[:-len("_over_time")], stride=stride)
+    if f in ("changes", "resets"):
+        return temporal.changes_resets_math(
+            resid, W=W, count_resets=f == "resets", stride=stride)
+    if f == "deriv":
+        return temporal.regression_math(
+            resid, W=W, step_s=step_s, predict_offset_s=0.0,
+            is_deriv=True, stride=stride)
+    if f == "predict_linear":
+        return temporal.regression_math(
+            resid, W=W, step_s=step_s, predict_offset_s=float(params[0]),
+            is_deriv=False, stride=stride) + base32[:, None]
+    # holt_winters (lowering admits nothing else)
+    return temporal.holt_winters_math(
+        resid, W=W, sf=float(params[0]), tf=float(params[1]),
+        stride=stride) + base32[:, None]
 
 
 def _lower_rangefunc(ctx: _Ctx, node: RangeFunc):
     f = node.func
     fetch = node.arg
     W, stride = fetch.W, fetch.stride
-    step_s = node.step_ns / 1e9
+    w_out = ctx.width_of[id(node)]
+    ext = (w_out - 1) * stride + W
+    staged = ctx.fetch_ins[fetch]
+
+    if f == "absent_over_time":
+        # Window presence counts, then ONE cross-row (and cross-shard)
+        # reduce: 1 where NO series has a sample in the window.
+        resid, _base32 = staged["resid"]
+        cnt = temporal._wsum(jnp.isfinite(resid[:, :ext]), W, stride)
+        total = cnt.sum(axis=0, keepdims=True)
+        # DELIBERATE: static program structure (mesh mode + edge
+        # sharding), same as the aggregate fan-in branches.
+        if ctx.sharded and fetch.edge.sharding == qplan.SHARDED:  # m3lint: disable=jax-traced-branch
+            total = jax.lax.psum(total, "shard")
+        return jnp.where(total > 0, jnp.nan, 1.0)
+
+    ins: Dict[str, tuple] = {}
     if f in ("rate", "increase", "delta"):
         kind = "ratec" if f in _RATE_COUNTER else "rated"
-        arrs = ctx.fetch_ins[fetch][kind]
-        grid32 = arrs[2] if f in _RATE_COUNTER else None
-        out = temporal.rate_math(
-            arrs[0], arrs[1], grid32, W=W, step_s=step_s,
-            range_s=node.range_ns / 1e9, is_counter=f in _RATE_COUNTER,
-            is_rate=f == "rate", stride=stride)
+        ins["diff"] = tuple(a[:, :ext] for a in staged[kind])
+    elif f in ("irate", "idelta"):
+        resid, _base32 = staged["resid"]
+        (value,) = staged["value"]
+        ins["instant"] = (resid[:, :ext], value[:, :ext])
     else:
-        resid, base32 = ctx.fetch_ins[fetch]["resid"]
-        if f.endswith("_over_time"):
-            out = temporal.over_time_math(
-                resid, base32, W=W, kind=f[:-len("_over_time")],
-                stride=stride)
-        elif f in ("changes", "resets"):
-            out = temporal.changes_resets_math(
-                resid, W=W, count_resets=f == "resets", stride=stride)
-        elif f == "deriv":
-            out = temporal.regression_math(
-                resid, W=W, step_s=step_s, predict_offset_s=0.0,
-                is_deriv=True, stride=stride)
-        elif f == "predict_linear":
-            out = temporal.regression_math(
-                resid, W=W, step_s=step_s,
-                predict_offset_s=float(node.params[0]), is_deriv=False,
-                stride=stride) + base32[:, None]
-        else:  # holt_winters (lowering admits nothing else)
-            out = temporal.holt_winters_math(
-                resid, W=W, sf=float(node.params[0]),
-                tf=float(node.params[1]), stride=stride) + base32[:, None]
-    return out[:, :ctx.geom.t_pad]
+        resid, base32 = staged["resid"]
+        ins["resid"] = (resid[:, :ext], base32)
+    out = _range_body(ctx, f, ins, W=W, stride=stride,
+                      step_s=node.step_ns / 1e9,
+                      range_s=node.range_ns / 1e9, params=node.params)
+    return out[:, :w_out]
+
+
+def _sub_gather(arr, cols, fill):
+    """Packed-window gather: [S, T_in] columns by the bind-time index
+    map; lanes with col -1 (outside the window) take `fill`."""
+    valid = (cols >= 0)[None, :]
+    g = arr[:, jnp.maximum(cols, 0)]
+    return jnp.where(valid, g, fill)
+
+
+def _lower_subqueryfunc(ctx: _Ctx, node: SubqueryFunc):
+    """f(expr[r:s]): window the inner plane. Direct selector inners read
+    their host-staged exact-f64 preps (the same kinds RangeFunc uses, on
+    the inner resolution grid); composite inners prep in-trace at the
+    plane's f32 (temporal.center_math / rate_inputs_math — the lowering
+    only admits difference-space planes there). Packed mode first
+    gathers each output step's drifting window through the bind-time
+    column map; shared mode reads contiguous strided windows."""
+    f = node.func
+    w_out = ctx.width_of[id(node)]
+    inner_w = ctx.width_of[id(node.arg)]
+    direct = isinstance(node.arg, Fetch)
+    if node.packed:
+        (cols,) = ctx.aux_ins[ctx.path_of[id(node)]]
+        W = stride = node.W
+    else:
+        cols = None
+        W, stride = node.W, node.stride
+
+    def windowed(a, fill):
+        a = a[:, :inner_w]
+        return a if cols is None else _sub_gather(a, cols, fill)
+
+    ins: Dict[str, tuple] = {}
+    if f in ("rate", "increase", "delta"):
+        counter = f in _RATE_COUNTER
+        if direct:
+            kind = "ratec" if counter else "rated"
+            arrs = ctx.fetch_ins[node.arg][kind]
+            adj, finite = arrs[0], arrs[1]
+            grid32 = arrs[2] if counter else None
+        else:
+            plane = _emit(ctx, node.arg)
+            adj, finite, z = temporal.rate_inputs_math(plane, counter)
+            grid32 = z if counter else None
+        ins["diff"] = (windowed(adj, 0.0), windowed(finite, False)) + (
+            (windowed(grid32, 0.0),) if counter else ())
+    elif f in ("irate", "idelta"):
+        if direct:
+            resid, _b = ctx.fetch_ins[node.arg]["resid"]
+            (value,) = ctx.fetch_ins[node.arg]["value"]
+        else:
+            plane = _emit(ctx, node.arg)
+            resid, _base = temporal.center_math(plane)
+            value = plane
+        ins["instant"] = (windowed(resid, jnp.nan),
+                          windowed(value, jnp.nan))
+    else:
+        if direct:
+            resid, base32 = ctx.fetch_ins[node.arg]["resid"]
+        else:
+            plane = _emit(ctx, node.arg)
+            resid, base32 = temporal.center_math(plane)
+        ins["resid"] = (windowed(resid, jnp.nan), base32)
+    out = _range_body(ctx, f, ins, W=W, stride=stride,
+                      step_s=node.res_ns / 1e9,
+                      range_s=node.range_ns / 1e9, params=node.params)
+    return out[:, :w_out]
+
+
+def _lower_rankagg(ctx: _Ctx, node: RankAgg):
+    """topk/bottomk/quantile: gather rows into the bind-time group
+    packing, sort-select along the packed axis (ops/series_agg), k / q
+    riding as a runtime slot. topk/bottomk return the argument plane
+    masked to the per-step winners (the data-dependent surviving row SET
+    is filtered on the host at the root finish)."""
+    perm, inv = ctx.aux_ins[ctx.path_of[id(node)]]
+    g_pad, smax_pad = ctx.rank_pads_of[id(node)]
+    kq = ctx.slots[node.param.slot]
+    if node.op == "quantile":
+        v = _emit(ctx, node.arg)
+        packed = series_agg.packed_gather_math(v, perm, g_pad, smax_pad)
+        return series_agg.packed_quantile_math(packed, kq)
+    if isinstance(node.arg, Fetch):
+        # Raw selector plane: the host-staged exact double-f32 split —
+        # sub-ulp counter differences must still rank like f64.
+        hi, lo = ctx.fetch_ins[node.arg]["value2"]
+        w = ctx.width_of[id(node.arg)]
+        v, vlo = hi[:, :w], lo[:, :w]
+    else:
+        v = _emit(ctx, node.arg)
+        vlo = jnp.zeros_like(v)
+    packed_hi = series_agg.packed_gather_math(v, perm, g_pad, smax_pad)
+    packed_lo = series_agg.packed_gather_math(vlo, perm, g_pad, smax_pad)
+    # int(k) truncation parity with the interpreter's _const_param.
+    keep = series_agg.packed_topk_keep_math(packed_hi, packed_lo,
+                                            jnp.floor(kq),
+                                            node.op == "topk")
+    flat = keep.reshape(g_pad * smax_pad, keep.shape[-1])
+    valid_row = (inv >= 0)[:, None]
+    keep_rows = jnp.where(valid_row, flat[jnp.maximum(inv, 0)], False)
+    return jnp.where(keep_rows, v, jnp.nan)
 
 
 def _lower_instantfunc(ctx: _Ctx, node: InstantFunc):
     v = _emit(ctx, node.arg)
+    if node.func == "timestamp":
+        # Step times ride as a bind-time aux vector (f32 — documented
+        # divergence: unix seconds round to ~128s granularity on the f32
+        # value plane, far inside the oracle tolerance at 1.7e9).
+        (times,) = ctx.aux_ins[ctx.path_of[id(node)]]
+        return jnp.where(jnp.isfinite(v), times[None, :], jnp.nan)
     fn = _MATH_JNP.get(node.func)
     if fn is not None:
         return fn(v)
@@ -362,12 +604,29 @@ def _lower_aggregate(ctx: _Ctx, node: Aggregate):
     fan_in = ctx.sharded and node.arg.edge.sharding == qplan.SHARDED
     if node.exact:
         resid, _base32 = ctx.fetch_ins[node.arg]["resid"]
-        v = resid[:, :ctx.geom.t_pad]
+        v = resid[:, :ctx.width_of[id(node)]]
     else:
         v = _emit(ctx, node.arg)
     mask = jnp.isfinite(v)
     cnt = jax.ops.segment_sum(mask.astype(_F32), gids, num_segments=g_pad)
     op = node.op
+    if op in ("stddev", "stdvar"):
+        # Population moments (promql stddev/stdvar; series_agg's segment
+        # kernel): mean first, then the squared-deviation reduce — each
+        # stage fanning in across shards before the next reads it.
+        z = jnp.where(mask, v, 0.0)
+        s = jax.ops.segment_sum(z, gids, num_segments=g_pad)
+        if fan_in:  # m3lint: disable=jax-traced-branch
+            s = jax.lax.psum(s, "shard")
+            cnt = jax.lax.psum(cnt, "shard")
+        mu = s / jnp.maximum(cnt, 1)
+        dev = jnp.where(mask, v - mu[gids], 0.0)
+        m2 = jax.ops.segment_sum(dev * dev, gids, num_segments=g_pad)
+        if fan_in:  # m3lint: disable=jax-traced-branch
+            m2 = jax.lax.psum(m2, "shard")
+        var = m2 / jnp.maximum(cnt, 1)
+        out = jnp.sqrt(var) if op == "stddev" else var
+        return jnp.where(cnt > 0, out, jnp.nan)
     if op in ("sum", "avg"):
         s = jax.ops.segment_sum(jnp.where(mask, v, 0.0), gids,
                                 num_segments=g_pad)
@@ -451,6 +710,10 @@ def _emit(ctx: _Ctx, node: PlanNode):
         val = _lower_fetch(ctx, node)
     elif isinstance(node, RangeFunc):
         val = _lower_rangefunc(ctx, node)
+    elif isinstance(node, SubqueryFunc):
+        val = _lower_subqueryfunc(ctx, node)
+    elif isinstance(node, RankAgg):
+        val = _lower_rankagg(ctx, node)
     elif isinstance(node, InstantFunc):
         val = _lower_instantfunc(ctx, node)
     elif isinstance(node, Aggregate):
@@ -470,8 +733,10 @@ def _emit(ctx: _Ctx, node: PlanNode):
 
 def _aux_layout(root: PlanNode) -> List[Tuple[int, int]]:
     """(preorder path, arity) per aux-consuming node: aggregates take one
-    group-id array, vector-vector binaries take two index arrays. The
-    stager and the trace-time unflattener both follow this order."""
+    group-id array; vector-vector binaries two index arrays; rank
+    aggregations a perm + inverse-perm pair; packed subqueries one
+    column map; timestamp() one step-time vector. The stager and the
+    trace-time unflattener both follow this order."""
     nodes: List[PlanNode] = []
     _preorder(root, nodes)
     out = []
@@ -480,6 +745,12 @@ def _aux_layout(root: PlanNode) -> List[Tuple[int, int]]:
             out.append((i, 1))
         elif _is_vv(n):
             out.append((i, 2))
+        elif isinstance(n, RankAgg):
+            out.append((i, 2))
+        elif isinstance(n, SubqueryFunc) and n.packed:
+            out.append((i, 1))
+        elif isinstance(n, InstantFunc) and n.func == "timestamp":
+            out.append((i, 1))
     return out
 
 
@@ -533,12 +804,24 @@ def _plan_executable(stripped: PlanNode, geom: Geometry,
                 fetch_specs.append(P("shard") if one_d
                                    else P("shard", None))
     # agg group-id vectors shard with their child's rows; aggregates over
-    # replicated children take replicated ids (vv binaries never mesh)
+    # replicated children take replicated ids; every other aux kind
+    # (subquery column maps, timestamp times) is a replicated index
+    # vector (vv binaries and rank aggs never mesh — mesh_ok is False)
     nodes: List[PlanNode] = []
     _preorder(stripped, nodes)
-    aux_specs = tuple(
-        P("shard") if n.arg.edge.sharding == qplan.SHARDED else P()
-        for n in nodes if isinstance(n, Aggregate))
+    aux_specs: List = []
+    for n in nodes:
+        if isinstance(n, Aggregate):
+            aux_specs.append(P("shard")
+                             if n.arg.edge.sharding == qplan.SHARDED
+                             else P())
+        elif _is_vv(n) or isinstance(n, RankAgg):
+            aux_specs += [P(), P()]
+        elif isinstance(n, SubqueryFunc) and n.packed:
+            aux_specs.append(P())
+        elif isinstance(n, InstantFunc) and n.func == "timestamp":
+            aux_specs.append(P())
+    aux_specs = tuple(aux_specs)
     root_edge = stripped.edge
     out_root_spec = (P("shard", None)
                      if root_edge.kind == SERIES
@@ -593,15 +876,16 @@ def execute(bound: "qplan.Bound", mesh: Optional[Mesh]):
     fetch_flat: List = []
     for fi, f in enumerate(plan.fetches):
         arrs = _stage_fetch(bound.fetches[f], kinds[f], geom.s_pads[fi],
-                            _ext_len(f, geom.t_pad), use_mesh)
+                            geom.f_exts[fi], use_mesh)
         fetch_flat.extend(arrs)
 
     # --- aux inputs (bind-time host label algebra -> index arrays)
     nodes: List[PlanNode] = []
     _preorder(plan.root, nodes)
     pad_rows = _padded_rows_map(bound, geom, nodes)
+    width_of, _ = _widths(plan.root, geom.t_pad, geom.sub_pads)
     aux_flat: List[np.ndarray] = []
-    vv_i = 0
+    vv_i = rank_i = 0
     for n in nodes:
         if isinstance(n, Aggregate):
             a = bound.aux[id(n)]
@@ -617,6 +901,36 @@ def execute(bound: "qplan.Bound", mesh: Optional[Mesh]):
             mi[:len(a["many_idx"])] = a["many_idx"]
             oi[:len(a["one_idx"])] = a["one_idx"]
             aux_flat += [mi, oi]
+        elif isinstance(n, RankAgg):
+            a = bound.aux[id(n)]
+            g_pad, smax_pad = geom.rank_pads[rank_i]
+            rank_i += 1
+            gids = a["group_ids"].astype(np.int64)
+            perm = np.full(g_pad * smax_pad, -1, dtype=np.int32)
+            inv = np.full(pad_rows[id(n.arg)], -1, dtype=np.int32)
+            if len(gids):
+                # Stable order packs each group's rows in their original
+                # row order (the interpreter's flatnonzero tie-break).
+                order = np.argsort(gids, kind="stable")
+                sorted_g = gids[order]
+                starts = np.searchsorted(
+                    sorted_g, np.arange(max(a["n_groups"], 1)))
+                slots_in_g = np.arange(len(gids)) - starts[sorted_g]
+                packed_idx = (sorted_g * smax_pad
+                              + slots_in_g).astype(np.int32)
+                perm[packed_idx] = order
+                inv[order] = packed_idx
+            aux_flat += [perm, inv]
+        elif isinstance(n, SubqueryFunc) and n.packed:
+            a = bound.aux[id(n)]
+            cols = np.full(width_of[id(n)] * n.W, -1, dtype=np.int32)
+            cols[:len(a["cols"])] = a["cols"]
+            aux_flat.append(cols)
+        elif isinstance(n, InstantFunc) and n.func == "timestamp":
+            a = bound.aux[id(n)]
+            times = np.zeros(width_of[id(n)], dtype=np.float32)
+            times[:len(a["times"])] = a["times"]
+            aux_flat.append(times)
 
     slots = np.asarray(bound.slots, dtype=np.float32)
     if slots.size == 0:
@@ -666,6 +980,22 @@ def execute(bound: "qplan.Bound", mesh: Optional[Mesh]):
         8 if isinstance(root, Aggregate) and root.op in ("sum", "avg")
         else 4)
 
+    if isinstance(root, RankAgg) and root.op in ("topk", "bottomk"):
+        # Eager host finish: the surviving SERIES SET is data-dependent
+        # (rows in the k best at any step), so the tags can only be
+        # fixed after materialization — the interpreter's all-NaN row
+        # drop, applied to the masked plane.
+        t0f = time.perf_counter() if actx is not None else 0.0
+        vals = np.asarray(root_val)[:n_rows, :steps]
+        telemetry.count_d2h(result_bytes)
+        keep = ~np.all(np.isnan(vals), axis=1)
+        tags = [t for t, k in zip(bound.out_tags, keep) if k]
+        vals = np.ascontiguousarray(vals[keep])
+        if actx is not None:
+            actx.add("result_materialize", time.perf_counter() - t0f)
+            actx.event("d2h_bytes", result_bytes)
+        return None, tags, (lambda: vals)
+
     if isinstance(root, Aggregate) and root.op in ("sum", "avg"):
         s_dev, cnt_dev = extras
         # The async D2H starts on the arrays fetch() actually reads (a
@@ -712,13 +1042,17 @@ def _padded_rows_map(bound: "qplan.Bound", geom: Geometry,
     plan = bound.plan
     g_iter = iter(geom.g_pads)
     r_iter = iter(geom.r_pads)
+    rank_iter = iter(geom.rank_pads)
     g_of: Dict[int, int] = {}
     r_of: Dict[int, int] = {}
+    rank_of: Dict[int, Tuple[int, int]] = {}
     for n in nodes:
         if isinstance(n, Aggregate):
             g_of[id(n)] = next(g_iter)
         elif _is_vv(n):
             r_of[id(n)] = next(r_iter)
+        elif isinstance(n, RankAgg):
+            rank_of[id(n)] = next(rank_iter)
 
     out: Dict[int, int] = {}
 
@@ -728,10 +1062,15 @@ def _padded_rows_map(bound: "qplan.Bound", geom: Geometry,
             return out[key]
         if isinstance(n, Fetch):
             r = geom.s_pads[plan.fetches.index(n)]
-        elif isinstance(n, (RangeFunc, InstantFunc)):
+        elif isinstance(n, RangeFunc):
+            r = 1 if n.func == "absent_over_time" else rows(n.arg)
+        elif isinstance(n, (SubqueryFunc, InstantFunc)):
             r = rows(n.arg)
         elif isinstance(n, Aggregate):
             r = g_of[key]
+        elif isinstance(n, RankAgg):
+            # quantile collapses to group rows; topk keeps arg rows.
+            r = rank_of[key][0] if n.op == "quantile" else rows(n.arg)
         elif isinstance(n, Binary):
             if _is_vv(n):
                 r = r_of[key]

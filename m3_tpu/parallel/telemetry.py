@@ -171,14 +171,21 @@ def plan_compile_recorded(seconds: float):
 _PLAN_FALLBACK = _SCOPE.sub_scope("plan_fallback")
 
 
-def plan_fallback(reason: str):
+def plan_fallback(reason: str, scope: str = "structural"):
     """One query that missed the compiled whole-plan route, tagged with
     its typed `query.plan.FallbackReason` VALUE (a closed set — raw
     query strings or other unbounded values must never ride as tag
-    values; m3lint's `unbounded-telemetry-tag` rule gates it). The
-    reason-tagged counters are the fallback taxonomy /debug/vars, the
-    self-scrape pipeline and scripts/coverage_report.py read."""
-    _SCOPE.sub_scope("plan_fallback", reason=reason).counter("count").inc()
+    values; m3lint's `unbounded-telemetry-tag` rule gates it) and its
+    SCOPE: "structural" (the query shape is outside the compiled
+    surface) vs "runtime" (a data-dependent or operational routing
+    decision — below-floor, kill switch, backend gap; see
+    query.plan.fallback_scope). The split keeps coverage_report.py's
+    structural re-lowering consistent with recorded routes: a
+    below-floor miss on a small-series corpus is not a lowering gap.
+    The reason-tagged counters are the fallback taxonomy /debug/vars,
+    the self-scrape pipeline and scripts/coverage_report.py read."""
+    _SCOPE.sub_scope("plan_fallback", reason=reason,
+                     scope=scope).counter("count").inc()
     _PLAN_FALLBACK.counter("total").inc()
     tracing.count_cost("plan_fallback")
 

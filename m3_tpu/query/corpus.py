@@ -295,6 +295,7 @@ def coverage(records: List[dict]) -> dict:
     total = len(records)
     compiled = 0
     fallbacks: Dict[str, int] = {}
+    runtime_fallbacks: Dict[str, int] = {}
     structural_compiled = 0
     structural_fallbacks: Dict[str, int] = {}
     shape_route: Dict[tuple, tuple] = {}
@@ -304,6 +305,13 @@ def coverage(records: List[dict]) -> dict:
         else:
             reason = rec.get("reason") or "unknown"
             fallbacks[reason] = fallbacks.get(reason, 0) + 1
+            # The recorded-route split telemetry carries as the `scope`
+            # tag: a runtime miss (below-floor/disabled/backend-gap) is
+            # not a lowering gap, so the structural replay below can
+            # legitimately disagree with it on small-series corpora.
+            if reason in qplan.RUNTIME_REASONS:
+                runtime_fallbacks[reason] = \
+                    runtime_fallbacks.get(reason, 0) + 1
         step_ns = int(rec.get("step_ns") or 30_000_000_000)
         key = (rec["shape"], step_ns)
         hit = shape_route.get(key)
@@ -323,12 +331,17 @@ def coverage(records: List[dict]) -> dict:
         else:
             structural_fallbacks[hit[1]] = \
                 structural_fallbacks.get(hit[1], 0) + 1
+    runtime_total = sum(runtime_fallbacks.values())
     return {
         "total": total,
         "shapes": len(shape_route),
         "compiled": compiled,
         "coverage": compiled / total if total else 0.0,
         "fallbacks": dict(sorted(fallbacks.items())),
+        # Recorded fallbacks split by telemetry scope: runtime reasons
+        # (data size / kill switches) vs structural lowering gaps.
+        "runtime_fallbacks": dict(sorted(runtime_fallbacks.items())),
+        "runtime_fallback_total": runtime_total,
         "structural_compiled": structural_compiled,
         "structural_coverage": structural_compiled / total if total else 0.0,
         "structural_fallbacks": dict(sorted(structural_fallbacks.items())),

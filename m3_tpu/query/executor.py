@@ -382,7 +382,8 @@ class Engine:
         plan, err, slot_values = qplan.lower_and_collect(
             node, params, self.lookback_ns)
         if plan is None:
-            telemetry.plan_fallback(err.reason.value)
+            telemetry.plan_fallback(err.reason.value,
+                                    qplan.fallback_scope(err.reason.value))
             self._set_route("interpreter", err.reason.value, str(err))
             return None
         # bind() fetches + grids every selector through the SAME cached
@@ -401,7 +402,8 @@ class Engine:
             # grids just fetched stay warm in the grid cache, so the
             # fallback evaluation below re-reads them for free.
             ROOT.counter("query.plan.below_floor").inc()
-            telemetry.plan_fallback(qplan.FallbackReason.BELOW_FLOOR.value)
+            telemetry.plan_fallback(qplan.FallbackReason.BELOW_FLOOR.value,
+                                    "runtime")
             self._set_route("interpreter",
                             qplan.FallbackReason.BELOW_FLOOR.value,
                             f"{bound.total_cells} cells < "
@@ -414,7 +416,8 @@ class Engine:
         except pcompile.PlanFallback as e:
             ROOT.counter("query.plan.fallback").inc()
             reason = getattr(e, "reason", qplan.FallbackReason.BACKEND_GAP)
-            telemetry.plan_fallback(reason.value)
+            telemetry.plan_fallback(reason.value,
+                                    qplan.fallback_scope(reason.value))
             self._set_route("interpreter", reason.value, str(e))
             return None
         ROOT.counter("query.plan.executed").inc()

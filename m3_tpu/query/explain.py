@@ -43,7 +43,7 @@ from . import plan as qplan
 from . import promql
 from .plan import (
     Aggregate, Binary, Fetch, InstantFunc, NotCompilable, PlanNode,
-    RangeFunc, ScalarConst,
+    RangeFunc, RankAgg, ScalarConst, SubqueryFunc,
 )
 
 ROUTE_COMPILED = "compiled"
@@ -116,6 +116,15 @@ def _plan_detail(node: PlanNode) -> str:
         return f"{name} role={node.role} W={node.W} stride={node.stride}"
     if isinstance(node, RangeFunc):
         return node.func
+    if isinstance(node, SubqueryFunc):
+        mode = "packed" if node.packed else "shared"
+        return (f"{node.func} subquery[{node.range_ns / 1e9:g}s"
+                f":{node.res_ns / 1e9:g}s] W={node.W} "
+                f"stride={node.stride} {mode}")
+    if isinstance(node, RankAgg):
+        mode = "without" if node.without else "by"
+        grp = ",".join(g.decode(errors="replace") for g in node.grouping)
+        return f"{node.op} {mode}({grp})" if node.grouping else node.op
     if isinstance(node, InstantFunc):
         return node.func
     if isinstance(node, Aggregate):

@@ -79,13 +79,18 @@ class Fetch(PlanNode):
     """A gridded selector: the consolidated [S, ext_T] grid at the window
     grid (range role) or the step grid with lookback (instant role).
     `sel` carries the source selector for binding; the compile key strips
-    it (the traced program depends only on the physical fields)."""
+    it (the traced program depends only on the physical fields). `ctx`
+    distinguishes otherwise-equal selectors gridded in DIFFERENT time
+    contexts (each subquery's inner grid gets a fresh ctx id), so
+    binding/staging never conflates an outer step-grid fetch with the
+    same selector on a subquery's resolution grid."""
 
     sel: VectorSelector
     role: str                 # "range" | "instant"
     W: int                    # cells per window (1 for instant)
     stride: int               # window-grid cells per output step
     wgrid_ns: int             # grid cell width
+    ctx: int = 0              # subquery grid context (0 = outer query)
 
     @property
     def edge(self) -> Edge:
@@ -104,7 +109,61 @@ class RangeFunc(PlanNode):
 
     @property
     def edge(self) -> Edge:
+        # absent_over_time collapses every row into one presence row —
+        # a cross-shard reduce whose output is whole on every device.
+        if self.func == "absent_over_time":
+            return Edge(SERIES, REPLICATED)
         return Edge(SERIES, SHARDED)
+
+
+@dataclasses.dataclass(frozen=True)
+class SubqueryFunc(PlanNode):
+    """A range function over `expr[range:res]`: the inner plan evaluates
+    on its own resolution grid (a nested range grid over the same
+    shard x time mesh), then the outer func re-windows that plane with
+    the SAME W/stride machinery matrix selectors use. `packed=False`
+    (res divides the query step) reads contiguous strided windows
+    straight off the inner plane; `packed=True` gathers each output
+    step's drifting window through a bind-time column-index map (the
+    compiled twin of the interpreter's packed layout). Window extraction
+    is a pure per-row COLUMN operation, so the mesh sharding of the
+    inner plan is preserved."""
+
+    func: str
+    arg: PlanNode
+    W: int                    # window cells (packed: == stride)
+    stride: int
+    packed: bool
+    res_ns: int               # inner resolution (the kernels' step)
+    range_ns: int
+    offset_ns: int = 0        # bind-only (stripped from the compile key)
+    inner_steps: int = 0      # inner grid length (geometry; stripped)
+    params: Tuple[float, ...] = ()
+
+    @property
+    def edge(self) -> Edge:
+        return Edge(SERIES, self.arg.edge.sharding)
+
+
+@dataclasses.dataclass(frozen=True)
+class RankAgg(PlanNode):
+    """Order-statistic aggregation (topk / bottomk / quantile): bind()
+    packs each group's rows contiguously (perm index arrays), the device
+    sort-selects along the packed axis (ops/series_agg packed_* math —
+    the PR 10 quantile_rank_select shape generalized), and the k / q
+    parameter rides as a runtime slot so one executable serves every
+    threshold. Needs cross-row gathers, so plans containing one compile
+    single-device (same rule as vector-vector matching)."""
+
+    op: str                   # "topk" | "bottomk" | "quantile"
+    arg: PlanNode
+    param: "ScalarConst"
+    grouping: Tuple[bytes, ...] = ()
+    without: bool = False
+
+    @property
+    def edge(self) -> Edge:
+        return Edge(SERIES, REPLICATED)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -202,23 +261,42 @@ class FallbackReason(enum.Enum):
     unbounded value (a raw query string) would explode the metric
     registry — m3lint's `unbounded-telemetry-tag` rule gates that."""
 
-    SUBQUERY = "subquery"                      # range func over expr[r:s]
+    # Retired in round 16 (now lowered): "subquery" (the SubqueryFunc
+    # nested range grid) and "group-matching" (one-to-many vv index
+    # maps). The members are GONE, not parked: the raise-site scan in
+    # tests/test_explain.py proves nothing still names them.
     MATRIX_SELECTOR = "matrix-selector"        # bare m[5m] outside a func
     AT_MODIFIER = "at-modifier"                # @-pinned selector
     SELECTOR_SHAPE = "selector-shape"          # range func w/o matrix arg
     UNSUPPORTED_NODE = "unsupported-node"      # AST node kind not lowered
-    UNSUPPORTED_FUNC = "unsupported-func"      # irate/idelta/absent/...
-    UNSUPPORTED_AGG = "unsupported-agg"        # topk/quantile/stddev/...
+    UNSUPPORTED_FUNC = "unsupported-func"      # absent/label_replace/...
+    UNSUPPORTED_AGG = "unsupported-agg"        # count_values/non-root topk
     AGG_OVER_SCALAR = "agg-over-scalar"        # sum(2) — type error shape
     SET_OP = "set-op"                          # and / or / unless
     F64_ARITH = "f64-arith"                    # % / ^ need f64 granularity
     ABS_COMPARISON = "abs-comparison"          # compare on 1e9+ f32 plane
-    GROUP_MATCHING = "group-matching"          # group_left / group_right
     NON_CONSTANT_PARAM = "non-constant-param"  # clamp(m, x) etc.
     SCALAR_ONLY = "scalar-only"                # no selector in the plan
     BELOW_FLOOR = "below-floor"                # total cells < PLAN_MIN_CELLS
     BACKEND_GAP = "backend-gap"                # compile-time PlanFallback
     DISABLED = "disabled"                      # plan route off (env/ref)
+
+
+# Reasons that are RUNTIME routing decisions (data size, kill switches,
+# backend gaps), not plan-structure facts: telemetry tags each fallback
+# with this split so a coverage replay's STRUCTURAL re-lowering can never
+# disagree with recorded routes on small-series corpora — a below-floor
+# miss is not a lowering gap (scripts/coverage_report.py reads both).
+RUNTIME_REASONS = frozenset({
+    "below-floor", "backend-gap", "disabled",
+})
+
+
+def fallback_scope(reason_value: str) -> str:
+    """telemetry.plan_fallback's scope tag for one FallbackReason value:
+    "runtime" (data-dependent / operational) vs "structural" (the query
+    shape is outside the compiled surface)."""
+    return "runtime" if reason_value in RUNTIME_REASONS else "structural"
 
 
 class NotCompilable(Exception):
@@ -239,15 +317,20 @@ class NotCompilable(Exception):
 
 
 # Range functions with fully-traceable device bodies (ops/temporal math).
-# irate/idelta/quantile_over_time gather exact f64 values on the host by
-# device-computed indices — a host sync mid-plan — so they stay on the
-# interpreter.
+# Round 16 closed the last gaps: irate/idelta compute their last-two-
+# sample differences in residual space on device (temporal.instant_math
+# — the staged resid decomposition keeps counter-magnitude diffs exact,
+# where the old host path gathered f64 values by device indices, a host
+# sync mid-plan), quantile_over_time interpolates in residual space
+# (shift-equivariant, temporal.quantile_ot_math), and absent_over_time
+# is a window-count + cross-row presence reduce.
 RANGE_FUNCS = frozenset({
     "rate", "increase", "delta", "deriv", "changes", "resets",
-    "predict_linear", "holt_winters",
+    "predict_linear", "holt_winters", "irate", "idelta",
     "sum_over_time", "avg_over_time", "min_over_time", "max_over_time",
     "count_over_time", "last_over_time", "stddev_over_time",
-    "stdvar_over_time", "present_over_time",
+    "stdvar_over_time", "present_over_time", "quantile_over_time",
+    "absent_over_time",
 })
 
 # Elementwise math with exact jnp twins (NaN-propagating like the host
@@ -259,7 +342,28 @@ MATH_FUNCS = frozenset({
     "asinh", "acosh", "atanh", "deg", "rad",
 })
 
-AGG_OPS = frozenset({"sum", "avg", "min", "max", "count", "group"})
+AGG_OPS = frozenset({"sum", "avg", "min", "max", "count", "group",
+                     "stddev", "stdvar"})
+
+# Order-statistic aggregations: the RankAgg packed sort-select path.
+RANK_AGGS = frozenset({"topk", "bottomk", "quantile"})
+
+# Outer funcs lowerable over a subquery. absent_over_time's cross-row
+# presence reduce is Fetch-shaped (selector tags, empty-fetch rows) and
+# stays on the interpreter over subqueries.
+SUBQUERY_FUNCS = RANGE_FUNCS - {"absent_over_time"}
+
+# Subquery funcs whose math DIFFERENCES or REGRESSES the plane: over a
+# composite (non-selector) inner expression the prep runs in-trace at
+# f32, which at absolute counter magnitudes (1e9+, ulp 64) turns
+# consecutive-sample diffs into rounding noise — those stay on the
+# interpreter (same f64-granularity reason %/^ do). Direct selector
+# inners stage their preps on the host in exact f64 and lower fully.
+_SUBQ_DIFF_FUNCS = frozenset({
+    "rate", "increase", "delta", "irate", "idelta", "deriv",
+    "predict_linear", "holt_winters", "stddev_over_time",
+    "stdvar_over_time",
+})
 
 # %/^ stay on the interpreter: fmod/pow need f64 granularity at counter
 # magnitudes (2^m % 7 on an f32 plane is pure rounding noise), and the
@@ -275,6 +379,7 @@ ARITH_OPS = frozenset({"+", "-", "*", "/"})
 _ABS_RANGE_FUNCS = frozenset({
     "sum_over_time", "avg_over_time", "min_over_time", "max_over_time",
     "last_over_time", "predict_linear", "holt_winters",
+    "quantile_over_time",
 })
 
 
@@ -287,11 +392,22 @@ def _abs_space(node: PlanNode) -> bool:
         return True
     if isinstance(node, RangeFunc):
         return node.func in _ABS_RANGE_FUNCS
+    if isinstance(node, SubqueryFunc):
+        return node.func in _ABS_RANGE_FUNCS
+    if isinstance(node, RankAgg):
+        # topk/bottomk/quantile select VALUES of the argument plane.
+        return _abs_space(node.arg)
     if isinstance(node, InstantFunc):
+        # timestamp() emits unix seconds (~1.7e9): absolute magnitudes
+        # regardless of its argument's space.
+        if node.func == "timestamp":
+            return True
         return _abs_space(node.arg)
     if isinstance(node, Aggregate):
-        return node.op in ("sum", "avg", "min", "max") \
-            and _abs_space(node.arg)
+        # stddev/stdvar spread across series of different baselines can
+        # itself reach baseline magnitude — treat as absolute space.
+        return node.op in ("sum", "avg", "min", "max", "stddev",
+                           "stdvar") and _abs_space(node.arg)
     if isinstance(node, Binary):
         return _abs_space(node.lhs) or _abs_space(node.rhs)
     return False
@@ -302,12 +418,22 @@ class _Lowerer:
         self.params = params
         self.lookback_ns = lookback_ns
         self.slots: List[AstNode] = []
+        self._depth = 0       # AST nesting below the root (1 = root node)
+        self._ctx = 0         # current subquery grid context (0 = outer)
+        self._next_ctx = 0
 
     def _slot(self, node: AstNode) -> ScalarConst:
         self.slots.append(node)
         return ScalarConst(len(self.slots) - 1)
 
     def lower(self, node: AstNode) -> PlanNode:
+        self._depth += 1
+        try:
+            return self._lower(node)
+        finally:
+            self._depth -= 1
+
+    def _lower(self, node: AstNode) -> PlanNode:
         p = self.params
         if isinstance(node, NumberLiteral):
             return self._slot(node)
@@ -321,7 +447,7 @@ class _Lowerer:
             if node.range_ns:
                 raise NotCompilable(FallbackReason.MATRIX_SELECTOR,
                                     "bare matrix selector", node)
-            return Fetch(node, "instant", 1, 1, p.step_ns)
+            return Fetch(node, "instant", 1, 1, p.step_ns, self._ctx)
         if isinstance(node, Call):
             return self._lower_call(node)
         if isinstance(node, Aggregation):
@@ -331,14 +457,22 @@ class _Lowerer:
         raise NotCompilable(FallbackReason.UNSUPPORTED_NODE,
                             type(node).__name__, node)
 
+    def _func_params(self, f: str, node: Call) -> Tuple[float, ...]:
+        if f == "predict_linear":
+            return (self._const(node.args[1]),)
+        if f == "holt_winters":
+            return (self._const(node.args[1]), self._const(node.args[2]))
+        if f == "quantile_over_time":
+            return (self._const(node.args[0]),)
+        return ()
+
     def _lower_call(self, node: Call) -> PlanNode:
         f = node.func
         if f in RANGE_FUNCS:
             sels = [a for a in node.args
                     if isinstance(a, (VectorSelector, Subquery))]
             if sels and isinstance(sels[-1], Subquery):
-                raise NotCompilable(FallbackReason.SUBQUERY,
-                                    f"{f} over subquery", node)
+                return self._lower_subquery(f, node, sels[-1])
             if not sels:
                 raise NotCompilable(FallbackReason.SELECTOR_SHAPE,
                                     f"{f} without a matrix selector", node)
@@ -353,14 +487,18 @@ class _Lowerer:
             wgrid = math.gcd(p.step_ns, sel.range_ns)
             W = sel.range_ns // wgrid
             stride = p.step_ns // wgrid
-            fetch = Fetch(sel, "range", W, stride, wgrid)
-            params: Tuple[float, ...] = ()
-            if f == "predict_linear":
-                params = (self._const(node.args[1]),)
-            elif f == "holt_winters":
-                params = (self._const(node.args[1]),
-                          self._const(node.args[2]))
-            return RangeFunc(f, fetch, wgrid, sel.range_ns, params)
+            fetch = Fetch(sel, "range", W, stride, wgrid, self._ctx)
+            return RangeFunc(f, fetch, wgrid, sel.range_ns,
+                             self._func_params(f, node))
+        if f == "timestamp":
+            if not node.args:
+                raise NotCompilable(FallbackReason.SELECTOR_SHAPE,
+                                    "timestamp with no args", node)
+            arg = self.lower(node.args[0])
+            if arg.edge.kind != SERIES:
+                raise NotCompilable(FallbackReason.SELECTOR_SHAPE,
+                                    "timestamp over a scalar operand", node)
+            return InstantFunc("timestamp", arg)
         if f in MATH_FUNCS:
             if not node.args:
                 raise NotCompilable(FallbackReason.SELECTOR_SHAPE,
@@ -373,7 +511,70 @@ class _Lowerer:
         raise NotCompilable(FallbackReason.UNSUPPORTED_FUNC,
                             f"function {f}", node)
 
+    def _lower_subquery(self, f: str, node: Call, sub: Subquery) -> PlanNode:
+        """`f(expr[range:res])`: lower the inner expression on its own
+        resolution grid (a fresh Fetch ctx), then wrap it in a
+        SubqueryFunc carrying the SAME W/stride window geometry the
+        interpreter's _eval_subquery_grid derives — shared-grid when res
+        divides the query step, packed-gather otherwise."""
+        from .executor import DEFAULT_SUBQUERY_RES_NS, QueryParams
+
+        if f not in SUBQUERY_FUNCS:
+            raise NotCompilable(FallbackReason.UNSUPPORTED_FUNC,
+                                f"{f} over subquery", node)
+        if sub.at_ns is not None:
+            raise NotCompilable(FallbackReason.AT_MODIFIER,
+                                f"{f} over @-pinned subquery", node)
+        p = self.params
+        res = sub.step_ns or max(p.step_ns, DEFAULT_SUBQUERY_RES_NS)
+        k_min, k_max = subquery_grid(sub.range_ns, res, sub.offset_ns, p)
+        inner_params = QueryParams(k_min * res, k_max * res, res)
+        x0 = p.start_ns - sub.offset_ns
+        if p.step_ns % res == 0 and sub.range_ns >= res:
+            W = x0 // res - (x0 - sub.range_ns) // res
+            stride = p.step_ns // res
+            packed = False
+        else:
+            W = stride = max(sub.range_ns // res
+                             + (1 if sub.range_ns % res else 0), 1)
+            packed = True
+        self._next_ctx += 1
+        outer_params, outer_ctx = self.params, self._ctx
+        self.params, self._ctx = inner_params, self._next_ctx
+        try:
+            arg = self.lower(sub.expr)
+        finally:
+            self.params, self._ctx = outer_params, outer_ctx
+        abs_arg = _abs_space(arg)
+        if not isinstance(arg, Fetch) and f in _SUBQ_DIFF_FUNCS and abs_arg:
+            # Composite inner at counter magnitudes: the in-trace f32
+            # prep would turn consecutive-sample diffs into rounding
+            # noise (selector inners stage exact-f64 preps instead).
+            raise NotCompilable(
+                FallbackReason.F64_ARITH,
+                f"{f} differences an absolute-magnitude subquery plane "
+                "(f64 granularity)", node)
+        if packed and f in ("rate", "increase") and abs_arg:
+            # The interpreter's packed layout places each window's first
+            # lane after a LATER cell of the previous window, so its
+            # counter-reset rule fires with the full absolute value
+            # (1e9+) as the adjustment — which then cancels only in the
+            # oracle's own f32 accumulation noise. That cancellation is
+            # not reproducible faithfully from the exact inner-grid
+            # preps, so counter rates over packed-grid subqueries of
+            # absolute-magnitude planes stay on the interpreter (delta
+            # and the window-local funcs are unaffected).
+            raise NotCompilable(
+                FallbackReason.F64_ARITH,
+                f"{f} over a packed-grid subquery of an "
+                "absolute-magnitude plane (f64 granularity)", node)
+        return SubqueryFunc(f, arg, W, stride, packed, res, sub.range_ns,
+                            sub.offset_ns, k_max - k_min + 1,
+                            self._func_params(f, node))
+
     def _lower_aggregation(self, node: Aggregation) -> PlanNode:
+        if node.op in RANK_AGGS:
+            return self._lower_rank_agg(node)
         if node.op not in AGG_OPS:
             raise NotCompilable(FallbackReason.UNSUPPORTED_AGG,
                                 f"aggregation {node.op}", node)
@@ -383,6 +584,32 @@ class _Lowerer:
                                 f"{node.op} over a scalar operand", node)
         exact = isinstance(arg, Fetch) and node.op in ("sum", "avg")
         return Aggregate(node.op, arg, node.grouping, node.without, exact)
+
+    def _lower_rank_agg(self, node: Aggregation) -> PlanNode:
+        if node.op in ("topk", "bottomk") and self._depth > 1:
+            # topk's output SERIES SET is data-dependent (rows in the k
+            # best at any step survive, the rest are dropped): only the
+            # root can host-filter rows after materialization; an inner
+            # topk would feed phantom all-NaN rows to its consumer.
+            raise NotCompilable(FallbackReason.UNSUPPORTED_AGG,
+                                f"non-root {node.op}", node)
+        if node.param is None:
+            raise NotCompilable(FallbackReason.NON_CONSTANT_PARAM,
+                                f"{node.op} without a parameter", node)
+        p_val = self._const(node.param)  # only constant k/q compile
+        if node.op == "quantile" and not 0.0 <= p_val <= 1.0:
+            # The interpreter (np.nanquantile) RAISES for q outside
+            # [0, 1]; the device sort-select would clip and extrapolate
+            # — keep the error behavior by staying interpreted.
+            raise NotCompilable(FallbackReason.UNSUPPORTED_AGG,
+                                f"quantile parameter {p_val} outside "
+                                "[0, 1]", node)
+        arg = self.lower(node.expr)
+        if arg.edge.kind != SERIES:
+            raise NotCompilable(FallbackReason.AGG_OVER_SCALAR,
+                                f"{node.op} over a scalar operand", node)
+        return RankAgg(node.op, arg, self._slot(node.param),
+                       node.grouping, node.without)
 
     def _lower_binary(self, node: BinaryOp) -> PlanNode:
         if node.op in promql.SET_OPS:
@@ -407,11 +634,9 @@ class _Lowerer:
                 FallbackReason.ABS_COMPARISON,
                 "comparison over an absolute-magnitude plane (f64 "
                 "granularity)", node)
-        if lhs.edge.kind == SERIES and rhs.edge.kind == SERIES:
-            m = node.matching
-            if m is not None and (m.group_left or m.group_right):
-                raise NotCompilable(FallbackReason.GROUP_MATCHING,
-                                    "group_left/group_right matching", node)
+        # group_left/group_right lowers like one-to-one matching: bind()
+        # emits one-to-many index maps and the compiled gather replays
+        # them — the label-copy columns are bind-time tag algebra.
         swap = bool(node.matching and node.matching.group_right)
         return Binary(node.op, lhs, rhs, node.bool_mode, node.matching,
                       swap)
@@ -443,9 +668,13 @@ def _walk_fetches(node: PlanNode, out: List[Fetch]):
 
 def _mesh_ok(node: PlanNode) -> bool:
     """True when no node needs cross-row gathers: vector-vector binaries
-    re-align rows by bind-time index maps, which a row-partitioned device
-    cannot serve without a full gather — those plans compile
-    single-device instead."""
+    re-align rows by bind-time index maps, and rank aggregations sort
+    across their whole group — both need rows a row-partitioned device
+    doesn't hold, so those plans compile single-device instead.
+    (SubqueryFunc's window extraction is a pure COLUMN operation and
+    preserves mesh sharding.)"""
+    if isinstance(node, RankAgg):
+        return False
     if isinstance(node, Binary):
         if (node.lhs.edge.kind == SERIES and node.rhs.edge.kind == SERIES):
             return False
@@ -515,6 +744,73 @@ def _preorder(node: PlanNode, out: List[PlanNode]) -> List[PlanNode]:
     return out
 
 
+def subquery_grid(range_ns: int, res: int, offset_ns: int, outer_params
+                  ) -> Tuple[int, int]:
+    """(k_min, k_max) of the res-aligned inner evaluation grid for a
+    subquery under `outer_params` — the ONE derivation shared by the
+    lowerer (window geometry), bind (inner QueryParams) and the packed
+    column maps, mirroring the interpreter's _eval_subquery_grid."""
+    x0 = outer_params.start_ns - offset_ns
+    k_min = (x0 - range_ns) // res + 1
+    k_max = max((x0 + (outer_params.steps - 1) * outer_params.step_ns)
+                // res, k_min)
+    return k_min, k_max
+
+
+def subquery_inner_params(node: SubqueryFunc, outer_params):
+    """The inner resolution-grid QueryParams for one SubqueryFunc under
+    `outer_params` — recomputed from the node's geometry fields so
+    binding needs no side-channel from the lowerer."""
+    from .executor import QueryParams
+
+    k_min, k_max = subquery_grid(node.range_ns, node.res_ns,
+                                 node.offset_ns, outer_params)
+    return QueryParams(k_min * node.res_ns, k_max * node.res_ns,
+                       node.res_ns)
+
+
+def node_params_map(root: PlanNode, params) -> Dict[int, object]:
+    """id(plan node) -> the QueryParams of its time-grid context: the
+    outer query's for everything outside subqueries, the inner
+    resolution grid inside each SubqueryFunc (nested subqueries
+    compose)."""
+    out: Dict[int, object] = {}
+
+    def walk(node: PlanNode, p):
+        out[id(node)] = p
+        child_p = (subquery_inner_params(node, p)
+                   if isinstance(node, SubqueryFunc) else p)
+        for f in dataclasses.fields(node):
+            v = getattr(node, f.name)
+            if isinstance(v, PlanNode):
+                walk(v, child_p)
+            elif isinstance(v, tuple):
+                for item in v:
+                    if isinstance(item, PlanNode):
+                        walk(item, child_p)
+
+    walk(root, params)
+    return out
+
+
+def _packed_cols(node: SubqueryFunc, outer_params) -> np.ndarray:
+    """Bind-time column-index map for a packed subquery window: for each
+    output step, the W inner-grid columns of its trailing (T-range, T]
+    window, -1 where the lane is outside the window (the interpreter's
+    packed-gather geometry, flattened to [steps * W])."""
+    res, W = node.res_ns, node.W
+    x0 = outer_params.start_ns - node.offset_ns
+    k_min, _ = subquery_grid(node.range_ns, res, node.offset_ns,
+                             outer_params)
+    steps = outer_params.steps
+    x = x0 + np.arange(steps, dtype=np.int64) * outer_params.step_ns
+    k_end = x // res
+    k_start = (x - node.range_ns) // res + 1
+    cols = (k_end[:, None] - (W - 1) + np.arange(W)[None, :] - k_min)
+    valid = cols >= (k_start - k_min)[:, None]
+    return np.where(valid, cols, -1).astype(np.int32).reshape(steps * W)
+
+
 def bind(plan: Plan, engine, params,
          slot_values: Sequence[float] = ()) -> Bound:
     """Fetch + grid every selector through the engine's cached selector
@@ -523,16 +819,22 @@ def bind(plan: Plan, engine, params,
     the interpreter's exact semantics for matching violations."""
     from . import executor as ex
 
+    # Per-node time-grid context: fetches under a subquery grid at the
+    # inner resolution (the fetch's ctx field keeps them distinct from
+    # equal selectors on the outer grid).
+    params_of = node_params_map(plan.root, params)
+
     fetches: Dict[Fetch, BoundFetch] = {}
     total = 0
     for f in plan.fetches:
+        fp = params_of[id(f)]
         if f.role == "range":
-            blk, W, stride = engine._eval_range_selector(f.sel, params)
+            blk, W, stride = engine._eval_range_selector(f.sel, fp)
             bf = BoundFetch(f, np.asarray(blk.values, dtype=np.float64),
                             blk.series_tags, W, stride,
                             blk.meta.step_ns)
         else:
-            blk = engine._eval_instant_selector(f.sel, params)
+            blk = engine._eval_instant_selector(f.sel, fp)
             bf = BoundFetch(f, np.asarray(blk.values, dtype=np.float64),
                             blk.series_tags, 1, 1, blk.meta.step_ns)
         fetches[f] = bf
@@ -556,6 +858,7 @@ def bind(plan: Plan, engine, params,
         nodes = _preorder(plan.root, [])
         node_tags = {id(n): t for n, t in zip(nodes, tags_seq)}
         aux = {id(n): a for n, a in zip(nodes, aux_seq) if a is not None}
+        _merge_param_aux(plan, params_of, aux)
         return Bound(plan, params, fetches, slots, node_tags, aux, total,
                      node_tags[id(plan.root)], out_kind)
 
@@ -570,10 +873,33 @@ def bind(plan: Plan, engine, params,
             out = fetches[node].tags
         elif isinstance(node, RangeFunc):
             base = tags_of(node.arg)
+            if node.func == "absent_over_time":
+                # One presence row labelled from the selector's equality
+                # matchers (functions.go funcAbsentOverTime).
+                out = [ex._absent_tags(node.arg.sel)]
+            elif node.func == "last_over_time":
+                out = list(base)
+            else:
+                out = [ex._strip_name(t) for t in base]
+        elif isinstance(node, SubqueryFunc):
+            base = tags_of(node.arg)
             if node.func == "last_over_time":
                 out = list(base)
             else:
                 out = [ex._strip_name(t) for t in base]
+        elif isinstance(node, RankAgg):
+            base = tags_of(node.arg)
+            gids, gtags = ex._group_series(base, node.grouping,
+                                           node.without)
+            smax = (int(np.bincount(
+                gids, minlength=max(len(gtags), 1)).max())
+                if len(base) else 0)
+            aux[id(node)] = {"group_ids": gids.astype(np.int32),
+                             "n_groups": len(gtags), "smax": smax}
+            # quantile collapses to group rows; topk/bottomk keep the
+            # argument's rows (the data-dependent subset is filtered on
+            # the host after materialization — root-only by lowering).
+            out = gtags if node.op == "quantile" else list(base)
         elif isinstance(node, InstantFunc):
             base = tags_of(node.arg)
             if node.func == "neg":
@@ -621,6 +947,11 @@ def bind(plan: Plan, engine, params,
             one_idx: List[int] = []
             out_tags: List[Tags] = []
             seen: Dict[bytes, int] = {}
+            # Duplicate result labels only raise for one-to-one matching
+            # (the interpreter's _vector_vector rule): group_left/right
+            # legitimately map many rows onto one match key.
+            one_to_one = not (matching and (matching.group_left
+                                            or matching.group_right))
             for i, t in enumerate(many_tags):
                 j = one_map.get(ex._match_key(t, matching))
                 if j is None:
@@ -628,7 +959,7 @@ def bind(plan: Plan, engine, params,
                 rt = ex._result_tags(t, one_tags[j], matching, comparison,
                                      node.bool_mode)
                 k = rt.id()
-                if k in seen:
+                if one_to_one and k in seen:
                     raise ex.QueryError(
                         "multiple matches for the same result labels")
                 seen[k] = i
@@ -660,8 +991,25 @@ def bind(plan: Plan, engine, params,
                                 plan.root.edge.kind)
         while len(_BIND_MEMO) > _BIND_MEMO_MAX:
             _BIND_MEMO.popitem(last=False)
+    _merge_param_aux(plan, params_of, aux)
     return Bound(plan, params, fetches, slots, node_tags, aux, total,
                  out_tags, plan.root.edge.kind)
+
+
+def _merge_param_aux(plan: Plan, params_of: Dict[int, object],
+                     aux: Dict[int, dict]) -> None:
+    """Params-DEPENDENT aux entries, recomputed on every bind (never
+    memoized — the bind memo is keyed on plan structure + tag lists, and
+    a sliding dashboard window changes these while hitting it): packed
+    subquery column maps and timestamp() step-time vectors."""
+    for n in _preorder(plan.root, []):
+        if isinstance(n, SubqueryFunc) and n.packed:
+            aux.setdefault(id(n), {})["cols"] = _packed_cols(
+                n, params_of[id(n)])
+        elif isinstance(n, InstantFunc) and n.func == "timestamp":
+            p = params_of[id(n)]
+            aux.setdefault(id(n), {})["times"] = (
+                p.meta().times() / 1e9)
 
 
 def lower_and_collect(ast: AstNode, params, lookback_ns: int
@@ -710,6 +1058,12 @@ def _demote_exact(node: PlanNode, is_root: bool) -> PlanNode:
     if isinstance(node, RangeFunc) or isinstance(node, Fetch) \
             or isinstance(node, ScalarConst):
         return node
+    if isinstance(node, SubqueryFunc):
+        return dataclasses.replace(node,
+                                   arg=_demote_exact(node.arg, False))
+    if isinstance(node, RankAgg):
+        return dataclasses.replace(node,
+                                   arg=_demote_exact(node.arg, False))
     if isinstance(node, InstantFunc):
         return InstantFunc(node.func, _demote_exact(node.arg, False),
                            node.params)
@@ -737,6 +1091,16 @@ def strip(node: PlanNode, fetch_index: Dict[Fetch, int]) -> PlanNode:
     if isinstance(node, RangeFunc):
         return RangeFunc(node.func, strip(node.arg, fetch_index),
                          node.step_ns, node.range_ns, node.params)
+    if isinstance(node, SubqueryFunc):
+        # offset/inner length are bind-time data: the traced program
+        # depends only on the window geometry (inner widths ride the
+        # Geometry bucket, packed column maps are aux inputs).
+        return SubqueryFunc(node.func, strip(node.arg, fetch_index),
+                            node.W, node.stride, node.packed, node.res_ns,
+                            node.range_ns, 0, 0, node.params)
+    if isinstance(node, RankAgg):
+        return RankAgg(node.op, strip(node.arg, fetch_index), node.param,
+                       (), node.without)
     if isinstance(node, InstantFunc):
         return InstantFunc(node.func, strip(node.arg, fetch_index),
                            node.params)

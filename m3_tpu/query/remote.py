@@ -75,12 +75,25 @@ class RemoteStorageServer:
         if req["method"] == "fetch_raw":
             series = self.storage.fetch_raw(
                 _matchers_from_wire(req["matchers"]), req["start"], req["end"])
-            return {"series": [
-                {"id": sid, "tags": entry["tags"],
-                 "times": np.asarray(entry["t"], np.int64),
-                 "values": np.asarray(entry["v"], np.float64)}
-                for sid, entry in series.items()
-            ]}
+            # Columnar result frame: ids/tags sidecar + ONE pair of
+            # concatenated (t, v) columns with an offsets vector —
+            # instead of one dict of arrays per series. The ragged
+            # per-series runs survive as offset slices; the client
+            # rebuilds zero-copy views.
+            ids, tags, ts, vs = [], [], [], []
+            for sid, entry in series.items():
+                ids.append(sid)
+                tags.append(entry["tags"])
+                ts.append(np.asarray(entry["t"], np.int64))
+                vs.append(np.asarray(entry["v"], np.float64))
+            offs = np.zeros(len(ids) + 1, np.int64)
+            if ids:
+                offs[1:] = np.cumsum([t.size for t in ts])
+            return {"ids": ids, "tags": tags, "offs": offs,
+                    "t": (np.concatenate(ts) if ids
+                          else np.zeros(0, np.int64)),
+                    "v": (np.concatenate(vs) if ids
+                          else np.zeros(0, np.float64))}
         if req["method"] == "write":
             self.storage.write(req["id"], req["tags"], req["time"], req["value"])
             return {"ok": True}
@@ -186,9 +199,13 @@ class RemoteStorage:
         resp = self._call({"method": "fetch_raw",
                            "matchers": _matchers_to_wire(matchers),
                            "start": start_ns, "end": end_ns}, deadline)
+        offs, t, v = resp["offs"], resp["t"], resp["v"]
+        # Offset-sliced VIEWS of the two wire columns — no per-series
+        # array copies on the federation read path.
         return {
-            e["id"]: {"tags": e["tags"], "t": e["times"], "v": e["values"]}
-            for e in resp["series"]
+            sid: {"tags": tags, "t": t[offs[i]:offs[i + 1]],
+                  "v": v[offs[i]:offs[i + 1]]}
+            for i, (sid, tags) in enumerate(zip(resp["ids"], resp["tags"]))
         }
 
     def write(self, series_id: bytes, tags, t_ns: int, value: float,

@@ -17,7 +17,7 @@ import socket
 import socketserver
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -202,46 +202,27 @@ class NodeService:
         t, v = self.db.read(ns, id, start_ns, end_ns)
         return {"t": t, "v": v}
 
-    def _series_segments(self, shard, idx: int, start_ns: int, end_ns: int) -> dict:
-        """Encoded sealed-block rows + raw buffer columns for one series.
-        Encoded bytes about to cross the wire charge the bytes-read limit
-        (query_limits.go bytes-read): the budget rejects a fetch mid
-        fan-in before it materializes the rest of an oversized result."""
-        segs = []
-        nbytes = 0
-        with shard.write_lock:  # snapshot racing tick's expiry/seal
-            blocks = dict(shard.blocks)
-            bt, bv = shard.buffer.read(idx, start_ns, end_ns)
-        for bs in sorted(blocks):
-            blk = blocks[bs]
-            if bs + shard.opts.block_size_ns <= start_ns or bs >= end_ns:
-                continue
-            row = blk.row_of(idx)
-            if row is None:
-                continue
-            words = np.asarray(blk.words[row])
-            nbytes += words.nbytes
-            segs.append({
-                "bs": bs,
-                "words": words,
-                "nbits": int(blk.nbits[row]),
-                "npoints": int(blk.npoints[row]),
-                "window": int(blk.window),
-                "time_unit": int(blk.time_unit),
-            })
-        charge_read(n_bytes=nbytes + bt.nbytes + bv.nbytes)
-        return {"segments": segs, "buf_t": bt, "buf_v": bv}
-
     def rpc_fetch_tagged(self, ns: bytes, query: dict, start_ns: int, end_ns: int,
                          fetch_data: bool = True, limit: int = 0):
+        """FetchTagged with a COLUMNAR result frame: per-series entries
+        carry only identity (id + tags — host label algebra); the data
+        plane rides beside them as ONE buffer sidecar (concatenated
+        mutable-buffer columns + an offsets vector) and one TILE per
+        (shard, sealed block) — the requested rows fancy-indexed out of
+        the block's word matrix in one numpy op, the same tile shape
+        peer streaming moves (rpc_fetch_block_tiles) and the client's
+        batched device decode consumes (client/decode.decode_tile).
+        Pre-change this loop built one dict of segments per series —
+        per-row python materialization on the hot read fan-in."""
         q = wire.query_from_wire(query)
         nsobj = self.db.namespace(ns)
         ids = self.db.query_ids(ns, q, start_ns, end_ns, limit=limit)
         out = []
+        by_shard: Dict[int, List[Tuple[int, int]]] = {}  # -> (idx, pos)
         for sid in ids:
-            # Mid-loop budget check: fetch_tagged is the expensive fan-in
-            # (per-series segment snapshots); a dead caller's request must
-            # stop here, not run the whole result set to completion.
+            # Mid-loop budget check: fetch_tagged is the expensive fan-in;
+            # a dead caller's request must stop here, not run the whole
+            # result set to completion.
             self._check_deadline("fetch_tagged")
             shard_id = self.db.shard_set.lookup(sid)
             shard = nsobj.shards.get(shard_id)
@@ -249,21 +230,93 @@ class NodeService:
                 continue
             idx = shard.registry.get(sid)
             if idx is None:
-                # Indexed on another replica's time range but not written here.
-                out.append({"id": sid, "tags": {}, "segments": [],
-                            "buf_t": np.zeros(0, np.int64), "buf_v": np.zeros(0)})
+                # Indexed on another replica's time range but not written
+                # here: identity-only row, no buffer/tile contribution.
+                out.append({"id": sid, "tags": {}})
                 continue
             # identity cost (id + tag pairs) charges bytes-read before the
             # segment payloads do — a tags-only fetch is still metered
             charge_read(n_bytes=shard.registry.entry_bytes(idx))
-            entry = {"id": sid, "tags": shard.registry.tags_of(idx) or {}}
             if fetch_data:
-                entry.update(self._series_segments(shard, idx, start_ns, end_ns))
-            else:
-                entry.update({"segments": [], "buf_t": np.zeros(0, np.int64),
-                              "buf_v": np.zeros(0)})
-            out.append(entry)
-        return {"series": out, "exhaustive": True}
+                by_shard.setdefault(shard_id, []).append((idx, len(out)))
+            out.append({"id": sid, "tags": shard.registry.tags_of(idx) or {}})
+        n = len(out)
+        buf_t = [np.zeros(0, np.int64)] * n
+        buf_v = [np.zeros(0, np.float64)] * n
+        tiles: List[dict] = []
+        for shard_id in sorted(by_shard):
+            shard = nsobj.shards[shard_id]
+            members = by_shard[shard_id]
+            # Buffer reads take the shard write lock in bounded CHUNKS —
+            # a dashboard-sized member set must not stall every
+            # concurrent write for one uninterrupted sweep (the
+            # per-series path re-acquired per row; chunking keeps that
+            # bound without paying the lock once per series). The block
+            # snapshot MERGES under every chunk's acquisition: a tick
+            # sealing the buffer between chunks moves later chunks'
+            # points into a block the first snapshot predates — the
+            # union sees it (earlier chunks may then appear in both
+            # their buffer read and the new block's tile; duplicate
+            # timestamps carry identical values and the client's
+            # replica merge dedups them, same as a replica overlap).
+            # Each chunk charges its buffer bytes BEFORE the next
+            # materializes (query_limits.go bytes-read: reject an
+            # oversized fetch mid fan-in).
+            blocks: Dict[int, object] = {}
+            chunk = 256
+            for c0 in range(0, len(members), chunk):
+                self._check_deadline("fetch_tagged")
+                part = members[c0:c0 + chunk]
+                with shard.write_lock:  # snapshot racing tick's expiry/seal
+                    blocks.update(shard.blocks)
+                    for idx, pos in part:
+                        buf_t[pos], buf_v[pos] = shard.buffer.read(
+                            idx, start_ns, end_ns)
+                charge_read(n_bytes=sum(
+                    buf_t[pos].nbytes + buf_v[pos].nbytes
+                    for _, pos in part))
+            for bs in sorted(blocks):
+                blk = blocks[bs]
+                if bs + shard.opts.block_size_ns <= start_ns or bs >= end_ns:
+                    continue
+                rows, poss = [], []
+                for idx, pos in members:
+                    row = blk.row_of(idx)
+                    if row is not None:
+                        rows.append(row)
+                        poss.append(pos)
+                if not rows:
+                    continue
+                self._check_deadline("fetch_tagged")
+                # Charge BEFORE the tile materializes (query_limits.go
+                # bytes-read): an oversized result must be rejected mid
+                # fan-in, not after every tile copy has been allocated —
+                # the same incremental guard the per-series path had.
+                all_words = np.asarray(blk.words)
+                rows_a = np.asarray(rows, np.int64)
+                charge_read(
+                    n_bytes=len(rows) * all_words.shape[-1]
+                    * all_words.itemsize)
+                tiles.append({
+                    "bs": bs,
+                    "rows": np.asarray(poss, np.int32),
+                    "words": all_words[rows_a],
+                    "nbits": np.asarray(blk.nbits)[rows_a].astype(np.int32),
+                    "npoints": np.asarray(blk.npoints)[rows_a].astype(
+                        np.int32),
+                    "window": int(blk.window),
+                    "time_unit": int(blk.time_unit),
+                })
+        offs = np.zeros(n + 1, np.int64)
+        if n:
+            offs[1:] = np.cumsum([t.size for t in buf_t])
+        bufs = {
+            "offs": offs,
+            "t": (np.concatenate(buf_t) if n else np.zeros(0, np.int64)),
+            "v": (np.concatenate(buf_v) if n else np.zeros(0, np.float64)),
+        }
+        return {"series": out, "bufs": bufs, "tiles": tiles,
+                "exhaustive": True}
 
     def rpc_query(self, ns: bytes, query: dict, start_ns: int, end_ns: int):
         """service.go:255 Query: ids + tags only (no data)."""

@@ -115,13 +115,25 @@ JAX_PLATFORMS=cpu python scripts/obs_smoke.py --seed 7
 echo "== plan-compiler smoke (<5s; compiled-vs-oracle, 100% warm plan-cache hit, fallback exercised) =="
 # Whole-plan pjit query execution: the compiled route must agree with
 # the retained interpreter oracle (counter sums BIT-equal), every
-# compilable query must actually compile (no silent fallback), the warm
-# pass must be served 100% from the plan cache, and a subquery must fall
-# back cleanly. The 8-virtual-device mesh exercises the shard_map
-# collective fan-in. Full matrix: tests/test_plan_compile.py; bench:
-# promql_plan_agg. Wall budget via PLAN_SMOKE_BUDGET_S.
+# compilable query must actually compile (no silent fallback — incl.
+# the round-16 families: subqueries, topk/quantile/stddev, group
+# matching, irate/timestamp/quantile_over_time), the warm pass must be
+# served 100% from the plan cache, and a set op must fall back cleanly.
+# The 8-virtual-device mesh exercises the shard_map collective fan-in.
+# Full matrix: tests/test_plan_compile.py; bench: promql_plan_agg.
+# Wall budget via PLAN_SMOKE_BUDGET_S.
 JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   python scripts/plan_smoke.py
+
+echo "== serve smoke (<5s; columnar HTTP result frames byte-identical to render_result_ref, one compiled round-trip per round-16 lowering family) =="
+# The columnar result plane: every response on /api/v1/query_range and
+# /api/v1/query renders straight from the value matrix (query/render.py,
+# zero per-series dicts) and must be byte-identical to the retained
+# per-series oracle; one query per new lowering family must take the
+# compiled route over real HTTP. Full matrix: tests/test_result_frame.py;
+# bench: query_serve_e2e. Wall budget via SERVE_SMOKE_BUDGET_S.
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  python scripts/serve_smoke.py
 
 echo "== explain smoke (<5s; EXPLAIN route round-trip via /debug/explain, ?explain=true + ANALYZE stages beside data, mini-corpus coverage) =="
 # The query observatory: a compiled query and a subquery fallback must

@@ -53,8 +53,10 @@ def main(argv) -> int:
     print(f"compiled (recorded): {cov['compiled']}  "
           f"coverage {cov['coverage']:.1%}")
     print("fallbacks by reason (recorded):")
+    runtime = cov.get("runtime_fallbacks", {})
     for reason, n in cov["fallbacks"].items():
-        print(f"  {reason:24s} {n}")
+        scope = "runtime" if reason in runtime else "structural"
+        print(f"  {reason:24s} {n}  [{scope}]")
     print(f"compiled (structural replay): {cov['structural_compiled']}  "
           f"coverage {cov['structural_coverage']:.1%}")
     if cov["structural_fallbacks"]:

@@ -92,13 +92,26 @@ def main() -> int:
         assert {n["node"] for n in nodes} == \
             {"Aggregate", "RangeFunc", "Fetch"}
 
-        # 1b. subquery fallback round trip: typed reason on the node.
-        fb_q = "max_over_time(rate(m[5m])[10m:1m])"
+        # 1b. fallback round trip: typed reason on the node (set ops
+        # stay on the interpreter; subqueries compile since round 16 —
+        # asserted as a SubqueryFunc plan node below).
+        fb_q = "m and m"
         out = _get(url("/debug/explain", query=fb_q))
         assert out["route"] == "interpreter", out
-        assert out["fallback_reason"] == "subquery", out
+        assert out["fallback_reason"] == "set-op", out
         culprits = [n for n in qexplain.walk(out["root"]) if "reason" in n]
-        assert culprits and culprits[0]["reason"] == "subquery"
+        assert culprits and culprits[0]["reason"] == "set-op"
+
+        # 1c. round-16 lowerings render their plan node kinds.
+        out = _get(url("/debug/explain",
+                       query="max_over_time(rate(m[5m])[10m:1m])"))
+        assert out["route"] == "compiled", out
+        assert any(n["node"] == "SubqueryFunc"
+                   for n in qexplain.walk(out["root"])), out
+        out = _get(url("/debug/explain", query="topk(3, m)"))
+        assert out["route"] == "compiled", out
+        assert any(n["node"] == "RankAgg"
+                   for n in qexplain.walk(out["root"])), out
 
         # 2. ?explain=true beside the data + ANALYZE stage timings.
         before = ROOT.snapshot()
@@ -115,17 +128,17 @@ def main() -> int:
         out = _get(url("/api/v1/query_range", query=fb_q, explain="true"))
         exp = out["data"]["explain"]
         assert exp["executed"]["route"] == "interpreter"
-        assert exp["executed"]["fallback_reason"] == "subquery"
+        assert exp["executed"]["fallback_reason"] == "set-op"
 
-        # 4. the reason-tagged fallback counter moved.
+        # 4. the reason+scope-tagged fallback counter moved.
         after = ROOT.snapshot()
-        key = "telemetry.plan_fallback.count{reason=subquery}"
+        key = "telemetry.plan_fallback.count{reason=set-op,scope=structural}"
         assert after.get(key, 0) > before.get(key, 0), \
-            "plan_fallback{reason=subquery} did not count"
+            "plan_fallback{reason=set-op,scope=structural} did not count"
 
         # 3. mini-corpus -> coverage number, counts sum to total.
         mixed = [compiled_q, "sum(m)", "rate(m[5m])", "m * 2",
-                 fb_q, "topk(3, m)", "m > 2e9", "m % 7"]
+                 fb_q, "sum(topk(3, m))", "m > 2e9", "m % 7"]
         with tempfile.TemporaryDirectory() as td:
             path = os.path.join(td, "corpus.jsonl")
             qcorpus.install(qcorpus.CorpusRecorder(path, sample=1.0))
@@ -145,7 +158,7 @@ def main() -> int:
                 sum(cov["structural_fallbacks"].values()) == cov["total"]
             assert cov["compiled"] == 4, cov   # the 4 compilable queries
             assert set(cov["fallbacks"]) == \
-                {"subquery", "unsupported-agg", "abs-comparison",
+                {"set-op", "unsupported-agg", "abs-comparison",
                  "f64-arith"}, cov
     finally:
         api.close()

@@ -57,10 +57,20 @@ COMPILED = [
     "rate(m[5m]) > 0.4",
     "m * on(host, i) b",                    # vector-vector match
     "sum(rate(m[5m])) > 100",
+    # round-16 lowerings, one per family:
+    "max_over_time(rate(m[5m])[30m:1m])",   # subquery (nested range grid)
+    "sum_over_time(m[30m:45s])",            # subquery, packed gather
+    "topk(3, m)",                           # rank agg (sort-select)
+    "quantile(0.5, m)",
+    "stddev by (host) (m)",                 # two-stage segment moments
+    "m * on(host) group_left c",            # one-to-many matching
+    "irate(m[5m])",                         # last-two-sample kernel
+    "timestamp(m)",
+    "quantile_over_time(0.9, m[5m])",
 ]
 
-# Deliberately non-compilable: a subquery stays on the interpreter.
-FALLBACK = "max_over_time(rate(m[5m])[10m:1m])"
+# Deliberately non-compilable: set ops stay on the interpreter.
+FALLBACK = "m and b"
 
 
 class _Storage:
@@ -98,6 +108,10 @@ def make_storage(seed=11, n=96):
             "tags": {b"__name__": b"b", b"host": b"h%d" % (i % 8),
                      b"i": str(i).encode()},
             "t": t, "v": rng.normal(10.0, 3.0, NPTS)}
+    for i in range(8):  # one per host: the "one" side for group_left
+        series[b"c-%d" % i] = {
+            "tags": {b"__name__": b"c", b"host": b"h%d" % i},
+            "t": t, "v": rng.normal(5.0, 1.0, NPTS)}
     return _Storage(series)
 
 

@@ -1,0 +1,127 @@
+"""Serving-stack smoke: the <5s check_all tier for the columnar result
+plane (query/render.py -> coordinator/http_api.py) over the round-16
+compiled lowerings. Asserts, not just times:
+
+  1. one query per NEW lowering family (subquery shared+packed, topk,
+     quantile, stddev, group_left, irate, timestamp,
+     quantile_over_time) round-trips over REAL HTTP on the compiled
+     route — no silent interpreter fallback;
+  2. every HTTP response's bytes are BYTE-IDENTICAL to the retained
+     per-series oracle (`render.render_result_ref`) for the same block
+     — the columnar frame is a renderer, not a reinterpretation;
+  3. the instant-vector columnar frame matches its oracle too, and a
+     fallback query (set op) still serves correct bytes through the
+     same columnar path.
+
+Usage: JAX_PLATFORMS=cpu python scripts/serve_smoke.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+import urllib.parse
+import urllib.request
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+S_NS = 1_000_000_000
+T0 = 1_700_000_000 * S_NS
+RES = 10 * S_NS
+NPTS = 180
+STEP = 30 * S_NS
+
+# One query per round-16 lowering family (+ two pre-existing shapes as
+# controls); each must take the compiled route over the smoke storage.
+FAMILIES = [
+    "sum by (host) (rate(m[5m]))",          # control: the PR 9 shape
+    "max_over_time(rate(m[5m])[30m:1m])",   # subquery, shared-grid able
+    "sum_over_time(m[30m:45s])",            # subquery, packed gather
+    "topk(3, m)",                           # rank agg sort-select
+    "quantile(0.5, m)",
+    "stddev by (host) (m)",
+    "m * on(host) group_left c",            # one-to-many matching
+    "irate(m[5m])",
+    "timestamp(m)",
+    "quantile_over_time(0.9, m[5m])",
+]
+
+FALLBACK = "m and b"
+
+
+def main() -> int:
+    t_start = time.perf_counter()
+    from plan_smoke import make_storage  # same seeded fixture
+
+    from m3_tpu.coordinator.http_api import HTTPApi
+    from m3_tpu.query import Engine
+    from m3_tpu.query import plan as qplan
+    from m3_tpu.query import render as qrender
+    from m3_tpu.utils.instrument import ROOT
+
+    qplan.PLAN_MIN_CELLS = 1
+    eng = Engine(make_storage())
+    api = HTTPApi(eng).serve()
+    start, end = T0 + 40 * RES, T0 + (NPTS - 1) * RES
+
+    def get(path, **params):
+        url = f"{api.endpoint}{path}?" + urllib.parse.urlencode(params)
+        with urllib.request.urlopen(url) as resp:
+            assert resp.headers["Content-Type"] == "application/json"
+            return resp.read()
+
+    try:
+        before = ROOT.snapshot().get("query.plan.executed", 0)
+        for q in FAMILIES:
+            got = get("/api/v1/query_range", query=q, start=start / S_NS,
+                      end=end / S_NS, step="30")
+            blk = eng.execute_range(q, start, end, STEP)
+            ref = qrender.render_result_ref(blk)
+            assert got == ref, (
+                f"{q}: columnar response diverged from render_result_ref "
+                f"({len(got)} vs {len(ref)} bytes)")
+            route = eng.last_route()
+            assert route and route["route"] == "compiled", \
+                f"{q}: fell back ({route})"
+        executed = ROOT.snapshot().get("query.plan.executed", 0) - before
+        # HTTP + oracle evaluation: two compiled runs per family query.
+        assert executed == 2 * len(FAMILIES), (
+            f"{executed}/{2 * len(FAMILIES)} compiled dispatches — a "
+            "family query silently fell back")
+
+        # Instant-vector columnar frame.
+        got = get("/api/v1/query", query="sum by (host) (m)",
+                  time=end / S_NS)
+        blk = eng.execute_instant("sum by (host) (m)", end)
+        assert got == qrender.render_result_ref(blk, instant=True), \
+            "instant vector columnar frame diverged"
+
+        # Fallback query: same columnar path, correct bytes.
+        got = get("/api/v1/query_range", query=FALLBACK,
+                  start=start / S_NS, end=end / S_NS, step="30")
+        blk = eng.execute_range(FALLBACK, start, end, STEP)
+        assert got == qrender.render_result_ref(blk), \
+            "fallback-route columnar frame diverged"
+        assert eng.last_route()["route"] == "interpreter"
+
+        n_bytes = len(got)
+    finally:
+        api.close()
+
+    total_s = time.perf_counter() - t_start
+    print(f"SERVE SMOKE PASS: {len(FAMILIES)} lowering families compiled "
+          f"over HTTP with columnar-vs-render_result_ref byte identity, "
+          f"instant vector + fallback frames identical "
+          f"({n_bytes}B sample), total {total_s:.1f}s")
+    budget_s = float(os.environ.get("SERVE_SMOKE_BUDGET_S", "60"))
+    assert total_s < budget_s, (
+        f"smoke tier took {total_s:.1f}s (> {budget_s:.0f}s budget)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    sys.exit(main())

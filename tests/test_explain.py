@@ -104,15 +104,19 @@ class TestFallbackTaxonomy:
 
     def test_reasons_match_expected_per_query(self):
         expected = {
-            "topk(3, m)": FallbackReason.UNSUPPORTED_AGG,
-            "quantile(0.5, m)": FallbackReason.UNSUPPORTED_AGG,
-            "irate(m[5m])": FallbackReason.UNSUPPORTED_FUNC,
-            "timestamp(m)": FallbackReason.UNSUPPORTED_FUNC,
-            "max_over_time(rate(m[5m])[10m:1m])": FallbackReason.SUBQUERY,
+            # round 16 retired topk/quantile/stddev aggs, irate/idelta/
+            # timestamp/quantile_over_time, subqueries and group
+            # matching from this table — they lower now; what remains:
+            "sum(topk(3, m))": FallbackReason.UNSUPPORTED_AGG,
+            'count_values("v", m)': FallbackReason.UNSUPPORTED_AGG,
+            "absent(m)": FallbackReason.UNSUPPORTED_FUNC,
+            "sort(m)": FallbackReason.UNSUPPORTED_FUNC,
+            "absent_over_time(m[10m:1m])": FallbackReason.UNSUPPORTED_FUNC,
+            "irate(abs(m)[10m:1m])": FallbackReason.F64_ARITH,
             "m and b": FallbackReason.SET_OP,
             "m % 7": FallbackReason.F64_ARITH,
             "m > 2e9": FallbackReason.ABS_COMPARISON,
-            "m * on(host) group_left b": FallbackReason.GROUP_MATCHING,
+            "timestamp(m) > 2e9": FallbackReason.ABS_COMPARISON,
             "m[5m]": FallbackReason.MATRIX_SELECTOR,
             "m @ 100": FallbackReason.AT_MODIFIER,
             "2 + 2": FallbackReason.SCALAR_ONLY,
@@ -124,12 +128,20 @@ class TestFallbackTaxonomy:
             assert plan is None, q
             assert err.reason is want, f"{q}: {err.reason} != {want}"
 
-    def test_telemetry_counts_reason_tagged(self, no_floor):
+    def test_retired_reasons_gone(self):
+        """Round 16: the lowered families' members are GONE from the
+        taxonomy, not parked at zero."""
+        values = {r.value for r in FallbackReason}
+        assert "subquery" not in values
+        assert "group-matching" not in values
+
+    def test_telemetry_counts_reason_and_scope_tagged(self, no_floor):
         eng = Engine(MemStorage())
         before = ROOT.snapshot()
-        eng.execute_range("topk(3, m)", START, END, STEP)
+        eng.execute_range("sum(topk(3, m))", START, END, STEP)
         after = ROOT.snapshot()
-        key = "telemetry.plan_fallback.count{reason=unsupported-agg}"
+        key = ("telemetry.plan_fallback.count"
+               "{reason=unsupported-agg,scope=structural}")
         assert after.get(key, 0) - before.get(key, 0) == 1
         assert after.get("telemetry.plan_fallback.total", 0) \
             - before.get("telemetry.plan_fallback.total", 0) == 1
@@ -139,9 +151,15 @@ class TestFallbackTaxonomy:
         before = ROOT.snapshot()
         eng.execute_range("sum(rate(m[5m]))", START, END, STEP).values
         after = ROOT.snapshot()
-        key = "telemetry.plan_fallback.count{reason=below-floor}"
+        # Satellite regression: a below-floor data-dependent miss tags
+        # scope=runtime — it must never read as a structural lowering
+        # gap (coverage_report.py's structural replay would disagree).
+        key = ("telemetry.plan_fallback.count"
+               "{reason=below-floor,scope=runtime}")
         assert after.get(key, 0) - before.get(key, 0) == 1
         assert eng.last_route()["fallback_reason"] == "below-floor"
+        assert qplan.fallback_scope("below-floor") == "runtime"
+        assert qplan.fallback_scope("unsupported-agg") == "structural"
 
     def test_plan_fallback_exception_carries_backend_gap(self):
         from m3_tpu.parallel.compile import PlanFallback
@@ -191,8 +209,8 @@ class TestExplainTree:
         assert culprits[0]["reason"] == "unsupported-agg"
 
     def test_fallback_reason_matches_lowering(self):
-        for q in ("irate(m[5m])", "m and b", "m > 2e9",
-                  "max_over_time(rate(m[5m])[10m:1m])"):
+        for q in ("sum(topk(3, m))", "m and b", "m > 2e9",
+                  "irate(abs(m)[10m:1m])"):
             out = _explain(q)
             _, err, _ = qplan.lower_and_collect(
                 promql.parse(q), PARAMS, DEFAULT_LOOKBACK_NS)
@@ -211,9 +229,9 @@ class TestSlowRingRoute:
         monkeypatch.setattr(SLOW_QUERIES, "threshold_ns", 0)
         eng = Engine(MemStorage())
         SLOW_QUERIES.clear()
-        eng.execute_range("topk(3, m)", START, END, STEP)
+        eng.execute_range("sum(topk(3, m))", START, END, STEP)
         entries = [e for e in SLOW_QUERIES.entries()
-                   if e["name"] == "topk(3, m)"]
+                   if e["name"] == "sum(topk(3, m))"]
         assert entries, "slow entry missing"
         assert entries[-1]["route"] == "interpreter"
         assert entries[-1]["plan_fallback"] == "unsupported-agg"
@@ -252,7 +270,7 @@ class TestAnalyze:
     def test_interpreter_route_stage(self, no_floor):
         eng = Engine(MemStorage())
         with qexplain.analyzing() as actx:
-            eng.execute_range("topk(3, m)", START, END, STEP)
+            eng.execute_range("sum(topk(3, m))", START, END, STEP)
         assert "interpreter_eval" in actx.to_dict()["stages_ms"]
 
     def test_disabled_is_inert(self, no_floor):
@@ -336,7 +354,7 @@ class TestCorpusRecorder:
         qcorpus.install(qcorpus.CorpusRecorder(path, sample=1.0))
         try:
             eng = Engine(MemStorage())
-            for q in ("sum by (host) (rate(m[5m]))", "topk(3, m)",
+            for q in ("sum by (host) (rate(m[5m]))", "sum(topk(3, m))",
                       "sum(m)", "m > 2e9", "sum by (host) (rate(m[5m]))"):
                 eng.execute_range(q, START, END, STEP).values
         finally:
@@ -437,11 +455,33 @@ class TestExplainHTTP:
                    for n in qexplain.walk(out["root"]))
 
     def test_debug_explain_fallback_reason(self, api):
-        out = _get(self._url(api, "max_over_time(rate(m[5m])[10m:1m])"))
+        out = _get(self._url(api, "m and b"))
         assert out["route"] == "interpreter"
-        assert out["fallback_reason"] == "subquery"
+        assert out["fallback_reason"] == "set-op"
         culprits = [n for n in qexplain.walk(out["root"]) if "reason" in n]
-        assert culprits and culprits[0]["reason"] == "subquery"
+        assert culprits and culprits[0]["reason"] == "set-op"
+
+    def test_debug_explain_new_node_kinds(self, api):
+        """Satellite: EXPLAIN shows the round-16 node kinds with their
+        mesh sharding annotations."""
+        out = _get(self._url(api, "max_over_time(rate(m[5m])[10m:1m])"))
+        assert out["route"] == "compiled"
+        nodes = {n["node"]: n for n in qexplain.walk(out["root"])}
+        assert "SubqueryFunc" in nodes
+        assert nodes["SubqueryFunc"]["sharding"] == qplan.SHARDED
+        assert "subquery" in nodes["SubqueryFunc"]["detail"]
+        assert out["mesh_ok"] is True
+
+        out = _get(self._url(api, "topk(3, m)"))
+        assert out["route"] == "compiled"
+        nodes = {n["node"]: n for n in qexplain.walk(out["root"])}
+        assert "RankAgg" in nodes
+        assert nodes["RankAgg"]["sharding"] == qplan.REPLICATED
+        assert out["mesh_ok"] is False  # cross-row sort: single-device
+
+        out = _get(self._url(api, "m * on(host) group_left c"))
+        assert out["route"] == "compiled"
+        assert out["mesh_ok"] is False  # vv gather: single-device
 
     def test_debug_explain_analyze_executes(self, api):
         out = _get(self._url(api, "sum by (host) (rate(m[5m]))",

@@ -43,10 +43,12 @@ class MemStorage:
         return out
 
 
-def make_storage(seed, n_m=24, n_b=11):
+def make_storage(seed, n_m=24, n_b=11, n_c=6):
     """Seeded mixed storage: metric `m` = counters at 1e9+ magnitude with
     interleaved gauge rows and gappy rows; metric `b` = gauges sharing
-    (host, i) labels with the first n_b rows of `m` (vector matching)."""
+    (host, i) labels with the first n_b rows of `m` (vector matching);
+    metric `c` = one gauge per host (the unique "one" side for
+    group_left/group_right matching)."""
     rng = np.random.default_rng(1000 + seed)
     st = MemStorage()
     t = T0 + np.arange(NPTS, dtype=np.int64) * RES
@@ -68,6 +70,9 @@ def make_storage(seed, n_m=24, n_b=11):
         tags = {b"__name__": b"b", b"host": b"h%d" % (i % 6),
                 b"i": str(i).encode()}
         st.add(tags, t, rng.normal(10.0, 3.0, NPTS))
+    for i in range(n_c):
+        st.add({b"__name__": b"c", b"host": b"h%d" % i}, t,
+               rng.normal(5.0, 1.0, NPTS))
     return st
 
 
@@ -95,21 +100,68 @@ COMPILED_QUERIES = [
     "m * on(host, i) b", "b + ignoring(host) b",
     "sum(m * on(host, i) b)",          # vv feeding an aggregate (padding)
     "sum(rate(m[5m])) > 100",
+    # --- round 16 lowerings ---------------------------------------
+    # instant-pair + window-order range funcs (resid-space on device)
+    "irate(m[5m])", "idelta(m[5m])",
+    "quantile_over_time(0.9, m[5m])", "quantile_over_time(0.25, m[5m])",
+    "absent_over_time(m[5m])",
+    "timestamp(m)", "timestamp(b)", "sum by (host) (timestamp(b))",
+    # subqueries: shared + packed grids, nested subquery-of-rate
+    "max_over_time(rate(m[5m])[10m:1m])",
+    "sum_over_time(m[10m:1m])",
+    "rate(rate(m[5m])[10m:1m])",       # nested subquery-of-rate
+    "avg_over_time(m[10m:90s])",       # res % step != 0: packed gather
+    "min_over_time(rate(m[5m])[7m:2m])",
+    "max_over_time(m[10m:5m])",
+    "changes(m[10m:1m])",
+    "rate(m[10m:30s])",                # shared-grid direct counter rate
+    "increase(m[15m:30s])",
+    "delta(m[10m:1m])",                # packed direct delta (no reset rule)
+    "deriv(rate(m[5m])[10m:1m])",
+    "quantile_over_time(0.5, rate(m[5m])[10m:1m])",
+    "irate(m[10m:1m])",
+    "last_over_time(m[10m:1m])",
+    "sum(max_over_time(rate(m[5m])[10m:1m]))",
+    # rank aggregations (packed sort-select)
+    "topk(3, m)", "bottomk(2, m)", "topk(2, rate(m[5m]))",
+    "quantile(0.5, m)", "quantile(0.9, rate(m[5m]))",
+    "quantile by (host) (0.5, m)",
+    # stddev/stdvar aggregations (two-stage segment moments)
+    "stddev(m)", "stdvar(m)", "stddev by (host) (m)",
+    "stdvar without (i) (m)", "stddev(rate(m[5m]))",
+    # group_left / group_right one-to-many matching
+    "m * on(host) group_left c",
+    "c * on(host) group_right m",
+    "m / on(host) group_left c",
+    "sum by (host) (m * on(host) group_left c)",
 ]
 
 # Outside the compiled surface: per-node interpreter fallback.
 FALLBACK_QUERIES = [
-    "irate(m[5m])", "idelta(m[5m])", "quantile_over_time(0.9, m[5m])",
-    "topk(3, m)", "quantile(0.5, m)", "stddev(m)",
-    "max_over_time(rate(m[5m])[10m:1m])", "absent_over_time(m[5m])",
-    "m % 7", "m ^ 2", "m and b", "timestamp(m)",
+    "sum(topk(3, m))",                 # non-root topk/bottomk
+    "avg(bottomk(2, m))",
+    'count_values("val", m)',
+    "m % 7", "m ^ 2", "m and b", "m or b", "m unless b",
+    "absent(m)", "sort(m)",
+    # absent_over_time's selector-row semantics stay host-side over
+    # subqueries; composite absolute-magnitude subquery planes can't
+    # difference at f32 granularity (F64_ARITH).
+    "absent_over_time(m[10m:1m])",
+    "irate(abs(m)[10m:1m])",
+    "deriv(abs(m)[10m:1m])",
+    # Counter rates over PACKED-grid subqueries of absolute planes: the
+    # interpreter's packed layout manufactures cross-window resets whose
+    # 1e9-magnitude adjustments cancel only in its own f32 noise — not
+    # reproducible faithfully, so these stay interpreted (shared-grid
+    # forms above compile).
+    "rate(m[10m:1m])", "increase(m[10m:1m])",
     # Comparisons over absolute-magnitude planes stay on the
     # interpreter: at 1e9+ counter values an f32 device compare can flip
     # sample PRESENCE vs the interpreter's f64 compare — a discrete
     # divergence no FP tolerance covers (rate-space comparisons above
-    # stay compiled).
+    # stay compiled). timestamp planes are unix seconds — same regime.
     "m > 2e9", "sum_over_time(m[5m]) > 6e10", "abs(m) >= 1e9",
-    "sum(m) > 1e10",
+    "sum(m) > 1e10", "timestamp(m) > 1.7e9",
 ]
 
 # FP-tolerance per function family: the compiled plan computes on f32
@@ -117,7 +169,11 @@ FALLBACK_QUERIES = [
 # amplifies f32 rounding through a cancelling denominator.
 _LOOSE = {"predict_linear": dict(rtol=2e-3, atol=1e-2),
           "holt_winters": dict(rtol=2e-3, atol=1e-2),
-          "deriv": dict(rtol=1e-3, atol=1e-4)}
+          "deriv": dict(rtol=1e-3, atol=1e-4),
+          # nested subquery-of-rate: both routes difference the same
+          # f32 inner rate plane, but fusion order differs — diffs of
+          # near-equal small values amplify the last-ulp disagreement
+          "rate(rate": dict(rtol=2e-3, atol=1e-5)}
 
 
 def _tol(query, ref):
@@ -270,10 +326,10 @@ class TestFallback:
     def test_non_lowerable_query_never_binds(self, no_floor):
         eng = Engine(make_storage(105))
         before = ROOT.snapshot().get("query.plan.executed", 0)
-        got = eng.execute_range("topk(2, m)", START, END, STEP)
+        got = eng.execute_range("sum(topk(2, m))", START, END, STEP)
         assert ROOT.snapshot().get("query.plan.executed", 0) == before
-        ref = eng.execute_range_ref("topk(2, m)", START, END, STEP)
-        assert_matches_oracle(got, ref, "topk(2, m)")
+        ref = eng.execute_range_ref("sum(topk(2, m))", START, END, STEP)
+        assert_matches_oracle(got, ref, "sum(topk(2, m))")
 
     def test_route_tagged_on_query_span(self, no_floor):
         from m3_tpu.utils import tracing
@@ -282,7 +338,7 @@ class TestFallback:
         with tracing.span("test_root") as sp:
             eng.execute_range("sum by (host) (rate(m[5m]))", START, END,
                               STEP).values
-            eng.execute_range("topk(2, m)", START, END, STEP)
+            eng.execute_range("sum(topk(2, m))", START, END, STEP)
         routes = [c.tags.get("route") for c in sp.children
                   if c.name == "query.execute_range"]
         assert routes == ["plan", "interpreter"]
@@ -332,6 +388,119 @@ class TestLazyMaterialization:
         assert vals.shape == (len(blk.series_tags), blk.meta.steps)
         ref = eng.execute_range_ref("rate(m[5m])", START, END, STEP)
         assert_matches_oracle(blk, ref, "rate(m[5m])")
+
+
+class TestRound16Lowerings:
+    """Edge cases of the round-16 lowerings: topk ties, group_left
+    label-copy collisions, irate across block-boundary gaps, quantile
+    over all-NaN windows — each against the interpreter oracle."""
+
+    def test_topk_ties_stable_order(self, no_floor):
+        # Exactly-equal values: both routes must break ties by original
+        # row order (stable sort within the group).
+        st = MemStorage()
+        t = T0 + np.arange(NPTS, dtype=np.int64) * RES
+        for i in range(8):
+            st.add({b"__name__": b"m", b"host": b"h", b"i": str(i).encode()},
+                   t, np.full(NPTS, 7.0))  # all tied
+        eng = Engine(st)
+        got = eng.execute_range("topk(3, m)", START, END, STEP)
+        ref = eng.execute_range_ref("topk(3, m)", START, END, STEP)
+        assert_matches_oracle(got, ref, "topk(3, m) ties")
+        assert got.n_series == 3  # first three rows win every step
+
+    def test_bottomk_ties_with_nan_rows(self, no_floor):
+        st = MemStorage()
+        t = T0 + np.arange(NPTS, dtype=np.int64) * RES
+        for i in range(6):
+            v = np.full(NPTS, float(i % 2))
+            if i == 4:
+                v = np.full(NPTS, np.nan)  # never sampled -> dropped
+            st.add({b"__name__": b"m", b"host": b"h",
+                    b"i": str(i).encode()}, t, v)
+        eng = Engine(st)
+        got = eng.execute_range("bottomk(2, m)", START, END, STEP)
+        ref = eng.execute_range_ref("bottomk(2, m)", START, END, STEP)
+        assert_matches_oracle(got, ref, "bottomk(2, m) ties+nan")
+
+    def test_group_left_label_copy_collision(self, no_floor):
+        """group_left(i) copies label i from a 'one' side that lacks it:
+        the result rows collapse onto duplicate label sets — legal in
+        one-to-many matching (no one-to-one duplicate raise), and both
+        routes must emit the same multiset of (labels, values) rows."""
+        st = MemStorage()
+        t = T0 + np.arange(NPTS, dtype=np.int64) * RES
+        for i in range(4):
+            st.add({b"__name__": b"m", b"host": b"h0",
+                    b"i": str(i).encode()}, t,
+                   np.full(NPTS, 10.0 + i))
+        st.add({b"__name__": b"c", b"host": b"h0"}, t, np.full(NPTS, 2.0))
+        eng = Engine(st)
+        q = "m * on(host) group_left(i) c"
+        got = eng.execute_range(q, START, END, STEP)
+        ref = eng.execute_range_ref(q, START, END, STEP)
+
+        def rowset(blk):
+            return sorted(
+                (bytes(tags.id()), np.asarray(vals, np.float32).tobytes())
+                for tags, vals in zip(blk.series_tags, blk.values))
+
+        assert rowset(got) == rowset(ref)
+
+    def test_irate_across_block_boundary_gaps(self, no_floor):
+        # Alternating long gaps: windows that straddle a gap see their
+        # last two samples at uneven spacing; some windows hold < 2.
+        st = MemStorage()
+        t = T0 + np.arange(NPTS, dtype=np.int64) * RES
+        rng = np.random.default_rng(99)
+        for i in range(12):
+            keep = np.ones(NPTS, bool)
+            keep[(np.arange(NPTS) // 7) % 2 == i % 2] = False
+            keep[0] = True
+            v = 1e9 + np.cumsum(rng.poisson(3.0, NPTS)).astype(np.float64)
+            st.add({b"__name__": b"m", b"host": b"h",
+                    b"i": str(i).encode()}, t[keep], v[keep])
+        eng = Engine(st)
+        for q in ("irate(m[2m])", "idelta(m[2m])"):
+            got = eng.execute_range(q, START, END, STEP)
+            ref = eng.execute_range_ref(q, START, END, STEP)
+            assert_matches_oracle(got, ref, q)
+
+    def test_quantile_over_time_nan_windows(self, no_floor):
+        st = MemStorage()
+        t = T0 + np.arange(NPTS, dtype=np.int64) * RES
+        rng = np.random.default_rng(7)
+        for i in range(10):
+            keep = rng.random(NPTS) > 0.6  # sparse: many empty windows
+            keep[0] = True
+            st.add({b"__name__": b"m", b"host": b"h",
+                    b"i": str(i).encode()},
+                   t[keep], rng.normal(50.0, 10.0, int(keep.sum())))
+        eng = Engine(st)
+        for q in ("quantile_over_time(0, m[2m])",
+                  "quantile_over_time(1, m[2m])",
+                  "quantile_over_time(0.37, m[2m])"):
+            got = eng.execute_range(q, START, END, STEP)
+            ref = eng.execute_range_ref(q, START, END, STEP)
+            assert_matches_oracle(got, ref, q)
+
+    def test_absent_over_time_empty_selector(self):
+        eng = Engine(make_storage(120, n_m=2, n_b=0, n_c=0))
+        q = "absent_over_time(nosuch[5m])"
+        got = eng.execute_range(q, START, END, STEP)
+        ref = eng.execute_range_ref(q, START, END, STEP)
+        assert_matches_oracle(got, ref, q)
+
+    def test_subquery_shared_vs_packed_geometry(self, no_floor):
+        """The same subquery at a step that divides the resolution
+        (shared grid) and one that doesn't (packed gather) both match
+        the oracle."""
+        eng = Engine(make_storage(121))
+        q = "max_over_time(rate(m[5m])[10m:1m])"
+        for step in (60 * S, 30 * S, 45 * S):
+            got = eng.execute_range(q, START, END, step)
+            ref = eng.execute_range_ref(q, START, END, step)
+            assert_matches_oracle(got, ref, f"{q} @step={step}")
 
 
 class TestExplainCorpus:

@@ -1748,6 +1748,111 @@ class TestFlushCallbackLoop:
                     "m3_tpu/aggregator/list.py") == []
 
 
+class TestPerSeriesResultDict:
+    """per-series-result-dict: per-row dict materialization inside
+    result-path functions on the serving tree (coordinator/ query/
+    rpc/); `_ref`-named oracles exempt (render_rules.py)."""
+
+    PATH = "m3_tpu/coordinator/http_api.py"
+
+    def test_flags_pre_change_matrix_renderer(self):
+        # The EXACT pre-change coordinator renderer: one dict per
+        # series, one [t, "v"] list per sample — the seeded positive
+        # (bench r16 measured it at 1.07 responses/sec).
+        src = '''
+            import numpy as np
+
+            def _prom_matrix(block):
+                times = block.meta.times() / 1e9
+                result = []
+                for tags, row in zip(block.series_tags, block.values):
+                    finite = np.isfinite(row)
+                    if not finite.any():
+                        continue
+                    values = [[float(t), str(v)]
+                              for t, v, ok in zip(times, row, finite) if ok]
+                    result.append({"metric": dict(tags), "values": values})
+                return {"status": "success",
+                        "data": {"resultType": "matrix", "result": result}}
+        '''
+        from m3_tpu.analysis.render_rules import PerSeriesResultDictRule
+
+        found = lint(src, PerSeriesResultDictRule(), self.PATH)
+        assert rule_ids(found) == ["per-series-result-dict"]
+        assert "_prom_matrix" in found[0].message
+
+    def test_flags_dict_comprehension_and_yield(self):
+        from m3_tpu.analysis.render_rules import PerSeriesResultDictRule
+
+        src = """
+            def render_series_result(block):
+                return [{"metric": t, "values": list(r)}
+                        for t, r in zip(block.series_tags, block.values)]
+        """
+        assert rule_ids(lint(src, PerSeriesResultDictRule(), self.PATH)) \
+            == ["per-series-result-dict"]
+        src = """
+            def vector_rows(block):
+                for t, r in zip(block.series_tags, block.values):
+                    yield {"metric": t, "value": r[-1]}
+        """
+        assert rule_ids(lint(src, PerSeriesResultDictRule(), self.PATH)) \
+            == ["per-series-result-dict"]
+
+    def test_ref_oracles_exempt(self):
+        from m3_tpu.analysis.render_rules import PerSeriesResultDictRule
+
+        src = """
+            def prom_matrix_ref(block):
+                result = []
+                for tags, row in zip(block.series_tags, block.values):
+                    result.append({"metric": dict(tags),
+                                   "values": list(row)})
+                return result
+        """
+        assert lint(src, PerSeriesResultDictRule(), self.PATH) == []
+
+    def test_columnar_renderer_and_nonresult_functions_pass(self):
+        from m3_tpu.analysis.render_rules import PerSeriesResultDictRule
+
+        # Columnar renderer: string chunks per series, no dicts.
+        src = """
+            def prom_matrix_bytes(block):
+                chunks = []
+                for r in range(len(block.series_tags)):
+                    chunks.append("{...}")
+                return ", ".join(chunks).encode()
+        """
+        assert lint(src, PerSeriesResultDictRule(), self.PATH) == []
+        # Non-result-path function names are out of scope even with
+        # per-row dicts (identity/tag metadata assembly is host work).
+        src = """
+            def rpc_fetch_tagged(ids):
+                out = []
+                for sid in ids:
+                    out.append({"id": sid, "tags": {}})
+                return out
+        """
+        assert lint(src, PerSeriesResultDictRule(), self.PATH) == []
+
+    def test_out_of_scope_dirs_and_suppression(self):
+        from m3_tpu.analysis.render_rules import PerSeriesResultDictRule
+
+        src = """
+            def render_result(rows):
+                return [{"r": r} for r in rows]
+        """
+        # aggregator/ is not on the serving result plane.
+        assert lint(src, PerSeriesResultDictRule(),
+                    "m3_tpu/aggregator/flush.py") == []
+        suppressed = """
+            def render_result(rows):
+                # m3lint: disable=per-series-result-dict
+                return [{"r": r} for r in rows]
+        """
+        assert lint(suppressed, PerSeriesResultDictRule(), self.PATH) == []
+
+
 class TestPerEntryReplay:
     """per-entry-replay: per-row registry/buffer loops on the recovery
     data plane (storage/bootstrap.py, persist/commitlog.py,
