@@ -33,6 +33,15 @@ if _os.environ.get("M3_TPU_LOCKDEP", "") not in ("", "0"):
 
     _lockdep.install()
 
+if _os.environ.get("M3_TPU_NUMERICS", "") not in ("", "0"):
+    # Runtime numerics witness (utils/numwatch.py): arms the jit-builder
+    # result observation points (plan compiler host finish, aggregator
+    # quantile gather) and the exit dump. Smoke tiers only — observation
+    # materializes padded planes. Opt-in — costs one bool read when off.
+    from .utils import numwatch as _numwatch
+
+    _numwatch.install()
+
 if _os.environ.get("M3_TPU_JAX_PLATFORM"):
     # Hard platform override (e.g. "cpu" for hermetic service runs/CI).
     # The env var JAX_PLATFORMS alone does not stop out-of-tree plugin

@@ -243,8 +243,8 @@ def index_functions(mod: Module) -> Dict[str, ast.FunctionDef]:
 
 def _registry() -> List[Rule]:
     from . import (batch_rules, cache_rules, hbm_rules, jax_rules,
-                   lifecycle_rules, lock_rules, obs_rules, overload_rules,
-                   render_rules, replay_rules, retry_rules)
+                   lifecycle_rules, lock_rules, numeric_rules, obs_rules,
+                   overload_rules, render_rules, replay_rules, retry_rules)
 
     return [
         *cache_rules.RULES,
@@ -258,6 +258,7 @@ def _registry() -> List[Rule]:
         *replay_rules.RULES,
         *render_rules.RULES,
         *lifecycle_rules.RULES,
+        *numeric_rules.RULES,
     ]
 
 

@@ -552,5 +552,201 @@ class CrossModuleTaintRule:
         return fi.module, fi.node, hit
 
 
+class MeshSpecRule(Rule):
+    """mesh-axis-unbound / shard-spec-arity / unannotated-out-sharding:
+    shard_map spec consistency for the mesh kernels.
+
+    * `mesh-axis-unbound` — a psum/pmin/pmax/pmean/all_gather collective
+      naming an axis that appears NOWHERE in the module's mesh
+      declarations (`Mesh(devs, ("shard", "time"))`) or partition specs
+      (`P("shard", None)`, nested tuples included). An unbound axis name
+      raises at trace time on the real mesh — but only on the code path
+      that dispatches sharded, which a single-device CI run never takes.
+    * `shard-spec-arity` — `shard_map(_compat)(fn, ..., in_specs=(...))`
+      whose static in_specs tuple arity disagrees with the wrapped local
+      function's positional parameter count.
+    * `unannotated-out-sharding` — in parallel/compile.py ONLY: an
+      out_specs entry carrying a sharded `P("shard", ...)` that is not
+      conditioned on the plan IR's edge annotation (an `... if
+      <edge>.sharding == SHARDED else ...` binding). The plan compiler's
+      out-sharding must mirror the SHARDED/REPLICATED edge the IR
+      recorded, or a replicated root is scattered (and a sharded one
+      gathered) behind the annotation's back.
+    """
+
+    id = "mesh-spec"  # umbrella; findings carry their specific ids
+    severity = "error"
+    dirs = ("parallel", "ops")
+    requires_import = "jax"
+
+    _SHARD_MAP_NAMES = ("shard_map", "shard_map_compat", "jax.shard_map",
+                        "exp_shard_map",
+                        "jax.experimental.shard_map.shard_map")
+    _COLLECTIVES = ("psum", "pmin", "pmax", "pmean", "all_gather",
+                    "axis_index", "ppermute")
+    _MESH_NAMES = ("Mesh", "jax.sharding.Mesh", "jax.make_mesh")
+    _SPEC_NAMES = ("P", "PartitionSpec", "jax.sharding.PartitionSpec")
+
+    @classmethod
+    def _spec_axis_names(cls, node: ast.AST) -> Set[str]:
+        """String constants inside a P(...)/PartitionSpec(...) call
+        (tuple-grouped axes like P(("shard", "time")) included)."""
+        out: Set[str] = set()
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call) and qualname(n.func) in cls._SPEC_NAMES:
+                for a in ast.walk(ast.Tuple(elts=list(n.args), ctx=ast.Load())):
+                    if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                        out.add(a.value)
+        return out
+
+    @classmethod
+    def _axis_vocabulary(cls, mod: Module) -> Set[str]:
+        """Axis names DECLARED anywhere in the module: mesh axis tuples
+        and partition-spec literals."""
+        out: Set[str] = set()
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            q = qualname(node.func)
+            if q in cls._MESH_NAMES:
+                cands = list(node.args[1:]) + [kw.value for kw in node.keywords
+                                               if kw.arg == "axis_names"]
+                for c in cands:
+                    for a in ast.walk(c):
+                        if isinstance(a, ast.Constant) and \
+                                isinstance(a.value, str):
+                            out.add(a.value)
+        out |= cls._spec_axis_names(mod.tree)
+        return out
+
+    @staticmethod
+    def _local_bindings(fn: ast.AST) -> Dict[str, ast.AST]:
+        """name -> value for names assigned exactly once in `fn`."""
+        out: Dict[str, ast.AST] = {}
+        dup: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name):
+                name = node.targets[0].id
+                if name in out:
+                    dup.add(name)
+                out[name] = node.value
+            elif isinstance(node, ast.AugAssign) and \
+                    isinstance(node.target, ast.Name):
+                dup.add(node.target.id)
+        for name in dup:
+            out.pop(name, None)
+        return out
+
+    def _deref_binding(self, node: ast.AST, bindings: Dict[str, ast.AST],
+                       depth: int = 2) -> ast.AST:
+        while depth > 0 and isinstance(node, ast.Name) and \
+                node.id in bindings:
+            node = bindings[node.id]
+            depth -= 1
+        return node
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        vocab = self._axis_vocabulary(mod)
+        by_name = _index_all_functions(mod)
+        in_compile = bool(mod.scope_parts) and \
+            mod.scope_parts[-1] == "compile.py"
+
+        # collective axis names must exist on some declared mesh/spec
+        if vocab:
+            for node in ast.walk(mod.tree):
+                if not (isinstance(node, ast.Call) and
+                        isinstance(node.func, ast.Attribute) and
+                        node.func.attr in self._COLLECTIVES):
+                    continue
+                axis = None
+                if len(node.args) > 1:
+                    axis = node.args[1]
+                elif node.args and isinstance(node.args[0], ast.Constant):
+                    axis = node.args[0]  # axis_index("shard")
+                for kw in node.keywords:
+                    if kw.arg == "axis_name":
+                        axis = kw.value
+                if not (isinstance(axis, ast.Constant) and
+                        isinstance(axis.value, str)):
+                    continue
+                if axis.value not in vocab:
+                    yield Finding(
+                        "mesh-axis-unbound", mod.relpath, node.lineno,
+                        f"`{node.func.attr}` over axis "
+                        f"{axis.value!r} which is bound by NO mesh or "
+                        f"partition spec in this module (declared axes: "
+                        f"{sorted(vocab)}) — this raises at trace time "
+                        "on the sharded dispatch path only; name an "
+                        "axis the bound mesh carries", self.severity)
+
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and qualname(node.func) in self._SHARD_MAP_NAMES
+                    and node.args):
+                continue
+            enclosing = mod.enclosing_function(node)
+            bindings = self._local_bindings(enclosing) if enclosing else {}
+            in_specs = None
+            out_specs = None
+            for kw in node.keywords:
+                if kw.arg == "in_specs":
+                    in_specs = self._deref_binding(kw.value, bindings)
+                elif kw.arg == "out_specs":
+                    # resolve a name-bound tuple so its ELEMENTS (which
+                    # keep their IfExp bindings) are what get checked
+                    out_specs = self._deref_binding(kw.value, bindings)
+            # arity: static in_specs tuple vs the wrapped local def
+            target = node.args[0]
+            fn_def = None
+            if isinstance(target, ast.Name):
+                fn_def = _resolve(target.id, node.lineno, by_name)
+            if fn_def is not None and isinstance(in_specs, ast.Tuple) \
+                    and fn_def.args.vararg is None:
+                n_params = len(fn_def.args.posonlyargs) + \
+                    len(fn_def.args.args)
+                n_defaults = len(fn_def.args.defaults)
+                n_specs = len(in_specs.elts)
+                if n_specs > n_params or n_specs < n_params - n_defaults:
+                    yield Finding(
+                        "shard-spec-arity", mod.relpath, node.lineno,
+                        f"in_specs carries {n_specs} spec(s) "
+                        f"but {fn_def.name!r} takes {n_params} positional "
+                        "argument(s) — shard_map raises a tree mismatch "
+                        "at trace time on the sharded path", self.severity)
+            # compile.py: out-sharding must follow the edge annotation
+            if in_compile and out_specs is not None:
+                elems = (list(out_specs.elts)
+                         if isinstance(out_specs, ast.Tuple) else [out_specs])
+                for el in elems:
+                    resolved = self._deref_binding(el, bindings)
+                    if not self._spec_axis_names(resolved):
+                        continue  # replicated P() — nothing to annotate
+                    if self._edge_conditioned(el, resolved):
+                        continue
+                    at = el if hasattr(el, "lineno") else node
+                    yield Finding(
+                        "unannotated-out-sharding", mod.relpath,
+                        getattr(at, "lineno", node.lineno),
+                        "sharded out_specs entry is not derived from the "
+                        "plan IR's edge annotation — bind it as "
+                        "`P(\"shard\", ...) if <edge>.sharding == SHARDED "
+                        "else P()` so the program's out-sharding mirrors "
+                        "the SHARDED/REPLICATED edge the plan recorded",
+                        self.severity)
+
+    @staticmethod
+    def _edge_conditioned(orig: ast.AST, resolved: ast.AST) -> bool:
+        """The spec binding is an IfExp whose test reads an edge's
+        `.sharding` annotation."""
+        for cand in (orig, resolved):
+            if isinstance(cand, ast.IfExp):
+                for n in ast.walk(cand.test):
+                    if isinstance(n, ast.Attribute) and \
+                            n.attr == "sharding":
+                        return True
+        return False
+
+
 RULES: List[Rule] = [JaxPurityRule(), NonStaticJitCacheRule(),
-                     ItemInLoopRule()]
+                     ItemInLoopRule(), MeshSpecRule()]

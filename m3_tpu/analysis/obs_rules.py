@@ -37,8 +37,9 @@ Rules:
   host-sync-in-plan    a host synchronization (`np.asarray`,
                        `jax.device_get`, `.item()`) inside the whole-plan
                        compiler's lowering surface (parallel/compile.py's
-                       `_lower_*` / `_emit` rules and the traced `body`
-                       they build). The lowering rules run UNDER JAX
+                       `_lower_*` / `_emit` rules, the traced `body` they
+                       build, and the round-16 SubqueryFunc/RankAgg
+                       helpers `_range_body` / `_sub_gather`). The lowering rules run UNDER JAX
                        TRACE: a host sync there re-introduces the per-op
                        "dispatch one kernel, pull the result to the host,
                        dispatch the next" round trip the plan compiler
@@ -154,7 +155,12 @@ class HostSyncInPlanRule(Rule):
     severity = "error"
     dirs = ("parallel",)
 
-    _LOWER_NAMES = ("_emit", "body")
+    # Named lowering helpers beyond the `_lower_*` prefix: `_emit` and
+    # the traced `body` (PR 9), plus the round-16 SubqueryFunc/RankAgg
+    # helpers — `_range_body` (the shared windowed-kernel ladder every
+    # RangeFunc/SubqueryFunc lowering routes through) and `_sub_gather`
+    # (the packed-window gather) — all of which run under jax trace.
+    _LOWER_NAMES = ("_emit", "body", "_range_body", "_sub_gather")
 
     @classmethod
     def _is_lowering_fn(cls, node: ast.AST) -> bool:

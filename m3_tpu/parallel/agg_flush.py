@@ -36,6 +36,7 @@ from jax.sharding import PartitionSpec as P
 from . import telemetry
 from .ingest import flush_mesh, shard_map_compat
 from ..ops import aggregation as agg
+from ..utils import numwatch
 
 # Pad the value axis to lane multiples to limit recompiles. MUST match
 # aggregator/list.py's _LANE: the oracle's single-device tile and the
@@ -134,4 +135,10 @@ def exact_quantile_values(buckets, counts: np.ndarray, qs: tuple):
                           np.maximum(counts - 1, 0)[:, None])
     vals = cat[starts[:, None] + safe_idx]
     vals[counts == 0] = 0.0
+    if numwatch.installed():
+        # Numerics witness: live rows (count > 0) carry the gathered
+        # exact values; count-0 rows must be exactly zero (the
+        # stream.go:145-146 empty convention) — a non-zero there means
+        # a padding row's ordering index leaked into the gather.
+        numwatch.observe_rows("agg_flush", vals, counts > 0)
     return vals

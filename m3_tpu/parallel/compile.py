@@ -56,6 +56,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import telemetry
 from ..ops import series_agg, temporal
+from ..utils import numwatch
 from ..query import explain as qexplain
 from ..query import plan as qplan
 from ..query import promql
@@ -290,7 +291,12 @@ def _stage_fetch(bf: "qplan.BoundFetch", kinds: Tuple[str, ...],
                     arrs.append(grid32)
             elif kind == "resid":
                 resid, base = temporal.center(gp)
-                arrs += [resid, base.astype(np.float32)]
+                # DELIBERATE downcast: base32 feeds only the device
+                # plane (predict_linear/holt_winters adds); the exact
+                # f64 baseline mass is re-derived on the host by
+                # _exact_base_contrib from the same grid, so nothing
+                # the f32 copy drops ever reaches a counter sum.
+                arrs += [resid, base.astype(np.float32)]  # m3lint: disable=f64-downcast-on-exact-path
             elif kind == "value2":
                 # Exact double-f32 split of the f64 grid: hi + lo
                 # round-trips the value to ~2e-4 absolute, and the lo
@@ -971,6 +977,17 @@ def execute(bound: "qplan.Bound", mesh: Optional[Mesh]):
     # --- host finish
     steps = plan.steps
     root = plan.root
+    if numwatch.installed():
+        # Numerics witness (M3_TPU_NUMERICS=1, smoke tiers only):
+        # observe the PADDED program output before the host slices it —
+        # live lanes are the bound result rows x real steps, and every
+        # padding ROW past them must still be NaN (a finite value there
+        # means a padding lane's value survived the masks).
+        numwatch.observe_result(
+            "plan", root_val,
+            live_rows=(None if root.edge.kind == SCALAR
+                       else len(bound.out_tags)),
+            live_cols=steps)
     if root.edge.kind == SCALAR:
         val = np.asarray(root_val, dtype=np.float64)
         return np.full(steps, float(val)), bound.out_tags, None

@@ -162,6 +162,30 @@ echo "== aggregator smoke (<5s; mesh-vs-ref bit-equality, one-publish-per-destin
 JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   python scripts/agg_smoke.py
 
+echo "== numerics witness (plan+agg smokes under M3_TPU_NUMERICS=1; witnessed ⊆ static-accepted, padding lanes never finite) =="
+# Runtime numerics witness (utils/numwatch.py): re-run the two
+# kernel-heavy smokes with the jit-builder result observation points
+# armed — every compiled plan's padded output plane and every
+# aggregator quantile gather is checked (no finite value in a padding
+# row, count-0 rows exactly zero, NaN/inf in live lanes only where the
+# static numerics pass derives acceptance from the module ASTs:
+# m3_tpu/analysis/numeric_rules.accepted_witness). Closes the
+# static/runtime loop the lockdep tier closes for lock discipline.
+# Wall budget via NUMERICS_SMOKE_BUDGET_S (feeds both smokes' budgets).
+( NUM_OUT=$(mktemp -d)
+  trap 'rm -rf "$NUM_OUT"' EXIT  # cleanup on failure too (set -e)
+  if [ -n "${NUMERICS_SMOKE_BUDGET_S:-}" ]; then
+    export PLAN_SMOKE_BUDGET_S="$NUMERICS_SMOKE_BUDGET_S"
+    export AGG_SMOKE_BUDGET_S="$NUMERICS_SMOKE_BUDGET_S"
+  fi
+  export M3_TPU_NUMERICS=1 M3_TPU_NUMERICS_OUT="$NUM_OUT"
+  JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python scripts/plan_smoke.py
+  JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python scripts/agg_smoke.py
+  unset M3_TPU_NUMERICS
+  python scripts/numerics_check.py "$NUM_OUT" )
+
 echo "== test suite =="
 python -m pytest tests/ -x -q
 

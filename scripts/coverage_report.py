@@ -73,7 +73,30 @@ def main(argv) -> int:
         print(f"INCONSISTENT: recorded {rec_sum} / structural "
               f"{struct_sum} != total {cov['total']}")
         return 1
-    print(f"\nconsistent: per-reason counts sum to {cov['total']} queries")
+
+    # The scope split must PARTITION the recorded fallbacks: every
+    # reason is wholly runtime or wholly structural, runtime reasons
+    # carry their full per-reason count, and the two scopes sum back to
+    # the fallback total — so a future taxonomy edit (a reason counted
+    # into both scopes, or a partial runtime count) can't silently
+    # double-count or drop queries.
+    fb = cov["fallbacks"]
+    bad = [r for r, n in runtime.items()
+           if r not in fb or n != fb[r]]
+    if bad:
+        print(f"INCONSISTENT: runtime-scope counts disagree with the "
+              f"per-reason totals for {sorted(bad)}")
+        return 1
+    structural_scope = sum(n for r, n in fb.items() if r not in runtime)
+    split_sum = sum(runtime.values()) + structural_scope
+    if split_sum != sum(fb.values()):
+        print(f"INCONSISTENT: scope split runtime {sum(runtime.values())} "
+              f"+ structural {structural_scope} = {split_sum} != "
+              f"fallback total {sum(fb.values())}")
+        return 1
+    print(f"\nconsistent: per-reason counts sum to {cov['total']} queries "
+          f"({sum(runtime.values())} runtime-scope + {structural_scope} "
+          "structural-scope fallbacks)")
     return 0
 
 

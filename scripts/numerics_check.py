@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""numerics_check: verify runtime-witnessed numerics findings against
+the static numerics pass's accepted set.
+
+Usage: python scripts/numerics_check.py <dump-dir-or-files...>
+
+Loads every numerics-witness JSON dump (utils/numwatch.py, one per
+witnessed process — the check_all numerics tier runs the plan and agg
+smokes under M3_TPU_NUMERICS=1), then asserts the tier's contracts:
+
+  1. The witness actually OBSERVED result planes (a silently-disarmed
+     witness must fail the tier, not pass it vacuously).
+  2. Every witnessed (site, kind) finding is in the STATIC pass's
+     accepted set (m3_tpu/analysis/numeric_rules.accepted_witness —
+     derived from the AST of each site's modules, never hand-listed):
+     NaN in live lanes only where the module provably treats NaN as its
+     missing-value domain, inf only where the lowered op table divides.
+  3. The padding kinds are NEVER accepted: a finite value in a padding
+     row ("pad-finite") or a non-zero count-0 quantile row
+     ("pad-nonzero") is a hard failure — that is the NaN-row/-1-index
+     padding contract the sentinel-taint rules gate statically.
+
+Exit status: 0 green; 1 on unaccepted findings; 2 on padding-contract
+violations (or an empty/unobserved witness).
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+_PAD_KINDS = ("pad-finite", "pad-nonzero")
+
+
+def load_dumps(paths):
+    files = []
+    for p in paths:
+        pp = pathlib.Path(p)
+        if pp.is_dir():
+            files.extend(sorted(pp.glob("numerics-*.json")))
+        else:
+            files.append(pp)
+    dumps = []
+    for f in files:
+        with open(f, encoding="utf-8") as fh:
+            dumps.append((str(f), json.load(fh)))
+    return dumps
+
+
+def main(argv) -> int:
+    if not argv:
+        print(__doc__)
+        return 2
+
+    from m3_tpu.analysis import numeric_rules
+    from m3_tpu.utils import numwatch
+
+    dumps = load_dumps(argv)
+    if not dumps:
+        print("numerics_check: NO witness dumps found — was "
+              "M3_TPU_NUMERICS=1 / M3_TPU_NUMERICS_OUT set?")
+        return 2
+
+    observed = 0
+    witnessed = []
+    for path, payload in dumps:
+        n = int(payload.get("observed", 0))
+        got = payload.get("findings", [])
+        observed += n
+        witnessed.extend(got)
+        print(f"{path}: observed {n} plane(s), {len(got)} finding kind(s)")
+    if observed == 0:
+        print("numerics_check: witness observed ZERO result planes — "
+              "the hooks never fired (vacuous pass refused)")
+        return 2
+
+    accepted = numeric_rules.accepted_witness(str(REPO / "m3_tpu"))
+    print(f"static accepted set: {sorted(accepted)}")
+
+    hard = [f for f in witnessed if f["kind"] in _PAD_KINDS]
+    soft = [f for f in numwatch.unaccepted(witnessed, accepted)
+            if f["kind"] not in _PAD_KINDS]
+
+    for f in hard:
+        print(f"PADDING CONTRACT VIOLATION: site={f['site']} "
+              f"kind={f['kind']} x{f['count']}: {f['detail']}")
+    for f in soft:
+        print(f"UNACCEPTED: site={f['site']} kind={f['kind']} "
+              f"x{f['count']}: {f['detail']} — not in the static pass's "
+              "accepted set")
+
+    if hard:
+        return 2
+    if soft:
+        return 1
+    kinds = sorted({(f["site"], f["kind"]) for f in witnessed})
+    print(f"numerics_check: OK — {observed} plane(s) observed across "
+          f"{len(dumps)} process(es); witnessed kinds {kinds} ⊆ accepted")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

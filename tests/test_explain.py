@@ -528,3 +528,66 @@ class TestExplainHTTP:
                   "step": "30"}
         out = _get(f"{api.endpoint}/api/v1/query_range?{urlencode(params)}")
         assert "explain" not in out["data"]
+
+
+class TestCoverageReportScopeSplit:
+    """scripts/coverage_report.py's scope-split invariant: the
+    structural|runtime fallback split must PARTITION the recorded
+    fallbacks per reason — a taxonomy edit that double-counts (or
+    half-counts) a reason fails the report, not just skews it."""
+
+    def _write_corpus(self, tmp_path, records):
+        path = tmp_path / "corpus.jsonl"
+        path.write_text("\n".join(json.dumps(r) for r in records) + "\n")
+        return path
+
+    def _run_report(self, path):
+        import pathlib
+        import subprocess
+        import sys
+
+        repo = pathlib.Path(__file__).resolve().parent.parent
+        return subprocess.run(
+            [sys.executable, str(repo / "scripts" / "coverage_report.py"),
+             str(path)],
+            capture_output=True, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+
+    def test_split_partitions_and_sums(self, tmp_path):
+        path = self._write_corpus(tmp_path, [
+            {"shape": "sum(m)", "route": "compiled", "step_ns": 30 * S},
+            {"shape": "m and b", "route": "interpreter",
+             "reason": "set-op", "step_ns": 30 * S},
+            # runtime-scope miss: structurally compilable, data too small
+            {"shape": "sum(m)", "route": "interpreter",
+             "reason": "below-floor", "step_ns": 30 * S},
+        ])
+        proc = self._run_report(path)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "1 runtime-scope + 1 structural-scope" in proc.stdout
+        assert "below-floor" in proc.stdout and "[runtime]" in proc.stdout
+        assert "set-op" in proc.stdout and "[structural]" in proc.stdout
+
+    def test_coverage_scope_split_partitions_per_reason(self):
+        # The invariant the report asserts, at the library level: every
+        # runtime-scope reason carries its FULL per-reason count (no
+        # partial/dual classification), and scopes sum to the fallback
+        # total.
+        records = [
+            {"shape": "sum(m)", "route": "compiled", "step_ns": 30 * S},
+            {"shape": "sum(m)", "route": "interpreter",
+             "reason": "below-floor", "step_ns": 30 * S},
+            {"shape": "sum(m)", "route": "interpreter",
+             "reason": "below-floor", "step_ns": 30 * S},
+            {"shape": "m and b", "route": "interpreter",
+             "reason": "set-op", "step_ns": 30 * S},
+        ]
+        cov = qcorpus.coverage(records)
+        runtime = cov["runtime_fallbacks"]
+        fb = cov["fallbacks"]
+        assert set(runtime) <= set(fb)
+        for reason, n in runtime.items():
+            assert n == fb[reason]
+        structural_scope = sum(n for r, n in fb.items() if r not in runtime)
+        assert sum(runtime.values()) + structural_scope == sum(fb.values())
+        assert runtime == {"below-floor": 2}

@@ -1,0 +1,250 @@
+"""Runtime numerics witness (utils/numwatch.py) + the
+scripts/numerics_check.py gate logic: live-lane NaN/inf trips,
+padding-lane value trips, masked-pad passes, the aggregator count-0
+zero convention, dump round-trips, and the statically-derived
+acceptance set (m3_tpu/analysis/numeric_rules.accepted_witness)."""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from m3_tpu.analysis import numeric_rules
+from m3_tpu.utils import numwatch
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture
+def witness():
+    numwatch.install()
+    numwatch.reset()
+    yield numwatch
+    numwatch.reset()
+    numwatch.uninstall()
+
+
+def kinds(w):
+    return sorted((f["site"], f["kind"]) for f in w.findings())
+
+
+class TestObserveResult:
+    def test_masked_pad_passes(self, witness):
+        # The contract shape: live lanes finite, padding rows all-NaN.
+        plane = np.full((8, 16), np.nan)
+        plane[:5, :12] = 1.5
+        witness.observe_result("plan", plane, live_rows=5, live_cols=12)
+        assert witness.findings() == []
+        assert witness.observed_count() == 1
+
+    def test_nan_in_live_lane_trips(self, witness):
+        plane = np.full((8, 16), np.nan)
+        plane[:5, :12] = 1.5
+        plane[2, 3] = np.nan
+        witness.observe_result("plan", plane, live_rows=5, live_cols=12)
+        assert kinds(witness) == [("plan", "nan-live")]
+
+    def test_inf_in_live_lane_trips(self, witness):
+        plane = np.full((8, 16), np.nan)
+        plane[:5, :12] = 1.5
+        plane[0, 0] = np.inf
+        witness.observe_result("plan", plane, live_rows=5, live_cols=12)
+        assert kinds(witness) == [("plan", "inf-live")]
+
+    def test_padding_lane_value_trips(self, witness):
+        # A finite value in a padding ROW: the unmasked-gather leak
+        # shape the witness exists to catch.
+        plane = np.full((8, 16), np.nan)
+        plane[:5, :12] = 1.5
+        plane[6, 2] = 42.0
+        witness.observe_result("plan", plane, live_rows=5, live_cols=12)
+        assert kinds(witness) == [("plan", "pad-finite")]
+
+    def test_column_padding_is_time_slack_not_a_finding(self, witness):
+        # Presence-style outputs (absent_over_time) legitimately fill
+        # pad COLUMNS; only pad ROWS carry the NaN contract.
+        plane = np.full((1, 16), np.nan)
+        plane[0, :12] = 1.0
+        plane[0, 14] = 1.0  # pad column, finite — sliced by the host
+        witness.observe_result("plan", plane, live_rows=1, live_cols=12)
+        assert witness.findings() == []
+
+    def test_counts_aggregate_per_site_kind(self, witness):
+        plane = np.full((4, 4), np.nan)
+        plane[0, 0] = np.inf
+        witness.observe_result("plan", plane, live_rows=1, live_cols=4)
+        witness.observe_result("plan", plane, live_rows=1, live_cols=4)
+        (f,) = [f for f in witness.findings() if f["kind"] == "inf-live"]
+        assert f["count"] == 2
+
+    def test_scalar_and_vector_planes_handled(self, witness):
+        witness.observe_result("plan", np.float64(3.0))
+        witness.observe_result("plan", np.array([1.0, 2.0]))
+        assert witness.findings() == []
+        witness.observe_result("plan", np.float64(np.nan))
+        assert kinds(witness) == [("plan", "nan-live")]
+
+    def test_disabled_witness_is_free(self):
+        numwatch.uninstall()
+        numwatch.reset()
+        numwatch.observe_result("plan", np.full((2, 2), np.inf))
+        assert numwatch.findings() == []
+        assert numwatch.observed_count() == 0
+
+
+class TestObserveRows:
+    def test_count0_zero_convention_passes(self, witness):
+        vals = np.array([[1.0, 2.0], [0.0, 0.0]])
+        witness.observe_rows("agg_flush", vals, np.array([True, False]))
+        assert witness.findings() == []
+
+    def test_pad_nonzero_trips(self, witness):
+        vals = np.array([[1.0, 2.0], [0.0, 7.0]])
+        witness.observe_rows("agg_flush", vals, np.array([True, False]))
+        assert kinds(witness) == [("agg_flush", "pad-nonzero")]
+
+    def test_live_nan_recorded(self, witness):
+        vals = np.array([[np.nan, 2.0], [0.0, 0.0]])
+        witness.observe_rows("agg_flush", vals, np.array([True, False]))
+        assert kinds(witness) == [("agg_flush", "nan-live")]
+
+
+class TestAggFlushHookEndToEnd:
+    """The real observation point: exact_quantile_values with the
+    witness armed."""
+
+    def test_clean_buckets_observe_no_findings(self, witness):
+        from m3_tpu.parallel import agg_flush
+
+        buckets = [np.array([3.0, 1.0, 2.0]), np.array([]),
+                   np.array([5.0])]
+        counts = np.array([3, 0, 1])
+        vals = agg_flush.exact_quantile_values(buckets, counts, (0.5, 0.99))
+        assert witness.observed_count() >= 1
+        assert (vals[1] == 0.0).all()
+        assert [f for f in witness.findings()
+                if f["kind"] in ("pad-nonzero", "inf-live")] == []
+
+    def test_nan_bucket_records_accepted_nan_live(self, witness):
+        from m3_tpu.parallel import agg_flush
+
+        buckets = [np.array([np.nan, np.nan])]
+        counts = np.array([2])
+        agg_flush.exact_quantile_values(buckets, counts, (0.99,))
+        got = kinds(witness)
+        assert ("agg_flush", "nan-live") in got
+        # ... and the static pass ACCEPTS that kind at that site
+        accepted = numeric_rules.accepted_witness(str(REPO / "m3_tpu"))
+        assert ("agg_flush", "nan-live") in accepted
+
+
+class TestDumpAndGate:
+    def test_dump_round_trip(self, witness, tmp_path):
+        plane = np.full((4, 4), np.nan)
+        plane[:2, :] = 1.0   # live lanes clean
+        plane[3, 0] = 5.0    # the padding-row leak
+        witness.observe_result("plan", plane, live_rows=2, live_cols=4)
+        path = witness.dump_now(str(tmp_path / "numerics-1.json"))
+        payload = json.loads(pathlib.Path(path).read_text())
+        assert payload["observed"] == 1
+        assert payload["findings"][0]["kind"] == "pad-finite"
+
+    def test_accepted_set_is_derived_not_listed(self):
+        accepted = numeric_rules.accepted_witness(str(REPO / "m3_tpu"))
+        # NaN-as-missing is provable at both sites; the padding kinds
+        # are NEVER accepted anywhere.
+        assert ("plan", "nan-live") in accepted
+        assert ("agg_flush", "nan-live") in accepted
+        assert not any(k in ("pad-finite", "pad-nonzero")
+                       for _s, k in accepted)
+
+    def test_unaccepted_filter(self):
+        witnessed = [
+            {"site": "plan", "kind": "nan-live", "count": 3, "detail": ""},
+            {"site": "plan", "kind": "pad-finite", "count": 1,
+             "detail": ""},
+        ]
+        accepted = {("plan", "nan-live")}
+        bad = numwatch.unaccepted(witnessed, accepted)
+        assert [f["kind"] for f in bad] == ["pad-finite"]
+
+    def _run_check(self, tmp_path):
+        return subprocess.run(
+            [sys.executable, str(REPO / "scripts" / "numerics_check.py"),
+             str(tmp_path)],
+            capture_output=True, text=True)
+
+    def test_check_script_green_on_accepted_findings(self, tmp_path):
+        (tmp_path / "numerics-1.json").write_text(json.dumps({
+            "pid": 1, "observed": 5,
+            "findings": [{"site": "plan", "kind": "nan-live", "count": 4,
+                          "detail": "NaN in live lanes"}]}))
+        proc = self._run_check(tmp_path)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_check_script_fails_hard_on_padding_violation(self, tmp_path):
+        (tmp_path / "numerics-1.json").write_text(json.dumps({
+            "pid": 1, "observed": 5,
+            "findings": [{"site": "plan", "kind": "pad-finite", "count": 1,
+                          "detail": "finite value in padding rows"}]}))
+        proc = self._run_check(tmp_path)
+        assert proc.returncode == 2, proc.stdout
+        assert "PADDING CONTRACT VIOLATION" in proc.stdout
+
+    def test_check_script_fails_on_unaccepted_site_kind(self, tmp_path):
+        (tmp_path / "numerics-1.json").write_text(json.dumps({
+            "pid": 1, "observed": 5,
+            "findings": [{"site": "agg_flush", "kind": "inf-live",
+                          "count": 1, "detail": "inf in live rows"}]}))
+        proc = self._run_check(tmp_path)
+        assert proc.returncode == 1, proc.stdout
+        assert "UNACCEPTED" in proc.stdout
+
+    def test_check_script_refuses_vacuous_pass(self, tmp_path):
+        (tmp_path / "numerics-1.json").write_text(json.dumps({
+            "pid": 1, "observed": 0, "findings": []}))
+        proc = self._run_check(tmp_path)
+        assert proc.returncode == 2
+        assert (tmp_path / "nothing").exists() is False
+
+
+class TestPlanHookEndToEnd:
+    """The compiled-plan observation point through the real executor:
+    compiled queries under the witness observe padded planes, and every
+    finding stays inside the static-accepted set (the numerics_check
+    tier's contract, in-process)."""
+
+    def test_compiled_queries_witnessed_within_accepted(self, witness,
+                                                        monkeypatch):
+        from test_plan_compile import make_storage, START, END, STEP
+        from m3_tpu.query import Engine
+        from m3_tpu.query import plan as qplan
+
+        monkeypatch.setattr(qplan, "PLAN_MIN_CELLS", 1)
+        eng = Engine(make_storage(7))
+        # one query per padded-output family: grouped exact sum (group
+        # rows pad), rangefunc root (series rows pad), vv binary
+        # (match-row pad), topk (masked winners + host row filter)
+        for q in ("sum by (host) (m)", "rate(m[5m])",
+                  "m * on(host, i) b", "topk(2, m)"):
+            eng.execute_range(q, START, END, STEP)
+        assert witness.observed_count() >= 4
+        accepted = numeric_rules.accepted_witness(str(REPO / "m3_tpu"))
+        bad = numwatch.unaccepted(witness.findings(), accepted)
+        assert bad == [], f"witnessed findings outside accepted: {bad}"
+
+
+class TestPadRowFullWidthScan:
+    """Review-pass regression: a leak landing in a padding row at a
+    padding COLUMN is still a pad-finite finding — the pad-row scan
+    covers the full time extent, not just the live columns."""
+
+    def test_pad_row_pad_column_leak_trips(self, witness):
+        plane = np.full((8, 16), np.nan)
+        plane[:5, :12] = 1.5
+        plane[6, 14] = 42.0  # pad row x pad column
+        witness.observe_result("plan", plane, live_rows=5, live_cols=12)
+        assert kinds(witness) == [("plan", "pad-finite")]

@@ -14,7 +14,9 @@ from m3_tpu.analysis.batch_rules import BatchPartialIngestRule
 from m3_tpu.analysis.cache_rules import (CacheKeyBufferRule,
                                          CacheMethodBufferKeyRule)
 from m3_tpu.analysis.jax_rules import (ItemInLoopRule, JaxPurityRule,
-                                       NonStaticJitCacheRule)
+                                       MeshSpecRule, NonStaticJitCacheRule)
+from m3_tpu.analysis.numeric_rules import (DtypeDataflowRule,
+                                           SentinelTaintRule)
 from m3_tpu.analysis.lock_rules import (FlushCallbackLoopRule,
                                         HotLoopUnderLockRule,
                                         LockDisciplineRule)
@@ -2662,3 +2664,702 @@ class TestNewFamiliesTreeGate:
         findings, _sup = run_program(mods)
         rendered = "\n".join(f.render() for f in findings)
         assert findings == [], f"program findings on the tree:\n{rendered}"
+
+
+class TestNumericDtypeRule:
+    """numeric_rules dtype dataflow: f64-downcast-on-exact-path /
+    f64-reduce-of-f32 / abs-f32-comparison — the exact-contract plane
+    (ops/, parallel/, query/plan.py)."""
+
+    def test_flags_silent_downcast_of_f64_plane(self):
+        # The historical exact-contract downcast shape: a counter grid
+        # staged f32 with no residual split — the f64 host-reduce
+        # exactness silently gone.
+        src = """
+            import numpy as np
+
+            def stage(raw):
+                grid = np.asarray(raw, dtype=np.float64)
+                return grid.astype(np.float32)
+        """
+        found = lint(src, DtypeDataflowRule(), "m3_tpu/parallel/stage.py")
+        assert rule_ids(found) == ["f64-downcast-on-exact-path"]
+
+    def test_residual_split_is_fine(self):
+        # temporal.center's own shape: the downcast operand IS the
+        # residual (a difference), which is downcast-safe by contract.
+        src = """
+            import numpy as np
+
+            def center(values):
+                values = np.asarray(values, dtype=np.float64)
+                finite = np.isfinite(values)
+                baseline = np.where(finite.any(axis=1), values[:, 0], 0.0)
+                resid = (values - baseline[:, None]).astype(np.float32)
+                return resid, baseline
+        """
+        assert lint(src, DtypeDataflowRule(), "m3_tpu/ops/t.py") == []
+
+    def test_double_f32_split_is_fine(self):
+        # The `value2` exact split (PR 16 topk ranking): hi is a lossy
+        # downcast but gp also feeds the lo-residual subtraction.
+        src = """
+            import numpy as np
+
+            def split(raw):
+                gp = np.asarray(raw, dtype=np.float64)
+                hi = gp.astype(np.float32)
+                lo = (gp - hi.astype(np.float64)).astype(np.float32)
+                return hi, lo
+        """
+        assert lint(src, DtypeDataflowRule(), "m3_tpu/parallel/s.py") == []
+
+    def test_live_f64_companion_is_fine(self):
+        # temporal._resid_args: base32 rides BESIDE the f64 base (the
+        # host finish reads the exact plane) — not a silent downcast.
+        src = """
+            import numpy as np
+
+            def center(values):
+                return values, values[:, 0]
+
+            def resid_args(g):
+                g = np.asarray(g, dtype=np.float64)
+                resid, base = center(g)
+                base32 = base.astype(np.float32)
+                return resid, base, base32
+        """
+        found = [f for f in lint(src, DtypeDataflowRule(), "m3_tpu/ops/t.py")
+                 if f.rule == "f64-downcast-on-exact-path"]
+        assert found == []
+
+    def test_center_baseline_signature_downcast_flags(self):
+        # The dropped-baseline shape: center()'s f64 baseline downcast
+        # with neither a residual companion nor the f64 plane kept.
+        src = """
+            import numpy as np
+            from m3_tpu.ops.temporal import center
+
+            def stage(gp):
+                resid, base = center(gp)
+                return [resid, base.astype(np.float32)]
+        """
+        found = lint(src, DtypeDataflowRule(), "m3_tpu/parallel/c.py")
+        assert rule_ids(found) == ["f64-downcast-on-exact-path"]
+
+    def test_flags_f64_reduce_of_f32(self):
+        # Upcast-after-accumulation-input: the f64 dtype on the reduce
+        # recovers nothing the f32 plane already lost.
+        src = """
+            import numpy as np
+
+            def total(raw):
+                v32 = np.zeros((4, 4), dtype=np.float32)
+                v32[:] = raw
+                return v32.astype(np.float64).sum(axis=0)
+        """
+        found = lint(src, DtypeDataflowRule(), "m3_tpu/ops/r.py")
+        assert rule_ids(found) == ["f64-reduce-of-f32"]
+
+    def test_flags_dtype_kwarg_reduce_of_f32(self):
+        src = """
+            import numpy as np
+
+            def total(raw):
+                v32 = np.asarray(raw, dtype=np.float32)
+                return np.sum(v32, dtype=np.float64)
+        """
+        found = lint(src, DtypeDataflowRule(), "m3_tpu/ops/r.py")
+        assert rule_ids(found) == ["f64-reduce-of-f32"]
+
+    def test_residual_provenance_reduce_is_fine(self):
+        # Residual-space f32 feeding an f64 reduce is exactly the
+        # sanctioned decomposition (device residual sum + host baseline).
+        src = """
+            import numpy as np
+
+            def total(values, baseline):
+                resid = (values - baseline[:, None]).astype(np.float32)
+                return np.sum(resid, dtype=np.float64)
+        """
+        assert lint(src, DtypeDataflowRule(), "m3_tpu/ops/r.py") == []
+
+    def test_flags_comparison_on_lossy_f32_plane(self):
+        # The abs-comparison bug class the interpreter-fallback policy
+        # dodges: thresholding a downcast counter plane.
+        src = """
+            import numpy as np
+
+            def filt(raw, threshold):
+                grid = np.asarray(raw, dtype=np.float64)
+                v = grid.astype(np.float32)
+                w = v * 1.0
+                return w > threshold
+        """
+        found = lint(src, DtypeDataflowRule(), "m3_tpu/query/plan.py")
+        assert "abs-f32-comparison" in rule_ids(found)
+
+    def test_comparison_on_f64_or_residual_plane_is_fine(self):
+        src = """
+            import numpy as np
+
+            def filt(raw, threshold):
+                grid = np.asarray(raw, dtype=np.float64)
+                resid = (grid - grid[:, :1]).astype(np.float32)
+                return (grid > threshold) | (resid > 0.5)
+        """
+        found = [f for f in lint(src, DtypeDataflowRule(),
+                                 "m3_tpu/query/plan.py")
+                 if f.rule == "abs-f32-comparison"]
+        assert found == []
+
+    def test_ref_oracles_exempt(self):
+        src = """
+            import numpy as np
+
+            def stage_ref(raw):
+                grid = np.asarray(raw, dtype=np.float64)
+                return grid.astype(np.float32)
+        """
+        assert lint(src, DtypeDataflowRule(), "m3_tpu/ops/t.py") == []
+
+    def test_out_of_scope_dirs_skipped(self):
+        src = """
+            import numpy as np
+
+            def stage(raw):
+                grid = np.asarray(raw, dtype=np.float64)
+                return grid.astype(np.float32)
+        """
+        assert lint(src, DtypeDataflowRule(), "m3_tpu/storage/db.py") == []
+        # query/ outside plan.py is host label algebra, out of scope
+        assert lint(src, DtypeDataflowRule(), "m3_tpu/query/render.py") == []
+
+    def test_suppression_silences(self):
+        src = """
+            import numpy as np
+
+            def stage(raw):
+                grid = np.asarray(raw, dtype=np.float64)
+                # exactness recovered on host  # m3lint: disable=f64-downcast-on-exact-path
+                return grid.astype(np.float32)
+        """
+        assert lint(src, DtypeDataflowRule(), "m3_tpu/ops/t.py") == []
+
+
+class TestSentinelTaintRule:
+    """numeric_rules sentinel taint: pad-lane-aggregate /
+    unmasked-sentinel-gather — NaN row padding and -1 index sentinels
+    must meet a mask/where/clamp before aggregates and gathers."""
+
+    def test_flags_padding_lanes_into_psum_aggregate(self):
+        # Historical shape 1: NaN-padded rows folding straight into a
+        # segment reduce + psum fan-in (no where-mask).
+        src = """
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+
+            def fan_in(grid, gids, g_pad):
+                padded = np.full((8, 16), np.nan)
+                padded[:4, :12] = grid
+                s = jax.ops.segment_sum(padded, gids, num_segments=g_pad)
+                return jax.lax.psum(s, "shard")
+        """
+        found = lint(src, SentinelTaintRule(), "m3_tpu/parallel/c.py")
+        assert rule_ids(found) == ["pad-lane-aggregate"]
+
+    def test_where_mask_before_reduce_is_fine(self):
+        # The PR 9 contract negative: every segment reduce behind
+        # jnp.where(mask, v, 0.0).
+        src = """
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+
+            def fan_in(grid, gids, g_pad):
+                padded = np.full((8, 16), np.nan)
+                padded[:4, :12] = grid
+                mask = jnp.isfinite(padded)
+                z = jnp.where(mask, padded, 0.0)
+                s = jax.ops.segment_sum(z, gids, num_segments=g_pad)
+                return jax.lax.psum(s, "shard")
+        """
+        assert lint(src, SentinelTaintRule(), "m3_tpu/parallel/c.py") == []
+
+    def test_flags_unmasked_vv_gather(self):
+        # Historical shape 2: the vv index map gathered raw — the -1
+        # sentinel wraps to the LAST row and replays its live values.
+        src = """
+            import numpy as np
+
+            def vv(many_v, pairs, r_pad):
+                many_idx = np.full(r_pad, -1, dtype=np.int32)
+                many_idx[:len(pairs)] = pairs
+                return many_v[many_idx]
+        """
+        found = lint(src, SentinelTaintRule(), "m3_tpu/parallel/c.py")
+        assert rule_ids(found) == ["unmasked-sentinel-gather"]
+
+    def test_clamped_gather_is_fine(self):
+        # The PR 16 `_sub_gather`/vv contract negative: clamp + valid
+        # mask.
+        src = """
+            import jax.numpy as jnp
+            import numpy as np
+
+            def vv(many_v, pairs, r_pad):
+                many_idx = np.full(r_pad, -1, dtype=np.int32)
+                many_idx[:len(pairs)] = pairs
+                valid = (many_idx >= 0)[:, None]
+                a = many_v[jnp.maximum(many_idx, 0)]
+                return jnp.where(valid, a, jnp.nan)
+        """
+        assert lint(src, SentinelTaintRule(), "m3_tpu/parallel/c.py") == []
+
+    def test_flags_where_built_sentinel_into_take(self):
+        # plan.py's packed-column construction (np.where(valid, c, -1))
+        # IS the sentinel source; consuming it untreated flags.
+        src = """
+            import jax.numpy as jnp
+            import numpy as np
+
+            def packed(arr, cols, valid):
+                cmap = np.where(valid, cols, -1)
+                return jnp.take(arr, cmap, axis=1)
+        """
+        found = lint(src, SentinelTaintRule(), "m3_tpu/query/plan.py")
+        assert rule_ids(found) == ["unmasked-sentinel-gather"]
+
+    def test_flags_neg1_ids_into_segment_and_add_at(self):
+        src = """
+            import jax
+            import numpy as np
+
+            def agg(v, n, g):
+                gids = np.full(n, -1, dtype=np.int64)
+                out = np.zeros((g, v.shape[1]))
+                np.add.at(out, gids, v)
+                return jax.ops.segment_sum(v, gids, num_segments=g)
+        """
+        found = rule_ids(lint(src, SentinelTaintRule(), "m3_tpu/ops/a.py"))
+        assert found == ["unmasked-sentinel-gather"] * 2
+
+    def test_pad_neutral_ops_pass(self):
+        src = """
+            import jax.numpy as jnp
+            import numpy as np
+
+            def reduce(grid):
+                padded = np.full((8, 16), np.nan)
+                padded[:4] = grid
+                return jnp.nansum(padded, axis=0), np.nanmax(padded)
+        """
+        assert lint(src, SentinelTaintRule(), "m3_tpu/ops/t.py") == []
+
+    def test_pad_grid_source_flags_and_masked_passes(self):
+        src = """
+            import jax.numpy as jnp
+
+            def _pad_grid(g, s, t):
+                return g
+
+            def bad(g):
+                gp = _pad_grid(g, 8, 16)
+                return jnp.sum(gp, axis=0)
+
+            def good(g):
+                gp = _pad_grid(g, 8, 16)
+                return jnp.sum(jnp.where(jnp.isfinite(gp), gp, 0.0), axis=0)
+        """
+        found = lint(src, SentinelTaintRule(), "m3_tpu/parallel/c.py")
+        assert rule_ids(found) == ["pad-lane-aggregate"]
+
+    def test_method_sum_on_padded_receiver_flags(self):
+        src = """
+            import numpy as np
+
+            def total(grid):
+                padded = np.full((8, 16), np.nan)
+                padded[:4] = grid
+                return padded.sum(axis=0)
+        """
+        found = lint(src, SentinelTaintRule(), "m3_tpu/ops/t.py")
+        assert rule_ids(found) == ["pad-lane-aggregate"]
+
+    def test_ref_oracles_and_out_of_scope_skipped(self):
+        src = """
+            import numpy as np
+
+            def total_ref(grid):
+                padded = np.full((8, 16), np.nan)
+                padded[:4] = grid
+                return padded.sum(axis=0)
+        """
+        assert lint(src, SentinelTaintRule(), "m3_tpu/ops/t.py") == []
+        bad = src.replace("total_ref", "total")
+        assert lint(bad, SentinelTaintRule(), "m3_tpu/storage/db.py") == []
+
+    def test_suppression_with_justification(self):
+        src = """
+            import numpy as np
+
+            def total(grid):
+                padded = np.full((8, 16), np.nan)
+                padded[:4] = grid
+                # pad-neutral by construction (all-finite input)
+                # m3lint: disable=pad-lane-aggregate
+                return padded.sum(axis=0)
+        """
+        assert lint(src, SentinelTaintRule(), "m3_tpu/ops/t.py") == []
+
+
+class TestMeshSpecRule:
+    """jax_rules mesh-spec checker: mesh-axis-unbound /
+    shard-spec-arity / unannotated-out-sharding."""
+
+    def test_flags_psum_axis_absent_from_mesh(self):
+        # Historical shape 3: a collective over an axis name the bound
+        # mesh does not carry (typo'd "shards" vs "shard").
+        src = """
+            import jax
+            import numpy as np
+            from jax.sharding import Mesh, PartitionSpec as P
+
+            def make(devs):
+                return Mesh(np.asarray(devs), ("shard", "time"))
+
+            def fan_in(part):
+                return jax.lax.psum(part, "shards")
+        """
+        found = lint(src, MeshSpecRule(), "m3_tpu/parallel/q.py")
+        assert rule_ids(found) == ["mesh-axis-unbound"]
+        assert "'shards'" in found[0].message
+
+    def test_bound_axes_and_spec_vocabulary_pass(self):
+        # The ingest/query shapes: axes declared by the Mesh ctor and by
+        # P(...) literals (nested-tuple grouping included) all count.
+        src = """
+            import jax
+            import numpy as np
+            from jax.sharding import Mesh, PartitionSpec as P
+
+            def make(devs):
+                return Mesh(np.asarray(devs).reshape(2, 2), ("shard", "time"))
+
+            def fan_in(part, blk):
+                rowc = P(("shard", "time"), None)
+                s = jax.lax.psum(part, "shard")
+                return jax.lax.pmin(blk, "time"), s, rowc
+        """
+        assert lint(src, MeshSpecRule(), "m3_tpu/parallel/i.py") == []
+
+    def test_module_without_declared_axes_is_skipped(self):
+        src = """
+            import jax
+
+            def fan_in(part):
+                return jax.lax.psum(part, "shard")
+        """
+        assert lint(src, MeshSpecRule(), "m3_tpu/parallel/h.py") == []
+
+    def test_flags_in_specs_arity_mismatch(self):
+        src = """
+            import jax
+            from jax.sharding import PartitionSpec as P
+
+            def shard_map_compat(fn, *, mesh, in_specs, out_specs):
+                return fn
+
+            def build(mesh):
+                def local(values, counts):
+                    return values
+
+                return shard_map_compat(local, mesh=mesh,
+                                        in_specs=(P("shard"),),
+                                        out_specs=P("shard"))
+        """
+        found = lint(src, MeshSpecRule(), "m3_tpu/parallel/a.py")
+        assert rule_ids(found) == ["shard-spec-arity"]
+
+    def test_matching_arity_and_name_bound_specs_pass(self):
+        src = """
+            import jax
+            from jax.sharding import PartitionSpec as P
+
+            def shard_map_compat(fn, *, mesh, in_specs, out_specs):
+                return fn
+
+            def build(mesh):
+                def local(values, counts):
+                    return values
+
+                specs = (P("shard"), P("shard"))
+                return shard_map_compat(local, mesh=mesh, in_specs=specs,
+                                        out_specs=P("shard"))
+        """
+        assert lint(src, MeshSpecRule(), "m3_tpu/parallel/a.py") == []
+
+    def test_flags_unconditional_sharded_out_spec_in_compile(self):
+        src = """
+            import jax
+            from jax.sharding import PartitionSpec as P
+
+            def shard_map_compat(fn, *, mesh, in_specs, out_specs):
+                return fn
+
+            def plan_executable(body, mesh):
+                return shard_map_compat(body, mesh=mesh,
+                                        in_specs=(P("shard", None),),
+                                        out_specs=(P("shard", None),))
+        """
+        found = lint(src, MeshSpecRule(), "m3_tpu/parallel/compile.py")
+        assert "unannotated-out-sharding" in rule_ids(found)
+
+    def test_edge_annotated_out_spec_passes(self):
+        # The real compile.py shape: the sharded out spec bound by an
+        # IfExp on the root edge's SHARDED annotation.
+        src = """
+            import jax
+            from jax.sharding import PartitionSpec as P
+
+            SHARDED = "shard"
+
+            def shard_map_compat(fn, *, mesh, in_specs, out_specs):
+                return fn
+
+            def plan_executable(body, mesh, root_edge):
+                out_root_spec = (P("shard", None)
+                                 if root_edge.sharding == SHARDED else P())
+                return shard_map_compat(body, mesh=mesh,
+                                        in_specs=(P("shard", None),),
+                                        out_specs=(out_root_spec, P()))
+        """
+        found = [f for f in lint(src, MeshSpecRule(),
+                                 "m3_tpu/parallel/compile.py")
+                 if f.rule == "unannotated-out-sharding"]
+        assert found == []
+
+    def test_out_spec_annotation_not_required_outside_compile(self):
+        src = """
+            import jax
+            from jax.sharding import PartitionSpec as P
+
+            def shard_map_compat(fn, *, mesh, in_specs, out_specs):
+                return fn
+
+            def build(mesh):
+                def local(rows):
+                    return rows
+
+                return shard_map_compat(local, mesh=mesh,
+                                        in_specs=(P("shard"),),
+                                        out_specs=(P("shard"),))
+        """
+        assert lint(src, MeshSpecRule(), "m3_tpu/parallel/ingest.py") == []
+
+    def test_suppression_silences(self):
+        src = """
+            import jax
+            from jax.sharding import Mesh, PartitionSpec as P
+            import numpy as np
+
+            def make(devs):
+                return Mesh(np.asarray(devs), ("shard",))
+
+            def fan_in(part):
+                # cross-module mesh carries this axis
+                # m3lint: disable=mesh-axis-unbound
+                return jax.lax.psum(part, "stage")
+        """
+        assert lint(src, MeshSpecRule(), "m3_tpu/parallel/q.py") == []
+
+
+class TestHostSyncInPlanRound16:
+    """host-sync-in-plan's widened scope: the SubqueryFunc/RankAgg
+    lowering helpers PR 16 added (`_range_body`, `_sub_gather`) are
+    lowering surface too."""
+
+    def test_flags_sync_in_range_body(self):
+        src = """
+            import numpy as np
+            import jax
+
+            def _range_body(ctx, f, ins):
+                adj = ins["diff"][0]
+                host = np.asarray(adj)
+                return host
+        """
+        from m3_tpu.analysis.obs_rules import HostSyncInPlanRule
+        found = lint(src, HostSyncInPlanRule(), "m3_tpu/parallel/compile.py")
+        assert rule_ids(found) == ["host-sync-in-plan"]
+
+    def test_flags_item_in_sub_gather(self):
+        src = """
+            import jax.numpy as jnp
+            import jax
+
+            def _sub_gather(arr, cols, fill):
+                first = cols[0].item()
+                return arr[:, jnp.maximum(cols, 0)], first
+        """
+        from m3_tpu.analysis.obs_rules import HostSyncInPlanRule
+        found = lint(src, HostSyncInPlanRule(), "m3_tpu/parallel/compile.py")
+        assert rule_ids(found) == ["host-sync-in-plan"]
+
+    def test_pure_jnp_helpers_pass(self):
+        src = """
+            import jax.numpy as jnp
+            import jax
+
+            def _sub_gather(arr, cols, fill):
+                valid = (cols >= 0)[None, :]
+                g = arr[:, jnp.maximum(cols, 0)]
+                return jnp.where(valid, g, fill)
+        """
+        from m3_tpu.analysis.obs_rules import HostSyncInPlanRule
+        assert lint(src, HostSyncInPlanRule(),
+                    "m3_tpu/parallel/compile.py") == []
+
+
+class TestNumericFamiliesTreeGate:
+    """Zero-findings gate for ONLY the numerics families — isolates a
+    regression in these rules from the umbrella TestTreeGate — plus the
+    --stats timing contract for the new family."""
+
+    def test_tree_clean_under_numeric_families(self):
+        rules = [DtypeDataflowRule(), SentinelTaintRule(), MeshSpecRule()]
+        findings, _sup, nmods = run_paths(
+            [str(REPO / "m3_tpu")], rules, program_rules=[])
+        assert nmods > 100
+        rendered = "\n".join(f.render() for f in findings)
+        assert findings == [], f"numeric findings on the tree:\n{rendered}"
+
+    def test_numeric_family_suppressions_are_in_use(self):
+        # The documented deliberate site (compile.py baseline staging)
+        # rides a justified suppression, not silence.
+        rules = [DtypeDataflowRule(), SentinelTaintRule(), MeshSpecRule()]
+        _findings, sup, _n = run_paths(
+            [str(REPO / "m3_tpu")], rules, program_rules=[])
+        assert sup >= 1
+
+    def test_stats_timing_covers_new_family(self):
+        src = "import numpy as np\n"
+        mod = Module.from_source(src, "m3_tpu/ops/t.py")
+        timings = {}
+        run_module(mod, [DtypeDataflowRule(), SentinelTaintRule(),
+                         MeshSpecRule()], timings=timings)
+        assert {"numeric-dtype", "sentinel-taint",
+                "mesh-spec"} <= set(timings)
+
+
+class TestFindingsCacheRulesDigest:
+    """The warm findings cache covers the new family: entries are keyed
+    on the analyzer's own rules-source digest, so editing any rule
+    module (numeric_rules.py included) invalidates the whole cache."""
+
+    def _run_cli(self, tmp_path, target):
+        import json as _json
+        import subprocess as _sp
+
+        proc = _sp.run(
+            [sys.executable, "-m", "m3_tpu.analysis", str(target)],
+            cwd=tmp_path, capture_output=True, text=True,
+            env={**__import__("os").environ,
+                 "PYTHONPATH": str(REPO)})
+        return proc
+
+    def test_cache_hit_then_rules_digest_invalidation(self, tmp_path):
+        import json as _json
+
+        target = tmp_path / "mod.py"
+        target.write_text("import numpy as np\n\n\ndef f(x):\n"
+                          "    return np.asarray(x)\n")
+        first = self._run_cli(tmp_path, target)
+        assert first.returncode == 0, first.stdout + first.stderr
+        assert "(0 cached)" in first.stdout
+        cache = tmp_path / ".m3lint_cache.json"
+        assert cache.exists()
+        second = self._run_cli(tmp_path, target)
+        assert "(1 cached)" in second.stdout
+        # A rules-source edit changes the digest: simulate by tampering
+        # the stored digest — every entry must be recomputed, not served.
+        payload = _json.loads(cache.read_text())
+        assert payload["rules"]  # digest present
+        payload["rules"] = "0" * 40
+        cache.write_text(_json.dumps(payload))
+        third = self._run_cli(tmp_path, target)
+        assert "(0 cached)" in third.stdout
+
+
+class TestMeshSpecReviewRegressions:
+    """Review-pass regressions: name-bound edge-conditioned out_specs,
+    vararg/defaulted wrapped functions."""
+
+    def test_name_bound_edge_conditioned_out_specs_passes(self):
+        # out_specs handed as a NAME bound to a tuple whose element is
+        # the sanctioned IfExp — must resolve through the binding, not
+        # flag the opaque name.
+        src = """
+            import jax
+            from jax.sharding import PartitionSpec as P
+
+            SHARDED = "shard"
+
+            def shard_map_compat(fn, *, mesh, in_specs, out_specs):
+                return fn
+
+            def plan_executable(body, mesh, root_edge):
+                out_root_spec = (P("shard", None)
+                                 if root_edge.sharding == SHARDED else P())
+                specs = (out_root_spec, P())
+                return shard_map_compat(body, mesh=mesh,
+                                        in_specs=(P("shard", None),),
+                                        out_specs=specs)
+        """
+        found = [f for f in lint(src, MeshSpecRule(),
+                                 "m3_tpu/parallel/compile.py")
+                 if f.rule == "unannotated-out-sharding"]
+        assert found == []
+
+    def test_vararg_wrapped_fn_never_arity_flags(self):
+        src = """
+            import jax
+            from jax.sharding import PartitionSpec as P
+
+            def shard_map_compat(fn, *, mesh, in_specs, out_specs):
+                return fn
+
+            def build(mesh):
+                def local(*planes):
+                    return planes[0]
+
+                return shard_map_compat(local, mesh=mesh,
+                                        in_specs=(P("shard"), P("shard")),
+                                        out_specs=P("shard"))
+        """
+        assert lint(src, MeshSpecRule(), "m3_tpu/parallel/a.py") == []
+
+    def test_defaulted_params_tolerated_but_excess_specs_flag(self):
+        src = """
+            import jax
+            from jax.sharding import PartitionSpec as P
+
+            def shard_map_compat(fn, *, mesh, in_specs, out_specs):
+                return fn
+
+            def build(mesh):
+                def local(values, counts=None):
+                    return values
+
+                ok = shard_map_compat(local, mesh=mesh,
+                                      in_specs=(P("shard"),),
+                                      out_specs=P("shard"))
+                bad = shard_map_compat(local, mesh=mesh,
+                                       in_specs=(P("shard"), P("shard"),
+                                                 P("shard")),
+                                       out_specs=P("shard"))
+                return ok, bad
+        """
+        found = lint(src, MeshSpecRule(), "m3_tpu/parallel/a.py")
+        assert rule_ids(found) == ["shard-spec-arity"]
