@@ -42,6 +42,17 @@ if _os.environ.get("M3_TPU_NUMERICS", "") not in ("", "0"):
 
     _numwatch.install()
 
+if _os.environ.get("M3_TPU_RACEWATCH", "") not in ("", "0"):
+    # Runtime race witness (utils/racewatch.py): arms attribute
+    # instrumentation on registered shared-state attrs (installing
+    # lockdep underneath for held-lock snapshots) and the exit dump.
+    # Must install BEFORE product modules import so their register()
+    # calls instrument immediately. Smoke tiers only — a watched attr
+    # becomes a descriptor. Opt-in — costs one list append when off.
+    from .utils import racewatch as _racewatch
+
+    _racewatch.install()
+
 if _os.environ.get("M3_TPU_JAX_PLATFORM"):
     # Hard platform override (e.g. "cpu" for hermetic service runs/CI).
     # The env var JAX_PLATFORMS alone does not stop out-of-tree plugin

@@ -423,3 +423,13 @@ def _stat_value(at: magg.AggType, stats: Dict[str, float]) -> float:
     if at == magg.AggType.STDEV:
         return math.sqrt(stats["m2"] / (cnt - 1)) if cnt > 1 else 0.0
     raise ValueError(f"no stat mapping for {at}")
+
+
+# Runtime race witness registration (utils/racewatch.py): _buckets is the
+# ledger-declared lock-free fresh-key fast path (verified dynamically);
+# _degraded is fully lock-protected and rides along as a witnessed
+# locked-pair — the witness should SEE its cross-thread accesses share
+# Elem._lock.
+from ..utils import racewatch as _racewatch  # noqa: E402
+
+_racewatch.register(Elem, "_buckets", "_degraded")

@@ -41,13 +41,15 @@ _CACHE_VERSION = 1
 
 
 def _rules_digest() -> str:
-    """Digest of the analyzer's own sources: any rule edit invalidates
-    the cache wholesale."""
+    """Digest of the analyzer's own sources AND data files (the
+    lock-free ledger is an input to the race family): any rule or
+    ledger edit invalidates the cache wholesale."""
     h = hashlib.sha1()
     pkg = pathlib.Path(__file__).parent
-    for p in sorted(pkg.glob("*.py")):
-        h.update(p.name.encode())
-        h.update(p.read_bytes())
+    for pat in ("*.py", "*.txt"):
+        for p in sorted(pkg.glob(pat)):
+            h.update(p.name.encode())
+            h.update(p.read_bytes())
     return h.hexdigest()
 
 
@@ -215,7 +217,8 @@ def main(argv=None) -> int:
                 modules.append(Module(path, rel, source))
             except SyntaxError:
                 continue  # already surfaced as parse-error per-file
-        prog_findings, prog_suppressed = run_program(modules)
+        prog_findings, prog_suppressed = run_program(modules,
+                                                     timings=timings)
         prog_rows = [_finding_to_row(f) for f in prog_findings]
         findings.extend(prog_findings)
         suppressed += prog_suppressed

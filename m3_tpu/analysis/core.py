@@ -269,10 +269,11 @@ def all_rules() -> List[Rule]:
 def program_registry() -> List:
     """Whole-program rules: run ONCE per tree walk over the
     ProgramIndex (never per module, never in a --jobs worker)."""
-    from . import callgraph, jax_rules
+    from . import callgraph, jax_rules, race_rules
 
     return [callgraph.CrossModuleLockOrderRule(),
-            jax_rules.CrossModuleTaintRule()]
+            jax_rules.CrossModuleTaintRule(),
+            race_rules.SharedStateRaceRule()]
 
 
 def _iter_files(paths: Sequence[str]) -> Iterator[Tuple[pathlib.Path, str]]:
@@ -328,10 +329,15 @@ def run_module(mod: Module, rules: Optional[Iterable[Rule]] = None,
 
 
 def run_program(modules: Sequence[Module], program_rules=None,
+                timings: Optional[Dict[str, float]] = None,
                 ) -> Tuple[List[Finding], int]:
     """(non-suppressed findings, suppressed count) from the whole-program
     rules over an already-parsed module set. Suppressions are honored
-    against the module each finding is attributed to."""
+    against the module each finding is attributed to. With `timings`,
+    per-rule wall time accumulates into it keyed by rule id (the same
+    contract as run_module, so --stats covers program rules too)."""
+    import time as _time
+
     from .callgraph import ProgramIndex
 
     rules = list(program_rules) if program_rules is not None \
@@ -343,12 +349,16 @@ def run_program(modules: Sequence[Module], program_rules=None,
     findings: List[Finding] = []
     suppressed = 0
     for rule in rules:
+        t0 = _time.perf_counter() if timings is not None else 0.0
         for f in rule.check_program(index):
             mod = by_relpath.get(f.path)
             if mod is not None and mod.suppressed(f):
                 suppressed += 1
             else:
                 findings.append(f)
+        if timings is not None:
+            timings[rule.id] = timings.get(rule.id, 0.0) + \
+                (_time.perf_counter() - t0)
     return findings, suppressed
 
 

@@ -370,7 +370,7 @@ class HotLoopUnderLockRule(Rule):
 
     id = "hot-loop-under-lock"
     severity = "warning"
-    dirs = ("storage", "index", "aggregator")
+    dirs = ("storage", "index", "aggregator", "parallel", "testing")
 
     def check(self, mod: Module) -> Iterator[Finding]:
         model = _LockModel(mod)

@@ -79,7 +79,7 @@ class WallClockLatencyRule(Rule):
 
     id = "wall-clock-latency"
     severity = "error"
-    dirs = ("storage", "rpc", "client", "query", "msg")
+    dirs = ("storage", "rpc", "client", "query", "msg", "parallel", "testing")
 
     @staticmethod
     def _bare_time_names(mod: Module) -> Set[str]:

@@ -149,3 +149,12 @@ def charge_read(n_series: int = 0, n_points: int = 0, n_bytes: int = 0):
         xlimits.charge("datapoints_decoded", n_points)
     if n_bytes:
         xlimits.charge("bytes_read", n_bytes)
+
+
+# Runtime race witness registration (utils/racewatch.py): the registry's
+# lock-free append-before-publish protocol is DECLARED in
+# analysis/lockfree_ledger.txt, so its attrs stay instrumented — the
+# declaration is verified dynamically, never silently trusted.
+from ..utils import racewatch as _racewatch  # noqa: E402
+
+_racewatch.register(SeriesRegistry, "_index", "_ids", "_tags")

@@ -44,7 +44,10 @@ class Panicmon:
                     or self.restarts >= self.max_restarts):
                 return
             self.restarts += 1
-            time.sleep(self.backoff_s)
+            # Interruptible backoff + re-check: stop() during the sleep
+            # must not be answered with a fresh child it never sees.
+            if self._stop.wait(self.backoff_s):
+                return
             self._proc = subprocess.Popen(self.argv)
 
     def alive(self) -> bool:

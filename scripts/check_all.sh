@@ -186,6 +186,31 @@ echo "== numerics witness (plan+agg smokes under M3_TPU_NUMERICS=1; witnessed âŠ
   unset M3_TPU_NUMERICS
   python scripts/numerics_check.py "$NUM_OUT" )
 
+echo "== race witness (write+churn smokes under M3_TPU_RACEWATCH=1; cross-thread pairs âŠ† protection model âˆª lock-free ledger, vacuous pass refused) =="
+# Runtime race witness (utils/racewatch.py): re-run the two most
+# thread-crossing smokes with the registered shared-state attrs wrapped
+# in recording descriptors (lockdep installed underneath for held-lock
+# snapshots), then assert every witnessed cross-thread access pair with
+# a write either shares a common held lock consistent with the static
+# protection model (analysis/race_rules.protection_model) or is a
+# declared lock-free protocol (analysis/lockfree_ledger.txt) â€” and
+# refuse a vacuous pass (zero observed shared accesses fails). Closes
+# the static/runtime loop for the concurrency plane, the same way the
+# lockdep and numerics tiers do for lock order and numerics. Wall
+# budget via RACE_SMOKE_BUDGET_S (feeds both smokes' budgets).
+( RACE_OUT=$(mktemp -d)
+  trap 'rm -rf "$RACE_OUT"' EXIT  # cleanup on failure too (set -e)
+  if [ -n "${RACE_SMOKE_BUDGET_S:-}" ]; then
+    export WRITE_SMOKE_BUDGET_S="$RACE_SMOKE_BUDGET_S"
+    export CHURN_SMOKE_BUDGET_S="$RACE_SMOKE_BUDGET_S"
+  fi
+  export M3_TPU_RACEWATCH=1 M3_TPU_RACEWATCH_OUT="$RACE_OUT"
+  JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python scripts/write_smoke.py
+  JAX_PLATFORMS=cpu python scripts/churn_smoke.py --seed 7
+  unset M3_TPU_RACEWATCH
+  python scripts/race_check.py "$RACE_OUT" )
+
 echo "== test suite =="
 python -m pytest tests/ -x -q
 

@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""race_check: verify runtime-witnessed shared-state access pairs
+against the static race protection model and the lock-free ledger.
+
+Usage: python scripts/race_check.py <dump-dir-or-files...>
+
+Loads every race-witness JSON dump (utils/racewatch.py, one per
+witnessed process — the check_all race tier re-runs the write and churn
+smokes under M3_TPU_RACEWATCH=1), then asserts the tier's contracts:
+
+  1. The witness actually OBSERVED shared state crossing threads: at
+     least one instrumented attribute was touched, and at least one was
+     touched from TWO OR MORE threads. A run whose instrumentation
+     never fired — or whose smokes degenerated to a single thread —
+     fails rather than passing vacuously.
+  2. Every witnessed CROSS-THREAD access pair with a write either
+     shares a common held lock or its attribute sits on the reviewed
+     lock-free ledger (analysis/lockfree_ledger.txt). A disjoint-lock
+     pair on an undeclared attribute is a race the static pass missed
+     or an instrumentation gap — both are hard failures.
+  3. Lock-protected pairs are cross-checked against the STATIC
+     protection model (analysis/race_rules.protection_model): when the
+     static pass inferred a protecting lock for the attribute, the
+     witnessed common lock must include it — a pair agreeing on the
+     WRONG lock is two sites that both believe they are protected while
+     excluding nothing.
+
+Exit status: 0 green; 1 on undeclared racy pairs or protection-model
+mismatches; 2 on a vacuous run (no dumps, nothing observed, or no
+cross-thread observation).
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def load_dumps(paths):
+    files = []
+    for p in paths:
+        pp = pathlib.Path(p)
+        if pp.is_dir():
+            files.extend(sorted(pp.glob("racewatch-*.json")))
+        else:
+            files.append(pp)
+    dumps = []
+    for f in files:
+        with open(f, encoding="utf-8") as fh:
+            dumps.append((str(f), json.load(fh)))
+    return dumps
+
+
+def main(argv) -> int:
+    if not argv:
+        print(__doc__)
+        return 2
+
+    from m3_tpu.analysis import race_rules
+
+    dumps = load_dumps(argv)
+    if not dumps:
+        print("race_check: NO witness dumps found — was "
+              "M3_TPU_RACEWATCH=1 / M3_TPU_RACEWATCH_OUT set?")
+        return 2
+
+    observed = 0
+    cross_thread = 0
+    entries = []
+    for path, payload in dumps:
+        n = int(payload.get("observed", 0))
+        attrs = payload.get("attrs", [])
+        observed += n
+        xt = [a for a in attrs if a.get("threads", 0) >= 2]
+        cross_thread += len(xt)
+        entries.extend(attrs)
+        print(f"{path}: observed {n} profile(s) on {len(attrs)} attr(s), "
+              f"{len(xt)} attr(s) cross-thread")
+    if observed == 0:
+        print("race_check: witness observed ZERO instrumented accesses — "
+              "the descriptors never fired (vacuous pass refused)")
+        return 2
+    if cross_thread == 0:
+        print("race_check: no instrumented attribute was touched from two "
+              "threads — the smokes never exercised shared state "
+              "(vacuous pass refused)")
+        return 2
+
+    ledger = race_rules.load_ledger()
+    model = race_rules.protection_model(str(REPO / "m3_tpu"))
+    print(f"ledger: {len(ledger)} declared protocol(s); static protection "
+          f"model: {len(model)} attr(s)")
+
+    undeclared = []
+    mismatched = []
+    for entry in entries:
+        ident = entry["attr"]
+        for a, b in entry.get("racy", []):
+            # disjoint-lock cross-thread pair with a write: only the
+            # ledger can bless it
+            if ident not in ledger:
+                undeclared.append((ident, a, b))
+        if ident not in model:
+            continue
+        inferred = set(model[ident])
+        profiles = entry.get("profiles", [])
+        for i, a in enumerate(profiles):
+            for b in profiles[i + 1:]:
+                if a["thread"] == b["thread"] or \
+                        not (a["write"] or b["write"]):
+                    continue
+                common = set(a["locks"]) & set(b["locks"])
+                if common and not (common & inferred):
+                    mismatched.append((ident, sorted(common),
+                                       sorted(inferred)))
+
+    for ident, a, b in undeclared:
+        print(f"UNDECLARED RACY PAIR: {ident}: thread {a['thread']} "
+              f"(locks {a['locks']}, write={a['write']}) vs thread "
+              f"{b['thread']} (locks {b['locks']}, write={b['write']}) "
+              "share no lock and the attr is not on "
+              "analysis/lockfree_ledger.txt")
+    for ident, common, inferred in mismatched:
+        print(f"PROTECTION MODEL MISMATCH: {ident}: witnessed common "
+              f"lock(s) {common} do not include the statically inferred "
+              f"protecting lock(s) {inferred}")
+
+    if undeclared or mismatched:
+        return 1
+    declared = sorted({e["attr"] for e in entries if e.get("racy")})
+    print(f"race_check: OK — {observed} profile(s) across {len(dumps)} "
+          f"process(es), {cross_thread} cross-thread attr observation(s); "
+          f"ledger-blessed racy attrs: {declared or 'none'}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

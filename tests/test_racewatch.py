@@ -1,0 +1,346 @@
+"""Runtime race witness (utils/racewatch.py) + the
+scripts/race_check.py gate: access-profile recording, racy-pair
+computation, first-write (construction) skip, slots wrapping, dump
+round-trips, ledger blessing, protection-model cross-checks, and the
+gate's vacuous-pass refusal."""
+
+import json
+import pathlib
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from m3_tpu.analysis import race_rules
+from m3_tpu.utils import racewatch
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture
+def witness(monkeypatch):
+    """Arm recording WITHOUT installing lockdep: held-lock snapshots
+    come from a per-thread override so tests control the lock story
+    exactly (lockdep only wraps in-repo-allocated locks, so test-local
+    locks would read as held-nothing anyway)."""
+    held = threading.local()
+    monkeypatch.setattr(racewatch, "_held_locks",
+                        lambda: frozenset(getattr(held, "locks", ())))
+    monkeypatch.setattr(racewatch, "_INSTALLED", True)
+    # fresh ident table: each test's throwaway class gets its own
+    # descriptor even when names (Box.v) repeat across tests
+    monkeypatch.setattr(racewatch, "_WATCHED", {})
+    racewatch.reset()
+    yield held
+    racewatch.reset()
+
+
+def in_thread(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join()
+
+
+class TestProfileRecording:
+    def test_disjoint_lock_cross_thread_write_is_racy(self, witness):
+        class Box:
+            def __init__(self):
+                self.v = 0
+
+        racewatch.watch(Box, "v")
+        b = Box()
+        witness.locks = ("Box._lock",)
+        b.v = 1  # main thread, under the lock
+
+        def other():
+            witness.locks = ()
+            b.v = 2  # lock-free from another thread
+
+        in_thread(other)
+        (f,) = racewatch.findings()
+        assert f["attr"] == "Box.v"
+        assert f["threads"] == 2
+        assert f["racy"], f
+        (a, c) = f["racy"][0]
+        assert not (set(a["locks"]) & set(c["locks"]))
+
+    def test_common_lock_pair_is_not_racy(self, witness):
+        class Box:
+            def __init__(self):
+                self.v = 0
+
+        racewatch.watch(Box, "v")
+        b = Box()
+        witness.locks = ("Box._lock",)
+        b.v = 1
+
+        def other():
+            witness.locks = ("Box._lock",)
+            b.v = 2
+
+        in_thread(other)
+        (f,) = racewatch.findings()
+        assert f["threads"] == 2
+        assert f["racy"] == []
+
+    def test_read_read_pair_is_not_racy(self, witness):
+        class Box:
+            def __init__(self):
+                self.v = 0
+
+        racewatch.watch(Box, "v")
+        b = Box()
+        witness.locks = ()
+        assert b.v == 0
+
+        def other():
+            witness.locks = ()
+            assert b.v == 0
+
+        in_thread(other)
+        (f,) = racewatch.findings()
+        assert f["threads"] == 2
+        assert f["racy"] == []
+
+    def test_first_write_is_construction_not_a_profile(self, witness):
+        class Box:
+            def __init__(self):
+                self.v = 0
+
+        racewatch.watch(Box, "v")
+        witness.locks = ()
+        Box()  # only the __init__ store: pre-publication by contract
+        assert racewatch.observed_count() == 0
+        b = Box()
+        b.v = 1  # the SECOND store is a real write profile
+        (f,) = racewatch.findings()
+        assert [p["write"] for p in f["profiles"]] == [True]
+
+    def test_profiles_deduplicate(self, witness):
+        class Box:
+            def __init__(self):
+                self.v = 0
+
+        racewatch.watch(Box, "v")
+        b = Box()
+        witness.locks = ()
+        for _ in range(50):
+            b.v += 1  # read+write, same thread/locks every iteration
+        assert racewatch.observed_count() == 2  # one read + one write
+
+    def test_slots_class_wraps_the_slot_descriptor(self, witness):
+        class SBox:
+            __slots__ = ("v",)
+
+            def __init__(self):
+                self.v = 7
+
+        racewatch.watch(SBox, "v")
+        b = SBox()
+        witness.locks = ()
+        assert b.v == 7
+        b.v = 8
+        assert b.v == 8
+        (f,) = racewatch.findings()
+        assert f["attr"] == "SBox.v"
+        assert {p["write"] for p in f["profiles"]} == {True, False}
+
+    def test_disarmed_witness_records_nothing(self, witness, monkeypatch):
+        class Box:
+            def __init__(self):
+                self.v = 0
+
+        racewatch.watch(Box, "v")
+        b = Box()
+        monkeypatch.setattr(racewatch, "_INSTALLED", False)
+        b.v = 1
+        assert b.v == 1  # descriptor still delegates storage
+        assert racewatch.observed_count() == 0
+
+
+class TestRegistration:
+    def test_register_is_pending_until_installed(self, monkeypatch):
+        monkeypatch.setattr(racewatch, "_INSTALLED", False)
+        monkeypatch.setattr(racewatch, "_PENDING", [])
+
+        class Box:
+            pass
+
+        racewatch.register(Box, "v")
+        assert not isinstance(Box.__dict__.get("v"),
+                              racewatch._WatchedAttr)
+        assert racewatch._PENDING == [(Box, ("v",))]
+
+    def test_register_instruments_when_armed(self, witness):
+        class Box:
+            pass
+
+        racewatch.register(Box, "v")
+        assert isinstance(Box.__dict__["v"], racewatch._WatchedAttr)
+
+
+class TestRacyPairs:
+    P = [
+        {"thread": 1, "locks": ["A"], "write": True},
+        {"thread": 2, "locks": ["A"], "write": True},
+        {"thread": 2, "locks": [], "write": False},
+        {"thread": 1, "locks": [], "write": False},
+    ]
+
+    def test_cross_thread_disjoint_with_write_only(self):
+        got = racewatch.racy_pairs(self.P)
+        # (1,A,w)x(2,[],r) and (2,A,w)x(1,[],r): write vs bare read on
+        # the other thread; the read-read and common-lock pairs drop
+        assert len(got) == 2
+        for a, b in got:
+            assert a["thread"] != b["thread"]
+            assert a["write"] or b["write"]
+            assert not (set(a["locks"]) & set(b["locks"]))
+
+
+class TestDump:
+    def test_dump_round_trip(self, witness, tmp_path):
+        class Box:
+            def __init__(self):
+                self.v = 0
+
+        racewatch.watch(Box, "v")
+        b = Box()
+        witness.locks = ()
+        b.v = 1
+        path = racewatch.dump_now(str(tmp_path / "racewatch-1.json"))
+        payload = json.loads(pathlib.Path(path).read_text())
+        assert payload["observed"] == 1
+        (f,) = [a for a in payload["attrs"] if a["attr"] == "Box.v"]
+        assert f["profiles"][0]["write"] is True
+
+    def test_no_out_dir_is_a_noop(self, witness, monkeypatch):
+        monkeypatch.delenv("M3_TPU_RACEWATCH_OUT", raising=False)
+        assert racewatch.dump_now() == ""
+
+
+def run_gate(*paths):
+    return subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "race_check.py"),
+         *[str(p) for p in paths]],
+        capture_output=True, text=True)
+
+
+def dump(tmp_path, name, attrs, observed=None):
+    n = observed if observed is not None else sum(
+        len(a["profiles"]) for a in attrs)
+    (tmp_path / name).write_text(json.dumps(
+        {"pid": 1, "observed": n, "attrs": attrs}))
+
+
+def attr_entry(ident, profiles):
+    return {"attr": ident,
+            "threads": len({p["thread"] for p in profiles}),
+            "profiles": profiles,
+            "racy": [[a, b] for a, b in racewatch.racy_pairs(profiles)]}
+
+
+class TestRaceCheckGate:
+    def test_ledger_blessed_racy_pair_is_green(self, tmp_path):
+        # SeriesRegistry._index is a DECLARED lock-free protocol: the
+        # witnessed disjoint-lock pair passes by declaration.
+        assert "SeriesRegistry._index" in race_rules.load_ledger()
+        dump(tmp_path, "racewatch-1.json", [attr_entry(
+            "SeriesRegistry._index",
+            [{"thread": 1, "locks": [], "write": True},
+             {"thread": 2, "locks": [], "write": False}])])
+        proc = run_gate(tmp_path)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "SeriesRegistry._index" in proc.stdout
+
+    def test_undeclared_racy_pair_fails(self, tmp_path):
+        dump(tmp_path, "racewatch-1.json", [attr_entry(
+            "NotOnLedger._x",
+            [{"thread": 1, "locks": [], "write": True},
+             {"thread": 2, "locks": [], "write": False}])])
+        proc = run_gate(tmp_path)
+        assert proc.returncode == 1, proc.stdout
+        assert "UNDECLARED RACY PAIR" in proc.stdout
+
+    def test_locked_pair_matching_the_model_is_green(self, tmp_path):
+        model = race_rules.protection_model(str(REPO / "m3_tpu"))
+        ident = sorted(model)[0]
+        lock = model[ident][0]
+        dump(tmp_path, "racewatch-1.json", [attr_entry(
+            ident,
+            [{"thread": 1, "locks": [lock], "write": True},
+             {"thread": 2, "locks": [lock], "write": False}])])
+        proc = run_gate(tmp_path)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_locked_pair_on_the_wrong_lock_fails(self, tmp_path):
+        model = race_rules.protection_model(str(REPO / "m3_tpu"))
+        ident = sorted(model)[0]
+        dump(tmp_path, "racewatch-1.json", [attr_entry(
+            ident,
+            [{"thread": 1, "locks": ["Wrong._mu"], "write": True},
+             {"thread": 2, "locks": ["Wrong._mu"], "write": False}])])
+        proc = run_gate(tmp_path)
+        assert proc.returncode == 1, proc.stdout
+        assert "PROTECTION MODEL MISMATCH" in proc.stdout
+
+    def test_refuses_vacuous_pass_nothing_observed(self, tmp_path):
+        dump(tmp_path, "racewatch-1.json", [], observed=0)
+        proc = run_gate(tmp_path)
+        assert proc.returncode == 2
+        assert "vacuous" in proc.stdout
+
+    def test_refuses_vacuous_pass_single_threaded(self, tmp_path):
+        # Observations happened, but never from two threads: the smokes
+        # did not exercise shared state — refuse, don't bless.
+        dump(tmp_path, "racewatch-1.json", [attr_entry(
+            "Some._attr",
+            [{"thread": 1, "locks": [], "write": True}])])
+        proc = run_gate(tmp_path)
+        assert proc.returncode == 2
+        assert "vacuous" in proc.stdout
+
+    def test_refuses_empty_dump_dir(self, tmp_path):
+        proc = run_gate(tmp_path)
+        assert proc.returncode == 2
+
+
+class TestAutoInstallEndToEnd:
+    """The wired path: M3_TPU_RACEWATCH=1 arms the witness at package
+    import, product register() calls instrument SeriesRegistry, real
+    threaded traffic produces a dump, and the gate accepts it."""
+
+    def test_registry_traffic_dumps_and_gate_accepts(self, tmp_path):
+        code = (
+            "import threading\n"
+            "from m3_tpu.storage.series import SeriesRegistry\n"
+            "from m3_tpu.utils import racewatch\n"
+            "assert racewatch.installed()\n"
+            "reg = SeriesRegistry()\n"
+            "def work(base):\n"
+            "    for i in range(32):\n"
+            "        reg.get_or_create(b'%d-%d' % (base, i), None)\n"
+            "        reg.get(b'%d-%d' % (base, i))\n"
+            "ts = [threading.Thread(target=work, args=(k,))"
+            " for k in (1, 2)]\n"
+            "[t.start() for t in ts]\n"
+            "work(0)\n"
+            "[t.join() for t in ts]\n"
+            "assert racewatch.observed_count() > 0\n"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            env={**__import__("os").environ,
+                 "M3_TPU_RACEWATCH": "1",
+                 "M3_TPU_RACEWATCH_OUT": str(tmp_path),
+                 "PYTHONPATH": str(REPO)})
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        dumps = list(tmp_path.glob("racewatch-*.json"))
+        assert len(dumps) == 1
+        payload = json.loads(dumps[0].read_text())
+        assert payload["observed"] > 0
+        idents = {a["attr"] for a in payload["attrs"]}
+        assert "SeriesRegistry._index" in idents
+        gate = run_gate(tmp_path)
+        assert gate.returncode == 0, gate.stdout + gate.stderr

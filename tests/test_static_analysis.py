@@ -3363,3 +3363,394 @@ class TestMeshSpecReviewRegressions:
         """
         found = lint(src, MeshSpecRule(), "m3_tpu/parallel/a.py")
         assert rule_ids(found) == ["shard-spec-arity"]
+
+
+# ===================================================================
+# PR 16: concurrency-plane race analysis — thread-spawn discovery,
+# lock-protection inference, the lock-free ledger, seeded PR 5/10 and
+# mid-__init__ leak shapes, widened hot-loop/wall-clock scopes
+# ===================================================================
+
+from m3_tpu.analysis import race_rules  # noqa: E402
+from m3_tpu.analysis.race_rules import (SharedStateRaceRule,  # noqa: E402
+                                        load_ledger, protection_model)
+
+
+def race_findings(srcs, ledger=None):
+    """Race-family findings over synthetic sources with a CONTROLLED
+    ledger (default empty: the real tree ledger must not leak into
+    shape tests)."""
+    idx = ProgramIndex.from_sources(
+        {rel: textwrap.dedent(s) for rel, s in srcs.items()})
+    rule = SharedStateRaceRule(ledger=ledger if ledger is not None else {})
+    return list(rule.check_program(idx))
+
+
+class TestSeededRegistryPublishBeforeAppend:
+    """Historical shape 1 (the pre-fix PR 5 registry): the series index
+    entry was published BEFORE the id/tags lists were appended, so a
+    lock-free reader resolving through the index could read past the
+    end of the lists. Reconstructed beside the fixed (append-first,
+    publish-last) ordering that shipped."""
+
+    PRE_FIX = {
+        "m3_tpu/storage/registry.py": """
+            import threading
+
+            class SeriesRegistry:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._index = {}
+                    self._ids = []
+                    self._tags = []
+
+                def start(self):
+                    threading.Thread(target=self._writer).start()
+
+                def _writer(self):
+                    self.get_or_create(b"s", None)
+
+                def get_or_create(self, series_id, tags):
+                    with self._lock:
+                        idx = len(self._ids)
+                        self._index[series_id] = idx
+                        self._ids.append(series_id)
+                        self._tags.append(tags)
+                        return idx
+
+                def get(self, series_id):
+                    return self._index.get(series_id)
+        """,
+    }
+
+    def test_pre_fix_ordering_flags_unsafe_publication(self):
+        found = race_findings(self.PRE_FIX)
+        pubs = [f for f in found if f.rule == "unsafe-publication"]
+        assert len(pubs) == 1, [f.render() for f in found]
+        assert "SeriesRegistry.'_index'" in pubs[0].message
+        assert "'_ids'" in pubs[0].message
+        assert "append first, publish last" in pubs[0].message
+
+    def test_fixed_append_first_publish_last_is_clean(self):
+        fixed = {
+            "m3_tpu/storage/registry.py": self.PRE_FIX[
+                "m3_tpu/storage/registry.py"].replace(
+                    """idx = len(self._ids)
+                        self._index[series_id] = idx
+                        self._ids.append(series_id)
+                        self._tags.append(tags)""",
+                    """idx = len(self._ids)
+                        self._ids.append(series_id)
+                        self._tags.append(tags)
+                        self._index[series_id] = idx"""),
+        }
+        found = race_findings(fixed)
+        assert [f for f in found if f.rule == "unsafe-publication"] == []
+
+    def test_ledger_never_exempts_unsafe_publication(self):
+        # Declaring the registry protocol grants the GUARD exemption
+        # only; the publication ORDER stays machine-checked.
+        ledger = {"SeriesRegistry._index": "publish-last",
+                  "SeriesRegistry._ids": "append-only"}
+        found = race_findings(self.PRE_FIX, ledger=ledger)
+        assert [f.rule for f in found] == ["unsafe-publication"]
+
+
+class TestSeededDegradedFlagGuard:
+    """Historical shape 2 (the PR 10 sticky `_degraded` flag): the flag
+    is read and cleared under the reconcile lock, but one writer set it
+    lock-free — racing the guarded sites. Reconstructed beside the
+    fixed (every access under the one lock) shape."""
+
+    def _srcs(self, mark_body):
+        return {
+            "m3_tpu/aggregator/elem.py": f"""
+                import threading
+
+                class Elem:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._mu = threading.Lock()
+                        self._degraded = False
+
+                    def start(self):
+                        threading.Thread(target=self._consume).start()
+
+                    def _consume(self):
+                        with self._lock:
+                            if self._degraded:
+                                return
+
+                    def reconcile(self):
+                        with self._lock:
+                            self._degraded = False
+
+                    def mark_degraded(self):
+                {mark_body}
+            """,
+        }
+
+    def test_lock_free_write_beside_guarded_sites_flags(self):
+        found = race_findings(self._srcs("        self._degraded = True"))
+        assert [f.rule for f in found] == ["unguarded-shared-write"]
+        msg = found[0].message
+        assert "Elem._degraded" in msg and "Elem._lock" in msg
+
+    def test_write_under_the_wrong_lock_is_inconsistent_guard(self):
+        found = race_findings(self._srcs(
+            "        with self._mu:\n"
+            "                            self._degraded = True"))
+        assert [f.rule for f in found] == ["inconsistent-guard"]
+        msg = found[0].message
+        assert "Elem._lock" in msg and "Elem._mu" in msg
+
+    def test_fixed_every_access_under_one_lock_is_clean(self):
+        found = race_findings(self._srcs(
+            "        with self._lock:\n"
+            "                            self._degraded = True"))
+        assert found == []
+
+    def test_ledger_declares_the_protocol(self):
+        found = race_findings(self._srcs("        self._degraded = True"),
+                              ledger={"Elem._degraded": "sticky flag"})
+        assert found == []
+
+
+class TestSeededInitHandleLeak:
+    """Historical shape 3: a drainer thread started mid-`__init__`,
+    before the batch buffer it reads is assigned — the spawned consumer
+    can observe a half-constructed instance. Reconstructed beside the
+    shipped insert-queue shape (construct fully, spawn from start())."""
+
+    LEAK = {
+        "m3_tpu/storage/insert_queue.py": """
+            import threading
+
+            class InsertQueue:
+                def __init__(self, shard):
+                    self.shard = shard
+                    self._lock = threading.Lock()
+                    self._thread = threading.Thread(
+                        target=self._drain, daemon=True)
+                    self._thread.start()
+                    self._batch = []
+
+                def _drain(self):
+                    with self._lock:
+                        self._batch.clear()
+        """,
+    }
+
+    def test_mid_init_spawn_before_assignment_flags(self):
+        found = race_findings(self.LEAK)
+        assert [f.rule for f in found] == ["unsafe-publication"]
+        msg = found[0].message
+        assert "self._drain" in msg and "'_batch'" in msg
+        assert "spawn from start()" in msg
+
+    def test_fixed_spawn_from_start_is_clean(self):
+        fixed = {
+            "m3_tpu/storage/insert_queue.py": """
+                import threading
+
+                class InsertQueue:
+                    def __init__(self, shard):
+                        self.shard = shard
+                        self._lock = threading.Lock()
+                        self._batch = []
+                        self._thread = threading.Thread(
+                            target=self._drain, daemon=True)
+
+                    def start(self):
+                        self._thread.start()
+
+                    def _drain(self):
+                        with self._lock:
+                            self._batch.clear()
+            """,
+        }
+        assert race_findings(fixed) == []
+
+    def test_handoff_escape_before_assignment_flags(self):
+        # The non-thread escape: `self` handed to a foreign registry
+        # before __init__ finishes.
+        srcs = {
+            "m3_tpu/msg/consumer.py": """
+                class Consumer:
+                    def __init__(self, registry):
+                        registry.register(self)
+                        self._queue = []
+            """,
+        }
+        found = race_findings(srcs)
+        assert [f.rule for f in found] == ["unsafe-publication"]
+        assert "escapes half-constructed" in found[0].message
+
+
+class TestRacyCheckThenAct:
+    """Rule 4: a read-test-write of a shared attr with no lock spanning
+    the test and the act."""
+
+    def _srcs(self, get_body):
+        return {
+            "m3_tpu/storage/cache.py": f"""
+                import threading
+
+                class Cache:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._m = {{}}
+
+                    def start(self):
+                        threading.Thread(target=self._work).start()
+
+                    def _work(self):
+                        self.get(b"k")
+
+                    def get(self, k):
+                {get_body}
+
+                    def size(self):
+                        return len(self._m)
+            """,
+        }
+
+    UNLOCKED = """        if k not in self._m:
+                            self._m[k] = 1
+                        return self._m[k]"""
+    LOCKED = """        with self._lock:
+                            if k not in self._m:
+                                self._m[k] = 1
+                            return self._m[k]"""
+
+    def test_unlocked_test_then_store_flags(self):
+        found = race_findings(self._srcs(self.UNLOCKED))
+        assert [f.rule for f in found] == ["racy-check-then-act"]
+        assert "Cache._m" in found[0].message
+
+    def test_lock_spanning_test_and_act_is_clean(self):
+        assert race_findings(self._srcs(self.LOCKED)) == []
+
+    def test_ledger_declared_single_flight_passes(self):
+        found = race_findings(self._srcs(self.UNLOCKED),
+                              ledger={"Cache._m": "idempotent insert"})
+        assert found == []
+
+
+class TestLockFreeLedger:
+    def test_parse_idents_and_invariants(self, tmp_path):
+        p = tmp_path / "ledger.txt"
+        p.write_text("# header comment\n"
+                     "\n"
+                     "Foo._bar  # sticky flag: set once\n"
+                     "Baz.q\n")
+        got = load_ledger(p)
+        assert got == {"Foo._bar": "sticky flag: set once", "Baz.q": ""}
+
+    def test_missing_file_is_empty(self, tmp_path):
+        assert load_ledger(tmp_path / "absent.txt") == {}
+
+    def test_tree_ledger_entries_carry_invariants(self):
+        # The review contract: every declared attr has a Class.attr
+        # identity and a non-empty one-line invariant.
+        ledger = load_ledger()
+        assert ledger  # the tree declares its lock-free protocols
+        for ident, reason in ledger.items():
+            cls, _, attr = ident.partition(".")
+            assert cls and attr, ident
+            assert reason, f"{ident} has no invariant line"
+
+
+class TestRaceFamilyTreeGate:
+    """Zero-findings gate for ONLY the race family, against the REAL
+    tree ledger — isolates a regression in these rules (or an undeclared
+    new race) from the umbrella TestTreeGate."""
+
+    def test_tree_clean_under_race_family(self):
+        findings, _sup, nmods = run_paths(
+            [str(REPO / "m3_tpu")], [],
+            program_rules=[SharedStateRaceRule()])
+        assert nmods > 100
+        rendered = "\n".join(f.render() for f in findings)
+        assert findings == [], f"race findings on the tree:\n{rendered}"
+
+    def test_protection_model_is_populated(self):
+        model = protection_model(str(REPO / "m3_tpu"))
+        # the witness acceptance surface: dozens of attrs with an
+        # inferred protecting lock, named in Class.attr form
+        assert len(model) >= 20
+        for ident, locks in model.items():
+            assert "." in ident and locks, (ident, locks)
+
+    def test_stats_timing_covers_the_race_family(self):
+        from m3_tpu.analysis.core import run_program
+
+        srcs = {"m3_tpu/ops/t.py": "X = 1\n"}
+        idx = ProgramIndex.from_sources(srcs)
+        timings = {}
+        run_program(list(idx.modules.values()),
+                    program_rules=[SharedStateRaceRule(ledger={})],
+                    timings=timings)
+        assert "shared-state-race" in timings
+
+
+class TestRulesDigestCoversLedger:
+    def test_ledger_edit_changes_the_digest(self):
+        # The findings cache keys on the analyzer digest; the lock-free
+        # ledger is an INPUT to the race family, so a ledger edit must
+        # invalidate the cache exactly like a rule-source edit.
+        from m3_tpu.analysis.__main__ import _rules_digest
+
+        before = _rules_digest()
+        probe = (REPO / "m3_tpu" / "analysis" /
+                 "zz_digest_probe_test.txt")
+        try:
+            probe.write_text("Probe._x  # test entry\n")
+            assert _rules_digest() != before
+        finally:
+            probe.unlink()
+        assert _rules_digest() == before
+
+
+class TestWidenedRuleScopes:
+    """hot-loop-under-lock and wall-clock-latency now cover parallel/
+    and testing/ — the harness and mesh planes hold locks and measure
+    latency too."""
+
+    HOT_LOOP = """
+        import threading
+
+        class Collector:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def absorb(self, items):
+                with self._lock:
+                    for sid, tags in items:
+                        self._terms.setdefault(sid, []).append(tags)
+    """
+
+    WALL_DELTA = """
+        import time
+
+        def handle(fn):
+            t0 = time.time()
+            fn()
+            return time.time() - t0
+    """
+
+    def test_hot_loop_flags_in_parallel_and_testing(self):
+        for rel in ("m3_tpu/parallel/mod.py", "m3_tpu/testing/mod.py"):
+            found = lint(self.HOT_LOOP, HotLoopUnderLockRule(), rel)
+            assert rule_ids(found) == ["hot-loop-under-lock"], rel
+
+    def test_wall_clock_flags_in_parallel_and_testing(self):
+        for rel in ("m3_tpu/parallel/mod.py", "m3_tpu/testing/mod.py"):
+            found = lint(self.WALL_DELTA, WallClockLatencyRule(), rel)
+            assert rule_ids(found) == ["wall-clock-latency"], rel
+
+    def test_unlisted_dirs_stay_out_of_scope(self):
+        assert lint(self.HOT_LOOP, HotLoopUnderLockRule(),
+                    "m3_tpu/tools/mod.py") == []
+        assert lint(self.WALL_DELTA, WallClockLatencyRule(),
+                    "m3_tpu/tools/mod.py") == []
