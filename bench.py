@@ -1235,6 +1235,19 @@ def bench_hot_set_read():
     stats = db.tick()
     assert stats["sealed"] >= n_blocks, stats
 
+    # BENCH_HOT_VERIFY=1: arm the serve-time lazy integrity path on
+    # every sealed block, as if each were paged in from a fileset —
+    # expected per-row adler32s attached, memo dropped so the first
+    # read actually pays the vectorized adler pass, then the per-read
+    # flag checks. The obs-overhead guard A/Bs this knob to bound the
+    # integrity tax on hot serving.
+    if os.environ.get("BENCH_HOT_VERIFY"):
+        for _sh in db.namespace(b"bench").shards.values():
+            for _blk in _sh.blocks.values():
+                _blk.expected_row_sums = _blk.row_checksums().copy()
+                _blk._row_sums = None
+                _blk._rows_verified = False
+
     n_hot = max(1, int(n_series * hot_frac))
     hot_ids = rng.permutation(n_series)[:n_hot]
     draws = rng.random(reads_per_pass)

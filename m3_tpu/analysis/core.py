@@ -242,12 +242,14 @@ def index_functions(mod: Module) -> Dict[str, ast.FunctionDef]:
 
 
 def _registry() -> List[Rule]:
-    from . import (batch_rules, cache_rules, hbm_rules, jax_rules,
-                   lifecycle_rules, lock_rules, numeric_rules, obs_rules,
-                   overload_rules, render_rules, replay_rules, retry_rules)
+    from . import (batch_rules, cache_rules, diskio_rules, hbm_rules,
+                   jax_rules, lifecycle_rules, lock_rules, numeric_rules,
+                   obs_rules, overload_rules, render_rules, replay_rules,
+                   retry_rules)
 
     return [
         *cache_rules.RULES,
+        *diskio_rules.RULES,
         *jax_rules.RULES,
         *lock_rules.RULES,
         *batch_rules.RULES,

@@ -27,6 +27,14 @@ from typing import Callable, Dict, Iterator, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
+from . import diskio
+from .diskio import classify_write_error
+
+# The disk I/O seam (persist/diskio.py): every file operation below
+# routes through this module-level indirection — one attribute lookup
+# when no injector is installed. testing/faultfs.py swaps it.
+_io = diskio.DEFAULT
+
 # Per-file format header, written before the first chunk: replay
 # refuses (skips, with a warning) files whose magic/version don't match
 # instead of misparsing a foreign or older layout into garbage entries.
@@ -88,11 +96,17 @@ class CommitLog:
         if self._f is not None:
             self.flush()
             self._f.close()
-        self._f = open(self._path(self._file_num), "ab")
-        if self._f.tell() == 0:
-            # Fresh file: stamp the format header before any chunk.
-            self._f.write(_FILE_HEADER)
-            self._f.flush()
+        self._f = _io.open(self._path(self._file_num), "ab")
+        try:
+            if self._f.tell() == 0:
+                # Fresh file: stamp the format header before any chunk.
+                self._f.write(_FILE_HEADER)
+                self._f.flush()
+        except OSError:
+            # Header write failed (EIO/ENOSPC): deferred — flush()
+            # re-stamps before the first chunk, so a headerless file
+            # never accumulates chunks replay would refuse to parse.
+            pass
         self._series_refs.clear()
         self._untagged_keys.clear()
         self._meta_count = 0
@@ -119,7 +133,7 @@ class CommitLog:
         for f in self.files():
             num = int(os.path.basename(f).split("-")[1].split(".")[0])
             if num < file_num:
-                os.remove(f)
+                _io.remove(f)
 
     # ---------------------------------------------------------------- writes
 
@@ -212,17 +226,75 @@ class CommitLog:
             self.flush()
 
     def flush(self):
-        """Write buffered entries as one checksummed chunk (writer.go)."""
+        """Write buffered entries as one checksummed chunk (writer.go).
+
+        A failed write/fsync is an ACK failure, not a silent accept: the
+        chunk is WITHDRAWN (truncated back, the file rotated so the
+        per-file series dictionary can't dangle into the torn region)
+        and the error re-raised TYPED — DiskWriteError for EIO-class
+        media failures, DiskFullError for ENOSPC — so the write path
+        propagates a classified error to the client instead of acking
+        bytes that never became durable."""
         with self._lock:
             if not self._buf or self._f is None:
                 return
             payload = bytes(self._buf)
             self._buf.clear()
-            self._f.write(_CHUNK_HEADER.pack(len(payload), zlib.adler32(payload)))
-            self._f.write(payload)
-            self._f.flush()
-            os.fsync(self._f.fileno())
+            start = self._f.tell()
+            try:
+                if start < len(_FILE_HEADER):
+                    # Header deferred by an earlier fault (or torn): the
+                    # file must open with the format stamp or replay
+                    # skips every chunk in it.
+                    self._f.truncate(0)
+                    start = 0
+                    self._f.write(_FILE_HEADER)
+                self._f.write(_CHUNK_HEADER.pack(len(payload),
+                                                 zlib.adler32(payload)))
+                self._f.write(payload)
+                self._f.flush()
+                _io.fsync(self._f)
+            except OSError as e:
+                path = self._path(self._file_num)
+                self._withdraw_failed_chunk(start)
+                raise classify_write_error(e, path) from e
             self._last_flush = self.clock()
+
+    def _withdraw_failed_chunk(self, start: int):
+        """Roll back a chunk whose write/fsync failed: truncate the file
+        to its pre-chunk length (best effort — a torn half-chunk at EOF
+        is dropped by replay either way) and rotate to a fresh file.
+        Rotation is unconditional: the failed payload may have carried
+        META entries the in-memory series dictionary already counted, so
+        appending more chunks to this file would emit data entries whose
+        refs dangle into the withdrawn region — replay would clean-stop
+        there and strand every later (acked) chunk in the file."""
+        try:
+            self._f.truncate(start)
+        except OSError:
+            pass
+        try:
+            self._f.close()
+        except OSError:
+            pass
+        self._f = None
+        self._file_num += 1
+        try:
+            self._f = _io.open(self._path(self._file_num), "ab")
+        except OSError:
+            # Could not even open a fresh file: the log stays closed
+            # (writes raise "commit log is closed") until rotate().
+            self._f = None
+        if self._f is not None:
+            try:
+                if self._f.tell() == 0:
+                    self._f.write(_FILE_HEADER)
+                    self._f.flush()
+            except OSError:
+                pass  # deferred: the next flush() re-stamps
+        self._series_refs.clear()
+        self._untagged_keys.clear()
+        self._meta_count = 0
 
     def position(self) -> Tuple[int, int]:
         """Durable WAL position (file_num, byte offset) AFTER flushing
@@ -240,9 +312,14 @@ class CommitLog:
     def close(self):
         with self._lock:
             if self._f is not None:
-                self.flush()
-                self._f.close()
-                self._f = None
+                try:
+                    self.flush()
+                finally:
+                    # A typed flush failure may already have swapped or
+                    # dropped the handle (_withdraw_failed_chunk).
+                    if self._f is not None:
+                        self._f.close()
+                    self._f = None
 
 
 def _iter_chunks(path: str) -> Iterator[Tuple[bytes, int]]:
@@ -252,7 +329,7 @@ def _iter_chunks(path: str) -> Iterator[Tuple[bytes, int]]:
     bounded by the largest chunk, never the WAL file size. A file
     without this format's header (foreign layout, older version) is
     SKIPPED with a warning — misparsing would fabricate entries."""
-    with open(path, "rb") as f:
+    with _io.open(path, "rb") as f:
         header = f.read(len(_FILE_HEADER))
         if header != _FILE_HEADER:
             logging.getLogger("m3_tpu.persist.commitlog").warning(
@@ -499,7 +576,7 @@ def replay_ref(directory: str) -> Iterator[Tuple[bytes, bytes, int, float]]:
     files = sorted(f for f in os.listdir(directory) if f.startswith("commitlog-"))
     for fname in files:
         series: List[Tuple[bytes, bytes]] = []
-        with open(os.path.join(directory, fname), "rb") as f:
+        with _io.open(os.path.join(directory, fname), "rb") as f:
             data = f.read()
         if not data.startswith(_FILE_HEADER):
             continue  # unrecognized format: same skip as _iter_chunks

@@ -23,9 +23,10 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import shutil
 import struct
 import zlib
-from typing import Dict, Iterator, List, Optional, Tuple
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -33,6 +34,18 @@ from ..storage.block import SealedBlock
 from ..utils import xtime
 from ..utils.bloom import BloomFilter
 from ..utils.checksum import adler32_rows
+from ..utils.instrument import ROOT
+from . import diskio
+from .diskio import CorruptionError, DiskWriteError, classify_write_error
+
+# The disk I/O seam: every file operation below routes through this
+# module-level indirection (one attribute lookup when no injector is
+# installed — zero overhead off). testing/faultfs.py swaps it.
+_io = diskio.DEFAULT
+
+# Serve-time integrity observability (quarantines, verify failures);
+# shared by name with the storage-side readers (storage/retriever.py).
+_CORRUPTION = ROOT.sub_scope("storage.corruption")
 
 INFO_FILE = "info.json"
 DATA_FILE = "data.bin"
@@ -54,7 +67,7 @@ def fileset_dir(root: str, namespace: bytes, shard: int, block_start: int,
 
 def _adler(path: str) -> int:
     a = 1
-    with open(path, "rb") as f:
+    with _io.open(path, "rb") as f:
         while True:
             chunk = f.read(1 << 20)
             if not chunk:
@@ -73,10 +86,24 @@ class FilesetWriter:
               wal_position: Optional[Tuple[int, int]] = None) -> str:
         d = fileset_dir(self.root, namespace, shard, blk.block_start, snapshot_version)
         tmp = d + ".tmp"
+        try:
+            return self._write(d, tmp, blk, registry, snapshot_version,
+                               wal_position)
+        except OSError as e:
+            # Typed classification (EIO -> DiskWriteError, ENOSPC ->
+            # DiskFullError): the flush path retries/degrades on these
+            # instead of folding a raw OSError into a broad except.
+            if isinstance(e, (CorruptionError, DiskWriteError)):
+                raise
+            raise classify_write_error(e, d) from e
+
+    def _write(self, d: str, tmp: str, blk: SealedBlock, registry,
+               snapshot_version: Optional[int],
+               wal_position: Optional[Tuple[int, int]]) -> str:
         os.makedirs(tmp, exist_ok=True)
 
         words = np.ascontiguousarray(blk.words, np.uint32)
-        with open(os.path.join(tmp, DATA_FILE), "wb") as f:
+        with _io.open(os.path.join(tmp, DATA_FILE), "wb") as f:
             f.write(words.tobytes())
 
         # Index entries sorted by series id (the write path buffers and sorts,
@@ -88,7 +115,7 @@ class FilesetWriter:
         bloom.add_batch([ids[i] for i in order])
         row_sums = adler32_rows(words) if len(ids) else np.zeros(0, np.int64)
         index_offsets: List[Tuple[bytes, int]] = []
-        with open(os.path.join(tmp, INDEX_FILE), "wb") as f:
+        with _io.open(os.path.join(tmp, INDEX_FILE), "wb") as f:
             for i in order:
                 entry = _IDX_HEADER.pack(
                     len(ids[i]), i, int(blk.nbits[i]), int(blk.npoints[i]),
@@ -97,11 +124,11 @@ class FilesetWriter:
                 index_offsets.append((ids[i], f.tell()))
                 f.write(entry)
                 f.write(ids[i])
-        with open(os.path.join(tmp, SUMMARIES_FILE), "wb") as f:
+        with _io.open(os.path.join(tmp, SUMMARIES_FILE), "wb") as f:
             for sid, off in index_offsets[::SUMMARY_EVERY]:
                 f.write(struct.pack("<IQ", len(sid), off))
                 f.write(sid)
-        with open(os.path.join(tmp, BLOOM_FILE), "wb") as f:
+        with _io.open(os.path.join(tmp, BLOOM_FILE), "wb") as f:
             f.write(bloom.tobytes())
 
         info = {
@@ -121,25 +148,23 @@ class FilesetWriter:
             # read: recovery replays only WAL chunks past it (everything
             # earlier is provably inside this snapshot).
             info["wal_position"] = [int(wal_position[0]), int(wal_position[1])]
-        with open(os.path.join(tmp, INFO_FILE), "w") as f:
+        with _io.open(os.path.join(tmp, INFO_FILE), "w") as f:
             json.dump(info, f)
 
         digests = {
             name: _adler(os.path.join(tmp, name))
             for name in (INFO_FILE, DATA_FILE, INDEX_FILE, SUMMARIES_FILE, BLOOM_FILE)
         }
-        with open(os.path.join(tmp, DIGEST_FILE), "w") as f:
+        with _io.open(os.path.join(tmp, DIGEST_FILE), "w") as f:
             json.dump(digests, f)
         # Checkpoint LAST: its presence + matching digest-of-digests marks the
         # fileset durable (write.go checkpoint semantics).
-        with open(os.path.join(tmp, CHECKPOINT_FILE), "w") as f:
+        with _io.open(os.path.join(tmp, CHECKPOINT_FILE), "w") as f:
             json.dump({"digest": _adler(os.path.join(tmp, DIGEST_FILE))}, f)
 
         if os.path.exists(d):
-            import shutil
-
             shutil.rmtree(d)
-        os.replace(tmp, d)
+        _io.replace(tmp, d)
         return d
 
 
@@ -150,11 +175,50 @@ def fileset_complete(d: str) -> bool:
     if not (os.path.exists(cp) and os.path.exists(dg)):
         return False
     try:
-        with open(cp) as f:
+        with _io.open(cp) as f:
             want = json.load(f)["digest"]
         return _adler(dg) == want
     except (ValueError, KeyError, OSError):
         return False
+
+
+# --------------------------------------------------------------- quarantine
+
+QUARANTINE_DIR = "quarantine"
+
+
+def quarantine_fileset(path: str, reason: str, rows: Sequence[int] = (),
+                       ids: Sequence[bytes] = ()) -> Optional[str]:
+    """Move a corrupt fileset out of the servable namespace: rename it
+    into `<shard-dir>/quarantine/<name>` (outside `list_filesets`'
+    `fileset-` prefix by construction) with a JSON sidecar naming the
+    failing rows, so an operator — or the scrubber's repair pass — can
+    attribute the rot before the copy is replaced from peers. Uses the
+    RAW os layer, not the `_io` seam: quarantine is the remediation
+    path and must not itself be fault-injected. Returns the quarantine
+    path, or None when the rename failed (counted, never raised — the
+    caller is already on a corruption error path)."""
+    path = os.path.abspath(path)
+    parent, name = os.path.split(path)
+    qdir = os.path.join(parent, QUARANTINE_DIR)
+    dst = os.path.join(qdir, name)
+    try:
+        os.makedirs(qdir, exist_ok=True)
+        if os.path.lexists(dst):
+            shutil.rmtree(dst, ignore_errors=True)
+        os.replace(path, dst)
+        with open(dst + ".json", "w") as f:
+            json.dump({
+                "reason": reason,
+                "source": path,
+                "rows": [int(r) for r in rows],
+                "ids": [i.decode("utf-8", "replace") for i in ids],
+            }, f)
+    except OSError:
+        _CORRUPTION.counter("quarantine_failed").inc()
+        return None
+    _CORRUPTION.counter("quarantined").inc()
+    return dst
 
 
 @dataclasses.dataclass
@@ -173,16 +237,24 @@ class FilesetReader:
         if not fileset_complete(path):
             raise FileNotFoundError(f"incomplete or missing fileset at {path}")
         self.path = path
-        with open(os.path.join(path, INFO_FILE)) as f:
+        with _io.open(os.path.join(path, INFO_FILE)) as f:
             self.info = json.load(f)
+        # The recorded whole-file adlers ride every reader (cheap: one
+        # small json), so each consumer verifies the EXACT bytes it read
+        # — a re-read-and-compare pass would leave a window where the
+        # verification read is clean and the consuming read is not.
+        try:
+            with _io.open(os.path.join(path, DIGEST_FILE)) as f:
+                self.digests: Dict[str, int] = json.load(f)
+        except (OSError, ValueError):
+            self.digests = {}
         if verify:
-            with open(os.path.join(path, DIGEST_FILE)) as f:
-                digests = json.load(f)
-            for name, want in digests.items():
+            for name, want in self.digests.items():
                 if _adler(os.path.join(path, name)) != want:
-                    raise IOError(f"digest mismatch for {name} in {path}")
-        self._words = np.memmap(
-            os.path.join(path, DATA_FILE), dtype=np.uint32, mode="r",
+                    raise CorruptionError(
+                        f"digest mismatch for {name} in {path}", path=path)
+        self._words = _io.memmap(
+            os.path.join(path, DATA_FILE), dtype=np.uint32,
             shape=(self.info["num_series"], self.info["max_words"]),
         )
         self.entries = list(self._read_index())
@@ -216,22 +288,36 @@ class FilesetReader:
             want = np.fromiter((e.checksum for e in self.entries), np.int64,
                                count=len(self.entries))
             if rows.min(initial=0) < 0 or rows.max(initial=-1) >= len(sums):
-                raise IOError(f"index entry row out of range in {self.path}")
+                raise CorruptionError(
+                    f"index entry row out of range in {self.path}",
+                    path=self.path)
             bad = np.flatnonzero(sums[rows] != want)
             if len(bad):
-                e = self.entries[int(bad[0])]
-                raise IOError(
-                    f"row checksum mismatch for {e.id!r} (row {e.row}) "
-                    f"in {self.path}")
+                bad_entries = [self.entries[int(b)] for b in bad]
+                raise CorruptionError(
+                    f"row checksum mismatch for {bad_entries[0].id!r} "
+                    f"(row {bad_entries[0].row}) in {self.path}",
+                    path=self.path,
+                    rows=[e.row for e in bad_entries],
+                    ids=[e.id for e in bad_entries])
         bloom = BloomFilter.for_capacity(len(self.entries))
         bloom.add_batch([e.id for e in self.entries])
-        with open(os.path.join(self.path, BLOOM_FILE), "rb") as f:
+        with _io.open(os.path.join(self.path, BLOOM_FILE), "rb") as f:
             if f.read() != bloom.tobytes():
-                raise IOError(f"bloom filter diverges from ids in {self.path}")
+                raise CorruptionError(
+                    f"bloom filter diverges from ids in {self.path}",
+                    path=self.path)
 
     def _read_index(self) -> Iterator[IndexEntry]:
-        with open(os.path.join(self.path, INDEX_FILE), "rb") as f:
+        with _io.open(os.path.join(self.path, INDEX_FILE), "rb") as f:
             data = f.read()
+        want = self.digests.get(INDEX_FILE)
+        if want is not None and zlib.adler32(data) != want:
+            # Verify the bytes ABOUT to be parsed: rotten index entries
+            # otherwise fail silently (a garbled id misses the binary
+            # search — a read that quietly skips durable data).
+            raise CorruptionError(
+                f"index digest mismatch in {self.path}", path=self.path)
         pos = 0
         while pos < len(data):
             id_len, row, nbits, npoints, checksum = _IDX_HEADER.unpack_from(data, pos)
@@ -259,6 +345,16 @@ class FilesetReader:
             time_unit=xtime.Unit(info["time_unit"]),
             checksum=info["block_checksum"],
         )
+        # Serve-time integrity: the index entries' recorded row adlers
+        # ride the block, and SealedBlock.read/read_all verify the data
+        # rows against them lazily on first touch — once per generation
+        # (verified flag cached on the block object), so the hot path
+        # pays one vectorized adler pass per loaded block, ever.
+        if rows:
+            blk.expected_row_sums = np.fromiter(
+                (e.checksum for e in rows), np.int64, count=len(rows))
+            blk.expected_row_ids = [e.id for e in rows]
+            blk.source_path = self.path
         return blk, [e.id for e in rows]
 
 
@@ -269,14 +365,20 @@ class Seeker:
     page the index; ours is small enough to hold) -> mmap row slice."""
 
     def __init__(self, path: str):
-        if not fileset_complete(path):
-            raise FileNotFoundError(f"incomplete or missing fileset at {path}")
-        self.path = path
-        with open(os.path.join(path, INFO_FILE)) as f:
-            self.info = json.load(f)
-        with open(os.path.join(path, BLOOM_FILE), "rb") as f:
-            self.bloom = BloomFilter.frombytes(f.read(), self.info["bloom_m"], self.info["bloom_k"])
         reader = FilesetReader(path, verify=False)
+        self.path = path
+        self.info = reader.info
+        with _io.open(os.path.join(path, BLOOM_FILE), "rb") as f:
+            raw = f.read()
+        want = reader.digests.get(BLOOM_FILE)
+        if want is not None and zlib.adler32(raw) != want:
+            # A rotten bloom is the nastiest fileset fault: every lookup
+            # turns into a silent false negative. Verify the exact bytes
+            # read before trusting a single membership answer.
+            raise CorruptionError(
+                f"bloom digest mismatch in {path}", path=path)
+        self.bloom = BloomFilter.frombytes(raw, self.info["bloom_m"],
+                                           self.info["bloom_k"])
         self._entries = sorted(reader.entries, key=lambda e: e.id)
         self._ids = [e.id for e in self._entries]
         self._words = reader._words
@@ -293,7 +395,10 @@ class Seeker:
         e = self._entries[i]
         row = np.asarray(self._words[e.row])
         if zlib.adler32(row.tobytes()) != e.checksum:
-            raise IOError(f"checksum mismatch for {series_id!r} in {self.path}")
+            _CORRUPTION.counter("seek_mismatch").inc()
+            raise CorruptionError(
+                f"checksum mismatch for {series_id!r} in {self.path}",
+                path=self.path, rows=[e.row], ids=[series_id])
         return row, e.nbits, e.npoints
 
 
@@ -343,6 +448,39 @@ class PersistManager:
                     _, version, block_start = name.split("-")
                     out.append((int(block_start), int(version), path))
         return sorted(out)
+
+    def list_quarantined(self, namespace: bytes, shard: int
+                         ) -> List[Tuple[int, str]]:
+        """Quarantined flush filesets for a shard: [(block_start, path)].
+        The scrubber routes these into repair and clears them once a
+        fresh replica-sourced fileset has replaced them."""
+        d = os.path.join(self.root, namespace.decode(),
+                         f"shard-{shard:05d}", QUARANTINE_DIR)
+        out = []
+        if not os.path.isdir(d):
+            return out
+        for name in os.listdir(d):
+            if name.startswith("fileset-") and not name.endswith(".json"):
+                out.append((int(name.split("-")[-1]), os.path.join(d, name)))
+        return sorted(out)
+
+    def clear_quarantined(self, namespace: bytes, shard: int,
+                          block_start: int) -> bool:
+        """Drop a quarantined fileset (+ sidecar) after repair rewrote a
+        healthy copy — the un-quarantine step. Returns True when one was
+        removed."""
+        d = os.path.join(self.root, namespace.decode(),
+                         f"shard-{shard:05d}", QUARANTINE_DIR)
+        path = os.path.join(d, f"fileset-{block_start}")
+        if not os.path.isdir(path):
+            return False
+        shutil.rmtree(path, ignore_errors=True)
+        if os.path.exists(path + ".json"):
+            try:
+                os.remove(path + ".json")
+            except OSError:
+                pass
+        return True
 
     def shards_with_data(self, namespace: bytes) -> List[int]:
         d = os.path.join(self.root, namespace.decode())

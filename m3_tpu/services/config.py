@@ -71,6 +71,10 @@ class DBNodeConfig:
     # Background mediator cadence (tick -> flush -> snapshot -> cleanup,
     # mediator.go ongoingTick); empty disables the background thread.
     tick_interval: str = ""
+    # Background fileset scrub cadence (storage/scrub.py: cold-data row
+    # checksum verification + quarantine + repair routing); empty
+    # disables the scrubber thread.
+    scrub_interval: str = ""
     kv_path: str = ""          # FileStore path; empty = in-memory
     kv_endpoint: str = ""      # networked KV service; overrides kv_path
     coordinator: Optional["CoordinatorConfig"] = None  # embedded mode

@@ -87,12 +87,15 @@ class DBNodeHandle:
     ns_watch: Optional[object] = None
     mediator: Optional[object] = None
     bootstrap_results: Optional[dict] = None
+    scrubber: Optional[object] = None
 
     @property
     def endpoint(self) -> str:
         return self.server.endpoint
 
     def close(self):
+        if self.scrubber is not None:
+            self.scrubber.stop()
         if self.mediator is not None:
             # Stop the background flush/snapshot loop BEFORE teardown so
             # a mid-close tick never races the listeners going away.
@@ -197,8 +200,25 @@ def run_dbnode(cfg: DBNodeConfig, clock=None) -> DBNodeHandle:
 
         mediator = Mediator(db, persist).start(
             interval_s=parse_duration_ns(cfg.tick_interval) / 1e9)
+    # Durable-write health feeds the process tracker: persistent WAL or
+    # flush failures degrade the exported /health state alongside the
+    # read-only write posture the database itself enforces.
+    from ..utils.health import TRACKER
+
+    TRACKER.register(f"disk.{cfg.host_id}", db.disk_health.saturation)
+    scrubber = None
+    if cfg.scrub_interval:
+        from ..storage.scrub import DatabaseScrubber, ScrubOptions
+
+        # No peer session at this assembly level: the scrubber runs in
+        # quarantine-only mode (detect + isolate); cluster harnesses
+        # construct it with a ShardRepairer for the full repair loop.
+        scrubber = DatabaseScrubber(
+            db, persist, opts=ScrubOptions(
+                interval_s=parse_duration_ns(cfg.scrub_interval) / 1e9)
+        ).start()
     return DBNodeHandle(db, server, persist, coordinator, kv, lock, httpjson,
-                        ns_watch, mediator, boot_results)
+                        ns_watch, mediator, boot_results, scrubber)
 
 
 @dataclasses.dataclass

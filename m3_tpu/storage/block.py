@@ -37,6 +37,10 @@ _GEN = itertools.count(1)
 # dryrun/tests assert the serving flush actually took the mesh path.
 _FLUSH_METRICS = ROOT.sub_scope("storage.flush")
 
+# Serve-time integrity counters (shared scope with persist/fs and the
+# retriever's quarantine path).
+_CORRUPTION = ROOT.sub_scope("storage.corruption")
+
 
 def choose_time_unit(ts: np.ndarray) -> xtime.Unit:
     """Coarsest unit that represents every timestamp losslessly (the codec
@@ -97,6 +101,40 @@ class SealedBlock:
         metadata comparison, persist/fs write.go per-entry checksum)."""
         return int(self.row_checksums()[row])
 
+    def _verify_rows(self) -> None:
+        """Lazy serve-time integrity. Blocks paged in from a fileset
+        carry the index's recorded per-row adler32s (`expected_row_sums`,
+        attached by FilesetReader.to_block); the FIRST read through this
+        block object compares them against checksums computed from the
+        bytes actually mapped. Verified once per generation — the flag
+        rides the block object, so the hot path pays one vectorized
+        adler pass per paged-in block, then two getattr lookups per
+        read. Divergence raises typed CorruptionError naming the rotten
+        rows so the serving layer can quarantine the fileset; nothing
+        bit-flipped is ever returned."""
+        expected = getattr(self, "expected_row_sums", None)
+        if expected is None or getattr(self, "_rows_verified", False):
+            return
+        expected = np.asarray(expected)
+        actual = self.row_checksums()
+        if actual.shape == expected.shape and bool((actual == expected).all()):
+            self._rows_verified = True
+            _CORRUPTION.counter("serve_verified").inc()
+            return
+        from ..persist.diskio import CorruptionError
+
+        if actual.shape == expected.shape:
+            bad = [int(b) for b in np.flatnonzero(actual != expected)]
+        else:
+            bad = list(range(self.num_series))
+        ids = getattr(self, "expected_row_ids", None) or []
+        _CORRUPTION.counter("serve_verify_failed").inc()
+        raise CorruptionError(
+            f"row checksum mismatch on read: {len(bad)} row(s) in block "
+            f"{self.block_start}",
+            path=getattr(self, "source_path", None), rows=bad,
+            ids=[ids[b] for b in bad if b < len(ids)])
+
     def row_of(self, series_idx: int) -> Optional[int]:
         i = int(np.searchsorted(self.series_indices, series_idx))
         if i < len(self.series_indices) and self.series_indices[i] == series_idx:
@@ -114,6 +152,7 @@ class SealedBlock:
         views of shared planes; the miss path freezes to keep the
         contract observable cold — the query layer already treats fetch
         results as immutable throughout)."""
+        self._verify_rows()
         row = self.row_of(series_idx)
         if row is None:
             return None
@@ -140,6 +179,7 @@ class SealedBlock:
         fetch-result immutability contract the query layer already
         relies on; the cold path freezes so the contract is observable
         before a block turns hot)."""
+        self._verify_rows()
         cache = block_cache.active()
         if cache is not None:
             dec = cache.decoded(self)

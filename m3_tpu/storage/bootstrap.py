@@ -21,7 +21,8 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..persist import commitlog as cl
-from ..persist.fs import FilesetReader, PersistManager
+from ..persist.diskio import CorruptionError
+from ..persist.fs import FilesetReader, PersistManager, quarantine_fileset
 from ..utils import tracing, xtime
 from ..utils.hashing import hash_batch
 from ..utils.instrument import ROOT
@@ -36,6 +37,10 @@ _PEER_BOOT_METRICS = ROOT.sub_scope("bootstrap.peers")
 # lookup on a partial shard set) means acked data was LEFT ON DISK —
 # counted, logged, and surfaced on the BootstrapResult, never silent.
 _CL_BOOT_METRICS = ROOT.sub_scope("bootstrap.commitlog")
+# Filesystem-bootstrap observability: a fileset flunking its integrity
+# verification is quarantined (not served, not silently skipped) and the
+# unclaimed range falls through to the commitlog/peers chain.
+_FS_BOOT_METRICS = ROOT.sub_scope("bootstrap.fs")
 _LOG = logging.getLogger("m3_tpu.storage.bootstrap")
 
 
@@ -81,6 +86,13 @@ class FilesystemBootstrapper(Bootstrapper):
 
     name = "filesystem"
 
+    def __init__(self):
+        self.notes: List[str] = []
+
+    def pop_notes(self) -> List[str]:
+        notes, self.notes = self.notes, []
+        return notes
+
     def bootstrap(self, ns, shard_ranges, ctx):
         claimed = ShardTimeRanges()
         if ctx.persist is None:
@@ -103,7 +115,27 @@ class FilesystemBootstrapper(Bootstrapper):
                     reader = FilesetReader(path)
                     reader.verify_rows()
                     blk, ids = reader.to_block()
-                except (IOError, FileNotFoundError):
+                except FileNotFoundError:
+                    continue  # cleanup raced the listing
+                except (CorruptionError, ValueError, KeyError, OSError) as e:
+                    # The fileset flunked its integrity verification:
+                    # quarantine it so nothing ever serves it, leave the
+                    # range UNCLAIMED so the chain falls through to the
+                    # commitlog (snapshot + WAL replay) / peers sources,
+                    # and surface the anomaly to the operator.
+                    _FS_BOOT_METRICS.counter("corrupt_quarantined").inc()
+                    qdst = quarantine_fileset(
+                        path,
+                        reason=f"bootstrap: {type(e).__name__}: {e}",
+                        rows=getattr(e, "rows", ()),
+                        ids=getattr(e, "ids", ()))
+                    note = (f"filesystem: fileset at {path} failed "
+                            f"verification ({type(e).__name__}: {e}); "
+                            + (f"quarantined to {qdst}" if qdst else
+                               "quarantine FAILED, left in place")
+                            + " — range left to the commitlog/peers chain")
+                    _LOG.warning(note)
+                    self.notes.append(note)
                     continue
                 with shard.write_lock:
                     remap, _created = shard.registry.get_or_create_batch(ids)

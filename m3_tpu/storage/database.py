@@ -14,9 +14,16 @@ from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..persist.diskio import DiskWriteError
 from ..utils import tracing
+from ..utils.health import DiskHealth, Priority
+from ..utils.instrument import ROOT
+from ..utils.limits import Backpressure
+from ..utils.retry import RetryOptions, Retrier
 from .namespace import Namespace, NamespaceOptions
 from .series import charge_read
+
+_FLUSH_METRICS = ROOT.sub_scope("storage.flush")
 
 
 def fold_tags(out: Dict[bytes, set], tags, filter_set, name_only: bool):
@@ -45,6 +52,17 @@ class Database:
         # watch threads); iterating code snapshots values() under the GIL.
         self._ns_lock = threading.Lock()
         self._bootstrapped = False
+        # Durable-write health: WAL/flush failures degrade the node to a
+        # read-only posture (NORMAL/BULK writes shed with Backpressure,
+        # CRITICAL and reads keep flowing); the first durable success
+        # lifts it. Services register its saturation with the tracker.
+        self.disk_health = DiskHealth(trip_after=3)
+        # Per-block flush retry: one quick re-attempt absorbs a transient
+        # media error; a persistent one surfaces typed, marks the block
+        # FAILED (still on the flush schedule) and degrades health.
+        self._flush_retrier = Retrier(RetryOptions(
+            max_attempts=2, initial_backoff_s=0.02, max_backoff_s=0.1,
+            jitter=False))
 
     # ------------------------------------------------------------- namespaces
 
@@ -108,6 +126,7 @@ class Database:
               tags: Optional[dict] = None, priority=None):
         """database.go:536 Write + :561 commit log append."""
         ns = self.namespace(namespace)
+        self._check_writable(priority)
         shard_id = self.shard_set.lookup(series_id)
         now = self.clock()
         if priority is None:
@@ -116,7 +135,14 @@ class Database:
             ns.shard_for(shard_id).write(series_id, t_ns, value, now, tags,
                                          priority=priority)
         if self.commitlog is not None and ns.opts.writes_to_commitlog:
-            self.commitlog.write(namespace, series_id, t_ns, value, tags)
+            try:
+                self.commitlog.write(namespace, series_id, t_ns, value, tags)
+            except DiskWriteError:
+                # WAL append/fsync failure is an ACK failure: the caller
+                # sees the typed error, nothing is silently accepted.
+                self.disk_health.failure()
+                raise
+            self.disk_health.success()
 
     def write_batch(self, namespace: bytes, ids: Sequence[bytes], ts, vals,
                     tags: Optional[Sequence[Optional[dict]]] = None,
@@ -125,9 +151,8 @@ class Database:
         append. `priority` (utils.health.Priority) rides down to the
         shard insert queues' admission gates — BULK backfill sheds first
         when a queue's bounded depth fills."""
-        from ..utils.health import Priority
-
         ns = self.namespace(namespace)
+        self._check_writable(priority)
         ts = np.asarray(ts, np.int64)
         vals = np.asarray(vals, np.float64)
         now = self.clock()
@@ -170,14 +195,40 @@ class Database:
             # before the error propagates — otherwise a restart replay
             # silently drops accepted datapoints.
             if applied is not None and applied.any():
-                self.commitlog.write_batch(
-                    namespace, ids_arr[applied].tolist(), ts[applied],
-                    vals[applied],
-                    tags_arr[applied].tolist() if tags_arr is not None
-                    else None)
+                try:
+                    self.commitlog.write_batch(
+                        namespace, ids_arr[applied].tolist(), ts[applied],
+                        vals[applied],
+                        tags_arr[applied].tolist() if tags_arr is not None
+                        else None)
+                except DiskWriteError:
+                    # The rescue append itself hit the disk fault: the
+                    # typed WAL error supersedes the shed — callers must
+                    # treat the whole batch as un-acked.
+                    self.disk_health.failure()
+                    raise
             raise
         if log:
-            self.commitlog.write_batch(namespace, ids, ts, vals, tags)
+            try:
+                self.commitlog.write_batch(namespace, ids, ts, vals, tags)
+            except DiskWriteError:
+                self.disk_health.failure()
+                raise
+            self.disk_health.success()
+
+    def _check_writable(self, priority) -> None:
+        """Read-only posture under persistent disk faults: shed NORMAL
+        and BULK writes with typed Backpressure (producers back off, the
+        data is never half-accepted) while CRITICAL traffic — health
+        probes, replication streams — keeps flowing. Reads are untouched.
+        Recovery is automatic: flush retries keep probing the disk and
+        the first durable success clears the posture."""
+        if priority == Priority.CRITICAL:
+            return
+        if self.disk_health.read_only():
+            raise Backpressure(
+                "disk health: durable writes failing, node is read-only "
+                "(CRITICAL traffic and reads still flow)")
 
     # ------------------------------------------------------------------- read
 
@@ -261,8 +312,25 @@ class Database:
             for shard in ns.shards.values():
                 wrote = False
                 for bs in shard.flushable(now):
-                    persist_manager.write_block(ns.name, shard.shard_id, shard.blocks[bs], shard.registry)
+                    blk = shard.blocks.get(bs)
+                    if blk is None:
+                        continue
+                    try:
+                        self._flush_retrier.attempt(
+                            persist_manager.write_block, ns.name,
+                            shard.shard_id, blk, shard.registry)
+                    except DiskWriteError:
+                        # Typed flush failure after the retry budget:
+                        # the block stays FAILED (flushable() keeps it
+                        # on the schedule), health degrades toward the
+                        # read-only posture, and the sweep moves on —
+                        # one bad block must not strand the rest.
+                        shard.mark_flushed(bs, ok=False)
+                        self.disk_health.failure()
+                        _FLUSH_METRICS.counter("flush_failed").inc()
+                        continue
                     shard.mark_flushed(bs)
+                    self.disk_health.success()
                     flushed += 1
                     wrote = True
                 if wrote and self.retriever is not None:
@@ -272,9 +340,15 @@ class Database:
                 # (persist_manager.go:193-332 index segment persist).
                 from ..index import persist as idx_persist
 
-                flushed += len(idx_persist.flush_index(
-                    persist_manager.root, ns.name, ns.index, now,
-                    ns.opts.retention_ns))
+                try:
+                    flushed += len(idx_persist.flush_index(
+                        persist_manager.root, ns.name, ns.index, now,
+                        ns.opts.retention_ns))
+                except OSError:
+                    # Index segments rebuild from data filesets at
+                    # bootstrap: degrade health, count, keep the sweep.
+                    self.disk_health.failure()
+                    _FLUSH_METRICS.counter("index_flush_failed").inc()
         if self.commitlog is not None and flushed:
             self.commitlog.rotate()
         return flushed

@@ -17,6 +17,7 @@ import threading
 import time
 from typing import Dict, Optional
 
+from ..persist.diskio import DiskWriteError
 from ..persist.fs import PersistManager
 from ..storage.block import encode_block
 from ..utils import xtime
@@ -116,9 +117,19 @@ class Mediator:
                         continue
                     series, tdense, vdense, npoints = dense
                     blk = encode_block(bs, series, tdense, vdense, npoints)
-                    self.persist.write_snapshot(ns.name, shard.shard_id, blk,
-                                                shard.registry, version,
-                                                wal_position=wal_position)
+                    try:
+                        self.persist.write_snapshot(
+                            ns.name, shard.shard_id, blk, shard.registry,
+                            version, wal_position=wal_position)
+                    except DiskWriteError:
+                        # Typed snapshot failure: the bucket stays WAL-
+                        # replayable (nothing is lost, recovery just
+                        # replays more), health degrades, the sweep
+                        # continues — the next tick re-attempts.
+                        health = getattr(self.db, "disk_health", None)
+                        if health is not None:
+                            health.failure()
+                        continue
                     count += 1
         return count
 

@@ -22,8 +22,12 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from ..persist.diskio import CorruptionError
 from ..utils import xtime
+from ..utils.instrument import ROOT
 from .block import SealedBlock, WiredList
+
+_CORRUPTION = ROOT.sub_scope("storage.corruption")
 
 
 class BlockRetriever:
@@ -109,11 +113,24 @@ class BlockRetriever:
         if blk is not None:
             self.stats["wired_hits"] += 1
             return blk.read(0)
-        sk = self._seeker(namespace, shard, block_start)
-        if sk is None:
+        try:
+            sk = self._seeker(namespace, shard, block_start)
+            if sk is None:
+                return None
+            self.stats["seeks"] += 1
+            got = sk.seek(series_id)
+        except CorruptionError as e:
+            # Rotten bytes detected (row adler or digest mismatch):
+            # quarantine the fileset and serve the window from whatever
+            # coverage remains (WAL buffer, peers) instead of crashing
+            # the query — the scrubber repairs + un-quarantines later.
+            self._quarantine(namespace, shard, block_start, e)
             return None
-        self.stats["seeks"] += 1
-        got = sk.seek(series_id)
+        except (ValueError, KeyError) as e:
+            # Unparseable fileset metadata (corrupt info/digest json) is
+            # corruption too — it just dies before a checksum can speak.
+            self._quarantine(namespace, shard, block_start, e)
+            return None
         if got is None:
             self.stats["misses"] += 1
             return None
@@ -129,3 +146,28 @@ class BlockRetriever:
         )
         self.wired.put(key, blk)
         return blk.read(0)
+
+    def _quarantine(self, namespace: bytes, shard: int, block_start: int,
+                    err: Exception) -> None:
+        """Serve-time corruption response: rename the fileset into
+        `<shard-dir>/quarantine/` with a sidecar naming the failing rows,
+        then drop every cached handle on the shard (listing, seekers,
+        wired one-row blocks — whose device-cache generations invalidate
+        via WiredList.drop). The window keeps serving from WAL/peer
+        coverage; the scrubber's repair pass rebuilds and un-quarantines."""
+        from ..persist import fs as pfs
+
+        with self._lock:
+            path = self._filesets.get((namespace, shard), {}).get(block_start)
+        if path is None:
+            try:
+                path = dict(self.pm.list_filesets(namespace, shard)
+                            ).get(block_start)
+            except OSError:
+                path = None
+        if path is not None:
+            pfs.quarantine_fileset(
+                path, reason=f"{type(err).__name__}: {err}",
+                rows=getattr(err, "rows", ()), ids=getattr(err, "ids", ()))
+        self.invalidate(namespace, shard)
+        _CORRUPTION.counter("serve_quarantined").inc()

@@ -19,13 +19,17 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..persist.diskio import CorruptionError
 from ..utils import xtime
 from ..utils.health import Priority
+from ..utils.instrument import ROOT
 from . import block_cache
 from .block import SealedBlock, encode_block, merge_same_start
 from .buffer import ShardBuffer
 from .insert_queue import InsertGroup, InsertQueue
 from .series import SeriesRegistry
+
+_CORRUPTION = ROOT.sub_scope("storage.corruption")
 
 
 class ShardState(enum.Enum):
@@ -349,7 +353,15 @@ class Shard:
         if idx is not None:
             for bs in sorted(blocks):
                 if overlaps(bs):
-                    clip_append(blocks[bs].read(idx))
+                    try:
+                        clip_append(blocks[bs].read(idx))
+                    except CorruptionError:
+                        # A block paged in from a fileset flunked its lazy
+                        # row verification mid-serve: drop it and keep
+                        # serving the window from buffer/disk/peer
+                        # coverage — never the rotten bytes. The scrubber
+                        # handles the on-disk copy.
+                        self._drop_corrupt_block(bs, blocks[bs])
         if self._retriever is not None:
             on_disk = self._retriever.block_starts(self._retriever_ns, self.shard_id)
             for bs in sorted(on_disk):
@@ -381,6 +393,17 @@ class Shard:
             keep = np.concatenate([t[:-1] != t[1:], [True]])
             t, v = t[keep], v[keep]
         return t, v
+
+    def _drop_corrupt_block(self, bs: int, blk: SealedBlock) -> None:
+        """Evict an in-memory block whose lazy row verification failed.
+        Clearing the flush state (instead of marking FAILED) lets a
+        repair re-install a clean copy and re-enter the flush schedule."""
+        _CORRUPTION.counter("memory_block_dropped").inc()
+        with self.write_lock:
+            if self.blocks.get(bs) is blk:
+                del self.blocks[bs]
+            self.flush_states.pop(bs, None)
+        block_cache.get_cache().invalidate_block(blk)
 
     # ------------------------------------------------------- flush/bootstrap
 

@@ -61,17 +61,24 @@ import yaml
 from ..client.session import Session, SessionOptions
 from ..cluster.placement import Instance, ShardState, initial_placement
 from ..cluster.topology import StaticTopology
+from ..persist import fs as pfs
 from ..storage.bootstrap import BootstrapContext, BootstrapProcess
-from ..storage.repair import DatabaseRepairer, RepairOptions
+from ..storage.repair import DatabaseRepairer, RepairOptions, ShardRepairer
+from ..storage.retriever import BlockRetriever
+from ..storage.scrub import DatabaseScrubber, ScrubOptions, ScrubStats
 from ..utils import xtime
+from ..utils.health import Priority
+from ..utils.limits import Backpressure
 from ..utils.retry import RetryOptions
+from . import faultfs
 from .cluster import ClusterHarness
 from .faultnet import FaultPlan
 from .loadgen import LoadGen, LoadReport, LoadSchedule, Phase
 
 __all__ = ["ChurnScenarioOptions", "ChurnScenario", "ScenarioResult",
            "WriteLedger", "KillRestartOptions", "KillRestartScenario",
-           "KillRestartResult"]
+           "KillRestartResult", "DiskFaultScenarioOptions",
+           "DiskFaultScenario", "DiskFaultResult"]
 
 # Outcome type names that mean "the server deliberately shed this"
 # (Backpressure subclasses ResourceExhausted and rides the wire as the
@@ -959,3 +966,374 @@ class KillRestartScenario:
                 s.close()
             if self._owns_dir:
                 shutil.rmtree(self.dir, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# disk-fault drill: bit rot, scrubbing, and full-disk degradation
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class DiskFaultScenarioOptions:
+    """One seeded disk-fault drill: an RF=3 cluster where ONE node's
+    storage stack runs under a seeded `testing.faultfs` plan, in phases:
+
+      corrupt   cold serving I/O on the victim flips bits / truncates
+                reads while open-loop load runs; serve-time row-checksum
+                verification must detect every rotten row, quarantine
+                the fileset, and let replica coverage hide the damage.
+      scrub     a DatabaseScrubber sweep (ShardRepairer attached) must
+                re-fetch quarantined blocks from the healthy peers,
+                un-quarantine them, and the rewrite flush must leave
+                every victim fileset verify_rows()-clean.
+      disk full every new write on the victim fails ENOSPC: flush
+                failures trip DiskHealth into the read-only posture
+                (NORMAL writes shed typed Backpressure, CRITICAL and
+                reads keep flowing), and the first durable flush after
+                the fault clears recovers the node automatically.
+
+    Throughout: zero acked-write loss, zero fabrication (every served
+    point is one the drill wrote), and bounded p99 under the corruption
+    window. Faults, load, and scrub jitter are pure functions of `seed`;
+    wall-clock timing is not, so the assertions are SLO-shaped."""
+
+    seed: int = 7
+    n_nodes: int = 3
+    replica_factor: int = 3
+    num_shards: int = 8
+    n_series: int = 24
+    victim: str = "node0"
+    # Seeded read-corruption plan (corrupt phase) on the victim's disk.
+    read_flip: float = 0.3
+    read_short: float = 0.1
+    # Seeded full-disk plan (disk-full phase): every new write ENOSPCs.
+    write_enospc: float = 1.0
+    # Open-loop offered load during the corruption window.
+    base_rate: float = 40.0
+    duration_s: float = 1.5
+    read_sweeps: int = 3          # deterministic cold-read passes
+    # SLO bounds asserted by verify().
+    p99_write_s: float = 2.0
+    p99_read_s: float = 2.0
+    session_timeout_s: float = 5.0
+    warm_kernels: bool = True
+
+
+@dataclasses.dataclass
+class DiskFaultResult:
+    report: Optional[LoadReport]
+    ledger: WriteLedger
+    quarantined_after_faults: int = 0
+    quarantined_after_scrub: int = 0
+    scrub_stats: Optional[ScrubStats] = None
+    health_tripped: bool = False
+    normal_shed: bool = False
+    critical_served: bool = False
+    recovered: bool = False
+    verified_points: int = 0
+    filesets_verified: int = 0
+
+
+class DiskFaultScenario:
+    """One seeded disk-fault drill over an in-process RF=3 cluster."""
+
+    NS = b"default"
+
+    def __init__(self,
+                 opts: DiskFaultScenarioOptions = DiskFaultScenarioOptions()):
+        self.opts = opts
+        self.cluster = ClusterHarness(
+            n_nodes=opts.n_nodes, replica_factor=opts.replica_factor,
+            num_shards=opts.num_shards, with_commitlog=True)
+        # Disk-backed cold reads on every node: the victim's sealed
+        # blocks are evicted after the seed flush, so its serving path
+        # actually crosses the (faulted) persist tier.
+        for node in self.cluster.nodes.values():
+            node.db.set_retriever(BlockRetriever(node.persist))
+        self.victim = self.cluster.nodes[opts.victim]
+        victim_scope = self.victim.data_dir + os.sep
+        self.read_plan = faultfs.DiskFaultPlan(
+            seed=opts.seed, read_flip=opts.read_flip,
+            read_short=opts.read_short, path_filter=victim_scope)
+        self.disk_full_plan = faultfs.DiskFaultPlan(
+            seed=opts.seed, write_enospc=opts.write_enospc,
+            path_filter=victim_scope)
+        self.ids = [b"disk-%04d" % i for i in range(opts.n_series)]
+        self.ledger = WriteLedger(self.cluster.clock.now_ns)
+        # Every write the drill EVER issued, acked or not: the
+        # fabrication check — anything any replica serves must be here.
+        self._attempted: Dict[Tuple[bytes, int], float] = {}
+        self.session = Session(
+            self.cluster.topology,
+            SessionOptions(timeout_s=opts.session_timeout_s,
+                           retry=RetryOptions(max_attempts=2,
+                                              initial_backoff_s=0.02),
+                           fanout_workers=64, pool_size=8))
+        self.admin_session = Session(
+            self.cluster.topology,
+            SessionOptions(timeout_s=max(10.0, opts.session_timeout_s)))
+        self.result = DiskFaultResult(report=None, ledger=self.ledger)
+
+    # ---------------------------------------------------------------- phases
+
+    def _warm_kernels(self):
+        """Pre-compile the encode/decode row buckets the drill touches
+        (see ChurnScenario._warm_kernels: a mid-run first-compile would
+        bill XLA time into the corruption-window p99)."""
+        from ..storage.block import encode_block
+
+        max_rows = max(16, 1 << (max(1, (2 * self.opts.n_series)
+                                     // self.opts.num_shards) - 1).bit_length())
+        bs = self.cluster.clock.now_ns - 4 * xtime.HOUR
+        rows = 1
+        while rows <= max_rows:
+            ts = np.tile(
+                bs + np.arange(4, dtype=np.int64) * xtime.SECOND, (rows, 1))
+            vs = np.ones((rows, 4), np.float64)
+            blk = encode_block(bs, np.arange(rows, dtype=np.int32), ts, vs,
+                               np.full(rows, 4, np.int32))
+            blk.read_all()
+            blk.read(0)
+            rows *= 2
+
+    def _seed_and_flush(self):
+        """Seed sealed history on every replica, flush it to disk
+        everywhere, and evict the VICTIM's in-memory copies — its cold
+        reads now cross the persist tier while the peers keep resident
+        (authoritative) copies for repair to fetch from."""
+        now = self.cluster.clock.now_ns
+        ts = [now - (i + 1) * xtime.SECOND for i in range(4)]
+        for j, sid in enumerate(self.ids):
+            vals = np.arange(len(ts), dtype=np.float64) + 1000.0 * j
+            for t_ns, v in zip(ts, vals):
+                self._attempted[(sid, t_ns)] = float(v)
+                self.ledger.ack(sid, t_ns, float(v))
+            self.session.write_batch(self.NS, [sid] * len(ts), ts, vals)
+        self.cluster.clock.advance(2 * xtime.HOUR + 11 * xtime.MINUTE)
+        self.cluster.tick_all()
+        now = self.cluster.clock.now_ns
+        for node in self.cluster.nodes.values():
+            node.db.flush(node.persist, now)
+        self.victim.db.evict_flushed()
+        self.ledger.base_t_ns = now
+
+    def _fire(self, kind: str):
+        rng = random.Random()  # content only; schedule is already seeded
+        sid = self.ids[rng.randrange(len(self.ids))]
+        if kind == "write":
+            t_ns, value = self.ledger.next_write(sid)
+            self._attempted[(sid, t_ns)] = value
+            self.session.write(self.NS, sid, t_ns, value)
+            # Only reached on quorum ack.
+            self.ledger.ack(sid, t_ns, value)
+        else:
+            self.session.fetch(self.NS, sid, 0,
+                               self.cluster.clock.now_ns + xtime.HOUR)
+
+    def _count_quarantined(self) -> int:
+        return sum(
+            len(self.victim.persist.list_quarantined(self.NS, shard))
+            for shard in range(self.opts.num_shards))
+
+    def _corruption_phase(self):
+        """Seeded bit rot under live load: victim cold reads hit flipped
+        bits / short reads; serve-time verification must quarantine the
+        rot while replica coverage keeps every fetch correct."""
+        o = self.opts
+        faultfs.install(self.read_plan)
+        try:
+            gen = LoadGen(LoadSchedule(
+                seed=o.seed, base_rate=o.base_rate,
+                phases=(Phase("corrupt", o.duration_s, 1.0),),
+                kinds=(("write", 2.0), ("read", 3.0))))
+            self.result.report = gen.run(
+                self._fire, join_timeout_s=max(30.0, 10 * o.duration_s))
+            # Deterministic cold sweeps on top of the open-loop load:
+            # every series' cold block is sought through the fault plan,
+            # so detection does not depend on the load mix.
+            end = self.cluster.clock.now_ns + xtime.HOUR
+            for _ in range(o.read_sweeps):
+                for sid in self.ids:
+                    self.session.fetch(self.NS, sid, 0, end)
+        finally:
+            faultfs.uninstall()
+        self.result.quarantined_after_faults = self._count_quarantined()
+
+    def _scrub_phase(self):
+        """Reconvergence: one scrubber sweep repairs the quarantined
+        blocks from the healthy peers and un-quarantines them; the
+        rewrite flush makes the victim's disk clean again."""
+        # Age the seed block into scrub's cold territory (outside the
+        # two-block mutable head) and seal the corruption-window writes.
+        self.cluster.clock.advance(4 * xtime.HOUR + 7 * xtime.MINUTE)
+        self.cluster.tick_all()
+        now = self.cluster.clock.now_ns
+        self.ledger.base_t_ns = now
+        scrubber = DatabaseScrubber(
+            self.victim.db, self.victim.persist,
+            repairer=ShardRepairer(self.admin_session,
+                                   host_id=self.opts.victim),
+            opts=ScrubOptions(seed=self.opts.seed))
+        stats = scrubber.run(now_ns=now)
+        total = ScrubStats()
+        for st in stats.values():
+            total.add(st)
+        self.result.scrub_stats = total
+        # Repaired blocks cleared their flush state: rewrite them (plus
+        # the just-sealed corruption-window block) while the disk heals.
+        for node in self.cluster.nodes.values():
+            node.db.flush(node.persist, now)
+        self.result.quarantined_after_scrub = self._count_quarantined()
+
+    def _degrade_phase(self):
+        """Full disk on the victim: flush failures trip DiskHealth into
+        read-only (NORMAL sheds typed Backpressure, CRITICAL and reads
+        flow), and the first clean flush recovers it."""
+        for sid in self.ids:
+            t_ns, value = self.ledger.next_write(sid)
+            self._attempted[(sid, t_ns)] = value
+            self.session.write(self.NS, sid, t_ns, value)
+            self.ledger.ack(sid, t_ns, value)
+        self.cluster.clock.advance(2 * xtime.HOUR + 11 * xtime.MINUTE)
+        self.cluster.tick_all()
+        self.ledger.base_t_ns = self.cluster.clock.now_ns
+        db = self.victim.db
+        faultfs.install(self.disk_full_plan)
+        try:
+            # Every sealed block's flush ENOSPCs (typed DiskFullError
+            # through the retry budget): consecutive failures trip the
+            # read-only posture.
+            db.flush(self.victim.persist, self.cluster.clock.now_ns)
+            self.result.health_tripped = db.disk_health.read_only()
+            sid = self.ids[0]
+            t_ns, value = self.ledger.next_write(sid)
+            self._attempted[(sid, t_ns)] = value
+            try:
+                db.write(self.NS, sid, t_ns, value)
+            except Backpressure:
+                self.result.normal_shed = True  # typed shed, not an ack
+            # CRITICAL traffic is never shed; reads keep flowing too.
+            crit_sid = self.ids[1]
+            t_ns, value = self.ledger.next_write(crit_sid)
+            self._attempted[(crit_sid, t_ns)] = value
+            db.write(self.NS, crit_sid, t_ns, value,
+                     priority=Priority.CRITICAL)
+            t, v = db.read(self.NS, crit_sid, t_ns, t_ns + 1)
+            self.result.critical_served = (
+                len(t) == 1 and float(v[0]) == value)
+        finally:
+            faultfs.uninstall()
+        # Recovery is automatic: the next flush sweep's durable success
+        # clears the posture and NORMAL writes flow again.
+        db.flush(self.victim.persist, self.cluster.clock.now_ns)
+        if not db.disk_health.read_only():
+            sid = self.ids[2]
+            t_ns, value = self.ledger.next_write(sid)
+            self._attempted[(sid, t_ns)] = value
+            db.write(self.NS, sid, t_ns, value)  # would raise if still RO
+            self.result.recovered = True
+
+    # ------------------------------------------------------------------- run
+
+    def run(self) -> DiskFaultResult:
+        if self.opts.warm_kernels:
+            self._warm_kernels()
+        self._seed_and_flush()
+        self._corruption_phase()
+        self._scrub_phase()
+        self._degrade_phase()
+        # Final convergence: seal + flush everything with the disk
+        # healthy so verify() reads a settled cluster.
+        self.cluster.clock.advance(2 * xtime.HOUR + 11 * xtime.MINUTE)
+        self.cluster.tick_all()
+        now = self.cluster.clock.now_ns
+        for node in self.cluster.nodes.values():
+            node.db.flush(node.persist, now)
+        return self.result
+
+    # ---------------------------------------------------------------- verify
+
+    def verify(self, result: DiskFaultResult) -> DiskFaultResult:
+        """Assert every disk-fault SLO; raises AssertionError naming the
+        violated guarantee."""
+        o = self.opts
+
+        # 1. detection: seeded bit rot was caught and quarantined.
+        assert result.quarantined_after_faults >= 1, \
+            "no fileset quarantined under seeded read corruption"
+
+        # 2. reconvergence: the scrub sweep repaired from peers and
+        # un-quarantined everything it found.
+        st = result.scrub_stats
+        assert st is not None and st.unquarantined >= 1, \
+            f"scrub un-quarantined nothing: {st}"
+        assert st.blocks_repaired >= 1, \
+            f"scrub repaired no blocks from peers: {st}"
+        assert st.filesets_scanned >= 1, \
+            f"scrub cold scan covered no filesets: {st}"
+        assert result.quarantined_after_scrub == 0, \
+            (f"{result.quarantined_after_scrub} fileset(s) still "
+             f"quarantined after scrub + repair")
+
+        # 3. the victim's disk is verifiably clean end-state: every
+        # fileset row-verifies (digest chain + per-row adlers + bloom).
+        verified = 0
+        for shard in range(o.num_shards):
+            for _bs, path in self.victim.persist.list_filesets(
+                    self.NS, shard):
+                pfs.FilesetReader(path).verify_rows()
+                verified += 1
+        assert verified >= 1, "victim holds no filesets to verify"
+        result.filesets_verified = verified
+
+        # 4. graceful degradation: full disk tripped read-only, NORMAL
+        # shed typed Backpressure, CRITICAL + reads flowed, and the
+        # first clean flush recovered the node.
+        assert result.health_tripped, \
+            "ENOSPC flush failures never tripped DiskHealth read-only"
+        assert result.normal_shed, \
+            "read-only posture did not shed a NORMAL write"
+        assert result.critical_served, \
+            "CRITICAL write/read did not flow under read-only posture"
+        assert result.recovered, \
+            "node did not auto-recover after the disk healed"
+
+        # 5. bounded p99 under the corruption window.
+        rep = result.report
+        p99_w = rep.quantile_latency(0.99, kind="write")
+        p99_r = rep.quantile_latency(0.99, kind="read")
+        assert p99_w <= o.p99_write_s, \
+            f"write p99 {p99_w:.3f}s > bound {o.p99_write_s}s"
+        assert p99_r <= o.p99_read_s, \
+            f"read p99 {p99_r:.3f}s > bound {o.p99_read_s}s"
+
+        # 6. zero lost acked writes, despite quarantine + read-only.
+        now = self.cluster.clock.now_ns
+        verified_points = 0
+        fetched: Dict[bytes, Dict[int, float]] = {}
+        for sid, points in sorted(result.ledger.acked().items()):
+            t, v = self.session.fetch(self.NS, sid, 0, now + 1)
+            got = dict(zip(t.tolist(), v.tolist()))
+            fetched[sid] = got
+            for t_ns, value in points:
+                assert got.get(t_ns) == value, \
+                    (f"ACKED write lost under disk faults: {sid!r} "
+                     f"t={t_ns} v={value} (fetched {len(got)} points)")
+                verified_points += 1
+        result.verified_points = verified_points
+
+        # 7. zero fabrication: corrupt bytes must never surface as data
+        # — every served point is one this drill wrote.
+        for sid, got in fetched.items():
+            for t_ns, value in got.items():
+                want = self._attempted.get((sid, int(t_ns)))
+                assert want == value, \
+                    (f"fabricated point served: {sid!r} t={t_ns} "
+                     f"v={value} (want {want})")
+        return result
+
+    def close(self):
+        faultfs.uninstall()  # idempotent: never leak a fault plan
+        self.session.close()
+        self.admin_session.close()
+        self.cluster.close()

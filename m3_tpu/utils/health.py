@@ -32,8 +32,8 @@ from typing import Callable, Dict, List, Optional, Tuple
 from .instrument import ROOT
 from .limits import Backpressure
 
-__all__ = ["Priority", "AdmissionGate", "HealthTracker", "TRACKER",
-           "OK", "DEGRADED", "SHEDDING"]
+__all__ = ["Priority", "AdmissionGate", "HealthTracker", "DiskHealth",
+           "TRACKER", "OK", "DEGRADED", "SHEDDING"]
 
 
 class Priority(enum.IntEnum):
@@ -203,6 +203,58 @@ class _Held:
     def __exit__(self, *exc):
         self._gate.release(self._n, tenant=self._tenant)
         return False
+
+
+class DiskHealth:
+    """Consecutive-failure breaker over durable-write health (the disk
+    leg of the degradation story; the reference's fs bootstrapping +
+    commitlog failure policies fold into its health reporting the same
+    way). WAL append/fsync and fileset-flush failures call `failure()`;
+    any durable-write success clears the streak.
+
+    After `trip_after` CONSECUTIVE failures the node takes a READ-ONLY
+    posture: `read_only()` is True — the write path sheds NORMAL/BULK
+    writes with typed Backpressure while CRITICAL traffic and reads keep
+    flowing — and `saturation()` reads 1.0 so a registered HealthTracker
+    degrades the exported state. Recovery is automatic: the first
+    successful durable write (flush retries keep probing via Retrier
+    backoff) resets the streak and lifts the posture."""
+
+    def __init__(self, trip_after: int = 3, name: str = "",
+                 tracker: Optional["HealthTracker"] = None):
+        if trip_after <= 0:
+            raise ValueError(f"trip_after must be positive, got {trip_after}")
+        self.trip_after = trip_after
+        self._lock = threading.Lock()
+        self._consecutive = 0
+        self.failures = 0
+        self.trips = 0
+        if name:
+            (tracker if tracker is not None else TRACKER).register(
+                name, self.saturation)
+
+    def failure(self) -> None:
+        with self._lock:
+            self._consecutive += 1
+            self.failures += 1
+            tripped = self._consecutive == self.trip_after
+        if tripped:
+            self.trips += 1
+            ROOT.sub_scope("health").counter("disk_read_only_trips").inc()
+
+    def success(self) -> None:
+        if self._consecutive == 0:
+            return  # hot-path fast out: nothing to clear, skip the lock
+        with self._lock:
+            self._consecutive = 0
+
+    def read_only(self) -> bool:
+        with self._lock:
+            return self._consecutive >= self.trip_after
+
+    def saturation(self) -> float:
+        with self._lock:
+            return min(1.0, self._consecutive / self.trip_after)
 
 
 class HealthTracker:

@@ -103,6 +103,20 @@ echo "== restart smoke (<10s; kill -9 a real dbnode mid-flush, restart, zero ack
 # budget via RESTART_SMOKE_BUDGET_S.
 JAX_PLATFORMS=cpu python scripts/restart_smoke.py --seed 7
 
+echo "== diskfault smoke (<10s; seeded I/O faults on one replica: quarantine, scrub repair from peers, ENOSPC read-only + recovery, zero acked loss) =="
+# The disk-fault plane: one RF=3 drill with the victim's persist tier
+# behind a seeded testing/faultfs plan — serve-time row-checksum
+# verification must quarantine every rotten fileset, the scrubber must
+# repair from healthy peers and un-quarantine, ENOSPC must trip
+# DiskHealth read-only (NORMAL sheds, CRITICAL + reads flow) and
+# auto-recover, with zero acked-write loss and zero fabrication. Full
+# matrix: tests/test_diskfault.py (4+ seeds); region-targeted bit-flip
+# corpus: scripts/fuzz_durability.py. Wall budget via
+# DISKFAULT_SMOKE_BUDGET_S (first cold run pays one-time kernel
+# compiles, persisted to .jax_cache for later runs — override the
+# budget on a cold tree).
+JAX_PLATFORMS=cpu python scripts/diskfault_smoke.py --seed 7
+
 echo "== observability smoke (<10s; cross-process span tree, slow-query log, self-scrape PromQL round trip, jit telemetry) =="
 # The tracing / /debug / self-scrape plane: one 2-node clustered run
 # asserting a client->coordinator->dbnode span tree (>=3 hops, grafted

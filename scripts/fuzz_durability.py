@@ -47,6 +47,8 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 from m3_tpu.persist import commitlog as cl  # noqa: E402
+from m3_tpu.persist import fs as pfs  # noqa: E402
+from m3_tpu.persist.diskio import CorruptionError  # noqa: E402
 from m3_tpu.persist.fs import (FilesetReader, PersistManager,  # noqa: E402
                                fileset_complete)
 from m3_tpu.storage.block import encode_block  # noqa: E402
@@ -182,6 +184,84 @@ def fileset_round(rng) -> None:
         shutil.rmtree(root, ignore_errors=True)
 
 
+# Region-targeted serve-path corpus: one flipped byte in one NAMED
+# fileset region, then read through the LAZY serve path (verify=False
+# reader -> SealedBlock row verification, and the Seeker point-lookup
+# path) instead of the up-front verify=True scan above. The invariant
+# is detect-or-serve-correct: every read either raises typed
+# (CorruptionError / parse rejection) or returns bit-identical data —
+# a clean read of wrong bytes is the only failure.
+REGIONS = ("index", "data", "bloom", "checkpoint", "summaries")
+_REGION_FILES = {
+    "index": pfs.INDEX_FILE, "data": pfs.DATA_FILE, "bloom": pfs.BLOOM_FILE,
+    "checkpoint": pfs.CHECKPOINT_FILE, "summaries": pfs.SUMMARIES_FILE,
+}
+
+
+def region_round(rng, region: str) -> str:
+    """Returns the outcome: 'detected' or 'served-correct'."""
+    root = tempfile.mkdtemp(prefix="fuzz_region_")
+    try:
+        n, w = int(rng.integers(2, 20)), int(rng.integers(4, 40))
+        reg = SeriesRegistry()
+        ids = [b"rz.%d" % i for i in range(n)]
+        for sid in ids:
+            reg.get_or_create(sid)
+        ts = (T0 + np.arange(w, dtype=np.int64)[None, :] * 10 * xtime.SECOND
+              + np.zeros((n, 1), np.int64))
+        vals = rng.integers(0, 50, size=(n, w)).astype(np.float64)
+        blk = encode_block(T0, np.arange(n, dtype=np.int32), ts, vals,
+                           np.full(n, w, np.int32))
+        pm = PersistManager(root)
+        path = pm.write_block(b"ns", 1, blk, reg)
+        clean_blk, clean_ids = FilesetReader(path, verify=True).to_block()
+        truth = clean_blk.read_all()
+        sk0 = pfs.Seeker(path)
+        truth_rows = {sid: sk0.seek(sid) for sid in clean_ids}
+        fpath = os.path.join(path, _REGION_FILES[region])
+        data = bytearray(open(fpath, "rb").read())
+        if not data:
+            return "detected"  # empty region; nothing to corrupt
+        i = int(rng.integers(0, len(data)))
+        data[i] ^= int(rng.integers(1, 256))
+        with open(fpath, "wb") as f:
+            f.write(bytes(data))
+        if not fileset_complete(path):
+            return "detected"  # checkpoint chain flagged it
+        # Serve path 1: lazy block materialization + row verification.
+        try:
+            got_blk, got_ids = FilesetReader(path, verify=False).to_block()
+            ts_g, vs_g, np_g = got_blk.read_all()
+        except (CorruptionError, ValueError, KeyError, OSError, IndexError):
+            return "detected"
+        assert list(got_ids) == list(clean_ids), (
+            f"{region} flip at {i} served a different id set")
+        for want, got, label in ((truth[0], ts_g, "timestamps"),
+                                 (truth[1], vs_g, "values"),
+                                 (truth[2], np_g, "npoints")):
+            assert np.array_equal(want, got, equal_nan=True), (
+                f"{region} flip at {i} served wrong {label}")
+        # Serve path 2: the Seeker point lookups (bloom + index + row
+        # adler route — distinct bytes from to_block's matrix route).
+        # seek returns the packed (words row, nbits, npoints) triple.
+        try:
+            sk = pfs.Seeker(path)
+            for sid in clean_ids:
+                got = sk.seek(sid)
+                if got is None:
+                    raise AssertionError(
+                        f"{region} flip at {i} dropped {sid!r} from seek")
+                want = truth_rows[sid]
+                assert np.array_equal(want[0], got[0]) and \
+                    want[1:] == got[1:], (
+                    f"{region} flip at {i} served wrong row for {sid!r}")
+        except (CorruptionError, ValueError, KeyError, OSError, IndexError):
+            return "detected"
+        return "served-correct"
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--rounds", type=int, default=100)
@@ -190,13 +270,17 @@ def main():
     rng = np.random.default_rng(args.seed)
     t0 = time.time()
     seq = 0
+    outcomes = {"detected": 0, "served-correct": 0}
     for r in range(args.rounds):
         seq = commitlog_round(rng, seq)
         fileset_round(rng)
+        outcomes[region_round(rng, REGIONS[r % len(REGIONS)])] += 1
         if (r + 1) % 25 == 0:
             print(f"  round {r + 1}/{args.rounds} "
                   f"({seq} wal records, {time.time() - t0:.0f}s)", flush=True)
     print(f"DURABILITY FUZZ PASS: {args.rounds} rounds, {seq} wal records, "
+          f"region corpus {outcomes['detected']} detected / "
+          f"{outcomes['served-correct']} served-correct, "
           f"seed {args.seed}, {time.time() - t0:.0f}s")
     return 0
 

@@ -20,6 +20,13 @@ Protocol:
     (default 3%), and ON >= the recorded bench_baseline.json floor
     (the acceptance criterion's "vs recorded baselines").
 
+VERIFY section: serve-time lazy row verification
+(storage/block._verify_rows) must cost <3% on `hot_set_read`'s warm
+reads/sec — the bench's BENCH_HOT_VERIFY=1 knob arms every sealed block
+with expected per-row adler32s (as paged-in filesets carry), so ON pays
+one adler pass per block cold plus the per-read verified-flag check
+warm. Bound via VERIFY_GUARD_MAX_REGRESSION.
+
 ANALYZE section (PERF.md round 15): the query observatory's ANALYZE
 hooks (query/explain.py — bind stage, device dispatch, result
 materialization, grid-cache events) must be free when disabled.
@@ -31,9 +38,10 @@ ANALYZE_GUARD_ON_MAX_REGRESSION (default 10%) as a pathology backstop,
 and ANALYZE-off above the recorded floors.
 
 Usage: python scripts/obs_overhead_guard.py
-Env: OBS_GUARD_REPS, OBS_GUARD_MAX_REGRESSION, ANALYZE_GUARD_REPS,
-ANALYZE_GUARD_MAX_REGRESSION, ANALYZE_GUARD_ON_MAX_REGRESSION, the
-benches' own BENCH_WRITE_*/BENCH_INDEX_*/BENCH_PLAN_* knobs.
+Env: OBS_GUARD_REPS, OBS_GUARD_MAX_REGRESSION, VERIFY_GUARD_MAX_REGRESSION,
+ANALYZE_GUARD_REPS, ANALYZE_GUARD_MAX_REGRESSION,
+ANALYZE_GUARD_ON_MAX_REGRESSION, the benches' own
+BENCH_WRITE_*/BENCH_INDEX_*/BENCH_HOT_*/BENCH_PLAN_* knobs.
 """
 
 from __future__ import annotations
@@ -115,6 +123,53 @@ def main() -> int:
     guard("write_path_ingest",
           {"steady_dps": off_w["steady_dps"]},
           {"steady_dps": on_w["steady_dps"]}, "write_path_ingest_steady")
+
+    # ---- Serve-time lazy verification (storage/block._verify_rows):
+    # the integrity tax on hot serving. A/B the BENCH_HOT_VERIFY knob
+    # on hot_set_read — ON arms every sealed block with its expected
+    # per-row adler32s as if paged in from a fileset, so the cold pass
+    # pays one vectorized adler pass per block and every warm read pays
+    # the two-getattr verified-flag check. Warm reads/sec (the headline,
+    # the dashboard steady state) must stay within
+    # VERIFY_GUARD_MAX_REGRESSION (default 3%) of the unverified run,
+    # and the VERIFIED run must still beat the recorded baseline floor.
+    # cold_qps reports unguarded: the one-time adler pass is the
+    # designed detection cost, bounded by the flag's laziness, not by
+    # this guard.
+    v_max = float(os.environ.get("VERIFY_GUARD_MAX_REGRESSION", "0.03"))
+
+    def verify_series(fn, extract):
+        best = ({}, {})
+        for _ in range(reps):
+            for mode in (0, 1):
+                if mode:
+                    os.environ["BENCH_HOT_VERIFY"] = "1"
+                try:
+                    vals = extract(fn())
+                finally:
+                    os.environ.pop("BENCH_HOT_VERIFY", None)
+                for k, v in vals.items():
+                    best[mode][k] = max(best[mode].get(k, 0.0), v)
+        return best
+
+    print("== hot_set_read (lazy row verification on vs off) ==")
+    v_off, v_on = verify_series(
+        bench.bench_hot_set_read,
+        lambda r: {"warm_qps": float(r["value"]),
+                   "cold_qps": float(r["extra"]["cold_qps"])})
+    ratio = (v_on["warm_qps"] / v_off["warm_qps"]
+             if v_off["warm_qps"] else 1.0)
+    check(f"hot_set_read.warm_qps verified within {v_max:.0%} of unverified",
+          ratio >= 1.0 - v_max,
+          f"off={v_off['warm_qps']:.1f} on={v_on['warm_qps']:.1f} "
+          f"ratio={ratio:.3f}")
+    floor = baselines.get("hot_set_read")
+    if floor:
+        check("hot_set_read verified beats recorded baseline",
+              v_on["warm_qps"] >= floor,
+              f"on={v_on['warm_qps']:.1f} floor={floor:.1f}")
+    print(f"  cold_qps (unguarded): off={v_off['cold_qps']:.1f} "
+          f"on={v_on['cold_qps']:.1f}")
 
     # ---- ANALYZE instrumentation (query/explain.py): the hooks on the
     # query path (bind stage, device dispatch, result materialization,
@@ -198,6 +253,7 @@ def main() -> int:
     out = {
         "index_fetch_tagged": {"off": off, "on": on},
         "write_path_ingest": {"off": off_w, "on": on_w},
+        "verify_hot_set_read": {"off": v_off, "on": v_on},
         "analyze_promql_plan_agg": {
             "bypass": p_bypass, "off": p_off, "on": p_on},
         "analyze_index_fetch_tagged": {

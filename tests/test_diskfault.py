@@ -1,0 +1,478 @@
+"""Disk-fault plane: seeded I/O fault injection (testing/faultfs),
+serve-time row-checksum verification + quarantine, background scrubbing
+with repair routing, and full-disk graceful degradation (reference
+model: dbnode digest verification at fileset open, repair.go's
+background sweeps, and the dtest destructive disk scenarios).
+
+The DiskFaultScenario composition drill at the bottom runs the whole
+stack at once: RF=3, one node's storage under a seeded fault plan,
+zero acked-write loss / zero fabrication asserted end-state."""
+
+import errno
+import json
+import os
+
+import numpy as np
+import pytest
+
+from m3_tpu.parallel.sharding import ShardSet
+from m3_tpu.persist import commitlog as cl
+from m3_tpu.persist import fs as pfs
+from m3_tpu.persist.diskio import (CorruptionError, DiskFullError,
+                                   DiskWriteError, classify_write_error)
+from m3_tpu.storage.block import encode_block
+from m3_tpu.storage.database import Database
+from m3_tpu.storage.namespace import NamespaceOptions
+from m3_tpu.storage.retriever import BlockRetriever
+from m3_tpu.storage.scrub import DatabaseScrubber, ScrubOptions
+from m3_tpu.storage.series import SeriesRegistry
+from m3_tpu.storage.timerange import overlaps
+from m3_tpu.testing import faultfs
+from m3_tpu.testing.scenario import (DiskFaultScenario,
+                                     DiskFaultScenarioOptions)
+from m3_tpu.utils import xtime
+from m3_tpu.utils.health import DiskHealth, Priority
+from m3_tpu.utils.limits import Backpressure
+
+NS = b"default"
+BLOCK = 2 * xtime.HOUR
+T0 = 1_600_000_000 * xtime.SECOND - (1_600_000_000 * xtime.SECOND) % BLOCK
+
+
+@pytest.fixture(autouse=True)
+def _clean_seam():
+    """Every test starts and ends on the real disk seam."""
+    faultfs.uninstall()
+    yield
+    faultfs.uninstall()
+
+
+def _mk_fileset(root, rng, n=8, w=6, shard=1, block_start=T0):
+    reg = SeriesRegistry()
+    ids = [b"df.%d" % i for i in range(n)]
+    for sid in ids:
+        reg.get_or_create(sid)
+    ts = (block_start + np.arange(w, dtype=np.int64)[None, :] * 10
+          * xtime.SECOND + np.zeros((n, 1), np.int64))
+    vals = rng.integers(0, 50, size=(n, w)).astype(np.float64)
+    blk = encode_block(block_start, np.arange(n, dtype=np.int32), ts, vals,
+                       np.full(n, w, np.int32))
+    pm = pfs.PersistManager(root)
+    return pm, ids, pm.write_block(NS, shard, blk, reg)
+
+
+# ---------------------------------------------------------------------------
+# faultfs: the schedule is a pure function of the seed
+# ---------------------------------------------------------------------------
+
+
+class TestFaultfsDeterminism:
+    def test_decisions_replay_schedule_exactly(self, tmp_path):
+        plan = faultfs.DiskFaultPlan(seed=3, read_flip=0.4, read_short=0.2)
+        p = os.path.join(str(tmp_path), "dir", "blob.bin")
+        os.makedirs(os.path.dirname(p))
+        with open(p, "wb") as f:
+            f.write(b"x" * 64)
+        io = faultfs.FaultIO(plan)
+        for _ in range(9):
+            with io.open(p, "rb") as f:
+                f.read()
+        key = faultfs._path_key(p)
+        assert io.decisions[("read", key)] == plan.schedule("read", key, 9)
+        # And a second injector replays the identical stream.
+        io2 = faultfs.FaultIO(plan)
+        for _ in range(9):
+            with io2.open(p, "rb") as f:
+                f.read()
+        assert io2.decisions == io.decisions
+
+    def test_schedule_independent_per_op_and_key(self):
+        plan = faultfs.DiskFaultPlan(seed=5, read_flip=0.5, write_eio=0.5)
+        a = plan.schedule("read", "d/a.bin", 32)
+        assert plan.schedule("read", "d/a.bin", 32) == a  # pure
+        assert plan.schedule("read", "d/b.bin", 32) != a  # per-key stream
+        assert plan.schedule("write", "d/a.bin", 32) != a  # per-op stream
+
+    def test_path_filter_scopes_faults(self, tmp_path):
+        inside = os.path.join(str(tmp_path), "node0", "f.bin")
+        outside = os.path.join(str(tmp_path), "node1", "f.bin")
+        for p in (inside, outside):
+            os.makedirs(os.path.dirname(p))
+            with open(p, "wb") as f:
+                f.write(b"y" * 32)
+        plan = faultfs.DiskFaultPlan(
+            seed=1, read_flip=1.0,
+            path_filter=os.path.join(str(tmp_path), "node0") + os.sep)
+        io = faultfs.FaultIO(plan)
+        with io.open(outside, "rb") as f:
+            assert f.read() == b"y" * 32  # untouched, no decision drawn
+        with io.open(inside, "rb") as f:
+            assert f.read() != b"y" * 32  # exactly one bit flipped
+        assert io.faults_injected == 1
+
+    def test_flip_changes_one_bit_short_truncates(self, tmp_path):
+        p = os.path.join(str(tmp_path), "d", "f.bin")
+        os.makedirs(os.path.dirname(p))
+        data = bytes(range(64))
+        with open(p, "wb") as f:
+            f.write(data)
+        io = faultfs.FaultIO(faultfs.DiskFaultPlan(seed=2, read_flip=1.0))
+        with io.open(p, "rb") as f:
+            got = f.read()
+        diff = [i for i in range(64) if got[i] != data[i]]
+        assert len(diff) == 1
+        assert bin(got[diff[0]] ^ data[diff[0]]).count("1") == 1
+        io = faultfs.FaultIO(faultfs.DiskFaultPlan(seed=2, read_short=1.0))
+        with io.open(p, "rb") as f:
+            assert len(f.read()) < len(data)
+
+    def test_write_faults_raise_before_bytes_land(self, tmp_path):
+        p = os.path.join(str(tmp_path), "d", "w.bin")
+        os.makedirs(os.path.dirname(p))
+        io = faultfs.FaultIO(faultfs.DiskFaultPlan(seed=2, write_eio=1.0))
+        with pytest.raises(OSError) as ei:
+            with io.open(p, "wb") as f:
+                f.write(b"data")
+        assert ei.value.errno == errno.EIO
+        assert os.path.getsize(p) == 0  # nothing landed
+        io = faultfs.FaultIO(faultfs.DiskFaultPlan(seed=2, write_enospc=1.0))
+        with pytest.raises(OSError) as ei:
+            with io.open(p, "wb") as f:
+                f.write(b"data")
+        assert ei.value.errno == errno.ENOSPC
+        assert isinstance(classify_write_error(ei.value, p), DiskFullError)
+
+    def test_fsync_lie_then_power_cut_drops_tail(self, tmp_path):
+        p = os.path.join(str(tmp_path), "d", "wal.bin")
+        os.makedirs(os.path.dirname(p))
+        io = faultfs.FaultIO(faultfs.DiskFaultPlan(seed=2, fsync_lie=1.0))
+        f = io.open(p, "wb")
+        f.write(b"acked-but-never-synced")
+        io.fsync(f)  # lies: acks without syncing
+        f.close()
+        assert io.fsync_lies == 1
+        assert io.power_cut() == 1
+        assert os.path.getsize(p) == 0  # the lie cost the whole tail
+
+    def test_torn_replace_leaves_incomplete_fileset(self, tmp_path, rng):
+        root = str(tmp_path)
+        faultfs.install(faultfs.DiskFaultPlan(seed=4, torn_replace=1.0))
+        with pytest.raises(DiskWriteError):
+            _mk_fileset(root, rng)
+        faultfs.uninstall()
+        # The torn destination exists but must never be servable.
+        shard_dir = os.path.join(root, NS.decode(), "shard-00001")
+        torn = [d for d in os.listdir(shard_dir)
+                if d.startswith("fileset-") and not d.endswith(".tmp")]
+        assert torn
+        assert not pfs.fileset_complete(os.path.join(shard_dir, torn[0]))
+        assert pfs.PersistManager(root).list_filesets(NS, 1) == []
+
+    def test_memmap_fault_materializes_flipped_copy(self, tmp_path, rng):
+        root = str(tmp_path)
+        _pm, _ids, path = _mk_fileset(root, rng)
+        clean = pfs.FilesetReader(path, verify=True)
+        clean_words = np.asarray(clean._words).copy()
+        faultfs.install(faultfs.DiskFaultPlan(seed=6, read_flip=1.0))
+        # Every component read is now rotten; some typed layer — the
+        # checkpoint completeness probe, the digest chain, or the
+        # per-row adlers — must catch it. Never a clean read.
+        with pytest.raises((CorruptionError, FileNotFoundError)):
+            r = pfs.FilesetReader(path, verify=False)
+            if np.array_equal(np.asarray(r._words), clean_words):
+                raise AssertionError("memmap fault did not corrupt a copy")
+            r.verify_rows()
+        faultfs.uninstall()
+        # The file on disk itself is untouched: faults live in the seam.
+        np.testing.assert_array_equal(
+            np.asarray(pfs.FilesetReader(path, verify=True)._words),
+            clean_words)
+
+
+# ---------------------------------------------------------------------------
+# serve-time verification + quarantine
+# ---------------------------------------------------------------------------
+
+
+def _flip_data_byte(path, offset=3):
+    dpath = os.path.join(path, pfs.DATA_FILE)
+    with open(dpath, "rb+") as f:
+        f.seek(offset)
+        b = f.read(1)
+        f.seek(offset)
+        f.write(bytes([b[0] ^ 0x10]))
+
+
+class TestServeTimeVerify:
+    def test_lazy_verify_catches_rot_and_names_rows(self, tmp_path, rng):
+        root = str(tmp_path)
+        _pm, ids, path = _mk_fileset(root, rng)
+        _flip_data_byte(path)  # lands in row 0's codewords
+        blk, _ = pfs.FilesetReader(path, verify=False).to_block()
+        with pytest.raises(CorruptionError) as ei:
+            blk.read_all()
+        assert ei.value.rows == [0]
+        assert ei.value.ids == [ids[0]]
+        assert ei.value.path == path
+        with pytest.raises(CorruptionError):
+            blk.read(0)  # per-row read path verifies too
+
+    def test_verified_once_per_generation(self, tmp_path, rng):
+        root = str(tmp_path)
+        _pm, _ids, path = _mk_fileset(root, rng)
+        blk, _ = pfs.FilesetReader(path, verify=False).to_block()
+        assert blk.expected_row_sums is not None
+        blk.read_all()
+        assert blk._rows_verified is True
+        # Cached: tampering the expectation after the first read is not
+        # re-checked — verification is once per loaded generation.
+        blk.expected_row_sums = blk.expected_row_sums + 1
+        blk.read_all()
+
+    def test_seeker_detects_flipped_row(self, tmp_path, rng):
+        root = str(tmp_path)
+        _pm, ids, path = _mk_fileset(root, rng)
+        _flip_data_byte(path)
+        with pytest.raises(CorruptionError):
+            # Digest-at-open (index/bloom) or row-adler-at-seek (data):
+            # one of the typed layers must refuse the rotten bytes.
+            sk = pfs.Seeker(path)
+            sk.seek(ids[0])
+
+    def test_retriever_quarantines_and_serves_none(self, tmp_path, rng):
+        root = str(tmp_path)
+        pm, ids, path = _mk_fileset(root, rng)
+        _flip_data_byte(path)
+        r = BlockRetriever(pm)
+        assert r.retrieve(NS, 1, T0, ids[0]) is None  # detected, not served
+        # The fileset moved to quarantine with a JSON sidecar naming it.
+        q = pm.list_quarantined(NS, 1)
+        assert [bs for bs, _p in q] == [T0]
+        sidecar = json.load(open(q[0][1] + ".json"))
+        assert "reason" in sidecar and sidecar["rows"]
+        # Gone from the serving listing; clear_quarantined removes it.
+        assert pm.list_filesets(NS, 1) == []
+        assert r.block_starts(NS, 1) == {}
+        assert pm.clear_quarantined(NS, 1, T0) is True
+        assert pm.list_quarantined(NS, 1) == []
+
+    def test_clean_fileset_serves_through_retriever(self, tmp_path, rng):
+        root = str(tmp_path)
+        pm, ids, _path = _mk_fileset(root, rng)
+        r = BlockRetriever(pm)
+        got = r.retrieve(NS, 1, T0, ids[2])
+        assert got is not None and len(got[0]) == 6
+        assert pm.list_quarantined(NS, 1) == []
+
+
+# ---------------------------------------------------------------------------
+# DiskHealth + read-only degradation
+# ---------------------------------------------------------------------------
+
+
+class TestDiskHealth:
+    def test_trips_after_consecutive_failures_and_recovers(self):
+        h = DiskHealth(trip_after=3)
+        assert not h.read_only()
+        h.failure()
+        h.failure()
+        assert not h.read_only()
+        assert h.saturation() == pytest.approx(2 / 3)
+        h.failure()
+        assert h.read_only()
+        assert h.saturation() == 1.0
+        h.success()  # one durable success clears the posture
+        assert not h.read_only()
+        assert h.failures == 3 and h.trips == 1
+
+    def test_database_sheds_normal_keeps_critical(self, tmp_path, rng):
+        now = {"t": T0 + xtime.MINUTE}
+        db = Database(ShardSet(8), clock=lambda: now["t"])
+        db.create_namespace(NS, NamespaceOptions(index_enabled=False))
+        pm = pfs.PersistManager(os.path.join(str(tmp_path), "data"))
+        ids = [b"deg-%d" % i for i in range(32)]
+        db.write_batch(NS, ids,
+                       np.full(32, T0 + 30 * xtime.SECOND, np.int64),
+                       rng.standard_normal(32))
+        now["t"] = T0 + BLOCK + 11 * xtime.MINUTE
+        db.tick()
+        faultfs.install(faultfs.DiskFaultPlan(seed=9, write_enospc=1.0))
+        assert db.flush(pm) == 0  # every block's flush ENOSPCed, typed
+        assert db.disk_health.read_only()
+        with pytest.raises(Backpressure):
+            db.write(NS, b"deg-0", now["t"], 1.0)
+        db.write(NS, b"deg-0", now["t"], 2.0,
+                 priority=Priority.CRITICAL)  # never shed
+        t, v = db.read(NS, b"deg-0", 0, now["t"] + 1)  # reads flow
+        assert 2.0 in v.tolist()
+        faultfs.uninstall()
+        assert db.flush(pm) > 0  # FAILED blocks stayed on the schedule
+        assert not db.disk_health.read_only()  # auto-recovery
+        db.write(NS, b"deg-0", now["t"], 3.0)  # NORMAL flows again
+
+    def test_wal_append_failure_is_typed_ack_failure(self, tmp_path):
+        faultfs.install(faultfs.DiskFaultPlan(seed=9, write_eio=1.0))
+        log = cl.CommitLog(os.path.join(str(tmp_path), "cl"),
+                           strategy=cl.Strategy.WRITE_WAIT)
+        db = Database(ShardSet(2), commitlog=log, clock=lambda: T0 + 1)
+        db.create_namespace(NS, NamespaceOptions(index_enabled=False))
+        with pytest.raises(DiskWriteError):
+            db.write(NS, b"wal-0", T0, 1.0)
+        assert db.disk_health.failures >= 1
+
+
+# ---------------------------------------------------------------------------
+# DatabaseScrubber: detect -> quarantine -> repair -> un-quarantine
+# ---------------------------------------------------------------------------
+
+
+def _scrub_db(tmp_path, rng):
+    """A db whose shard 1 holds a sealed, flushed, cold block — with
+    the sealed copy still RESIDENT (the no-peer repair source)."""
+    now = {"t": T0 + 5 * xtime.MINUTE}
+    db = Database(ShardSet(2), clock=lambda: now["t"])
+    db.create_namespace(NS, NamespaceOptions(index_enabled=False))
+    pm = pfs.PersistManager(os.path.join(str(tmp_path), "data"))
+    db.set_retriever(BlockRetriever(pm))
+    ids = [b"scrub-%d" % i for i in range(8)]
+    shard_ids = [sid for sid in ids if db.shard_set.lookup(sid) == 1]
+    assert shard_ids  # murmur spreads 8 ids over 2 shards
+    db.write_batch(NS, ids, np.full(8, T0 + 4 * xtime.MINUTE, np.int64),
+                   rng.standard_normal(8))
+    now["t"] = T0 + 3 * BLOCK  # cold: outside the 2-block mutable head
+    db.tick()
+    assert db.flush(pm) >= 1
+    return db, pm, now, shard_ids
+
+
+class TestDatabaseScrubber:
+    def test_sweep_detects_quarantines_repairs_unquarantines(
+            self, tmp_path, rng):
+        db, pm, now, shard_ids = _scrub_db(tmp_path, rng)
+        path = dict(pm.list_filesets(NS, 1))[T0]
+        _flip_data_byte(path)
+        scrubber = DatabaseScrubber(db, pm, opts=ScrubOptions(seed=1))
+        st = scrubber.run(now_ns=now["t"])[NS]
+        assert st.filesets_scanned >= 1 and st.corrupt_found == 1
+        assert st.quarantined == 1
+        # No repairer: the RESIDENT sealed block is the repair source —
+        # its flush state cleared, the quarantined copy removed.
+        assert st.unquarantined == 1
+        assert pm.list_quarantined(NS, 1) == []
+        # The next flush sweep rewrites the fileset, clean.
+        assert db.flush(pm) >= 1
+        path2 = dict(pm.list_filesets(NS, 1))[T0]
+        pfs.FilesetReader(path2, verify=True).verify_rows()
+        # ... and the data still serves.
+        t, v = db.read(NS, shard_ids[0], 0, now["t"])
+        assert len(t) == 1
+
+    def test_clean_sweep_touches_nothing(self, tmp_path, rng):
+        db, pm, now, _ = _scrub_db(tmp_path, rng)
+        st = DatabaseScrubber(db, pm, opts=ScrubOptions(seed=1)).run(
+            now_ns=now["t"])[NS]
+        assert st.corrupt_found == 0 and st.quarantined == 0
+        assert st.filesets_scanned >= 1 and st.bytes_verified > 0
+
+    def test_warm_head_not_scanned(self, tmp_path, rng):
+        """Blocks inside the two-block mutable head are skipped: a flush
+        may still be racing to write them."""
+        db, pm, now, _ = _scrub_db(tmp_path, rng)
+        now["t"] = T0 + BLOCK + 11 * xtime.MINUTE  # head is warm again
+        st = DatabaseScrubber(db, pm, opts=ScrubOptions(seed=1)).run(
+            now_ns=now["t"])[NS]
+        assert st.filesets_scanned == 0
+
+    def test_quarantined_past_retention_cleared_without_repair(
+            self, tmp_path, rng):
+        db, pm, now, _ = _scrub_db(tmp_path, rng)
+        path = dict(pm.list_filesets(NS, 1))[T0]
+        assert pfs.quarantine_fileset(path, reason="test") is not None
+        retention = db.namespace(NS).opts.retention_ns
+        now["t"] = T0 + BLOCK + retention + xtime.MINUTE
+        st = DatabaseScrubber(db, pm, opts=ScrubOptions(seed=1)).run(
+            now_ns=now["t"])[NS]
+        assert st.unquarantined == 1 and st.repair_attempts == 0
+        assert pm.list_quarantined(NS, 1) == []
+
+    def test_seeded_jitter_deterministic_and_backoff_grows(self):
+        db = Database(ShardSet(1), clock=lambda: T0)
+        a = DatabaseScrubber(db, None, opts=ScrubOptions(seed=5))
+        b = DatabaseScrubber(db, None, opts=ScrubOptions(seed=5))
+        assert [a.next_delay_s() for _ in range(4)] \
+            == [b.next_delay_s() for _ in range(4)]
+        a.consecutive_failures = 3
+        assert a.next_delay_s() > b.next_delay_s()
+
+
+# ---------------------------------------------------------------------------
+# bootstrap: corrupt filesets quarantined, range falls through the chain
+# ---------------------------------------------------------------------------
+
+
+class TestBootstrapQuarantine:
+    def test_corrupt_fileset_quarantined_not_claimed(self, tmp_path, rng):
+        from m3_tpu.storage.bootstrap import (BootstrapContext,
+                                              BootstrapProcess)
+
+        root = str(tmp_path)
+        pm, ids, path = _mk_fileset(root, rng)
+        _flip_data_byte(path)
+        db = Database(ShardSet(2), clock=lambda: T0 + BLOCK)
+        db.create_namespace(NS, NamespaceOptions(index_enabled=False))
+        proc = BootstrapProcess(chain=("filesystem",),
+                                ctx=BootstrapContext(persist=pm))
+        res = proc.run(db)[NS]
+        # Not served, not silently skipped: quarantined + surfaced.
+        assert pm.list_quarantined(NS, 1) and pm.list_filesets(NS, 1) == []
+        assert any("quarantined" in n for n in res.notes)
+        # The range stays UNCLAIMED so the chain's next source owns it.
+        assert not overlaps(res.claimed["filesystem"].ranges(1), T0, T0 + BLOCK)
+        assert overlaps(res.unfulfilled.ranges(1), T0, T0 + BLOCK)
+        t, _v = db.read(NS, ids[0], 0, T0 + BLOCK)
+        assert len(t) == 0
+
+    def test_clean_fileset_claims_and_serves(self, tmp_path, rng):
+        from m3_tpu.storage.bootstrap import (BootstrapContext,
+                                              BootstrapProcess)
+
+        root = str(tmp_path)
+        pm, ids, _path = _mk_fileset(root, rng)
+        db = Database(ShardSet(2), clock=lambda: T0 + BLOCK)
+        db.create_namespace(NS, NamespaceOptions(index_enabled=False))
+        res = BootstrapProcess(chain=("filesystem",),
+                               ctx=BootstrapContext(persist=pm)).run(db)[NS]
+        assert res.notes == []
+        assert overlaps(res.claimed["filesystem"].ranges(1), T0, T0 + BLOCK)
+        sid = next(s for s in ids if db.shard_set.lookup(s) == 1)
+        t, _v = db.read(NS, sid, 0, T0 + BLOCK)
+        assert len(t) == 6
+
+
+# ---------------------------------------------------------------------------
+# the composition drill: everything at once, zero loss / zero fabrication
+# ---------------------------------------------------------------------------
+
+
+def _drill(seed):
+    sc = DiskFaultScenario(DiskFaultScenarioOptions(seed=seed))
+    try:
+        return sc.verify(sc.run())
+    finally:
+        sc.close()
+
+
+class TestDiskFaultScenario:
+    @pytest.mark.parametrize("seed", [7, 11])
+    def test_zero_loss_zero_fabrication(self, seed):
+        res = _drill(seed)
+        assert res.verified_points > 0
+        assert res.quarantined_after_faults >= 1
+        assert res.scrub_stats.blocks_repaired >= 1
+        assert res.health_tripped and res.recovered
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("seed", [23, 42, 1234])
+    def test_more_seeds(self, seed):
+        res = _drill(seed)
+        assert res.verified_points > 0

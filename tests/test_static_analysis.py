@@ -26,6 +26,7 @@ from m3_tpu.analysis.obs_rules import (HostSyncInPlanRule,
                                        WallClockLatencyRule)
 from m3_tpu.analysis.overload_rules import UnboundedQueueRule
 from m3_tpu.analysis.replay_rules import PerEntryReplayRule
+from m3_tpu.analysis.diskio_rules import UncheckedDiskIORule
 from m3_tpu.analysis.retry_rules import (BroadExceptWireIORule,
                                          RawSleepRetryRule)
 
@@ -1635,6 +1636,140 @@ class TestUnboundedTelemetryTag:
         """
         assert lint(src, UnboundedTelemetryTagRule(),
                     "m3_tpu/query/mod.py") == []
+
+
+class TestUncheckedDiskIO:
+    """unchecked-disk-io: broad handlers around direct file I/O in the
+    persist plane without typed classification (persist/diskio.py's
+    CorruptionError / DiskWriteError / classify_write_error taxonomy)."""
+
+    # The seeded true positive: the pre-typed fileset-writer shape — an
+    # ENOSPC swallowed whole, so nothing upstream ever trips the
+    # read-only posture or withdraws the torn fileset.
+    SEEDED = """
+        import os
+
+        def write_fileset(path, payload):
+            try:
+                with open(path, "wb") as f:
+                    f.write(payload)
+                os.replace(path, path[:-4])
+            except Exception:
+                return None
+    """
+
+    def test_seeded_positive_flags(self):
+        found = lint(self.SEEDED, UncheckedDiskIORule(),
+                     "m3_tpu/persist/fs.py")
+        assert rule_ids(found) == ["unchecked-disk-io"]
+        assert "classify_write_error" in found[0].message
+
+    def test_bare_except_around_seam_io_flags(self):
+        src = """
+            def sync(io, f):
+                try:
+                    io.fsync(f)
+                except:
+                    pass
+        """
+        # `io.fsync` matches the seam-owner shape (_io/diskio/os/io).
+        assert rule_ids(lint(src, UncheckedDiskIORule(),
+                             "m3_tpu/persist/commitlog.py")) == \
+            ["unchecked-disk-io"]
+
+    def test_typed_handler_is_clean(self):
+        src = """
+            import os
+
+            def remove(path):
+                try:
+                    os.remove(path)
+                except OSError:
+                    return False
+                return True
+        """
+        assert lint(src, UncheckedDiskIORule(),
+                    "m3_tpu/persist/fs.py") == []
+
+    def test_classifying_handler_is_clean(self):
+        src = """
+            from .diskio import classify_write_error
+
+            def write(path, payload):
+                try:
+                    with open(path, "wb") as f:
+                        f.write(payload)
+                except Exception as e:
+                    raise classify_write_error(e, path) from e
+        """
+        assert lint(src, UncheckedDiskIORule(),
+                    "m3_tpu/persist/fs.py") == []
+
+    def test_bare_reraise_tail_is_clean(self):
+        src = """
+            import os
+
+            def replace(src_p, dst_p, log):
+                try:
+                    os.replace(src_p, dst_p)
+                except Exception:
+                    log.warning("replace failed")
+                    raise
+        """
+        assert lint(src, UncheckedDiskIORule(),
+                    "m3_tpu/persist/fs.py") == []
+
+    def test_typed_raise_in_handler_is_clean(self):
+        src = """
+            from .diskio import CorruptionError
+
+            def read(path):
+                try:
+                    with open(path, "rb") as f:
+                        return f.read()
+                except Exception as e:
+                    raise CorruptionError(str(e), path=path)
+        """
+        assert lint(src, UncheckedDiskIORule(),
+                    "m3_tpu/persist/fs.py") == []
+
+    def test_scoped_to_persist_and_seed_module_exempt(self):
+        # Identical shape outside persist/ is another rule's business...
+        assert lint(self.SEEDED, UncheckedDiskIORule(),
+                    "m3_tpu/query/mod.py") == []
+        # ...and diskio.py itself is where broad->typed translation lives.
+        assert lint(self.SEEDED, UncheckedDiskIORule(),
+                    "m3_tpu/persist/diskio.py") == []
+
+    def test_non_io_try_is_clean(self):
+        src = """
+            def parse(blob):
+                try:
+                    return int(blob)
+                except Exception:
+                    return None
+        """
+        assert lint(src, UncheckedDiskIORule(),
+                    "m3_tpu/persist/fs.py") == []
+
+    def test_inner_typed_try_owns_its_io(self):
+        src = """
+            import os
+
+            def robust(path):
+                try:
+                    try:
+                        os.remove(path)
+                    except OSError:
+                        return False
+                    return True
+                except Exception:
+                    return None
+        """
+        # The inner try's typed handler owns the I/O call; the outer
+        # broad handler guards no direct I/O.
+        assert lint(src, UncheckedDiskIORule(),
+                    "m3_tpu/persist/fs.py") == []
 
 
 class TestTreeGate:
