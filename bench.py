@@ -1712,6 +1712,134 @@ def bench_query_serve_e2e():
         api.close()
 
 
+def bench_codec_decode_fanout():
+    """Decode fan-out: one sealed block serves its three decode consumers.
+
+    Measures the serve-side codec floor that every read bottoms out in:
+    a production SealedBlock (sealed through encode_block, realistic
+    counter / fixed-decimal gauge / NaN-hole gauge / float-noise mix) is
+    decoded per iteration by (1) the block-cache plane build
+    (SealedBlock._decode_plane), (2) the client tile path
+    (client.decode.decode_tile), and (3) the plan compiler's fetch
+    staging downcast (padded f32 value plane, the `value` fetch kind).
+    The device block cache is bypassed so every pass pays a real decode.
+
+    Oracle: a row subsample is re-decoded through ops/ref_codec.py (the
+    scalar bit-identity reference) and compared bit-for-bit (u64 views,
+    NaN-safe) against the plane decode, every run."""
+    from m3_tpu.client import decode as client_decode
+    from m3_tpu.ops import ref_codec
+    from m3_tpu.parallel import compile as plan_compile
+    from m3_tpu.storage import block as storage_block
+    from m3_tpu.storage import block_cache
+
+    n = int(os.environ.get("BENCH_DECODE_SERIES", "4096"))
+    w = int(os.environ.get("BENCH_DECODE_WINDOW", "120"))
+    iters = int(os.environ.get("BENCH_DECODE_ITERS", "5"))
+    s_ns = 1_000_000_000
+    rng = np.random.default_rng(23)
+
+    _phase("decode_fanout: building corpus")
+    t0_ns = 1_700_000_000 * s_ns
+    tdense = (t0_ns + np.arange(w, dtype=np.int64) * 10 * s_ns)[None, :]
+    tdense = np.repeat(tdense, n, axis=0)
+    # A quarter of the rows get second-aligned jitter so the ts stream
+    # exercises the irregular delta-of-delta buckets, not just '0' bits.
+    jrows = rng.random(n) < 0.25
+    jit_s = rng.integers(-4, 5, size=(jrows.sum(), w)).astype(np.int64)
+    tdense[jrows] += jit_s * s_ns
+    tdense[jrows] = np.maximum.accumulate(tdense[jrows], axis=1)
+
+    kind = rng.integers(0, 4, size=n)
+    vdense = np.empty((n, w), np.float64)
+    vdense[kind == 0] = np.cumsum(
+        rng.poisson(5.0, (int((kind == 0).sum()), w)), axis=1)  # counters
+    vdense[kind == 1] = np.round(
+        rng.normal(250.0, 40.0, (int((kind == 1).sum()), w)), 2)  # 2dp gauge
+    g = rng.normal(0.0, 10.0, (int((kind == 2).sum()), w))
+    g[rng.random(g.shape) < 0.1] = np.nan  # sparse NaN holes (float mode)
+    vdense[kind == 2] = g
+    vdense[kind == 3] = rng.standard_normal(
+        (int((kind == 3).sum()), w)) * 1e3  # float noise
+    npoints = np.full(n, w, np.int32)
+    short = rng.random(n) < 0.05
+    npoints[short] = rng.integers(1, w, size=int(short.sum()))
+
+    _phase("decode_fanout: sealing block (encode_block)")
+    blk = storage_block.encode_block(
+        t0_ns, np.arange(n, dtype=np.int32), tdense, vdense, npoints)
+    unit = int(blk.time_unit)
+    wb = blk.window  # encode_block pads the window to a power of two
+    s_pad = 1 << (max(n, 1) - 1).bit_length()
+    ext_pad = wb + 8
+
+    def _stage_leg(vals):
+        # The fetch-staging `value` kind: pad the grid, downcast to f32.
+        # When compile.py grows a fused one-pass stager, pick it up so the
+        # bench keeps measuring the canonical consumer path.
+        fused = getattr(plan_compile, "stage_value_plane", None)
+        if fused is not None:
+            return fused(vals, s_pad, ext_pad)
+        gp = plan_compile._pad_grid(vals, s_pad, ext_pad)
+        return gp.astype(np.float32)
+
+    def fanout():
+        ts_p, vals_p = blk._decode_plane()
+        ts_t, vals_t = client_decode.decode_tile(
+            blk.words, blk.npoints, blk.window, unit)
+        staged = _stage_leg(vals_p)
+        return ts_p, vals_p, ts_t, vals_t, staged
+
+    with block_cache.disabled():
+        _phase("decode_fanout: warmup + compile")
+        ts_p, vals_p, ts_t, vals_t, staged = fanout()
+
+        # Oracle: scalar reference decode on a row subsample, bit-for-bit.
+        sample = rng.choice(n, size=min(24, n), replace=False)
+        for i in sample:
+            i = int(i)
+            npts = int(blk.npoints[i])
+            rts, rvs = ref_codec.decode(ref_codec.EncodedBlock(
+                words=np.asarray(blk.words[i], np.uint32),
+                nbits=int(blk.nbits[i]), npoints=npts))
+            assert np.array_equal(rts * blk.time_unit.nanos, ts_p[i, :npts]), (
+                f"decode_fanout oracle: ts mismatch on row {i}")
+            assert np.array_equal(
+                np.asarray(rvs).view(np.uint64),
+                np.ascontiguousarray(vals_p[i, :npts]).view(np.uint64)), (
+                f"decode_fanout oracle: value bits mismatch on row {i}")
+            assert np.array_equal(ts_p[i, :npts], ts_t[i, :npts])
+            assert np.array_equal(
+                np.ascontiguousarray(vals_p[i, :npts]).view(np.uint64),
+                np.ascontiguousarray(vals_t[i, :npts]).view(np.uint64))
+        assert np.array_equal(
+            staged[:n, :wb][~np.isnan(vals_p)],
+            vals_p.astype(np.float32)[~np.isnan(vals_p)]), (
+            "decode_fanout: staged f32 plane diverged from numpy downcast")
+
+        _phase("decode_fanout: timing")
+        best = np.inf
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            fanout()
+            best = min(best, time.perf_counter() - t0)
+    points = int(npoints.sum())
+    return {
+        "metric": "codec_decode_fanout",
+        "value": round(points / best, 1),
+        "unit": "datapoints/sec",
+        "extra": {
+            "series": n, "window": w, "iters": iters,
+            "consumers": ["block._decode_plane", "client.decode_tile",
+                          "compile value-kind staging (pad + f32)"],
+            "per_pass_ms": round(best * 1000, 2),
+            "oracle": "ref_codec bit-identity on 24-row subsample",
+            "note": ("value = datapoints decoded per second through the "
+                     "full three-consumer fan-out of one sealed block"),
+        },
+    }
+
+
 _BENCHES = [
     ("m3tsz_encode_1m_rollup", bench_encode_rollup),
     ("counter_gauge_rollup", bench_counter_gauge),
@@ -1726,6 +1854,7 @@ _BENCHES = [
     ("peer_migration", bench_peer_migration),
     ("bootstrap_replay", bench_bootstrap_replay),
     ("query_serve_e2e", bench_query_serve_e2e),
+    ("codec_decode_fanout", bench_codec_decode_fanout),
 ]
 
 

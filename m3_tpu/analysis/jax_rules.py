@@ -26,6 +26,10 @@ Rules:
                        for/while loop in a jax module — a per-element
                        device sync in what should be one batched
                        transfer. (warning)
+  unguarded-pallas-dispatch  pl.pallas_call without the repo's two
+                       Pallas safety seams: a forwarded `interpret`
+                       builder parameter and a module-level
+                       _PALLAS_ORACLE parity-test pointer that exists.
 """
 
 from __future__ import annotations
@@ -748,5 +752,115 @@ class MeshSpecRule(Rule):
         return False
 
 
+class UnguardedPallasDispatchRule(Rule):
+    """unguarded-pallas-dispatch: every `pl.pallas_call` site must keep
+    the repo's two Pallas safety seams intact.
+
+    1. The enclosing builder must take an `interpret` parameter and
+       forward it into the call (`interpret=interpret`). A hard-coded
+       `interpret=False` breaks every non-TPU environment (CI, the CPU
+       fallback protocol); a hard-coded `True` means real hardware never
+       gets a compiled kernel; a missing kwarg silently defaults to
+       compiled-only. The parameter seam is what lets the dispatch gate
+       (`M3_TPU_PALLAS`) pick per-backend behavior from OUTSIDE the
+       lru_cached builder.
+    2. The module must declare `_PALLAS_ORACLE = "<path>"` naming the
+       test file that asserts interpret-vs-XLA parity, and the path must
+       exist. Pallas kernels ship only with a standing bit-identity
+       oracle — pallas_window.py and pallas_codec.py both ride this
+       contract, and the constant keeps the pointer from rotting
+       silently when tests move.
+    """
+
+    id = "unguarded-pallas-dispatch"
+    severity = "error"
+    requires_import = "jax"
+
+    _PALLAS_CALL = ("pl.pallas_call", "pallas.pallas_call",
+                    "jax.experimental.pallas.pallas_call")
+
+    @staticmethod
+    def _oracle_decl(mod: Module) -> Optional[str]:
+        """Module-level `_PALLAS_ORACLE = "<str literal>"`, or None."""
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name) and \
+                    node.targets[0].id == "_PALLAS_ORACLE" and \
+                    isinstance(node.value, ast.Constant) and \
+                    isinstance(node.value.value, str):
+                return node.value.value
+        return None
+
+    @staticmethod
+    def _repo_root(mod: Module) -> str:
+        """Path prefix before the m3_tpu package dir (cwd fallback —
+        the analyzer runs from the repo root)."""
+        import os
+
+        norm = mod.path.replace(os.sep, "/")
+        idx = norm.rfind("/m3_tpu/")
+        return mod.path[:idx] if idx > 0 else "."
+
+    def _enclosing_fn(self, mod: Module,
+                      node: ast.AST) -> Optional[ast.FunctionDef]:
+        cur = mod.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = mod.parents.get(cur)
+        return None
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        import os
+
+        sites = [n for n in ast.walk(mod.tree)
+                 if isinstance(n, ast.Call) and
+                 qualname(n.func) in self._PALLAS_CALL]
+        if not sites:
+            return
+        oracle = self._oracle_decl(mod)
+        if oracle is None:
+            yield self.finding(
+                mod, sites[0],
+                "module calls pl.pallas_call but declares no "
+                "_PALLAS_ORACLE = \"<parity test path>\" constant")
+        elif not os.path.exists(os.path.join(self._repo_root(mod), oracle)):
+            yield self.finding(
+                mod, sites[0],
+                f"_PALLAS_ORACLE points at {oracle!r}, which does not "
+                "exist — the interpret-vs-XLA parity oracle moved or "
+                "was never written")
+        for call in sites:
+            kw = next((k for k in call.keywords if k.arg == "interpret"),
+                      None)
+            if kw is None:
+                yield self.finding(
+                    mod, call,
+                    "pallas_call without interpret= forwards: the kernel "
+                    "can never run on CPU (tests, fallback protocol); "
+                    "thread an `interpret` parameter through the builder")
+                continue
+            if isinstance(kw.value, ast.Constant):
+                yield self.finding(
+                    mod, call,
+                    f"pallas_call hard-codes interpret={kw.value.value!r}; "
+                    "forward the builder's `interpret` parameter so the "
+                    "dispatch gate can pick per-backend behavior")
+                continue
+            fn = self._enclosing_fn(mod, call)
+            params = ({a.arg for a in func_params(fn)}
+                      if fn is not None else set())
+            names = {n.id for n in ast.walk(kw.value)
+                     if isinstance(n, ast.Name)}
+            if fn is None or not (names & params):
+                yield self.finding(
+                    mod, call,
+                    "pallas_call's interpret= does not come from an "
+                    "enclosing builder parameter — the lru_cached "
+                    "`_build(..., interpret)` seam is the contract "
+                    "(pallas_window.py / pallas_codec.py)")
+
+
 RULES: List[Rule] = [JaxPurityRule(), NonStaticJitCacheRule(),
-                     ItemInLoopRule(), MeshSpecRule()]
+                     ItemInLoopRule(), MeshSpecRule(),
+                     UnguardedPallasDispatchRule()]

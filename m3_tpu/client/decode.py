@@ -63,11 +63,13 @@ def decode_segment_groups(segments: Sequence[dict]) -> List[Tuple[np.ndarray, np
         # Shape-bucket telemetry: a first-seen (rows-pow2, width, window)
         # geometry means a fresh decode-kernel compile for this fetch.
         telemetry.record_bucket("client.decode", (rp, mw, window, unit))
-        ts, vs = tsz.decode(words, npoints, window)
-        scale = xtime.Unit(unit).nanos
+        # Unit scaling fuses into the decode program (one launch; no host
+        # multiply pass over the plane).
+        ts, vs = tsz.decode_plane(words, npoints, window=window,
+                                  unit_nanos=xtime.Unit(unit).nanos)
         for row, i in enumerate(idxs):
             n = int(npoints[row])
-            out[i] = (ts[row, :n] * scale, vs[row, :n].copy())
+            out[i] = (ts[row, :n].copy(), vs[row, :n].copy())
     return out
 
 
@@ -92,9 +94,11 @@ def decode_tile(words, npoints, window: int, time_unit: int
         np_pad = npoints
     telemetry.record_bucket("client.decode_tile",
                             (rp, int(words.shape[-1]), int(window)))
-    ts, vs = tsz.decode(words, np_pad, window)
-    scale = xtime.Unit(time_unit).nanos
-    return np.asarray(ts[:n]) * scale, np.asarray(vs[:n])
+    # Fused decode: tick cumsum + time-unit scaling happen inside the one
+    # decode program; the host just slices the padded rows back off.
+    ts, vs = tsz.decode_plane(words, np_pad, window=window,
+                              unit_nanos=xtime.Unit(time_unit).nanos)
+    return np.asarray(ts[:n]), np.asarray(vs[:n])
 
 
 def merge_replica_points(

@@ -200,6 +200,45 @@ def pair_to_i32(a):
     return a[1].astype(jnp.int32)
 
 
+def mul64_const(a, c: int):
+    """a * c mod 2^64 for a STATIC Python int c >= 0, as shl64/add64 over
+    the set bits of c (binary decomposition at trace time). Lets the fused
+    decode multiply tick pairs by a time-unit scale (up to minute-unit
+    6e10 ns, which exceeds u32 range) without any 64-bit multiply op."""
+    c = int(c)
+    if c < 0:
+        raise ValueError("mul64_const: c must be non-negative")
+    if c == 1:
+        return a
+    zero = (jnp.zeros_like(a[0]), jnp.zeros_like(a[1]))
+    acc = zero
+    s = 0
+    while c and s < 64:
+        if c & 1:
+            acc = add64(acc, shl64(a, U32(s)) if s else a)
+        c >>= 1
+        s += 1
+    return acc
+
+
+def i64_pair_to_f64_bits(a):
+    """Exact f64 BITS of the signed 64-bit integer in pair `a`, pure u32
+    math. Caller guarantees |value| < 2^53 (the int-mode k=0 encode
+    contract, detect_int_mode), so the magnitude's top bit index e <= 52
+    and mantissa = |value| << (52 - e) loses nothing — bit-identical to
+    numpy's astype(int64).astype(float64) on that domain. Zero -> +0.0."""
+    hi, lo = a
+    neg = (hi >> U32(31)) == U32(1)
+    mag = tuple(jnp.where(neg, n, p) for n, p in zip(neg64(a), a))
+    nz = (mag[0] | mag[1]) != 0
+    e = jnp.maximum(63 - clz64(mag), 0)
+    mant = shl64(mag, jnp.clip(52 - e, 0, 63).astype(U32))
+    bhi = (jnp.where(neg, U32(1), U32(0)) << U32(31)) \
+        | ((e + 1023).astype(U32) << U32(20)) | (mant[0] & U32(0xFFFFF))
+    z = U32(0)
+    return (jnp.where(nz, bhi, z), jnp.where(nz, mant[1], z))
+
+
 def f64_bits_to_f32(hi, lo):
     """Exact float64 -> float32 conversion from raw bit pairs, entirely in
     u32 integer math (round-to-nearest-even, matching numpy's astype):

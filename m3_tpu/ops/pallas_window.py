@@ -26,9 +26,17 @@ O(W/stride) work saving never materializes: each output step reduces an
 unaligned static offset, while XLA's fused reduce_window streams full
 [8, 128] tiles. The kernel stays opt-in (M3_TPU_PALLAS=1) as an
 honestly-measured negative result — the pallas playbook's "don't
-hand-schedule what the compiler already schedules well" conclusion,
-kept because its structure (VMEM tiling, static-unroll constraint) is
-the template for kernels XLA does NOT already fuse.
+hand-schedule what the compiler already schedules well" conclusion.
+Its structure became the template for the codec kernels
+(ops/pallas_codec.py), and the lesson splits cleanly down the middle:
+the codec kernels inherit the VMEM-tiling half (lane-tiled BlockSpecs,
+lru_cached `_build(..., interpret)` seams, interpret-mode parity as the
+CPU oracle) but NOT the strided-window-scheduling half — their inner
+loop walks a data-dependent bit cursor that XLA cannot fuse or
+pre-schedule, so there is no MAX_UNROLL_STEPS analog and no compiler
+schedule to lose to. Hand-written windows over data XLA already tiles:
+loses (this file). Hand-written cursors over data XLA serializes into
+gather chains: wins (pallas_codec).
 
 Opt-in wiring: temporal._window_stat_strided dispatches here when
 M3_TPU_PALLAS=1 (interpret mode backs the kernel on CPU so the tests
@@ -45,6 +53,10 @@ import numpy as np
 from jax.experimental import pallas as pl
 
 _F32 = jnp.float32
+
+# Where this module's interpret-vs-XLA parity is asserted (the m3lint
+# unguarded-pallas-dispatch rule checks the declared oracle exists).
+_PALLAS_ORACLE = "tests/test_temporal.py"
 
 # Row tile: f32 VMEM tiling is (8, 128); eight series rows per program
 # keeps the window slice a native sublane group.

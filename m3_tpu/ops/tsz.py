@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import functools
 import os
+import time
 
 import jax
 import jax.numpy as jnp
@@ -49,10 +50,43 @@ HEADER_MAX_BITS = (8 + 64) + (32 + 64)
 MAX_POINT_BITS = 39 + 79
 
 
+class CursorOverflowError(ValueError):
+    """A packed bit cursor exceeded the block's max_words bound.
+
+    Every pack backend (scatter-OR, merge tree, Pallas) silently DROPS
+    bits past max_words — scatter via mode="drop", the tree via the final
+    slice, the Pallas kernel via its dense word-window mask — so an
+    undersized bound would truncate streams into undecodable garbage.
+    check_cursor turns that into this typed error at encode time."""
+
+
+@functools.lru_cache(maxsize=None)
 def max_words_for(window: int) -> int:
-    """Conservative packed-words bound for a block of `window` points."""
+    """Conservative packed-words bound for a block of `window` points.
+
+    Memoized: the per-window constants are pure arithmetic but every
+    encode/merge/bench call site recomputed them; one table keeps the
+    bound definitionally identical everywhere (and check_cursor asserts
+    the packed cursors actually stayed under it)."""
     bits = HEADER_MAX_BITS + max(window - 1, 0) * MAX_POINT_BITS
     return (bits + 31) // 32 + 1
+
+
+def check_cursor(nbits, max_words: int) -> None:
+    """Assert no packed stream's final bit cursor exceeds max_words.
+
+    Called at encode time on HOST-materialized nbits (the seal path
+    fetches them anyway); raises CursorOverflowError naming the worst
+    row instead of letting any pack backend truncate silently."""
+    nb = np.asarray(nbits)
+    if nb.size == 0:
+        return
+    worst = int(nb.max())
+    if worst > 32 * int(max_words):
+        row = int(nb.argmax())
+        raise CursorOverflowError(
+            f"packed cursor overflow: row {row} needs {worst} bits but "
+            f"max_words={int(max_words)} holds {32 * int(max_words)}")
 
 
 # ---------------------------------------------------------------------------
@@ -226,7 +260,22 @@ def _float_value_chunks(vhi, vlo, valid):
     return chunk, cn
 
 
-@functools.partial(jax.jit, static_argnames=("max_words", "pack"))
+def _default_pack() -> str:
+    """Pack backend when the caller passes pack=None: the Pallas one-pass
+    kernel when the codec kernels are enabled, else the XLA backend the
+    platform favors (tree on TPU where scatters serialize, scatter-OR on
+    host CPU). Resolved OUTSIDE the jitted program so M3_TPU_PALLAS flips
+    take effect per call, not per trace cache."""
+    from . import pallas_codec
+
+    if pallas_codec.enabled():
+        return "pallas"
+    return "tree" if jax.default_backend() == "tpu" else "scatter"
+
+
+_ENCODE_TIMED: set = set()
+
+
 def encode_batch(dt, t0, vhi, vlo, int_mode, k, npoints, ts_regular=None,
                  delta0=None, *, max_words, pack=None):
     """Encode a batch of series blocks (wire format v2, see ref_codec).
@@ -242,14 +291,47 @@ def encode_batch(dt, t0, vhi, vlo, int_mode, k, npoints, ts_regular=None,
         timestamp codes are omitted (None -> computed here).
       delta0: int32 [N] — dt[:, 1] where npoints > 1 else 0 (None -> computed).
       max_words: static output row width in u32 words.
-      pack: "tree" (recursive-doubling concat, the TPU path — scatters
-        serialize there) or "scatter" (cumsum + scatter-OR, faster on host
-        CPU where scatters are cheap). None selects by default backend.
+      pack: "tree" (recursive-doubling concat, the XLA TPU path — scatters
+        serialize there), "scatter" (cumsum + scatter-OR, faster on host
+        CPU where scatters are cheap), or "pallas" (the one-pass VMEM
+        bit-cursor kernel, ops/pallas_codec). None selects by dispatch
+        gate + backend; all three are bit-identical.
 
     Returns: (words u32 [N, max_words], nbits int32 [N]).
+
+    This host-level dispatcher resolves the route, counts it, and calls
+    the jitted program with `pack` static. Under an enclosing trace
+    (e.g. the fuzz harness jits this whole function) the telemetry fires
+    once per trace rather than per call — routes still prove dispatch.
     """
     if pack is None:
-        pack = "tree" if jax.default_backend() == "tpu" else "scatter"
+        pack = _default_pack()
+    from ..parallel import telemetry
+
+    telemetry.codec_route("encode", pack == "pallas")
+    traced = isinstance(dt, jax.core.Tracer)
+    # isinstance() is a host-side type test — it never concretizes the
+    # tracer; the branch exists precisely to SKIP host timing under an
+    # enclosing trace.
+    if pack == "pallas" and not traced:  # m3lint: disable=jax-traced-branch
+        key = (tuple(dt.shape), int(max_words))
+        if key not in _ENCODE_TIMED:
+            _ENCODE_TIMED.add(key)
+            t_start = time.perf_counter()
+            out = _encode_batch(dt, t0, vhi, vlo, int_mode, k, npoints,
+                                ts_regular, delta0, max_words=max_words,
+                                pack=pack)
+            jax.block_until_ready(out)
+            telemetry.codec_compile_recorded(
+                "encode", time.perf_counter() - t_start)
+            return out
+    return _encode_batch(dt, t0, vhi, vlo, int_mode, k, npoints,
+                         ts_regular, delta0, max_words=max_words, pack=pack)
+
+
+@functools.partial(jax.jit, static_argnames=("max_words", "pack"))
+def _encode_batch(dt, t0, vhi, vlo, int_mode, k, npoints, ts_regular=None,
+                  delta0=None, *, max_words, pack):
     n, w = dt.shape
     cols = jnp.arange(w, dtype=I32)[None, :]
     valid = (cols < npoints[:, None]) & (cols >= 1)
@@ -319,7 +401,11 @@ def encode_batch(dt, t0, vhi, vlo, int_mode, k, npoints, ts_regular=None,
     snb = interleave(ts_bits.at[:, 0].set(hn0), val_bits.at[:, 0].set(hn1))
 
     total = jnp.sum(snb, axis=1)
-    if pack == "tree":
+    if pack == "pallas":
+        from . import pallas_codec
+
+        out = pallas_codec.pack_chunks(sc, snb, max_words)
+    elif pack == "tree":
         out = _pack_segments(sc, snb, max_words)
     else:
         out = _pack_scatter(sc, snb, max_words)
@@ -419,7 +505,34 @@ def _read32(words, pos):
 
 
 def _read64(words, pos):
-    return _read32(words, pos), _read32(words, pos + 32)
+    """64-bit window at bit pos: three gathers (not two chained read32s,
+    which would fetch the middle word twice)."""
+    wi = pos >> 5
+    bi = (pos & 31).astype(U32)
+    inv = U32(32) - bi
+    w0 = _take_word(words, wi)
+    w1 = _take_word(words, wi + 1)
+    w2 = _take_word(words, wi + 2)
+    return (_shl32(w0, bi) | _shr32(w1, inv),
+            _shl32(w1, bi) | _shr32(w2, inv))
+
+
+def _read96(words, pos):
+    """96-bit window starting at bit pos [N]: four clamped gathers serve
+    EVERY value-path read of a decode step (ctrl bits + all speculative
+    payloads live within [pos, pos+96)), replacing the step's per-payload
+    read32/read64 gathers with static shifts of one shared window — the
+    gather count is what bounds the scan on host CPU."""
+    wi = pos >> 5
+    bi = (pos & 31).astype(U32)
+    inv = U32(32) - bi
+    w0 = _take_word(words, wi)
+    w1 = _take_word(words, wi + 1)
+    w2 = _take_word(words, wi + 2)
+    w3 = _take_word(words, wi + 3)
+    return (_shl32(w0, bi) | _shr32(w1, inv),
+            _shl32(w1, bi) | _shr32(w2, inv),
+            _shl32(w2, bi) | _shr32(w3, inv))
 
 
 def _sext(value_u, nbits):
@@ -427,6 +540,201 @@ def _sext(value_u, nbits):
     v = value_u.astype(I32)
     sb = _shl32(jnp.ones_like(value_u), (nbits - 1).astype(U32)).astype(I32)
     return (v ^ sb) - sb
+
+
+def _decode_header(read32, read64, zero):
+    """Parse the v2 stream header (flags + t0 [+ delta0] + v0).
+
+    Parameterized by the bit readers so the XLA scan (clamped gathers
+    into [N, MW] rows) and the Pallas kernel (VMEM-resident word tile)
+    share ONE definition of the wire format. `zero` is an i32 zeros
+    array whose shape sets the batch axis ([N] or a lane tile)."""
+    b0 = read32(zero)
+    int_mode = (b0 >> 31) == 1
+    kexp = ((b0 >> 28) & 7).astype(I32)
+    ts_regular = ((b0 >> 27) & 1) == 1
+    t0c = ((b0 >> 26) & 1).astype(I32)
+    vc = ((b0 >> 25) & 1).astype(I32)
+    dc = ((b0 >> 24) & 1).astype(I32)
+    nt0 = 32 + 32 * t0c
+    t0 = b64.unzigzag64(
+        b64.shr64(read64(zero + 8), (64 - nt0).astype(U32)))
+    pos = zero + 8 + nt0
+    nd = jnp.where(ts_regular, 8 + 24 * dc, 0)
+    dzz = b64.shr64(read64(pos), (64 - nd).astype(U32))
+    delta0 = jnp.where(ts_regular, b64.pair_to_i32(b64.unzigzag64(dzz)), 0)
+    pos = pos + nd
+    nv = jnp.where(int_mode, 32 + 32 * vc, 64)
+    vraw = b64.shr64(read64(pos), (64 - nv).astype(U32))
+    v0un = b64.unzigzag64(vraw)
+    v0 = tuple(jnp.where(int_mode, a, b) for a, b in zip(v0un, vraw))
+    return dict(int_mode=int_mode, k=kexp, ts_regular=ts_regular, t0=t0,
+                delta0=delta0, v0=v0, pos0=pos + nv)
+
+
+def _lut(idx, table):
+    """Tiny lookup by where-chain over scalar literals instead of a
+    gather into a constant array: Pallas kernels may not capture
+    constant arrays, and both decode routes must share one step
+    definition — scalars inline as immediates on either route."""
+    out = jnp.full_like(idx, table[-1])
+    for j in range(len(table) - 2, -1, -1):
+        out = jnp.where(idx == j, table[j], out)
+    return out
+
+
+def _decode_step(read32, read64, read96, npoints, int_mode, ts_regular,
+                 carry, i):
+    """One decode step for point column i (>= 1), shared by the XLA scan
+    and the Pallas kernel's fori_loop. All arrays ride the batch axis.
+
+    Carry: (pos, prev_delta, pvd_hi, pvd_lo, pv_hi, pv_lo, la, ma, lb,
+    mb, ts_hi, ts_lo) — the trailing tick pair accumulates t0 + sum(dt)
+    in-scan so the fused decode emits final timestamps with no host
+    cumsum pass. Emits (delta, ts_hi, ts_lo, vhi, vlo); consumers that
+    ignore the tick pair (decode_batch's dict contract) let XLA DCE the
+    accumulation away."""
+    (pos, prev_delta, pvd_hi, pvd_lo, pv_hi, pv_lo,
+     la, ma, lb, mb, ts_hi, ts_lo) = carry
+    ts_payload = (0, 4, 7, 9, 12, 16, 20, 32)
+    int_payload = (0, 4, 7, 12, 20, 32, 64)
+
+    # --- timestamp: leading-ones prefix selects the payload width ---
+    # One 64-bit window covers ctrl + payload (prefix <= 7 bits, payload
+    # <= 32: everything ends within pos+39), so the payload read is a
+    # dynamic shift of the same window instead of a second gather.
+    t64_hi, t64_lo = read64(pos)
+    cw = t64_hi
+    ones_t = jnp.minimum(b64.clz32(~cw), 7)
+    is0 = ones_t == 0
+    plen = jnp.where(is0, 1, jnp.where(ones_t <= 5, ones_t + 1, 7))
+    nbits = _lut(ones_t, ts_payload)
+    pr = plen.astype(U32)
+    pw = _shl32(t64_hi, pr) | _shr32(t64_lo, U32(32) - pr)
+    pay = _shr32(pw, (U32(32) - nbits.astype(U32)))
+    dod = jnp.where(is0 | ts_regular, 0, _sext(pay, jnp.maximum(nbits, 1)))
+    delta = prev_delta + dod
+    pos1 = pos + jnp.where(ts_regular, 0, jnp.where(is0, 1, plen + nbits))
+
+    # ONE 96-bit window at pos1 serves every value read below: the float
+    # ctrl + both reuse payloads + the rewrite header/payload end within
+    # pos1+79, the int prefix + payload within pos1+70. Static shifts of
+    # the shared window replace per-payload gathers (4 per step vs 18).
+    a96_0, a96_1, a96_2 = read96(pos1)
+
+    def w64(s: int):
+        """64-bit pair at static bit offset s (1 <= s <= 31) in the window."""
+        return (_shl32(a96_0, U32(s)) | _shr32(a96_1, U32(32 - s)),
+                _shl32(a96_1, U32(s)) | _shr32(a96_2, U32(32 - s)))
+
+    # --- value: float path ('0' | '10' A | '110' B | '111' rewrite) ---
+    cf = a96_0
+    fxor0 = (cf >> 31) == 0
+    fa = (cf >> 30) == 0b10
+    fb = (cf >> 29) == 0b110
+    frw = ~fxor0 & ~fa & ~fb
+    # reuse A: payload mlenA bits at pos1+2; reuse B: mlenB at pos1+3.
+    xor_a = b64.shl64(
+        b64.shr64(w64(2), (64 - ma).astype(U32)), (64 - la - ma).astype(U32))
+    xor_b = b64.shl64(
+        b64.shr64(w64(3), (64 - mb).astype(U32)), (64 - lb - mb).astype(U32))
+    # rewrite: lead(6) mlen-1(6) payload at pos1+15
+    lead_n = ((cf >> 23) & 63).astype(I32)
+    mlen_n = (((cf >> 17) & 63) + 1).astype(I32)
+    xor_w = b64.shl64(
+        b64.shr64(w64(15), (64 - mlen_n).astype(U32)), (64 - lead_n - mlen_n).astype(U32)
+    )
+    xor = tuple(
+        jnp.where(fxor0, 0, jnp.where(fa, a, jnp.where(fb, b_, w_)))
+        for a, b_, w_ in zip(xor_a, xor_b, xor_w)
+    )
+    fval = b64.xor64((pv_hi, pv_lo), xor)
+    fconsumed = jnp.where(
+        fxor0, 1, jnp.where(fa, 2 + ma, jnp.where(fb, 3 + mb, 15 + mlen_n)))
+    la2 = jnp.where(frw, lead_n, la)
+    ma2 = jnp.where(frw, mlen_n, ma)
+    lb2 = jnp.where(frw, la, lb)
+    mb2 = jnp.where(frw, ma, mb)
+
+    # --- value: int path (leading-ones prefix, v2 buckets) ---
+    ci = a96_0
+    ones_i = jnp.minimum(b64.clz32(~ci), 6)
+    iz = ones_i == 0
+    iplen = jnp.where(iz, 1, jnp.where(ones_i <= 4, ones_i + 1, 6))
+    inb = _lut(ones_i, int_payload)
+    # dynamic offset iplen in [1, 6]: the same window, shifted in-vector
+    ir = iplen.astype(U32)
+    iinv = U32(32) - ir
+    p64i = (_shl32(a96_0, ir) | _shr32(a96_1, iinv),
+            _shl32(a96_1, ir) | _shr32(a96_2, iinv))
+    zz = b64.shr64(p64i, (64 - inb).astype(U32))
+    vdod = b64.unzigzag64(zz)
+    vdod = tuple(jnp.where(iz, 0, x) for x in vdod)
+    nvd = b64.add64((pvd_hi, pvd_lo), vdod)
+    ival = b64.add64((pv_hi, pv_lo), nvd)
+    iconsumed = jnp.where(iz, 1, iplen + inb)
+
+    # --- select by per-series mode ---
+    val = tuple(jnp.where(int_mode, a, b) for a, b in zip(ival, fval))
+    pos2 = pos1 + jnp.where(int_mode, iconsumed, fconsumed)
+    active = i < npoints
+    pos2 = jnp.where(active, pos2, pos)
+    delta_o = jnp.where(active, delta, 0)
+    val = tuple(jnp.where(active, v, p) for v, p in zip(val, (pv_hi, pv_lo)))
+    prev_delta2 = jnp.where(active, delta, prev_delta)
+    nvd = tuple(jnp.where(active & int_mode, x, p) for x, p in zip(nvd, (pvd_hi, pvd_lo)))
+    la2 = jnp.where(active, la2, la)
+    ma2 = jnp.where(active, ma2, ma)
+    lb2 = jnp.where(active, lb2, lb)
+    mb2 = jnp.where(active, mb2, mb)
+    ts2 = b64.add64((ts_hi, ts_lo), b64.i32_to_pair(delta_o))
+
+    carry2 = (pos2, prev_delta2, nvd[0], nvd[1], val[0], val[1],
+              la2, ma2, lb2, mb2, ts2[0], ts2[1])
+    return carry2, (delta_o, ts2[0], ts2[1], val[0], val[1])
+
+
+def _decode_core(words, npoints, *, window):
+    """Header parse + point scan over [N, MW] streams (the XLA route).
+
+    Returns dict with dt [N, W] i32, ts (hi, lo) u32 [N, W] tick pairs
+    (t0 + running delta sum), vhi/vlo [N, W] u32, int_mode, k, t0."""
+    n = words.shape[0]
+    zero = jnp.zeros((n,), I32)
+    read32 = functools.partial(_read32, words)
+    read64 = functools.partial(_read64, words)
+    read96 = functools.partial(_read96, words)
+    hdr = _decode_header(read32, read64, zero)
+    int_mode, ts_regular = hdr["int_mode"], hdr["ts_regular"]
+    t0, v0 = hdr["t0"], hdr["v0"]
+
+    def step(carry, i):
+        return _decode_step(read32, read64, read96, npoints, int_mode,
+                            ts_regular, carry, i)
+
+    init = (
+        hdr["pos0"],
+        jnp.where(ts_regular, hdr["delta0"], zero),
+        jnp.zeros((n,), U32),
+        jnp.zeros((n,), U32),
+        v0[0],
+        v0[1],
+        jnp.full((n,), -1, I32),
+        jnp.full((n,), -1, I32),
+        jnp.full((n,), -1, I32),
+        jnp.full((n,), -1, I32),
+        t0[0],
+        t0[1],
+    )
+    _, (deltas, tshis, tslos, vhis, vlos) = jax.lax.scan(
+        step, init, jnp.arange(1, window, dtype=I32))
+    dt = jnp.concatenate([jnp.zeros((n, 1), I32), deltas.T], axis=1)
+    ts = (jnp.concatenate([t0[0][:, None], tshis.T], axis=1),
+          jnp.concatenate([t0[1][:, None], tslos.T], axis=1))
+    vhi = jnp.concatenate([v0[0][:, None], vhis.T], axis=1)
+    vlo = jnp.concatenate([v0[1][:, None], vlos.T], axis=1)
+    return {"dt": dt, "ts": ts, "vhi": vhi, "vlo": vlo,
+            "int_mode": int_mode, "k": hdr["k"], "t0": t0}
 
 
 @functools.partial(jax.jit, static_argnames=("window",))
@@ -441,129 +749,9 @@ def decode_batch(words, npoints, *, window):
     Returns dict with dt [N, W] int32, vhi/vlo [N, W] u32 (f64 bits or int64
     m per mode), int_mode bool [N], k int32 [N], t0 (hi, lo) u32 [N].
     """
-    n = words.shape[0]
-    zero = jnp.zeros((n,), I32)
-    b0 = _read32(words, zero)
-    int_mode = (b0 >> 31) == 1
-    kexp = ((b0 >> 28) & 7).astype(I32)
-    ts_regular = ((b0 >> 27) & 1) == 1
-    t0c = ((b0 >> 26) & 1).astype(I32)
-    vc = ((b0 >> 25) & 1).astype(I32)
-    dc = ((b0 >> 24) & 1).astype(I32)
-    nt0 = 32 + 32 * t0c
-    t0 = b64.unzigzag64(
-        b64.shr64(_read64(words, zero + 8), (64 - nt0).astype(U32)))
-    pos = zero + 8 + nt0
-    nd = jnp.where(ts_regular, 8 + 24 * dc, 0)
-    dzz = b64.shr64(_read64(words, pos), (64 - nd).astype(U32))
-    delta0 = jnp.where(ts_regular, b64.pair_to_i32(b64.unzigzag64(dzz)), 0)
-    pos = pos + nd
-    nv = jnp.where(int_mode, 32 + 32 * vc, 64)
-    vraw = b64.shr64(_read64(words, pos), (64 - nv).astype(U32))
-    v0un = b64.unzigzag64(vraw)
-    v0 = tuple(jnp.where(int_mode, a, b) for a, b in zip(v0un, vraw))
-    pos0 = pos + nv
-
-    ts_payload = jnp.array([0, 4, 7, 9, 12, 16, 20, 32], I32)
-    int_payload = jnp.array([0, 4, 7, 12, 20, 32, 64], I32)
-
-    def step(carry, i):
-        (pos, prev_delta, pvd_hi, pvd_lo, pv_hi, pv_lo,
-         la, ma, lb, mb) = carry
-
-        # --- timestamp: leading-ones prefix selects the payload width ---
-        cw = _read32(words, pos)
-        ones_t = jnp.minimum(b64.clz32(~cw), 7)
-        is0 = ones_t == 0
-        plen = jnp.where(is0, 1, jnp.where(ones_t <= 5, ones_t + 1, 7))
-        nbits = jnp.take(ts_payload, ones_t)
-        pw = _read32(words, pos + plen)
-        pay = _shr32(pw, (U32(32) - nbits.astype(U32)))
-        dod = jnp.where(is0 | ts_regular, 0, _sext(pay, jnp.maximum(nbits, 1)))
-        delta = prev_delta + dod
-        pos1 = pos + jnp.where(ts_regular, 0, jnp.where(is0, 1, plen + nbits))
-
-        # --- value: float path ('0' | '10' A | '110' B | '111' rewrite) ---
-        cf = _read32(words, pos1)
-        fxor0 = (cf >> 31) == 0
-        fa = (cf >> 30) == 0b10
-        fb = (cf >> 29) == 0b110
-        frw = ~fxor0 & ~fa & ~fb
-        # reuse A: payload mlenA bits at pos1+2; reuse B: mlenB at pos1+3.
-        p64a = _read64(words, pos1 + 2)
-        xor_a = b64.shl64(
-            b64.shr64(p64a, (64 - ma).astype(U32)), (64 - la - ma).astype(U32))
-        p64b = _read64(words, pos1 + 3)
-        xor_b = b64.shl64(
-            b64.shr64(p64b, (64 - mb).astype(U32)), (64 - lb - mb).astype(U32))
-        # rewrite: lead(6) mlen-1(6) payload at pos1+15
-        lead_n = ((cf >> 23) & 63).astype(I32)
-        mlen_n = (((cf >> 17) & 63) + 1).astype(I32)
-        p64w = _read64(words, pos1 + 15)
-        xor_w = b64.shl64(
-            b64.shr64(p64w, (64 - mlen_n).astype(U32)), (64 - lead_n - mlen_n).astype(U32)
-        )
-        xor = tuple(
-            jnp.where(fxor0, 0, jnp.where(fa, a, jnp.where(fb, b_, w_)))
-            for a, b_, w_ in zip(xor_a, xor_b, xor_w)
-        )
-        fval = b64.xor64((pv_hi, pv_lo), xor)
-        fconsumed = jnp.where(
-            fxor0, 1, jnp.where(fa, 2 + ma, jnp.where(fb, 3 + mb, 15 + mlen_n)))
-        la2 = jnp.where(frw, lead_n, la)
-        ma2 = jnp.where(frw, mlen_n, ma)
-        lb2 = jnp.where(frw, la, lb)
-        mb2 = jnp.where(frw, ma, mb)
-
-        # --- value: int path (leading-ones prefix, v2 buckets) ---
-        ci = _read32(words, pos1)
-        ones_i = jnp.minimum(b64.clz32(~ci), 6)
-        iz = ones_i == 0
-        iplen = jnp.where(iz, 1, jnp.where(ones_i <= 4, ones_i + 1, 6))
-        inb = jnp.take(int_payload, ones_i)
-        p64i = _read64(words, pos1 + iplen)
-        zz = b64.shr64(p64i, (64 - inb).astype(U32))
-        vdod = b64.unzigzag64(zz)
-        vdod = tuple(jnp.where(iz, 0, x) for x in vdod)
-        nvd = b64.add64((pvd_hi, pvd_lo), vdod)
-        ival = b64.add64((pv_hi, pv_lo), nvd)
-        iconsumed = jnp.where(iz, 1, iplen + inb)
-
-        # --- select by per-series mode ---
-        val = tuple(jnp.where(int_mode, a, b) for a, b in zip(ival, fval))
-        pos2 = pos1 + jnp.where(int_mode, iconsumed, fconsumed)
-        active = i < npoints
-        pos2 = jnp.where(active, pos2, pos)
-        delta_o = jnp.where(active, delta, 0)
-        val = tuple(jnp.where(active, v, p) for v, p in zip(val, (pv_hi, pv_lo)))
-        prev_delta2 = jnp.where(active, delta, prev_delta)
-        nvd = tuple(jnp.where(active & int_mode, x, p) for x, p in zip(nvd, (pvd_hi, pvd_lo)))
-        la2 = jnp.where(active, la2, la)
-        ma2 = jnp.where(active, ma2, ma)
-        lb2 = jnp.where(active, lb2, lb)
-        mb2 = jnp.where(active, mb2, mb)
-
-        carry2 = (pos2, prev_delta2, nvd[0], nvd[1], val[0], val[1],
-                  la2, ma2, lb2, mb2)
-        return carry2, (delta_o, val[0], val[1])
-
-    init = (
-        pos0,
-        jnp.where(ts_regular, delta0, zero),
-        jnp.zeros((n,), U32),
-        jnp.zeros((n,), U32),
-        v0[0],
-        v0[1],
-        jnp.full((n,), -1, I32),
-        jnp.full((n,), -1, I32),
-        jnp.full((n,), -1, I32),
-        jnp.full((n,), -1, I32),
-    )
-    _, (deltas, vhis, vlos) = jax.lax.scan(step, init, jnp.arange(1, window, dtype=I32))
-    dt = jnp.concatenate([jnp.zeros((n, 1), I32), deltas.T], axis=1)
-    vhi = jnp.concatenate([v0[0][:, None], vhis.T], axis=1)
-    vlo = jnp.concatenate([v0[1][:, None], vlos.T], axis=1)
-    return {"dt": dt, "vhi": vhi, "vlo": vlo, "int_mode": int_mode, "k": kexp, "t0": t0}
+    out = _decode_core(words, npoints, window=window)
+    return {key: out[key]
+            for key in ("dt", "vhi", "vlo", "int_mode", "k", "t0")}
 
 
 def prepare_on_device_math(ts_hi, ts_lo, vhi, vlo, npoints):
@@ -807,10 +995,8 @@ def encode(timestamps: np.ndarray, values: np.ndarray, npoints=None, max_words: 
         inp["delta0"],
         max_words=max_words,
     )
-    if max_words < max_words_for(ts.shape[1]) and int(jnp.max(nbits)) > 32 * max_words:
-        raise ValueError(
-            f"max_words={max_words} too small: a stream needs {int(jnp.max(nbits))} bits"
-        )
+    if max_words < max_words_for(ts.shape[1]):
+        check_cursor(nbits, max_words)
     return words, nbits
 
 
@@ -897,17 +1083,111 @@ def encode_with_boundary(timestamps, values, npoints=None,
     return words, nbits, boundary_metadata(inp)
 
 
+_DECODE_TIMED: set = set()
+
+
+def _decode_route():
+    """Decode scan route: "pallas" when the Pallas codec kernels are
+    enabled (interpret-mode on CPU), else the XLA lax.scan."""
+    from . import pallas_codec
+
+    return "pallas" if pallas_codec.enabled() else "xla"
+
+
+@functools.lru_cache(maxsize=None)
+def _decode_fused_jit(window: int, unit_nanos: int, with_f32: bool,
+                      route: str):
+    """Jitted fused decode program for one static (window, unit, route):
+    stream scan + tick cumsum + unit-nanos multiply (mul64_const — minute
+    units exceed u32 range) + exact on-device int->f64 bit conversion for
+    k=0 int rows, emitting PAIR_HI-ordered [N, W, 2] u32 planes the host
+    views zero-copy as int64/f64. k>0 rows (fixed-decimal gauges) keep
+    raw mantissa pairs; `fix` marks them for the host's exact /10^k."""
+    hi = b64.PAIR_HI
+
+    def stack(pair):
+        parts = [None, None]
+        parts[hi] = pair[0]
+        parts[1 - hi] = pair[1]
+        return jnp.stack(parts, axis=-1)
+
+    @jax.jit
+    def run(words, npoints):
+        if route == "pallas":
+            from . import pallas_codec
+
+            out = pallas_codec.decode_core(words, npoints, window=window)
+        else:
+            out = _decode_core(words, npoints, window=window)
+        ts_ns = b64.mul64_const(out["ts"], unit_nanos)
+        k0 = out["int_mode"] & (out["k"] == 0)
+        fb = b64.i64_pair_to_f64_bits((out["vhi"], out["vlo"]))
+        vhi = jnp.where(k0[:, None], fb[0], out["vhi"])
+        vlo = jnp.where(k0[:, None], fb[1], out["vlo"])
+        res = {"ts": stack(ts_ns), "vals": stack((vhi, vlo)),
+               "fix": out["int_mode"] & (out["k"] > 0), "k": out["k"]}
+        if with_f32:
+            res["f32"] = b64.f64_bits_to_f32(vhi, vlo)
+        return res
+
+    return run
+
+
+def decode_plane(words, npoints, *, window: int, unit_nanos: int = 1,
+                 with_f32: bool = False):
+    """Fused whole-plane decode -> (ts int64 [N, W] nanos, vals f64
+    [N, W][, vals_f32 [N, W]]).
+
+    ONE device program replaces the five host passes the unfused decode()
+    paid per plane (int64 cumsum, time-unit multiply, u64 view merge,
+    int->float convert, mode select): timestamps accumulate in the scan
+    carry and are unit-scaled on device, int-mode k=0 values convert to
+    exact f64 bits on device (|m| < 2^53, no rounding), and the outputs
+    land as native-order pairs so the host just reinterprets the buffer.
+    Only rows with decimal exponent k>0 pay a host fixup — f64 division
+    by 10^k has no exact integer formulation. Returned arrays may be
+    read-only zero-copy views of the fetched buffers.
+
+    with_f32 additionally returns the float32 downcast plane computed on
+    device (bits64.f64_bits_to_f32, bit-identical to numpy's astype) —
+    the plan compiler's `value` fetch staging consumes this instead of
+    running its own downcast pass."""
+    from ..parallel import telemetry
+
+    route = _decode_route()
+    telemetry.codec_route("decode", route == "pallas")
+    run = _decode_fused_jit(int(window), int(unit_nanos), bool(with_f32),
+                            route)
+    key = (int(window), int(unit_nanos), bool(with_f32), route)
+    timed = route == "pallas" and key not in _DECODE_TIMED
+    t_start = time.perf_counter() if timed else 0.0
+    out = run(jnp.asarray(words), jnp.asarray(npoints, I32))
+    if timed:
+        _DECODE_TIMED.add(key)
+        jax.block_until_ready(out)
+        telemetry.codec_compile_recorded(
+            "decode", time.perf_counter() - t_start)
+    ts = np.asarray(out["ts"]).view(np.int64)[..., 0]
+    vals = np.asarray(out["vals"]).view(np.float64)[..., 0]
+    f32 = np.asarray(out["f32"]) if with_f32 else None
+    rows = np.flatnonzero(np.asarray(out["fix"]))
+    if rows.size:
+        k = np.asarray(out["k"])[rows].astype(np.float64)
+        raw = np.ascontiguousarray(
+            np.asarray(out["vals"])[rows]).view(np.int64)[..., 0]
+        fixed = raw.astype(np.float64) / np.power(10.0, k)[:, None]
+        if not vals.flags.writeable:
+            vals = vals.copy()
+        vals[rows] = fixed
+        if with_f32:
+            if not f32.flags.writeable:
+                f32 = f32.copy()
+            f32[rows] = fixed.astype(np.float32)
+    return (ts, vals, f32) if with_f32 else (ts, vals)
+
+
 def decode(words, npoints, window: int):
-    """Decode device streams -> host (timestamps int64 [N, W], values f64)."""
-    out = decode_batch(jnp.asarray(words), jnp.asarray(npoints, I32), window=window)
-    dt = np.asarray(out["dt"], dtype=np.int64)
-    t0 = b64.to_u64_np(np.asarray(out["t0"][0]), np.asarray(out["t0"][1])).astype(np.int64)
-    ts = t0[:, None] + np.cumsum(dt, axis=1)
-    bits = b64.to_u64_np(np.asarray(out["vhi"]), np.asarray(out["vlo"]))
-    int_mode = np.asarray(out["int_mode"])
-    k = np.asarray(out["k"])
-    scale = np.power(10.0, k.astype(np.float64))[:, None]
-    as_int = bits.astype(np.int64).astype(np.float64) / scale
-    as_flt = bits.view(np.float64)
-    values = np.where(int_mode[:, None], as_int, as_flt)
-    return ts, values
+    """Decode device streams -> host (timestamps int64 [N, W] ticks,
+    values f64). Runs the fused plane decode at unit scale 1 — the
+    merge/concat recode paths dogfood the same program serving reads."""
+    return decode_plane(words, npoints, window=window, unit_nanos=1)

@@ -269,6 +269,20 @@ def _pad_grid(grid: np.ndarray, s_pad: int, ext_pad: int) -> np.ndarray:
     return out
 
 
+def stage_value_plane(grid: np.ndarray, s_pad: int, ext_pad: int
+                      ) -> np.ndarray:
+    """Padded f32 staging for a `value`-kind fetch plane in ONE pass:
+    allocate the padded plane at f32 and downcast-copy the grid straight
+    into it, replacing the f64 pad + separate astype(float32) two-pass
+    (which materialized an [s_pad, ext_pad] f64 intermediate per fetch).
+    Identical cells: NaN padding survives the downcast and copyto's
+    unsafe cast is exactly astype's round-to-nearest."""
+    S, T = grid.shape
+    out = np.full((s_pad, ext_pad), np.nan, np.float32)
+    np.copyto(out[:S, :T], grid, casting="unsafe")
+    return out
+
+
 def _stage_fetch(bf: "qplan.BoundFetch", kinds: Tuple[str, ...],
                  s_pad: int, ext_pad: int, mesh: Optional[Mesh]):
     """Prepared, padded, placed input arrays for one fetch — content/id
@@ -280,7 +294,10 @@ def _stage_fetch(bf: "qplan.BoundFetch", kinds: Tuple[str, ...],
     kind_tag = f"plan:{','.join(kinds)}:{s_pad}x{ext_pad}:{mesh_tag}"
 
     def build(g):
-        gp = _pad_grid(g, s_pad, ext_pad)
+        # The padded f64 intermediate is only needed by the non-"value"
+        # kinds; a plain value fetch stages through the one-pass f32 path.
+        gp = (_pad_grid(g, s_pad, ext_pad)
+              if any(kind != "value" for kind in kinds) else None)
         arrs: List[np.ndarray] = []
         for kind in kinds:
             if kind in ("ratec", "rated"):
@@ -306,7 +323,7 @@ def _stage_fetch(bf: "qplan.BoundFetch", kinds: Tuple[str, ...],
                 lo = (gp - hi.astype(np.float64)).astype(np.float32)
                 arrs += [hi, lo]
             else:  # "value"
-                arrs.append(gp.astype(np.float32))
+                arrs.append(stage_value_plane(g, s_pad, ext_pad))
         if mesh is not None:
             sh2 = NamedSharding(mesh, P("shard", None))
             sh1 = NamedSharding(mesh, P("shard"))

@@ -240,6 +240,35 @@ def record_bucket(path: str, key: tuple):
         tracing.count_cost("shape_bucket_miss")
 
 
+# ------------------------------------------------------------ codec routes
+
+_CODEC = _SCOPE.sub_scope("codec")
+
+
+def codec_route(kernel: str, pallas: bool):
+    """Count one codec dispatch for `kernel` in {"encode", "decode",
+    "hash"}: Pallas kernel route (`telemetry.codec.pallas_<kernel>`) vs
+    the XLA/numpy path (`telemetry.codec.xla_<kernel>`), tagged onto the
+    active span — EXPLAIN/slow-query output shows which codec route a
+    query actually took. The smoke tier asserts the pallas_* counters
+    move when M3_TPU_PALLAS=1, proving dispatch rather than silently
+    falling back."""
+    name = ("pallas_" if pallas else "xla_") + kernel
+    _CODEC.counter(name).inc()
+    _CODEC.counter("pallas" if pallas else "fallback").inc()
+    tracing.count_cost(f"codec_{name}")
+
+
+def codec_compile_recorded(kernel: str, seconds: float):
+    """Wall time of one codec kernel build's first invocation (trace +
+    Mosaic lowering, or interpret-mode setup on CPU) — the codec twin of
+    jit_builder's compile timing, same histogram bounds, span-tagged."""
+    _SCOPE.sub_scope("codec", kernel=kernel).counter("compiles").inc()
+    _CODEC.counter("compiles").inc()
+    _CODEC.histogram("compile_s", _COMPILE_BOUNDS).record(seconds)
+    tracing.count_cost("codec_pallas_compile")
+
+
 # ------------------------------------------------------------- dispatches
 
 

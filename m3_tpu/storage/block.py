@@ -162,9 +162,11 @@ class SealedBlock:
             if dec is not None:
                 n = int(self.npoints[row])
                 return dec[0][row, :n], dec[1][row, :n]
-        ts, vals = tsz.decode(self.words[row : row + 1], self.npoints[row : row + 1], window=self.window)
+        ts, vals = tsz.decode_plane(
+            self.words[row : row + 1], self.npoints[row : row + 1],
+            window=self.window, unit_nanos=self.time_unit.nanos)
         n = int(self.npoints[row])
-        t_out = ts[0, :n] * self.time_unit.nanos
+        t_out = np.ascontiguousarray(ts[0, :n])
         v_out = np.ascontiguousarray(vals[0, :n])
         t_out.setflags(write=False)
         v_out.setflags(write=False)
@@ -220,8 +222,12 @@ class SealedBlock:
             "block.decode_plane",
             (int(np.asarray(words).shape[0]),
              int(np.asarray(words).shape[-1]), int(self.window)))
-        ts, vals = tsz.decode(words, npoints, window=self.window)
-        ts = ts[:s] * self.time_unit.nanos
+        # Fused plane decode: the tick cumsum, unit-nanos scaling and
+        # int->f64 select all run inside the ONE decode program
+        # (tsz.decode_plane) instead of as host passes over [S, W] planes.
+        ts, vals = tsz.decode_plane(words, npoints, window=self.window,
+                                    unit_nanos=self.time_unit.nanos)
+        ts = np.ascontiguousarray(ts[:s])
         vals = np.ascontiguousarray(vals[:s])
         ts.setflags(write=False)
         vals.setflags(write=False)
@@ -287,6 +293,9 @@ def encode_block(block_start: int, series_indices, tdense, vdense, npoints,
         encoded_dev = (words, np.asarray(npoints, np.int32))
     words = np.asarray(words)[:s]
     nbits = np.asarray(nbits)[:s]
+    # Every pack backend silently drops bits past max_words; an undersized
+    # caller-supplied bound would seal truncated, undecodable streams.
+    tsz.check_cursor(nbits, mw)
     npoints = npoints[:s]
     boundary = {k: v[:s] for k, v in boundary.items()}
     blk = SealedBlock(

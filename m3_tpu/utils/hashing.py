@@ -102,6 +102,21 @@ def hash_batch(ids: Sequence[bytes], seed: int = 0) -> np.ndarray:
         buf[mask] = np.frombuffer(joined, np.uint8)
     words = buf.view("<u4")  # [n, padded // 4]
 
+    # Pallas route (ops.pallas_codec.hash_words, lane-parallel murmur3):
+    # same padded-buffer layout, bit-identical output; gated on the codec
+    # dispatch switch plus a column bound past which the VMEM tile stops
+    # paying. The numpy loop below stays the fallback AND the oracle.
+    try:
+        from ..ops import pallas_codec
+    except Exception:  # jax-less contexts keep the pure-numpy path
+        pallas_codec = None
+    if pallas_codec is not None:
+        use = (pallas_codec.enabled()
+               and 0 < words.shape[1] <= pallas_codec.HASH_MAX_COLS)
+        pallas_codec.route("hash", use)
+        if use:
+            return pallas_codec.hash_words(words, lens, seed)
+
     h = np.full(n, seed, np.uint32)
     nblocks = lens // 4
     with np.errstate(over="ignore"):

@@ -40,6 +40,18 @@ echo "== block-cache smoke (<5s; warm hit-rate, eviction under tiny budget, zero
 # CACHE_SMOKE_BUDGET_S.
 JAX_PLATFORMS=cpu python scripts/cache_smoke.py
 
+echo "== codec-kernel smoke (<10s; Pallas route counters prove dispatch, pack/decode/hash bit-identical to XLA + ref_codec, kill switch routes back) =="
+# The Pallas bitstream kernels (ops/pallas_codec.py) behind the
+# M3_TPU_PALLAS gate: every kernel must actually dispatch (the
+# telemetry.codec.pallas_* route counters move — a silent fallback
+# passes parity while benchmarking the wrong code), outputs must be
+# bit-identical to the XLA/numpy twins and the scalar reference codec,
+# and =0 must route back to XLA. Full matrix: tests/test_codec_pallas.py;
+# campaign: fuzz_codec under M3_TPU_PALLAS=1 adds the pallas packer to
+# its parity set. Wall budget via CODEC_SMOKE_BUDGET_S (interpret-mode
+# compiles dominate the cold run).
+JAX_PLATFORMS=cpu python scripts/codec_smoke.py
+
 echo "== chaos smoke (seeded faultnet, one scenario per layer) =="
 # Resilience regressions (retry/breaker/deadline/dedup) fail HERE in
 # seconds, not twenty minutes in; the full matrix is tests/test_resilience.py.

@@ -14,7 +14,8 @@ from m3_tpu.analysis.batch_rules import BatchPartialIngestRule
 from m3_tpu.analysis.cache_rules import (CacheKeyBufferRule,
                                          CacheMethodBufferKeyRule)
 from m3_tpu.analysis.jax_rules import (ItemInLoopRule, JaxPurityRule,
-                                       MeshSpecRule, NonStaticJitCacheRule)
+                                       MeshSpecRule, NonStaticJitCacheRule,
+                                       UnguardedPallasDispatchRule)
 from m3_tpu.analysis.numeric_rules import (DtypeDataflowRule,
                                            SentinelTaintRule)
 from m3_tpu.analysis.lock_rules import (FlushCallbackLoopRule,
@@ -3889,3 +3890,96 @@ class TestWidenedRuleScopes:
                     "m3_tpu/tools/mod.py") == []
         assert lint(self.WALL_DELTA, WallClockLatencyRule(),
                     "m3_tpu/tools/mod.py") == []
+
+
+class TestUnguardedPallasDispatch:
+    """unguarded-pallas-dispatch: pl.pallas_call must forward a builder
+    `interpret` parameter and the module must declare an existing
+    _PALLAS_ORACLE parity-test pointer."""
+
+    CLEAN = """
+        import jax
+        from jax.experimental import pallas as pl
+
+        _PALLAS_ORACLE = "tests/test_temporal.py"
+
+        def _build(n, interpret):
+            return pl.pallas_call(_kernel, interpret=interpret)
+    """
+
+    def test_clean_builder_passes(self):
+        assert lint(self.CLEAN, UnguardedPallasDispatchRule()) == []
+
+    def test_missing_interpret_kwarg_flags(self):
+        src = self.CLEAN.replace(", interpret=interpret", "")
+        found = lint(src, UnguardedPallasDispatchRule())
+        assert rule_ids(found) == ["unguarded-pallas-dispatch"]
+        assert "interpret" in found[0].message
+
+    def test_hardcoded_interpret_flags(self):
+        for const in ("False", "True"):
+            src = self.CLEAN.replace("interpret=interpret",
+                                     f"interpret={const}")
+            found = lint(src, UnguardedPallasDispatchRule())
+            assert rule_ids(found) == ["unguarded-pallas-dispatch"], const
+            assert "hard-codes" in found[0].message
+
+    def test_interpret_not_from_builder_param_flags(self):
+        src = """
+            import jax
+            from jax.experimental import pallas as pl
+
+            _PALLAS_ORACLE = "tests/test_temporal.py"
+            _GLOBAL_INTERPRET = True
+
+            def _build(n):
+                return pl.pallas_call(_kernel,
+                                      interpret=_GLOBAL_INTERPRET)
+        """
+        found = lint(src, UnguardedPallasDispatchRule())
+        assert rule_ids(found) == ["unguarded-pallas-dispatch"]
+        assert "builder parameter" in found[0].message
+
+    def test_missing_oracle_decl_flags(self):
+        src = self.CLEAN.replace(
+            '_PALLAS_ORACLE = "tests/test_temporal.py"', "")
+        found = lint(src, UnguardedPallasDispatchRule())
+        assert rule_ids(found) == ["unguarded-pallas-dispatch"]
+        assert "_PALLAS_ORACLE" in found[0].message
+
+    def test_nonexistent_oracle_path_flags(self):
+        src = self.CLEAN.replace("tests/test_temporal.py",
+                                 "tests/test_gone_forever.py")
+        found = lint(src, UnguardedPallasDispatchRule())
+        assert rule_ids(found) == ["unguarded-pallas-dispatch"]
+        assert "does not" in found[0].message
+
+    def test_jit_wrapped_pallas_call_sees_through(self):
+        # the _build_hash idiom: jax.jit(pl.pallas_call(...))
+        src = """
+            import jax
+            from jax.experimental import pallas as pl
+
+            _PALLAS_ORACLE = "tests/test_temporal.py"
+
+            def _build(n, interpret):
+                return jax.jit(pl.pallas_call(_kernel, interpret=interpret))
+        """
+        assert lint(src, UnguardedPallasDispatchRule()) == []
+
+    def test_module_without_pallas_call_is_ignored(self):
+        src = """
+            import jax
+
+            def f(x):
+                return jax.jit(lambda y: y)(x)
+        """
+        assert lint(src, UnguardedPallasDispatchRule()) == []
+
+    def test_repo_pallas_modules_conform(self):
+        for rel in ("m3_tpu/ops/pallas_window.py",
+                    "m3_tpu/ops/pallas_codec.py"):
+            path = REPO / rel
+            mod = Module(str(path), rel, path.read_text())
+            findings, _ = run_module(mod, [UnguardedPallasDispatchRule()])
+            assert findings == [], rel
