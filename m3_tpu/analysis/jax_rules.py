@@ -30,6 +30,12 @@ Rules:
                        Pallas safety seams: a forwarded `interpret`
                        builder parameter and a module-level
                        _PALLAS_ORACLE parity-test pointer that exists.
+  unclassified-device-dispatch  bare/broad `except` around a
+                       jit-dispatch or pallas_call site that neither
+                       classifies into the ComputeError taxonomy
+                       (parallel/guard.py) nor re-raises — untyped
+                       swallowing of device faults bypasses the
+                       breaker/quarantine/telemetry plane.
 """
 
 from __future__ import annotations
@@ -861,6 +867,158 @@ class UnguardedPallasDispatchRule(Rule):
                     "(pallas_window.py / pallas_codec.py)")
 
 
+class UnclassifiedDeviceDispatchRule(Rule):
+    """unclassified-device-dispatch: a bare or broad `except` (bare,
+    `Exception`, `BaseException`) wrapped around a device dispatch site
+    must CLASSIFY the failure into the compute-fault taxonomy
+    (`parallel.guard.classify` / the ComputeError subclasses) or
+    re-raise — swallowing an `XlaRuntimeError` untyped is exactly the
+    silent degradation the guarded dispatch layer exists to prevent
+    (a device OOM absorbed by `except Exception: return None` never
+    reaches the breaker, the quarantine, or the telemetry that names
+    the degraded route).
+
+    A *device dispatch site* inside the `try` body is any of:
+      1. a `pl.pallas_call` invocation;
+      2. a call to a function this module hands to jax.jit (the
+         find_traced discovery the whole rule family shares);
+      3. a call THROUGH the repo's jit-builder idiom: `fn = _build(...)`
+         then `fn(...)` (or directly `_build(...)(args)`) where
+         `_build` returns `jax.jit(...)` or is decorated with
+         `telemetry.jit_builder` / `guard.guarded_builder`.
+
+    A broad handler is compliant when it re-raises (any `raise`) or
+    references the taxonomy (`classify`, `ComputeError`, `CompileError`,
+    `DeviceOOM`, `KernelFault`, `DispatchTimeout`) — the guard seam
+    itself is the canonical negative: its broad handler funnels every
+    exception through `classify()` and re-raises the unclassifiable.
+    """
+
+    id = "unclassified-device-dispatch"
+    severity = "error"
+    requires_import = "jax"
+    dirs = ("ops", "parallel", "storage", "query")
+
+    _PALLAS_CALL = UnguardedPallasDispatchRule._PALLAS_CALL
+    _BROAD = {"Exception", "BaseException"}
+    _TAXONOMY = {"classify", "ComputeError", "CompileError", "DeviceOOM",
+                 "KernelFault", "DispatchTimeout"}
+    _BUILDER_DECOS = {"telemetry.jit_builder", "jit_builder",
+                      "guard.guarded_builder", "guarded_builder",
+                      "pguard.guarded_builder"}
+
+    @staticmethod
+    def _is_broad(handler: ast.ExceptHandler) -> bool:
+        t = handler.type
+        if t is None:
+            return True
+        names = [t] if not isinstance(t, ast.Tuple) else list(t.elts)
+        return any(qualname(n).rsplit(".", 1)[-1] in
+                   UnclassifiedDeviceDispatchRule._BROAD for n in names)
+
+    def _is_compliant(self, handler: ast.ExceptHandler) -> bool:
+        for node in ast.walk(handler):
+            if isinstance(node, ast.Raise):
+                return True
+            if isinstance(node, ast.Name) and node.id in self._TAXONOMY:
+                return True
+            if isinstance(node, ast.Attribute) and \
+                    node.attr in self._TAXONOMY:
+                return True
+        return False
+
+    def _is_jit_builder(self, fn: ast.FunctionDef) -> bool:
+        for dec in fn.decorator_list:
+            d = dec.func if isinstance(dec, ast.Call) else dec
+            if qualname(d) in self._BUILDER_DECOS:
+                return True
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Return) and \
+                    isinstance(node.value, ast.Call) and \
+                    _is_jax_jit(node.value.func):
+                return True
+        return False
+
+    def _builder_vars(self, mod: Module, try_node: ast.Try,
+                      by_name) -> Set[str]:
+        """Names bound (in the enclosing function, before the try) from
+        a call to a jit-builder — the `fn = _plan_executable(...)`
+        idiom."""
+        cur = mod.parents.get(try_node)
+        while cur is not None and not isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            cur = mod.parents.get(cur)
+        scope = cur if cur is not None else mod.tree
+        out: Set[str] = set()
+        for node in ast.walk(scope):
+            if not (isinstance(node, ast.Assign) and
+                    isinstance(node.value, ast.Call) and
+                    node.lineno <= try_node.lineno):
+                continue
+            callee = node.value.func
+            target = (_resolve(callee.id, node.lineno, by_name)
+                      if isinstance(callee, ast.Name) else None)
+            if target is not None and self._is_jit_builder(target):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+        return out
+
+    def _dispatch_site(self, mod: Module, try_node: ast.Try,
+                       traced, by_name) -> Optional[ast.Call]:
+        builder_vars = None  # computed lazily (scope walk is not free)
+        for stmt in try_node.body:
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                q = qualname(node.func)
+                if q in self._PALLAS_CALL:
+                    return node
+                if isinstance(node.func, ast.Name):
+                    target = _resolve(node.func.id, node.lineno, by_name)
+                    if target is not None and (
+                            id(target) in traced or
+                            self._is_jit_builder(target)):
+                        return node
+                    if builder_vars is None:
+                        builder_vars = self._builder_vars(
+                            mod, try_node, by_name)
+                    if node.func.id in builder_vars:
+                        return node
+                if isinstance(node.func, ast.Call) and \
+                        isinstance(node.func.func, ast.Name):
+                    target = _resolve(node.func.func.id,
+                                      node.lineno, by_name)
+                    if target is not None and self._is_jit_builder(target):
+                        return node
+        return None
+
+    def check(self, mod: Module) -> Iterator[Finding]:
+        tries = [n for n in ast.walk(mod.tree) if isinstance(n, ast.Try)]
+        if not tries:
+            return
+        traced = find_traced(mod)
+        by_name = _index_all_functions(mod)
+        for t in tries:
+            bad = [h for h in t.handlers
+                   if self._is_broad(h) and not self._is_compliant(h)]
+            if not bad:
+                continue
+            site = self._dispatch_site(mod, t, traced, by_name)
+            if site is None:
+                continue
+            for h in bad:
+                yield self.finding(
+                    mod, h,
+                    "broad except around a device dispatch (jit/pallas "
+                    f"call at line {site.lineno}) neither classifies "
+                    "into the ComputeError taxonomy nor re-raises — "
+                    "route it through parallel.guard.classify (or "
+                    "dispatch via guard.dispatch) so device faults "
+                    "reach the breaker/quarantine/telemetry plane")
+
+
 RULES: List[Rule] = [JaxPurityRule(), NonStaticJitCacheRule(),
                      ItemInLoopRule(), MeshSpecRule(),
-                     UnguardedPallasDispatchRule()]
+                     UnguardedPallasDispatchRule(),
+                     UnclassifiedDeviceDispatchRule()]

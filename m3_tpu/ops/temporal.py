@@ -42,6 +42,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..parallel import guard
 from ..parallel import telemetry
 from ..utils.instrument import ROOT
 
@@ -390,6 +391,7 @@ def _take_t(grid, abs_idx):
         grid, jnp.clip(abs_idx, 0, grid.shape[-1] - 1), axis=-1)
 
 
+@guard.guarded_builder("temporal.rate")
 @telemetry.jit_builder("rate")
 @functools.lru_cache(maxsize=256)
 def _rate_fn(W: int, step_s: float, range_s: float, is_counter: bool,
@@ -581,6 +583,7 @@ def delta_async(grid: np.ndarray, W: int, step_ns: int, range_ns: int,
                                stride)
 
 
+@guard.guarded_builder("temporal.last_two_idx")
 @telemetry.jit_builder("last_two_idx")
 @functools.lru_cache(maxsize=256)
 def _last_two_idx_fn(W: int, stride: int = 1):
@@ -707,6 +710,7 @@ def _window_stat_strided(resid, W: int, stat: str, stride: int):
     return _window_stat(resid, W, stat, stride)
 
 
+@guard.guarded_builder("temporal.over_time")
 @telemetry.jit_builder("over_time")
 @functools.lru_cache(maxsize=256)
 def _over_time_fn(W: int, stat: str, stride: int = 1):
@@ -756,6 +760,7 @@ def over_time_math(resid, base32, *, W: int, kind: str, stride: int = 1):
     return jnp.where(cnt > 0, out, jnp.nan).astype(_F32)
 
 
+@guard.guarded_builder("temporal.over_time_finish")
 @telemetry.jit_builder("over_time_finish")
 @functools.lru_cache(maxsize=256)
 def _over_time_finish_fn(W: int, kind: str, stride: int = 1):
@@ -833,6 +838,7 @@ def over_time(grid: np.ndarray, W: int, kind: str, stride: int = 1,
     return over_time_async(grid, W, kind, stride, finish)()
 
 
+@guard.guarded_builder("temporal.quantile_idx")
 @telemetry.jit_builder("quantile_idx")
 @functools.lru_cache(maxsize=256)
 def _quantile_idx_fn(W: int, stride: int = 1):
@@ -891,6 +897,7 @@ def changes_resets_math(resid, *, W: int, count_resets: bool,
     return jnp.where(cnt > 0, hits.sum(axis=-1).astype(_F32), jnp.nan)
 
 
+@guard.guarded_builder("temporal.changes_resets")
 @telemetry.jit_builder("changes_resets")
 @functools.lru_cache(maxsize=256)
 def _changes_resets_fn(W: int, count_resets: bool, stride: int = 1):
@@ -940,6 +947,7 @@ def regression_math(resid, *, W: int, step_s: float,
     return jnp.where(ok, intercept + slope * t_eval, jnp.nan)
 
 
+@guard.guarded_builder("temporal.regression")
 @telemetry.jit_builder("regression")
 @functools.lru_cache(maxsize=256)
 def _regression_fn(W: int, step_s: float, predict_offset_s: float,
@@ -993,6 +1001,7 @@ def holt_winters_math(resid, *, W: int, sf: float, tf: float,
     return jax.vmap(jax.vmap(one_window))(vol, mask)
 
 
+@guard.guarded_builder("temporal.holt_winters")
 @telemetry.jit_builder("holt_winters")
 @functools.lru_cache(maxsize=256)
 def _holt_winters_fn(W: int, sf: float, tf: float, stride: int = 1):

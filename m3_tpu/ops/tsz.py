@@ -267,8 +267,9 @@ def _default_pack() -> str:
     host CPU). Resolved OUTSIDE the jitted program so M3_TPU_PALLAS flips
     take effect per call, not per trace cache."""
     from . import pallas_codec
+    from ..parallel import guard
 
-    if pallas_codec.enabled():
+    if pallas_codec.enabled() and guard.available("codec.encode"):
         return "pallas"
     return "tree" if jax.default_backend() == "tpu" else "scatter"
 
@@ -314,17 +315,34 @@ def encode_batch(dt, t0, vhi, vlo, int_mode, k, npoints, ts_regular=None,
     # tracer; the branch exists precisely to SKIP host timing under an
     # enclosing trace.
     if pack == "pallas" and not traced:  # m3lint: disable=jax-traced-branch
-        key = (tuple(dt.shape), int(max_words))
-        if key not in _ENCODE_TIMED:
-            _ENCODE_TIMED.add(key)
-            t_start = time.perf_counter()
+        from ..parallel import guard
+
+        def _pallas_encode():
+            key = (tuple(dt.shape), int(max_words))
+            timed = key not in _ENCODE_TIMED
+            if timed:
+                _ENCODE_TIMED.add(key)
+                t_start = time.perf_counter()
             out = _encode_batch(dt, t0, vhi, vlo, int_mode, k, npoints,
                                 ts_regular, delta0, max_words=max_words,
                                 pack=pack)
-            jax.block_until_ready(out)
-            telemetry.codec_compile_recorded(
-                "encode", time.perf_counter() - t_start)
+            if timed:
+                jax.block_until_ready(out)
+                telemetry.codec_compile_recorded(
+                    "encode", time.perf_counter() - t_start)
             return out
+
+        def _xla_encode(_err):
+            # The XLA twin is bit-identical by contract (the property
+            # corpus proves all three packs equal) — the proven fallback
+            # when the Pallas kernel faults or its breaker is open.
+            xla_pack = ("tree" if jax.default_backend() == "tpu"
+                        else "scatter")
+            return _encode_batch(dt, t0, vhi, vlo, int_mode, k, npoints,
+                                 ts_regular, delta0, max_words=max_words,
+                                 pack=xla_pack)
+
+        return guard.dispatch("codec.encode", _pallas_encode, _xla_encode)
     return _encode_batch(dt, t0, vhi, vlo, int_mode, k, npoints,
                          ts_regular, delta0, max_words=max_words, pack=pack)
 
@@ -1090,8 +1108,10 @@ def _decode_route():
     """Decode scan route: "pallas" when the Pallas codec kernels are
     enabled (interpret-mode on CPU), else the XLA lax.scan."""
     from . import pallas_codec
+    from ..parallel import guard
 
-    return "pallas" if pallas_codec.enabled() else "xla"
+    return ("pallas" if pallas_codec.enabled()
+            and guard.available("codec.decode") else "xla")
 
 
 @functools.lru_cache(maxsize=None)
@@ -1158,15 +1178,34 @@ def decode_plane(words, npoints, *, window: int, unit_nanos: int = 1,
     telemetry.codec_route("decode", route == "pallas")
     run = _decode_fused_jit(int(window), int(unit_nanos), bool(with_f32),
                             route)
-    key = (int(window), int(unit_nanos), bool(with_f32), route)
-    timed = route == "pallas" and key not in _DECODE_TIMED
-    t_start = time.perf_counter() if timed else 0.0
-    out = run(jnp.asarray(words), jnp.asarray(npoints, I32))
-    if timed:
-        _DECODE_TIMED.add(key)
-        jax.block_until_ready(out)
-        telemetry.codec_compile_recorded(
-            "decode", time.perf_counter() - t_start)
+    jwords = jnp.asarray(words)
+    jnp_ = jnp.asarray(npoints, I32)
+    if route == "pallas":
+        from ..parallel import guard
+
+        def _pallas_decode():
+            key = (int(window), int(unit_nanos), bool(with_f32), route)
+            timed = key not in _DECODE_TIMED
+            t_start = time.perf_counter() if timed else 0.0
+            res = run(jwords, jnp_)
+            if timed:
+                _DECODE_TIMED.add(key)
+                jax.block_until_ready(res)
+                telemetry.codec_compile_recorded(
+                    "decode", time.perf_counter() - t_start)
+            return res
+
+        def _xla_decode(_err):
+            # The XLA scan twin — bit-identical across the property
+            # corpus — rebuilt under its own lru key ("xla" rides in the
+            # cache key, so no cache surgery is needed to reroute).
+            fb = _decode_fused_jit(int(window), int(unit_nanos),
+                                   bool(with_f32), "xla")
+            return fb(jwords, jnp_)
+
+        out = guard.dispatch("codec.decode", _pallas_decode, _xla_decode)
+    else:
+        out = run(jwords, jnp_)
     ts = np.asarray(out["ts"]).view(np.int64)[..., 0]
     vals = np.asarray(out["vals"]).view(np.float64)[..., 0]
     f32 = np.asarray(out["f32"]) if with_f32 else None

@@ -33,6 +33,7 @@ import jax
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from . import guard as pguard
 from . import telemetry
 from .ingest import flush_mesh, shard_map_compat
 from ..ops import aggregation as agg
@@ -85,15 +86,29 @@ def quantile_rank_rows(tile: np.ndarray, counts: np.ndarray,
     mesh = flush_mesh()
     min_cells = int(os.environ.get("M3_TPU_MESH_AGG_MIN_CELLS", "2048"))
     if mesh is not None and n * width >= min_cells:
+        orig_tile, orig_counts = tile, counts
         ndev = mesh.devices.size
         pad = (-n) % ndev
         if pad:
             tile = np.concatenate(
                 [tile, np.zeros((pad, width), tile.dtype)])
             counts = np.concatenate([counts, np.zeros(pad, counts.dtype)])
-        telemetry.mesh_dispatch("agg_flush", cells=int(tile.size))
-        sel = make_mesh_rank_selector(mesh, width, qs)
-        return np.asarray(sel(tile, counts))[:n]
+
+        def _mesh_select():
+            telemetry.mesh_dispatch("agg_flush", cells=int(tile.size))
+            sel = make_mesh_rank_selector(mesh, width, qs)
+            return np.asarray(sel(tile, counts))[:n]
+
+        def _single_select(_err):
+            # The single-device jit is bit-identical by construction
+            # (row-independent, same kernel) — the proven fallback when
+            # the mesh program faults or its breaker is open. Runs on the
+            # UNpadded tile; nothing was partially applied (the flush
+            # consumes only this function's return value).
+            return np.asarray(
+                _single_rank_selector(width, qs)(orig_tile, orig_counts))
+
+        return pguard.dispatch("agg_flush", _mesh_select, _single_select)
     return np.asarray(_single_rank_selector(width, qs)(tile, counts))
 
 

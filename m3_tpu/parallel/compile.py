@@ -54,6 +54,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from . import guard as pguard
 from . import telemetry
 from ..ops import series_agg, temporal
 from ..utils import numwatch
@@ -959,6 +960,18 @@ def execute(bound: "qplan.Bound", mesh: Optional[Mesh]):
     if slots.size == 0:
         slots = np.zeros(1, dtype=np.float32)
 
+    # Shape-bucket key for the compute-fault quarantine: a bucket whose
+    # executable faulted post-compile must route to the interpreter
+    # WITHOUT rebuilding (lru_cache has no per-key eviction — the guard
+    # clears the whole builder cache on quarantine, and this pre-builder
+    # probe keeps the poisoned bucket from recompiling until its TTL).
+    bucket = (_bucket_sig(geom), hash((stripped, kinds_sig)))
+    if pguard.is_quarantined("plan", bucket):
+        telemetry.compute_route("plan", primary=False)
+        raise PlanFallback(
+            f"quarantined shape bucket {bucket[0]}",
+            reason=qplan.FallbackReason.DEVICE_FAULT)
+
     fn = _plan_executable(stripped, geom, use_mesh, kinds_sig)
     missed = isinstance(fn, telemetry._CompileTimed)
     if missed:
@@ -975,7 +988,22 @@ def execute(bound: "qplan.Bound", mesh: Optional[Mesh]):
     actx = qexplain.current()
     sync = missed or actx is not None
     t0 = time.perf_counter() if sync else 0.0
-    root_val, extras = fn(tuple(fetch_flat), tuple(aux_flat), slots)
+
+    def _fault_fallback(err):
+        # The interpreter is the plan route's proven oracle: surface the
+        # typed DEVICE_FAULT reason so the executor's existing fallback
+        # path counts it (telemetry.plan_fallback scope=runtime) and
+        # EXPLAIN shows the route the execution actually took.
+        raise PlanFallback(
+            f"device fault: {err}" if err is not None
+            else "plan route degraded",
+            reason=qplan.FallbackReason.DEVICE_FAULT)
+
+    root_val, extras = pguard.dispatch(
+        "plan",
+        lambda: fn(tuple(fetch_flat), tuple(aux_flat), slots),
+        _fault_fallback,
+        key=bucket, evict=_plan_executable.cache_clear)
     if sync:
         (root_val, extras) = jax.block_until_ready((root_val, extras))
         dt = time.perf_counter() - t0

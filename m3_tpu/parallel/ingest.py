@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from . import guard as pguard
 from . import telemetry
 from ..ops import aggregation as agg
 from ..ops import bits64 as b64
@@ -246,11 +247,20 @@ def flush_encode_prepared(inp: dict, max_words: int):
     min_cells = int(os.environ.get("M3_TPU_MESH_FLUSH_MIN_CELLS", "2048"))
     if n * shape[1] < min_cells:
         return None
-    enc = make_flush_encoder(mesh, max_words)
-    telemetry.mesh_dispatch("flush_encode", cells=int(n * shape[1]))
-    return enc(inp["dt"], inp["t0"][0], inp["t0"][1], inp["vhi"],
-               inp["vlo"], inp["int_mode"], inp["k"], inp["npoints"],
-               inp["ts_regular"], inp["delta0"])
+    def _mesh_encode():
+        enc = make_flush_encoder(mesh, max_words)
+        telemetry.mesh_dispatch("flush_encode", cells=int(n * shape[1]))
+        return enc(inp["dt"], inp["t0"][0], inp["t0"][1], inp["vhi"],
+                   inp["vlo"], inp["int_mode"], inp["k"], inp["npoints"],
+                   inp["ts_regular"], inp["delta0"])
+
+    # Guarded dispatch: a device fault here degrades to the plain
+    # single-device encode by returning None — the caller consumes ONLY
+    # this function's return value, so a mid-dispatch fault leaves
+    # nothing partially applied (the PR 5 all-or-nothing seal contract
+    # holds under injected faults; acked writes still seal via the
+    # fallback path).
+    return pguard.dispatch("flush_encode", _mesh_encode, lambda _err: None)
 
 
 def make_sharded_ingest(mesh: Mesh, *, rollup_factor: int, max_words: int, quantile_qs=(0.5, 0.99)):

@@ -269,6 +269,80 @@ def codec_compile_recorded(kernel: str, seconds: float):
     tracing.count_cost("codec_pallas_compile")
 
 
+# ---------------------------------------------------------- compute plane
+
+_COMPUTE = _SCOPE.sub_scope("compute")
+
+
+@functools.lru_cache(maxsize=None)
+def _compute_route_counters(route: str):
+    # The guard dispatches on hot interpreter paths (one per temporal
+    # op invocation): resolve the tagged counter objects once per route
+    # so the per-dispatch cost is two Counter.inc()s, not a sub_scope
+    # build + registry lookup (the obs_overhead_guard guard-seam section
+    # holds this under 3%).
+    scope = _SCOPE.sub_scope("compute", route=route)
+    return (scope.counter("primary"), scope.counter("fallback"),
+            _COMPUTE.counter("primary"), _COMPUTE.counter("fallback"))
+
+
+def compute_route(route: str, primary: bool):
+    """Count one guarded dispatch for an accelerated `route` (plan,
+    agg_flush, flush_encode, codec.*, block.decode, temporal.*): the
+    primary accelerated path vs its proven fallback twin. `route` is a
+    closed set — the guard registry's route names — never a query string
+    (m3lint `unbounded-telemetry-tag` applies). Span-tagged so EXPLAIN
+    and the slow-query log name the degraded route."""
+    prim, fb, tot_prim, tot_fb = _compute_route_counters(route)
+    if primary:
+        prim.inc()
+        tot_prim.inc()
+    else:
+        fb.inc()
+        tot_fb.inc()
+        tracing.count_cost(f"compute_fallback_{route}")
+
+
+def compute_fault(route: str, kind: str):
+    """One classified device/kernel fault on `route`, tagged with its
+    `ComputeError` taxonomy kind (compile / oom / kernel / timeout — a
+    closed set)."""
+    _SCOPE.sub_scope("compute", route=route, kind=kind).counter(
+        "faults").inc()
+    _COMPUTE.counter("faults").inc()
+    tracing.count_cost(f"compute_fault_{kind}")
+
+
+def compute_trip(route: str, state: str):
+    """One breaker state transition on `route` (state in {"open",
+    "half_open", "closed"}). `open` transitions are the degradation
+    signal HealthTracker's compute probe and /debug/vars surface."""
+    _SCOPE.sub_scope("compute", route=route).counter(
+        "trip_" + state).inc()
+    if state == "open":
+        _COMPUTE.counter("trips").inc()
+        tracing.count_cost("compute_breaker_trip")
+
+
+def compute_quarantine(route: str):
+    """One shape-bucket executable quarantined on `route` (a post-compile
+    fault dropped the cache entry and keyed the bucket into the TTL'd
+    quarantine set — no recompile-crash-loop)."""
+    _SCOPE.sub_scope("compute", route=route).counter("quarantined").inc()
+    _COMPUTE.counter("quarantined").inc()
+    tracing.count_cost("compute_quarantine")
+
+
+def compute_oom_reclaim(route: str, freed: int):
+    """One DeviceOOM-triggered HBMBudget cross-tenant reclaim before the
+    single retry; `freed` accumulates bytes reclaimed."""
+    _SCOPE.sub_scope("compute", route=route).counter("oom_reclaims").inc()
+    _COMPUTE.counter("oom_reclaims").inc()
+    if freed > 0:
+        _COMPUTE.counter("oom_reclaimed_bytes").inc(int(freed))
+    tracing.count_cost("compute_oom_reclaim")
+
+
 # ------------------------------------------------------------- dispatches
 
 

@@ -280,6 +280,7 @@ class FallbackReason(enum.Enum):
     BELOW_FLOOR = "below-floor"                # total cells < PLAN_MIN_CELLS
     BACKEND_GAP = "backend-gap"                # compile-time PlanFallback
     DISABLED = "disabled"                      # plan route off (env/ref)
+    DEVICE_FAULT = "device-fault"              # guarded dispatch tripped
 
 
 # Reasons that are RUNTIME routing decisions (data size, kill switches,
@@ -288,7 +289,7 @@ class FallbackReason(enum.Enum):
 # disagree with recorded routes on small-series corpora — a below-floor
 # miss is not a lowering gap (scripts/coverage_report.py reads both).
 RUNTIME_REASONS = frozenset({
-    "below-floor", "backend-gap", "disabled",
+    "below-floor", "backend-gap", "disabled", "device-fault",
 })
 
 

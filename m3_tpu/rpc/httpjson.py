@@ -102,7 +102,11 @@ class HTTPJSONServer:
                 code = 200
                 try:
                     if path == "/debug/vars":
-                        out = json.dumps({"metrics": ROOT.snapshot()}).encode()
+                        from ..parallel import guard
+
+                        out = json.dumps(
+                            {"metrics": ROOT.snapshot(),
+                             "compute": guard.debug_snapshot()}).encode()
                     elif path == "/debug/traces":
                         tid = params.get("trace_id", [None])[0]
                         out = json.dumps(tracing.debug_traces_payload(

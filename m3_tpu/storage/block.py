@@ -162,9 +162,9 @@ class SealedBlock:
             if dec is not None:
                 n = int(self.npoints[row])
                 return dec[0][row, :n], dec[1][row, :n]
-        ts, vals = tsz.decode_plane(
+        ts, vals = _dispatch_decode(
             self.words[row : row + 1], self.npoints[row : row + 1],
-            window=self.window, unit_nanos=self.time_unit.nanos)
+            self.window, self.time_unit.nanos)
         n = int(self.npoints[row])
         t_out = np.ascontiguousarray(ts[0, :n])
         v_out = np.ascontiguousarray(vals[0, :n])
@@ -225,8 +225,8 @@ class SealedBlock:
         # Fused plane decode: the tick cumsum, unit-nanos scaling and
         # int->f64 select all run inside the ONE decode program
         # (tsz.decode_plane) instead of as host passes over [S, W] planes.
-        ts, vals = tsz.decode_plane(words, npoints, window=self.window,
-                                    unit_nanos=self.time_unit.nanos)
+        ts, vals = _dispatch_decode(words, npoints, self.window,
+                                    self.time_unit.nanos)
         ts = np.ascontiguousarray(ts[:s])
         vals = np.ascontiguousarray(vals[:s])
         ts.setflags(write=False)
@@ -235,6 +235,47 @@ class SealedBlock:
 
     def nbytes(self) -> int:
         return int(self.words.nbytes)
+
+
+def _decode_plane_host(words, npoints, window: int, unit_nanos: int):
+    """Host oracle decode (ops/ref_codec, row by row) — the block-decode
+    route's fallback when the device decode faults or its breaker is
+    open. Bit-identical on the valid region by the property-corpus
+    contract; padding cells are zero (consumers never read past
+    npoints[r])."""
+    from ..ops import ref_codec
+
+    words = np.asarray(words)
+    npoints = np.asarray(npoints)
+    s = words.shape[0]
+    ts = np.zeros((s, window), np.int64)
+    vals = np.zeros((s, window), np.float64)
+    for r in range(s):
+        n = int(npoints[r])
+        if n == 0:
+            continue
+        t, v = ref_codec.decode(ref_codec.EncodedBlock(
+            words=words[r], nbits=0, npoints=n))
+        ts[r, :n] = np.asarray(t, np.int64) * unit_nanos
+        vals[r, :n] = np.asarray(v, np.float64)
+    return ts, vals
+
+
+def _dispatch_decode(words, npoints, window: int, unit_nanos: int):
+    """The block plane decode through the compute-fault guard: primary
+    is the fused device program (tsz.decode_plane, itself guarded at the
+    codec.decode level for its Pallas-vs-XLA routing); fallback is the
+    host ref_codec oracle."""
+    from ..parallel import guard
+
+    def _device():
+        return tsz.decode_plane(words, npoints, window=window,
+                                unit_nanos=unit_nanos)
+
+    return guard.dispatch(
+        "block.decode", _device,
+        lambda _err: _decode_plane_host(words, npoints, window,
+                                        unit_nanos))
 
 
 def _next_pow2(n: int, floor: int = 8) -> int:

@@ -1337,3 +1337,84 @@ class DiskFaultScenario:
         self.session.close()
         self.admin_session.close()
         self.cluster.close()
+
+
+# ---------------------------------------------------------------------------
+# compute-fault churn drill (the compute leg of the fault trilogy)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ComputeFaultChurnOptions(ChurnScenarioOptions):
+    """ChurnScenario options plus a seeded compute-fault plan armed on
+    the guarded dispatch seam for the whole run: every accelerated
+    dispatch in the process (block-plane decode, codec kernels, plan
+    programs — whatever the load actually drives) runs under seeded
+    device/kernel chaos while the network chaos plan and placement churn
+    run as usual. The SLO set is UNCHANGED: device faults must degrade
+    to the proven fallback twins invisibly."""
+
+    # Per-dispatch fault rates (testing/faultcomp.ComputeFaultPlan).
+    compute_dispatch_raise: float = 0.15
+    compute_oom: float = 0.05
+    compute_corrupt: float = 0.15
+    compute_delay: float = 0.05
+    compute_delay_s: float = 0.01
+    compute_route_filter: str = ""    # all guarded routes
+
+
+class ComputeFaultChurnScenario(ChurnScenario):
+    """One seeded churn run with the compute-fault plane armed: the
+    faultcomp seam intercepts every guarded accelerated dispatch with a
+    pure-function-of-(seed, route, index) fault schedule, and the full
+    ChurnScenario SLO set — zero lost acked writes, zero shed CRITICAL,
+    bounded p99/queues, converged placement, replica-consistent
+    checksums — must hold anyway: raises, OOMs, hangs, and silently
+    corrupted output planes all land on the breaker-gated fallbacks,
+    never on the serving contract."""
+
+    def __init__(self, opts: ComputeFaultChurnOptions =
+                 ComputeFaultChurnOptions()):
+        super().__init__(opts)
+        from . import faultcomp
+
+        self.compute_plan = faultcomp.ComputeFaultPlan(
+            seed=opts.seed,
+            dispatch_raise=opts.compute_dispatch_raise,
+            oom=opts.compute_oom,
+            corrupt=opts.compute_corrupt,
+            delay=opts.compute_delay,
+            delay_s=opts.compute_delay_s,
+            route_filter=opts.compute_route_filter)
+        self.compute_seam = None
+
+    def run(self) -> ScenarioResult:
+        from ..parallel import guard
+        from . import faultcomp
+
+        # Fresh breakers/quarantine: a previous drill's tripped routes
+        # must not pre-degrade this one.
+        guard.reset()
+        self.compute_seam = faultcomp.install(self.compute_plan)
+        try:
+            return super().run()
+        finally:
+            faultcomp.uninstall()
+
+    def verify(self, result: ScenarioResult) -> ScenarioResult:
+        result = super().verify(result)
+        seam = self.compute_seam
+        assert seam is not None and seam.faults_injected > 0, \
+            "compute chaos never fired — the drill proved nothing"
+        # Replayability: the recorded decision log IS the pure schedule.
+        for route, decisions in seam.decisions.items():
+            assert decisions == self.compute_plan.schedule(
+                route, len(decisions)), \
+                f"decision log diverged from the seeded schedule: {route}"
+        return result
+
+    def close(self):
+        from . import faultcomp
+
+        faultcomp.uninstall()  # idempotent: never leak the fault seam
+        super().close()

@@ -111,11 +111,22 @@ def hash_batch(ids: Sequence[bytes], seed: int = 0) -> np.ndarray:
     except Exception:  # jax-less contexts keep the pure-numpy path
         pallas_codec = None
     if pallas_codec is not None:
+        from ..parallel import guard
+
         use = (pallas_codec.enabled()
-               and 0 < words.shape[1] <= pallas_codec.HASH_MAX_COLS)
+               and 0 < words.shape[1] <= pallas_codec.HASH_MAX_COLS
+               and guard.available("codec.hash"))
         pallas_codec.route("hash", use)
         if use:
-            return pallas_codec.hash_words(words, lens, seed)
+            out = guard.dispatch(
+                "codec.hash",
+                lambda: np.asarray(pallas_codec.hash_words(
+                    words, lens, seed)),
+                lambda _err: None)
+            if out is not None:
+                return out
+            # Guarded fallback: fall through to the numpy loop below —
+            # the declared oracle for this kernel.
 
     h = np.full(n, seed, np.uint32)
     nblocks = lens // 4

@@ -129,6 +129,20 @@ echo "== diskfault smoke (<10s; seeded I/O faults on one replica: quarantine, sc
 # budget on a cold tree).
 JAX_PLATFORMS=cpu python scripts/diskfault_smoke.py --seed 7
 
+echo "== computefault smoke (<10s; seeded device/kernel faults on the guarded routes: oracle equality, typed DEVICE_FAULT + quarantine, breaker trip + half-open recovery) =="
+# The compute-fault plane: one seeded pass arms the testing/faultcomp
+# dispatch seam over the real guarded routes (plan, agg-flush, codec)
+# — every answer must stay oracle-equal under raises/OOMs/corrupt
+# planes, the plan fallback must be typed DEVICE_FAULT scope=runtime
+# with the shape bucket quarantined (no recompile crash-loop), a
+# crash-looping route must trip its breaker OPEN and read as
+# compute-degraded (never shedding) then recover through the half-open
+# probe, and the decision log must replay from the pure seeded
+# schedule. Full matrix: tests/test_compute_faults.py; per-kernel kill
+# switches: tests/test_codec_pallas.py. Wall budget via
+# COMPUTEFAULT_SMOKE_BUDGET_S.
+JAX_PLATFORMS=cpu python scripts/computefault_smoke.py --seed 7
+
 echo "== observability smoke (<10s; cross-process span tree, slow-query log, self-scrape PromQL round trip, jit telemetry) =="
 # The tracing / /debug / self-scrape plane: one 2-node clustered run
 # asserting a client->coordinator->dbnode span tree (>=3 hops, grafted

@@ -213,3 +213,82 @@ class TestCursorOverflow:
         words, nbits = tsz.encode(ts, vals,
                                   max_words=tsz.max_words_for(16))
         assert int(np.max(np.asarray(nbits))) <= 32 * tsz.max_words_for(16)
+
+
+class TestGuardRouteMatrix:
+    """The M3_TPU_PALLAS route matrix under the guard's per-kernel kill
+    switches: guard.set_disabled("codec.<kernel>") flips each codec
+    kernel's route independently, MID-PROCESS (no env churn, no cache
+    surgery — the route pickers resolve outside jit per call), with the
+    route counters proving the dispatch actually moved and bit-identity
+    holding on both sides of every flip."""
+
+    KERNELS = ("encode", "decode", "hash")
+
+    @pytest.fixture(autouse=True)
+    def _clean_guard(self):
+        from m3_tpu.parallel import guard
+        guard.reset()
+        yield
+        guard.reset()
+
+    def _counts(self):
+        snap = telemetry.snapshot()
+        return {k: snap.get(f"telemetry.codec.{k}", 0)
+                for k in ("pallas_encode", "xla_encode", "pallas_decode",
+                          "xla_decode", "pallas_hash", "xla_hash")}
+
+    @staticmethod
+    def _bits(a):
+        a = np.asarray(a)
+        return a.view(np.uint64) if a.dtype == np.float64 else a
+
+    def test_per_kernel_kill_switch_matrix(self, monkeypatch):
+        from m3_tpu.parallel import guard
+        monkeypatch.setenv("M3_TPU_PALLAS", "1")
+        ts, vals, npoints = _corpus(41, 16, 16)
+        kw = _encode_args(ts, vals, npoints)
+        mw = tsz.max_words_for(16)
+        rng = np.random.default_rng(43)
+        ids = [bytes(rng.integers(0, 256, ln, dtype=np.uint8))
+               for ln in rng.integers(1, 33, 64)]
+
+        def run_all():
+            words, nbits = tsz.encode_batch(**kw, max_words=mw)
+            tsp, vsp = tsz.decode_plane(np.asarray(words), npoints,
+                                        window=16, unit_nanos=1)
+            return (np.asarray(words), np.asarray(nbits),
+                    np.asarray(tsp), np.asarray(vsp),
+                    hashing.hash_batch(ids))
+
+        base = self._counts()
+        ref = run_all()  # all three kernels on the pallas route
+        after = self._counts()
+        for kern in self.KERNELS:
+            assert after[f"pallas_{kern}"] == base[f"pallas_{kern}"] + 1
+
+        for kern in self.KERNELS:  # flip ONE switch at a time
+            guard.set_disabled(f"codec.{kern}", True)
+            before = self._counts()
+            got = run_all()
+            now = self._counts()
+            # the killed kernel re-routed to its XLA/host twin...
+            assert now[f"xla_{kern}"] == before[f"xla_{kern}"] + 1
+            # ...the other two kept their pallas route (independence)...
+            for other in self.KERNELS:
+                if other != kern:
+                    assert now[f"pallas_{other}"] == \
+                        before[f"pallas_{other}"] + 1, (kern, other)
+            # ...and every output is bit-identical across the flip.
+            for a, b in zip(ref, got):
+                np.testing.assert_array_equal(self._bits(a),
+                                              self._bits(b), err_msg=kern)
+            guard.set_disabled(f"codec.{kern}", False)
+
+        before = self._counts()  # all switches restored: pallas again
+        got = run_all()
+        now = self._counts()
+        for kern in self.KERNELS:
+            assert now[f"pallas_{kern}"] == before[f"pallas_{kern}"] + 1
+        for a, b in zip(ref, got):
+            np.testing.assert_array_equal(self._bits(a), self._bits(b))

@@ -1,0 +1,804 @@
+"""Compute-fault plane: seeded device/kernel fault injection
+(testing/faultcomp) against the guarded dispatch layer (parallel/guard).
+
+Per-route seeded fault campaigns prove every guarded accelerated route
+stays bit-identical (or FP-equal where the fallback twin is eager
+execution) to its proven oracle under ALL five fault kinds — compile
+failure, dispatch raise, device OOM, dispatch hang, corrupted output
+planes — plus the breaker lifecycle (trip within N dispatches,
+half-open recovery), the OOM evict-then-retry contract, executable
+quarantine (no recompile crash-loops), flush all-or-nothing, the typed
+DEVICE_FAULT plan-fallback surface, and decision-log replayability
+(the schedule is a pure function of (seed, route, call-index)).
+
+The composition drill at the bottom runs ChurnScenario with the
+compute seam armed: zero acked-write loss, zero shed CRITICAL."""
+
+import numpy as np
+import pytest
+
+from m3_tpu.ops import ref_codec, temporal, tsz
+from m3_tpu.parallel import agg_flush, guard, telemetry
+from m3_tpu.parallel import ingest as pingest
+from m3_tpu.query import Engine
+from m3_tpu.query import plan as qplan
+from m3_tpu.storage import block as blk
+from m3_tpu.testing import faultcomp
+from m3_tpu.utils import hashing, hbm
+from m3_tpu.utils.instrument import ROOT
+from m3_tpu.utils.retry import Breaker, BreakerOptions
+
+S = 1_000_000_000
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    """Every test starts and ends on the real seam with fresh routes."""
+    faultcomp.uninstall()
+    guard.reset()
+    yield
+    faultcomp.uninstall()
+    guard.reset()
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float):
+        self.t += dt
+
+
+def _find_seed(route: str, want, n: int = 2, **rates) -> int:
+    """Search seeds for a plan whose first n decisions on `route` equal
+    `want` — the pure-function schedule makes 'fault then clear'
+    campaigns deterministic without any mutable injector state."""
+    for seed in range(500):
+        plan = faultcomp.ComputeFaultPlan(seed=seed, **rates)
+        if plan.schedule(route, n) == list(want):
+            return seed
+    raise AssertionError(f"no seed gives {want} on {route}")
+
+
+# ---------------------------------------------------------------------------
+# schedule purity + replay
+# ---------------------------------------------------------------------------
+
+
+class TestScheduleReplay:
+    def test_decide_at_is_pure(self):
+        plan = faultcomp.ComputeFaultPlan(seed=11, dispatch_raise=0.3,
+                                          oom=0.2, corrupt=0.1)
+        a = [plan.decide_at("r", i) for i in range(64)]
+        b = [plan.decide_at("r", i) for i in reversed(range(64))]
+        assert a == list(reversed(b))
+        assert plan.schedule("r", 64) == a
+
+    def test_schedule_varies_by_seed_and_route(self):
+        mk = lambda s: faultcomp.ComputeFaultPlan(seed=s, dispatch_raise=0.5)
+        assert mk(1).schedule("r", 64) != mk(2).schedule("r", 64)
+        assert mk(1).schedule("r1", 64) != mk(1).schedule("r2", 64)
+
+    def test_decision_log_equals_schedule(self):
+        plan = faultcomp.ComputeFaultPlan(seed=5, dispatch_raise=0.25,
+                                          oom=0.15, corrupt=0.2)
+        # A breaker that never trips: every dispatch reaches the seam,
+        # so the decision log covers all 20 calls per route.
+        never = BreakerOptions(window=64, failure_ratio=1.01,
+                               min_samples=1000, cooldown_s=0.0)
+        with faultcomp.injected(plan) as seam:
+            for route in ("a.x", "a.y"):
+                guard.configure(route, opts=never)
+                for _ in range(20):
+                    guard.dispatch(route, lambda: np.ones(2),
+                                   lambda _e: np.ones(2))
+        for route in ("a.x", "a.y"):
+            n = len(seam.decisions[route])
+            assert n >= 20  # OOM retries draw fresh indices
+            assert seam.decisions[route] == plan.schedule(route, n)
+        assert seam.faults_injected == sum(
+            1 for r in ("a.x", "a.y")
+            for d in seam.decisions[r] if d != faultcomp.NO_FAULT)
+
+    def test_route_filter_scopes_faults(self):
+        plan = faultcomp.ComputeFaultPlan(seed=0, dispatch_raise=1.0,
+                                          route_filter="codec.")
+        with faultcomp.injected(plan) as seam:
+            assert guard.dispatch("plan", lambda: 7, lambda _e: -1) == 7
+            assert guard.dispatch("codec.hash", lambda: 7,
+                                  lambda _e: -1) == -1
+        assert "plan" not in seam.decisions
+        assert seam.decisions["codec.hash"] == ["dispatch_raise"]
+
+
+# ---------------------------------------------------------------------------
+# taxonomy classification
+# ---------------------------------------------------------------------------
+
+
+class TestClassify:
+    def test_injected_kinds_map_to_taxonomy(self):
+        X = faultcomp.XlaRuntimeError
+        cases = [
+            (X("INTERNAL: injected XLA compilation failure (route=r, "
+               "index=0)"), guard.CompileError),
+            (X("INTERNAL: injected device fault during program execution"),
+             guard.KernelFault),
+            (X("RESOURCE_EXHAUSTED: injected: attempting to allocate 2.0G"),
+             guard.DeviceOOM),
+            (X("DEADLINE_EXCEEDED: collective timed out"),
+             guard.DispatchTimeout),
+        ]
+        for exc, want in cases:
+            err = guard.classify(exc, "r")
+            assert type(err) is want, (exc, err)
+            assert err.route == "r"
+
+    def test_oom_marker_wins_regardless_of_type(self):
+        err = guard.classify(MemoryError("RESOURCE_EXHAUSTED on device"),
+                             "r")
+        assert isinstance(err, guard.DeviceOOM)
+
+    def test_program_bugs_are_not_device_faults(self):
+        for exc in (ValueError("bad shape"), TypeError("nope"),
+                    ZeroDivisionError()):
+            assert guard.classify(exc, "r") is None
+
+    def test_compute_error_passthrough(self):
+        e = guard.KernelFault("r", "x")
+        assert guard.classify(e, "other") is e
+
+    def test_unclassified_exception_reraises_through_dispatch(self):
+        def bad():
+            raise ValueError("a real program bug")
+
+        with pytest.raises(ValueError):
+            guard.dispatch("r", bad, lambda _e: None)
+        # ...and the probe slot was released: the breaker still works.
+        assert guard.dispatch("r", lambda: 5, lambda _e: None) == 5
+        assert guard.debug_snapshot()["r"]["state"] == Breaker.CLOSED
+
+
+# ---------------------------------------------------------------------------
+# breaker lifecycle
+# ---------------------------------------------------------------------------
+
+
+class TestBreakerLifecycle:
+    OPTS = BreakerOptions(window=8, failure_ratio=0.5, min_samples=2,
+                          cooldown_s=10.0)
+
+    def test_trips_within_min_samples_dispatches(self):
+        clock = FakeClock()
+        guard.configure("t.trip", opts=self.OPTS, clock=clock)
+        plan = faultcomp.ComputeFaultPlan(seed=0, dispatch_raise=1.0)
+        calls = {"n": 0}
+
+        def primary():
+            calls["n"] += 1
+            return 1
+
+        with faultcomp.injected(plan):
+            for _ in range(6):
+                guard.dispatch("t.trip", primary, lambda _e: 0)
+        snap = guard.debug_snapshot()["t.trip"]
+        assert snap["state"] == Breaker.OPEN
+        # Trip within N = min_samples dispatches: the primary was only
+        # attempted while the breaker admitted it, never after.
+        assert calls["n"] == 0  # dispatch_raise fires before the fn body
+        assert not guard.available("t.trip")
+
+    def test_half_open_recovery_after_faults_clear(self):
+        clock = FakeClock()
+        guard.configure("t.rec", opts=self.OPTS, clock=clock)
+        before = ROOT.snapshot()
+        with faultcomp.injected(
+                faultcomp.ComputeFaultPlan(seed=0, dispatch_raise=1.0)):
+            for _ in range(4):
+                guard.dispatch("t.rec", lambda: 1, lambda _e: 0)
+        assert guard.debug_snapshot()["t.rec"]["state"] == Breaker.OPEN
+
+        # While OPEN pre-cooldown the fallback short-circuits.
+        with faultcomp.injected(faultcomp.ComputeFaultPlan(seed=0)):
+            assert guard.dispatch("t.rec", lambda: 1, lambda _e: 0) == 0
+
+        clock.advance(self.OPTS.cooldown_s + 1)  # -> half-open re-probe
+        with faultcomp.injected(faultcomp.ComputeFaultPlan(seed=0)):
+            assert guard.dispatch("t.rec", lambda: 1, lambda _e: 0) == 1
+        assert guard.debug_snapshot()["t.rec"]["state"] == Breaker.CLOSED
+        assert guard.available("t.rec")
+
+        after = ROOT.snapshot()
+        trip_open = "telemetry.compute.trip_open{route=t.rec}"
+        trip_closed = "telemetry.compute.trip_closed{route=t.rec}"
+        assert after.get(trip_open, 0) - before.get(trip_open, 0) == 1
+        assert after.get(trip_closed, 0) - before.get(trip_closed, 0) == 1
+        assert after.get("telemetry.compute.trips", 0) \
+            - before.get("telemetry.compute.trips", 0) == 1
+
+    def test_available_does_not_consume_probe_slot(self):
+        clock = FakeClock()
+        guard.configure("t.avail", opts=self.OPTS, clock=clock)
+        with faultcomp.injected(
+                faultcomp.ComputeFaultPlan(seed=0, dispatch_raise=1.0)):
+            for _ in range(4):
+                guard.dispatch("t.avail", lambda: 1, lambda _e: 0)
+        clock.advance(self.OPTS.cooldown_s + 1)
+        for _ in range(10):  # half-open now; reads must not burn the probe
+            guard.available("t.avail")
+        assert guard.dispatch("t.avail", lambda: 1, lambda _e: 0) == 1
+        assert guard.debug_snapshot()["t.avail"]["state"] == Breaker.CLOSED
+
+    def test_slow_dispatch_keeps_answer_but_counts_against_breaker(self):
+        clock = None  # real clock: the injected delay really elapses
+        guard.configure("t.slow", opts=self.OPTS, timeout_s=0.005)
+        plan = faultcomp.ComputeFaultPlan(seed=0, delay=1.0, delay_s=0.02)
+        before = ROOT.snapshot()
+        with faultcomp.injected(plan):
+            for _ in range(2):
+                # The VALID (slow) answer is returned...
+                assert guard.dispatch("t.slow", lambda: 41,
+                                      lambda _e: -1) == 41
+        # ...but repeated hangs trip the route to the faster fallback.
+        assert guard.debug_snapshot()["t.slow"]["state"] == Breaker.OPEN
+        after = ROOT.snapshot()
+        key = "telemetry.compute.faults{kind=timeout,route=t.slow}"
+        assert after.get(key, 0) - before.get(key, 0) == 2
+
+
+# ---------------------------------------------------------------------------
+# OOM evict-then-retry
+# ---------------------------------------------------------------------------
+
+
+class TestOOMEvictThenRetry:
+    def test_oom_reclaims_then_retries_once(self):
+        seed = _find_seed("t.oom", ["oom", "ok"], oom=0.5)
+        evictions = {"n": 0}
+
+        def evict_one():
+            evictions["n"] += 1
+            return 4096
+
+        budget = hbm.shared_budget()
+        budget.register("test-compute-oom", lambda: 4096, evict_one)
+        before = ROOT.snapshot()
+        try:
+            plan = faultcomp.ComputeFaultPlan(seed=seed, oom=0.5)
+            with faultcomp.injected(plan) as seam:
+                out = guard.dispatch("t.oom", lambda: np.full(3, 7.0),
+                                     lambda _e: None)
+            assert seam.decisions["t.oom"] == ["oom", "ok"]
+        finally:
+            budget.unregister("test-compute-oom")
+        # The retry (a FRESH schedule index) served the primary result.
+        assert out is not None and np.all(np.asarray(out) == 7.0)
+        assert evictions["n"] >= 1, "OOM never drove a cross-tenant evict"
+        after = ROOT.snapshot()
+        key = "telemetry.compute.oom_reclaims{route=t.oom}"
+        assert after.get(key, 0) - before.get(key, 0) == 1
+        # The route ended healthy: one fault, one success.
+        assert guard.debug_snapshot()["t.oom"]["state"] == Breaker.CLOSED
+
+    def test_double_oom_falls_back(self):
+        seed = _find_seed("t.oom2", ["oom", "oom"], oom=0.9)
+        plan = faultcomp.ComputeFaultPlan(seed=seed, oom=0.9)
+        with faultcomp.injected(plan):
+            out = guard.dispatch("t.oom2", lambda: 1, lambda _e: "FB")
+        assert out == "FB"
+
+    def test_oom_retry_disabled_goes_straight_to_fallback(self):
+        guard.configure("t.oom3", oom_retry=False)
+        seed = _find_seed("t.oom3", ["oom", "ok"], oom=0.5)
+        with faultcomp.injected(
+                faultcomp.ComputeFaultPlan(seed=seed, oom=0.5)) as seam:
+            out = guard.dispatch("t.oom3", lambda: 1, lambda _e: "FB")
+        assert out == "FB"
+        assert seam.decisions["t.oom3"] == ["oom"]  # no second attempt
+
+
+# ---------------------------------------------------------------------------
+# quarantine
+# ---------------------------------------------------------------------------
+
+
+class TestQuarantine:
+    def test_faulting_key_is_quarantined_and_short_circuits(self):
+        clock = FakeClock()
+        guard.configure("t.q", clock=clock, quarantine_ttl_s=100.0)
+        evicted = {"n": 0}
+        attempts = {"n": 0}
+
+        def primary():
+            attempts["n"] += 1
+            return 1
+
+        plan = faultcomp.ComputeFaultPlan(seed=0, dispatch_raise=1.0)
+        before = ROOT.snapshot()
+        with faultcomp.injected(plan) as seam:
+            for _ in range(5):
+                out = guard.dispatch(
+                    "t.q", primary, lambda _e: "FB", key=("bucket", 1),
+                    evict=lambda: evicted.__setitem__(
+                        "n", evicted["n"] + 1))
+                assert out == "FB"
+        # ONE dispatch reached the seam; the quarantine blocked the other
+        # four before any rebuild/re-dispatch — no recompile crash-loop.
+        assert len(seam.decisions["t.q"]) == 1
+        assert evicted["n"] == 1
+        assert guard.is_quarantined("t.q", ("bucket", 1))
+        assert guard.quarantined_keys("t.q") == [("bucket", 1)]
+        after = ROOT.snapshot()
+        key = "telemetry.compute.quarantined{route=t.q}"
+        assert after.get(key, 0) - before.get(key, 0) == 1
+
+    def test_quarantine_ttl_expires(self):
+        clock = FakeClock()
+        guard.configure("t.qttl", clock=clock, quarantine_ttl_s=50.0)
+        with faultcomp.injected(
+                faultcomp.ComputeFaultPlan(seed=0, dispatch_raise=1.0)):
+            guard.dispatch("t.qttl", lambda: 1, lambda _e: 0, key="k")
+        assert guard.is_quarantined("t.qttl", "k")
+        clock.advance(51.0)
+        assert not guard.is_quarantined("t.qttl", "k")
+        assert guard.quarantined_keys("t.qttl") == []
+        # Healthy again: the key dispatches normally post-TTL.
+        with faultcomp.injected(faultcomp.ComputeFaultPlan(seed=0)):
+            assert guard.dispatch("t.qttl", lambda: 1, lambda _e: 0,
+                                  key="k") == 1
+
+    def test_evict_exception_does_not_mask_fallback(self):
+        def bad_evict():
+            raise RuntimeError("cache refused")
+
+        with faultcomp.injected(
+                faultcomp.ComputeFaultPlan(seed=0, dispatch_raise=1.0)):
+            out = guard.dispatch("t.qe", lambda: 1, lambda _e: "FB",
+                                 key="k", evict=bad_evict)
+        assert out == "FB"
+        assert guard.is_quarantined("t.qe", "k")  # set still blocks it
+
+
+# ---------------------------------------------------------------------------
+# corrupted output planes
+# ---------------------------------------------------------------------------
+
+
+class TestCorruptionValidator:
+    def test_poisoned_detects_nan_and_garbage_planes(self):
+        assert guard.poisoned(np.full(8, np.nan)) is not None
+        assert guard.poisoned(np.full(8, guard.GARBAGE_F)) is not None
+        assert guard.poisoned(
+            np.full(8, guard.GARBAGE_I, np.int32)) is not None
+        assert guard.poisoned((np.ones(4), np.zeros(4))) is None
+        # a single NaN sample is DATA, not corruption
+        assert guard.poisoned(np.array([1.0, np.nan, 2.0])) is None
+
+    def test_corrupt_fault_routes_to_fallback(self):
+        plan = faultcomp.ComputeFaultPlan(seed=1, corrupt=1.0)
+        before = ROOT.snapshot()
+        with faultcomp.injected(plan):
+            out = guard.dispatch("t.c", lambda: (np.ones(4), np.arange(4)),
+                                 lambda _e: "FB")
+        assert out == "FB"
+        after = ROOT.snapshot()
+        key = "telemetry.compute.faults{kind=kernel,route=t.c}"
+        assert after.get(key, 0) - before.get(key, 0) == 1
+
+    def test_validator_inert_without_seam(self):
+        # Production dispatches never pay the validator: an (unlikely)
+        # all-NaN plane from a real kernel is the oracle layer's job.
+        out = guard.dispatch("t.cv", lambda: np.full(4, np.nan),
+                             lambda _e: "FB")
+        assert isinstance(out, np.ndarray)
+
+
+# ---------------------------------------------------------------------------
+# per-route seeded campaigns: bit-identity to the oracle under faults
+# ---------------------------------------------------------------------------
+
+MIXED = dict(dispatch_raise=0.2, oom=0.1, delay=0.05, corrupt=0.2,
+             delay_s=0.001)
+
+
+def _corpus(seed, n, w):
+    rng = np.random.default_rng(seed)
+    base = np.int64(1_700_000_000)
+    ts = base + np.arange(w, dtype=np.int64)[None, :] * 10 \
+        + rng.integers(0, 2, (n, w))
+    ts = np.sort(ts, axis=1)
+    vals = np.where(rng.random((n, w)) < 0.05, np.nan,
+                    np.round(rng.normal(100, 10, (n, w)), 2))
+    npoints = rng.integers(1, w + 1, n).astype(np.int32)
+    return ts, vals, npoints
+
+
+class TestCodecCampaigns:
+    @pytest.mark.parametrize("kinds", [
+        dict(compile_fail=1.0), dict(dispatch_raise=1.0), dict(oom=1.0),
+        dict(delay=1.0, delay_s=0.001), dict(corrupt=1.0), MIXED])
+    def test_encode_decode_bit_identical_under_faults(self, kinds,
+                                                      monkeypatch):
+        monkeypatch.setenv("M3_TPU_PALLAS", "1")
+        ts, vals, npoints = _corpus(31, 16, 16)
+        inp = tsz.prepare_encode_inputs(ts, vals, npoints)
+        kw = dict(dt=inp["dt"], t0=inp["t0"], vhi=inp["vhi"],
+                  vlo=inp["vlo"], int_mode=inp["int_mode"], k=inp["k"],
+                  npoints=inp["npoints"], ts_regular=inp["ts_regular"],
+                  delta0=inp["delta0"])
+        mw = tsz.max_words_for(16)
+        ow, onb = tsz.encode_batch(**kw, max_words=mw, pack="scatter")
+        ow, onb = np.asarray(ow), np.asarray(onb)
+        plan = faultcomp.ComputeFaultPlan(seed=3, route_filter="codec.",
+                                          **kinds)
+        with faultcomp.injected(plan) as seam:
+            for _ in range(4):
+                w2, nb2 = tsz.encode_batch(**kw, max_words=mw)
+                np.testing.assert_array_equal(np.asarray(w2), ow)
+                np.testing.assert_array_equal(np.asarray(nb2), onb)
+                tsp, vsp = tsz.decode_plane(ow, npoints, window=16,
+                                            unit_nanos=1)
+                for r in range(ow.shape[0]):
+                    n = int(npoints[r])
+                    t_ref, v_ref = ref_codec.decode(ref_codec.EncodedBlock(
+                        words=ow[r], nbits=0, npoints=n))
+                    np.testing.assert_array_equal(
+                        t_ref, np.asarray(tsp[r, :n]))
+                    np.testing.assert_array_equal(
+                        np.asarray(v_ref).view(np.uint64),
+                        np.asarray(vsp[r, :n]).view(np.uint64))
+        assert sum(len(v) for v in seam.decisions.values()) > 0
+
+    @pytest.mark.parametrize("kinds", [
+        dict(dispatch_raise=1.0), dict(corrupt=1.0), MIXED])
+    def test_hash_bit_identical_under_faults(self, kinds, monkeypatch):
+        monkeypatch.setenv("M3_TPU_PALLAS", "1")
+        rng = np.random.default_rng(7)
+        ids = [bytes(rng.integers(0, 256, ln, dtype=np.uint8))
+               for ln in rng.integers(1, 33, 64)]
+        ref = np.array([hashing.murmur3_32(i) for i in ids], np.uint32)
+        plan = faultcomp.ComputeFaultPlan(seed=9, route_filter="codec.hash",
+                                          **kinds)
+        with faultcomp.injected(plan) as seam:
+            for _ in range(4):
+                np.testing.assert_array_equal(hashing.hash_batch(ids), ref)
+        assert len(seam.decisions.get("codec.hash", [])) > 0
+
+
+class TestBlockDecodeCampaign:
+    @pytest.mark.parametrize("kinds", [
+        dict(dispatch_raise=1.0), dict(corrupt=1.0), MIXED])
+    def test_block_reads_bit_identical_under_faults(self, kinds):
+        ts, vals, npoints = _corpus(41, 8, 8)
+        ts = ts * S
+        npoints = np.maximum(npoints, 1)
+        b = blk.encode_block(0, np.arange(8, dtype=np.int32), ts, vals,
+                             npoints)
+        oracle_ts, oracle_vals, oracle_np = b.read_all()
+        plan = faultcomp.ComputeFaultPlan(
+            seed=13, route_filter="block.decode", **kinds)
+        with faultcomp.injected(plan) as seam:
+            for _ in range(3):
+                b2 = blk.encode_block(0, np.arange(8, dtype=np.int32), ts,
+                                      vals, npoints)
+                g_ts, g_vals, g_np = b2.read_all()
+                np.testing.assert_array_equal(g_np, oracle_np)
+                for r in range(8):
+                    # Padding beyond npoints is unspecified — the device
+                    # and host twins differ there by design; the valid
+                    # prefix must be bit-identical.
+                    n = int(npoints[r])
+                    np.testing.assert_array_equal(
+                        np.asarray(g_ts)[r, :n],
+                        np.asarray(oracle_ts)[r, :n])
+                    np.testing.assert_array_equal(
+                        np.asarray(g_vals)[r, :n].view(np.uint64),
+                        np.asarray(oracle_vals)[r, :n].view(np.uint64))
+                for r in range(8):
+                    out = b2.read(r)
+                    assert out is not None
+                    n = int(npoints[r])
+                    np.testing.assert_array_equal(out[0], ts[r, :n])
+                    np.testing.assert_array_equal(
+                        np.asarray(out[1]).view(np.uint64),
+                        vals[r, :n].view(np.uint64))
+        assert len(seam.decisions.get("block.decode", [])) > 0
+
+
+class TestTemporalCampaign:
+    def test_guarded_builder_exact_under_faults(self):
+        # Integer-exact builders: jit primary and the eager fallback are
+        # bit-identical by construction (no FP reassociation ambiguity).
+        finite = np.random.default_rng(3).random((4, 32)) > 0.3
+        fn = temporal._last_two_idx_fn(8)
+        oracle = np.asarray(fn(finite))
+        plan = faultcomp.ComputeFaultPlan(
+            seed=2, route_filter="temporal.", dispatch_raise=0.5,
+            corrupt=0.3)
+        with faultcomp.injected(plan) as seam:
+            for _ in range(6):
+                np.testing.assert_array_equal(np.asarray(fn(finite)),
+                                              oracle)
+        decs = seam.decisions.get("temporal.last_two_idx", [])
+        # The breaker may trip mid-campaign and short-circuit later
+        # calls straight to the eager twin — the EXACTNESS above is the
+        # property; the seam only needs to have actually fired.
+        assert any(d != faultcomp.NO_FAULT for d in decs)
+
+    def test_builder_forwarding_survives_guard(self):
+        assert temporal._last_two_idx_fn.cache_info is not None
+        fn = temporal._last_two_idx_fn(8)
+        assert isinstance(fn, guard._GuardedFn)
+
+
+class TestAggFlushCampaign:
+    @pytest.fixture
+    def one_device_mesh(self, monkeypatch):
+        mesh = pingest.make_mesh(1)
+        monkeypatch.setattr(agg_flush, "flush_mesh", lambda: mesh)
+        monkeypatch.setenv("M3_TPU_MESH_AGG_MIN_CELLS", "0")
+        return mesh
+
+    @pytest.mark.parametrize("kinds", [
+        dict(dispatch_raise=1.0), dict(corrupt=1.0), MIXED])
+    def test_quantile_values_identical_under_faults(self, kinds,
+                                                    one_device_mesh):
+        rng = np.random.default_rng(17)
+        counts = rng.integers(0, 40, 12).astype(np.int64)
+        counts[0] = 0
+        buckets = [np.sort(rng.normal(100, 20, int(c))) for c in counts]
+        qs = (0.5, 0.99)
+        oracle = agg_flush.exact_quantile_values(
+            buckets, counts, qs)  # mesh route, no faults
+        plan = faultcomp.ComputeFaultPlan(seed=23,
+                                          route_filter="agg_flush", **kinds)
+        with faultcomp.injected(plan) as seam:
+            for _ in range(3):
+                got = agg_flush.exact_quantile_values(buckets, counts, qs)
+                # bit-identical: the single-device fallback runs the SAME
+                # kernel on the same (unpadded) rows.
+                np.testing.assert_array_equal(got, oracle)
+        assert len(seam.decisions.get("agg_flush", [])) > 0
+
+
+class TestFlushEncodeAllOrNothing:
+    @pytest.fixture
+    def one_device_mesh(self, monkeypatch):
+        mesh = pingest.make_mesh(1)
+        monkeypatch.setattr(pingest, "flush_mesh", lambda: mesh)
+        monkeypatch.setenv("M3_TPU_MESH_FLUSH_MIN_CELLS", "0")
+        return mesh
+
+    def test_fault_returns_none_nothing_partially_applied(
+            self, one_device_mesh):
+        ts, vals, npoints = _corpus(51, 4, 8)
+        inp = tsz.prepare_encode_inputs(ts, vals, npoints)
+        mw = tsz.max_words_for(8)
+        clean = pingest.flush_encode_prepared(inp, mw)
+        assert clean is not None
+        plain_w, plain_nb = tsz.encode_batch(
+            dt=inp["dt"], t0=inp["t0"], vhi=inp["vhi"], vlo=inp["vlo"],
+            int_mode=inp["int_mode"], k=inp["k"], npoints=inp["npoints"],
+            ts_regular=inp["ts_regular"], delta0=inp["delta0"],
+            max_words=mw, pack="scatter")
+        np.testing.assert_array_equal(np.asarray(clean[0]),
+                                      np.asarray(plain_w))
+
+        plan = faultcomp.ComputeFaultPlan(
+            seed=0, route_filter="flush_encode", dispatch_raise=1.0)
+        with faultcomp.injected(plan):
+            out = pingest.flush_encode_prepared(inp, mw)
+        # All-or-nothing: the faulted mesh flush hands back None and the
+        # caller's plain path owns the seal — no partial application.
+        assert out is None
+
+    def test_corrupt_mesh_flush_never_surfaces(self, one_device_mesh):
+        ts, vals, npoints = _corpus(53, 4, 8)
+        inp = tsz.prepare_encode_inputs(ts, vals, npoints)
+        mw = tsz.max_words_for(8)
+        plan = faultcomp.ComputeFaultPlan(
+            seed=1, route_filter="flush_encode", corrupt=1.0)
+        with faultcomp.injected(plan):
+            assert pingest.flush_encode_prepared(inp, mw) is None
+
+
+# ---------------------------------------------------------------------------
+# plan route: typed DEVICE_FAULT fallback + quarantine, vs the interpreter
+# ---------------------------------------------------------------------------
+
+
+class MemStorage:
+    def __init__(self, n=8):
+        rng = np.random.default_rng(5)
+        t0 = 1_700_000_000 * S
+        self.t = t0 + np.arange(120, dtype=np.int64) * 10 * S
+        self.series = []
+        for i in range(n):
+            tags = {b"__name__": b"m", b"host": b"h%d" % (i % 3),
+                    b"i": str(i).encode()}
+            v = 1e9 * (1 + i) + np.cumsum(
+                rng.poisson(5.0, 120)).astype(np.float64)
+            self.series.append((tags, self.t, v))
+
+    def fetch_raw(self, matchers, start_ns, end_ns):
+        out = {}
+        for tags, t, v in self.series:
+            if all(m.matches(tags.get(m.name, b"")) for m in matchers):
+                keep = (t >= start_ns) & (t < end_ns)
+                sid = b",".join(k + b"=" + x
+                                for k, x in sorted(tags.items()))
+                out[sid] = {"tags": tags, "t": t[keep], "v": v[keep]}
+        return out
+
+
+class TestPlanRoute:
+    QUERY = "sum by (host) (rate(m[5m]))"
+
+    @pytest.fixture
+    def eng(self, monkeypatch):
+        monkeypatch.setattr(qplan, "PLAN_MIN_CELLS", 1)
+        st = MemStorage()
+        start = int(st.t[30])
+        end = int(st.t[-1])
+        return Engine(st), start, end, 30 * S
+
+    def _assert_matches(self, got, ref):
+        gtags = [bytes(t.id()) for t in got.series_tags]
+        rtags = [bytes(t.id()) for t in ref.series_tags]
+        assert set(gtags) == set(rtags)
+        order = {t: i for i, t in enumerate(rtags)}
+        g = np.asarray(got.values)
+        r = np.asarray(ref.values)[[order[t] for t in gtags]]
+        np.testing.assert_allclose(g, r, rtol=1e-5, atol=1e-9,
+                                   equal_nan=True)
+
+    def test_device_fault_typed_fallback_and_explain_route(self, eng):
+        engine, start, end, step = eng
+        ref = engine.execute_range_ref(self.QUERY, start, end, step)
+        # Warm the compiled route first (clean), proving it engages.
+        got = engine.execute_range(self.QUERY, start, end, step)
+        assert engine.last_route()["route"] == "compiled"
+        self._assert_matches(got, ref)
+
+        before = ROOT.snapshot()
+        plan = faultcomp.ComputeFaultPlan(seed=0, route_filter="plan",
+                                          dispatch_raise=1.0)
+        with faultcomp.injected(plan):
+            got = engine.execute_range(self.QUERY, start, end, step)
+        self._assert_matches(got, ref)  # interpreter oracle served it
+        # The ?explain=true record shows the route the execution TOOK,
+        # with the typed runtime-scoped reason.
+        route = engine.last_route()
+        assert route["route"] == "interpreter"
+        assert route["fallback_reason"] == \
+            qplan.FallbackReason.DEVICE_FAULT.value
+        assert "device fault" in route["fallback_detail"]
+        after = ROOT.snapshot()
+        key = ("telemetry.plan_fallback.count"
+               "{reason=device-fault,scope=runtime}")
+        assert after.get(key, 0) - before.get(key, 0) == 1
+        assert qplan.fallback_scope("device-fault") == "runtime"
+        fb = "telemetry.compute.fallback{route=plan}"
+        assert after.get(fb, 0) - before.get(fb, 0) >= 1
+
+    def test_quarantine_prevents_recompile_loop(self, eng, monkeypatch):
+        engine, start, end, step = eng
+        from m3_tpu.parallel import compile as pcompile
+
+        builds = {"n": 0}
+        orig = pcompile._plan_executable
+
+        def counting(*a, **kw):
+            builds["n"] += 1
+            return orig(*a, **kw)
+
+        counting.cache_clear = orig.cache_clear
+        monkeypatch.setattr(pcompile, "_plan_executable", counting)
+
+        ref = engine.execute_range_ref(self.QUERY, start, end, step)
+        plan = faultcomp.ComputeFaultPlan(seed=0, route_filter="plan",
+                                          dispatch_raise=1.0)
+        with faultcomp.injected(plan) as seam:
+            for _ in range(5):
+                got = engine.execute_range(self.QUERY, start, end, step)
+                self._assert_matches(got, ref)
+        # ONE faulted dispatch quarantined the shape bucket; the other
+        # four short-circuited to the interpreter BEFORE the builder —
+        # a crash-looping bucket never recompiles until its TTL.
+        assert len(seam.decisions.get("plan", [])) == 1
+        assert builds["n"] == 1
+        assert guard.quarantined_keys("plan")
+        assert engine.last_route()["fallback_reason"] == \
+            qplan.FallbackReason.DEVICE_FAULT.value
+        # After the drill the compiled route recovers (fresh routes).
+        guard.reset()
+        got = engine.execute_range(self.QUERY, start, end, step)
+        assert engine.last_route()["route"] == "compiled"
+        self._assert_matches(got, ref)
+
+    def test_mixed_campaign_always_matches_oracle(self, eng):
+        engine, start, end, step = eng
+        ref = engine.execute_range_ref(self.QUERY, start, end, step)
+        plan = faultcomp.ComputeFaultPlan(seed=29, route_filter="plan",
+                                          **MIXED)
+        with faultcomp.injected(plan):
+            for _ in range(6):
+                guard.reset()  # each iteration: fresh breaker/quarantine
+                got = engine.execute_range(self.QUERY, start, end, step)
+                self._assert_matches(got, ref)
+                assert engine.last_route()["route"] in (
+                    "compiled", "interpreter")
+
+
+# ---------------------------------------------------------------------------
+# degradation surfaces: health probe + /debug/vars
+# ---------------------------------------------------------------------------
+
+
+class TestDegradationSurfaces:
+    def test_tripped_breaker_reads_degraded_never_shedding(self):
+        from m3_tpu.utils import health
+
+        guard.configure("t.h", opts=BreakerOptions(
+            window=8, failure_ratio=0.5, min_samples=2, cooldown_s=60.0))
+        assert guard._degradation() == 0.0
+        with faultcomp.injected(
+                faultcomp.ComputeFaultPlan(seed=0, dispatch_raise=1.0)):
+            for _ in range(4):
+                guard.dispatch("t.h", lambda: 1, lambda _e: 0)
+        sat = guard._degradation()
+        tracker = health.HealthTracker()
+        assert tracker.degraded_at <= sat < tracker.shedding_at
+        # the probe is registered on the process tracker
+        assert "compute_degraded" in health.TRACKER._sources
+
+    def test_kill_switch_is_not_an_incident(self):
+        guard.set_disabled("t.k", True)
+        assert guard._degradation() == 0.0
+
+    def test_debug_snapshot_names_state_and_quarantine(self):
+        guard.configure("t.d", opts=BreakerOptions(
+            window=8, failure_ratio=0.5, min_samples=2, cooldown_s=60.0))
+        with faultcomp.injected(
+                faultcomp.ComputeFaultPlan(seed=0, dispatch_raise=1.0)):
+            for i in range(4):
+                # Distinct shape buckets: each dispatch reaches the seam
+                # (a quarantined key would short-circuit pre-breaker).
+                guard.dispatch("t.d", lambda: 1, lambda _e: 0,
+                               key=("shape", i))
+        snap = guard.debug_snapshot()["t.d"]
+        assert snap["state"] == Breaker.OPEN
+        assert snap["disabled"] is False
+        # min_samples=2 trips the breaker after two faults; the later
+        # dispatches short-circuit at allow() and never quarantine.
+        assert snap["quarantined"] == [repr(("shape", 0)),
+                                       repr(("shape", 1))]
+
+
+# ---------------------------------------------------------------------------
+# composition drill: churn SLOs hold under compute chaos
+# ---------------------------------------------------------------------------
+
+
+class TestComputeFaultChurn:
+    def test_scenario(self):
+        """ChurnScenario with the compute seam armed: seeded device
+        faults on every guarded dispatch, the full SLO set unchanged —
+        zero acked-write loss, zero shed CRITICAL, and the decision log
+        replayable from the plan."""
+        from m3_tpu.testing.scenario import (ComputeFaultChurnOptions,
+                                             ComputeFaultChurnScenario)
+
+        sc = ComputeFaultChurnScenario(ComputeFaultChurnOptions(
+            seed=19, duration_s=1.0, base_rate=30, n_series=24,
+            num_shards=8))
+        try:
+            result = sc.verify(sc.run())
+        finally:
+            sc.close()
+        assert result.verified_points > 0
+        assert sc.compute_seam.faults_injected > 0
+        assert result.report.select(kind="critical", outcome="ok")

@@ -15,6 +15,7 @@ from m3_tpu.analysis.cache_rules import (CacheKeyBufferRule,
                                          CacheMethodBufferKeyRule)
 from m3_tpu.analysis.jax_rules import (ItemInLoopRule, JaxPurityRule,
                                        MeshSpecRule, NonStaticJitCacheRule,
+                                       UnclassifiedDeviceDispatchRule,
                                        UnguardedPallasDispatchRule)
 from m3_tpu.analysis.numeric_rules import (DtypeDataflowRule,
                                            SentinelTaintRule)
@@ -3983,3 +3984,134 @@ class TestUnguardedPallasDispatch:
             mod = Module(str(path), rel, path.read_text())
             findings, _ = run_module(mod, [UnguardedPallasDispatchRule()])
             assert findings == [], rel
+
+
+class TestUnclassifiedDeviceDispatch:
+    """unclassified-device-dispatch: broad except around a device
+    dispatch site (jit-builder call, traced fn, pallas_call) must
+    classify into the ComputeError taxonomy or re-raise."""
+
+    # the exact pre-guard shape: a jit-builder result dispatched under
+    # `except Exception: return None` — a device OOM absorbed here never
+    # reaches the breaker/quarantine/telemetry plane.
+    SEEDED = """
+        import jax
+
+        def _build(n):
+            return jax.jit(lambda x: x * n)
+
+        def execute(x, n):
+            fn = _build(n)
+            try:
+                return fn(x)
+            except Exception:
+                return None
+    """
+
+    def test_seeded_builder_dispatch_flags(self):
+        found = lint(self.SEEDED, UnclassifiedDeviceDispatchRule(),
+                     "m3_tpu/parallel/mod.py")
+        assert rule_ids(found) == ["unclassified-device-dispatch"]
+        assert "ComputeError taxonomy" in found[0].message
+        assert "guard.dispatch" in found[0].message
+
+    def test_direct_builder_call_flags(self):
+        src = """
+            import jax
+
+            def _build(n):
+                return jax.jit(lambda x: x * n)
+
+            def execute(x, n):
+                try:
+                    return _build(n)(x)
+                except Exception:
+                    return None
+        """
+        found = lint(src, UnclassifiedDeviceDispatchRule())
+        assert rule_ids(found) == ["unclassified-device-dispatch"]
+
+    def test_bare_except_around_traced_fn_flags(self):
+        src = """
+            import jax
+
+            def _kernel(x):
+                return x + 1
+
+            _fast = jax.jit(_kernel)
+
+            def run(x):
+                try:
+                    return _kernel(x)
+                except:
+                    return None
+        """
+        found = lint(src, UnclassifiedDeviceDispatchRule())
+        assert rule_ids(found) == ["unclassified-device-dispatch"]
+
+    def test_classifying_handler_is_clean(self):
+        # the guard-seam shape: broad handler funnels through classify()
+        # and re-raises the unclassifiable — the canonical negative.
+        src = """
+            import jax
+            from ..parallel import guard
+
+            def _build(n):
+                return jax.jit(lambda x: x * n)
+
+            def execute(x, n):
+                fn = _build(n)
+                try:
+                    return fn(x)
+                except Exception as exc:
+                    err = guard.classify(exc, "plan")
+                    if err is None:
+                        raise
+                    return err
+        """
+        assert lint(src, UnclassifiedDeviceDispatchRule()) == []
+
+    def test_reraising_handler_is_clean(self):
+        src = self.SEEDED.replace("return None", "raise")
+        assert lint(src, UnclassifiedDeviceDispatchRule()) == []
+
+    def test_taxonomy_raise_is_clean(self):
+        src = self.SEEDED.replace(
+            "return None", 'raise KernelFault("plan", "boom")')
+        assert lint(src, UnclassifiedDeviceDispatchRule()) == []
+
+    def test_narrow_handler_is_out_of_scope(self):
+        src = self.SEEDED.replace("except Exception:",
+                                  "except ValueError:")
+        assert lint(src, UnclassifiedDeviceDispatchRule()) == []
+
+    def test_broad_except_without_dispatch_is_clean(self):
+        src = """
+            import jax
+
+            def parse(raw):
+                try:
+                    return int(raw)
+                except Exception:
+                    return 0
+        """
+        assert lint(src, UnclassifiedDeviceDispatchRule()) == []
+
+    def test_out_of_scope_dirs_are_skipped(self):
+        found = lint(self.SEEDED, UnclassifiedDeviceDispatchRule(),
+                     "m3_tpu/coordinator/mod.py")
+        assert found == []
+
+    def test_guard_seam_itself_is_clean(self):
+        rel = "m3_tpu/parallel/guard.py"
+        path = REPO / rel
+        mod = Module(str(path), rel, path.read_text())
+        findings, _ = run_module(mod, [UnclassifiedDeviceDispatchRule()])
+        assert findings == []
+
+    def test_tree_has_zero_findings(self):
+        findings, _sup, nmods = run_paths(
+            [str(REPO / "m3_tpu")], [UnclassifiedDeviceDispatchRule()],
+            program_rules=[])
+        assert nmods > 100
+        assert findings == []
