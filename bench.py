@@ -1840,6 +1840,164 @@ def bench_codec_decode_fanout():
     }
 
 
+def _rules_corpus(n_metrics: int, n_mapping: int, n_rollup: int,
+                  n_services: int = 500):
+    """Seeded (rule set x metric batch) for the downsample_rules config.
+
+    Rules: per-service mapping rules on literal-prefix name globs (some
+    with an extra tag filter), a DROP_MUST class, and rollup rules whose
+    first op is the rollup (new-id generation). Batch: mixed
+    counter/gauge/timer samples whose names land every id on >=1 rule."""
+    from m3_tpu.metrics.aggregation import AggID, AggType
+    from m3_tpu.metrics.filters import TagsFilter
+    from m3_tpu.metrics.metric import MetricType
+    from m3_tpu.metrics.pipeline import Op, Pipeline
+    from m3_tpu.metrics.policy import DropPolicy
+    from m3_tpu.metrics.rules import (MappingRuleSnapshot,
+                                      RollupRuleSnapshot, RollupTarget, Rule,
+                                      RuleSet)
+    from m3_tpu.metrics.policy import StoragePolicy
+
+    pol_1m = (StoragePolicy.parse("1m:40h"),)
+    pol_5m = (StoragePolicy.parse("5m:40h"),)
+    mapping = []
+    for k in range(n_mapping):
+        svc = k % n_services
+        filt = {"__name__": f"svc{svc:03d}_*"}
+        if k % 7 == 0:
+            filt["dc"] = "east" if k % 2 else "west"
+        mapping.append(Rule([MappingRuleSnapshot(
+            f"map-{k}", 0, TagsFilter(filt),
+            storage_policies=pol_5m if k % 5 == 0 else pol_1m)]))
+    # DROP_MUST class: ids named drop_* match ONLY this rule.
+    mapping.append(Rule([MappingRuleSnapshot(
+        "map-drop", 0, TagsFilter({"__name__": "drop_*"}),
+        storage_policies=pol_1m, drop_policy=DropPolicy.DROP_MUST)]))
+    rollup = []
+    for k in range(n_rollup):
+        svc = (k * 3) % n_services
+        pipe = Pipeline((Op.roll(b"rollup_svc%03d" % svc, (b"dc",),
+                                 AggID.compress([AggType.SUM])),))
+        rollup.append(Rule([RollupRuleSnapshot(
+            f"roll-{k}", 0, TagsFilter({"__name__": f"svc{svc:03d}_*"}),
+            (RollupTarget(pipe, pol_1m),))]))
+    rs = RuleSet(b"default", 1, mapping, rollup)
+
+    types = (MetricType.GAUGE, MetricType.COUNTER, MetricType.TIMER)
+    samples = []
+    t0 = 1_700_000_000 * 1_000_000_000
+    for i in range(n_metrics):
+        if i % 50 == 49:  # 2%: the DROP_MUST class
+            name = b"drop_%d" % i
+        else:
+            name = b"svc%03d_lat_%d" % (i % n_services, i)
+        tags = {b"__name__": name, b"host": b"h%02d" % (i % 64),
+                b"dc": b"east" if i % 2 else b"west",
+                b"endpoint": b"e%02d" % (i % 16)}
+        samples.append((tags, t0, float(i % 97) + 0.5, types[i % 3]))
+    return rs, samples
+
+
+def bench_downsample_rules():
+    """Streaming rules-engine config (ROADMAP item 2's bench): one
+    100k-metric mixed columnar batch matched + aggregated against a
+    >=1k-rule set (mapping + rollup pipelines + a DROP_MUST class)
+    through the embedded downsampler. The COLD pass is the headline —
+    matching every distinct id against the whole rule set is the
+    per-metric path's hot loop; the warm pass (match-memo steady state)
+    rides along in extra. Post-change builds route through
+    Downsampler.write_batch (batch matcher + grouped columnar adds) and
+    must hold the retained per-metric path bit-identical on a subset
+    mirror, in-bench."""
+    from m3_tpu.cluster.kv import MemStore
+    from m3_tpu.coordinator.downsample import Downsampler
+    from m3_tpu.metrics.matcher import Matcher, RuleSetStore
+
+    n = int(os.environ.get("BENCH_RULES_METRICS", "100000"))
+    n_mapping = int(os.environ.get("BENCH_RULES_MAPPING", "800"))
+    n_rollup = int(os.environ.get("BENCH_RULES_ROLLUP", "200"))
+    _phase("downsample_rules: building rule set + batch")
+    rs, samples = _rules_corpus(n, n_mapping, n_rollup)
+    clock = lambda: samples[0][1]  # noqa: E731 - frozen bench clock
+
+    def build():
+        store = RuleSetStore(MemStore())
+        store.publish(rs)
+        matcher = Matcher(store, b"default", clock=clock)
+        sink = []
+        ds = Downsampler(
+            matcher, lambda mid, tags, t, v, pol, _s=sink: _s.append(mid),
+            clock=clock)
+        return ds, sink
+
+    batched = hasattr(Downsampler, "write_batch")
+
+    def run_pass(ds):
+        t0 = time.perf_counter()
+        if batched:
+            matched, dropped = ds.write_batch(samples)
+            assert matched + dropped > 0
+        else:
+            for tags, t, v, mt in samples:
+                ds.write(tags, t, v, mt)
+        return time.perf_counter() - t0
+
+    _phase(f"downsample_rules: warmup (subset, batched={batched})")
+    ds_w, _ = build()
+    if batched:
+        ds_w.write_batch(samples[:2000])
+    else:
+        for tags, t, v, mt in samples[:2000]:
+            ds_w.write(tags, t, v, mt)
+
+    _phase("downsample_rules: cold pass")
+    ds, sink = build()
+    cold_dt = run_pass(ds)
+    matched, dropped = ds.samples_matched, ds.samples_dropped
+    assert matched > 0.9 * n and dropped > 0, (matched, dropped)
+    _phase(f"downsample_rules: cold {cold_dt:.1f}s; warm pass")
+    warm_dt = min(run_pass(ds) for _ in range(2))
+    ds.flush(samples[0][1] + 10 * 60 * 1_000_000_000)
+    assert sink, "flush produced no aggregated output"
+
+    extra = {
+        "metrics": n, "mapping_rules": n_mapping + 1,
+        "rollup_rules": n_rollup, "mix": "gauge/counter/timer round-robin",
+        "matched": matched, "dropped_drop_must": dropped,
+        "cold_ms": round(cold_dt * 1000, 1),
+        "warm_dps": round(n / warm_dt, 1),
+        "flushed_rows": len(sink),
+        "batched_path": batched,
+    }
+    if batched:
+        # In-bench oracle: the retained per-metric path must produce the
+        # SAME matches and the SAME aggregated flush rows on a subset
+        # mirror (rounds 6-10 protocol).
+        _phase("downsample_rules: per-metric oracle mirror")
+        sub = samples[:4000]
+        got_ds, got_sink = build()
+        got_ds.write_batch(sub)
+        ref_ds, ref_sink = build()
+        for tags, t, v, mt in sub:
+            ref_ds.write_ref(tags, t, v, mt)
+        assert (got_ds.samples_matched, got_ds.samples_dropped) == \
+            (ref_ds.samples_matched, ref_ds.samples_dropped)
+        t_f = sub[0][1] + 10 * 60 * 1_000_000_000
+        got_ds.flush(t_f)
+        ref_ds.flush(t_f)
+        assert sorted(got_sink) == sorted(ref_sink), (
+            "batched downsample diverged from the per-metric oracle "
+            f"({len(got_sink)} vs {len(ref_sink)} flushed rows)")
+        extra["oracle"] = (f"write_ref per-metric mirror ({len(sub)} "
+                           "samples), flush rows identical")
+    return {
+        "metric": "downsample_rules",
+        "value": round(n / cold_dt, 1),
+        "unit": "datapoints/sec",
+        "extra": extra,
+    }
+
+
 _BENCHES = [
     ("m3tsz_encode_1m_rollup", bench_encode_rollup),
     ("counter_gauge_rollup", bench_counter_gauge),
@@ -1855,6 +2013,7 @@ _BENCHES = [
     ("bootstrap_replay", bench_bootstrap_replay),
     ("query_serve_e2e", bench_query_serve_e2e),
     ("codec_decode_fanout", bench_codec_decode_fanout),
+    ("downsample_rules", bench_downsample_rules),
 ]
 
 

@@ -246,6 +246,35 @@ class Aggregator:
         shard = self._shard(mu.id)
         return shard is not None and shard.map.add_untimed(mu, metadatas)
 
+    def add_untimed_batch(self, mus: Sequence[MetricUnion],
+                          metadatas: Sequence[StagedMetadata] = ()
+                          ) -> List[bool]:
+        """Grouped columnar add: every sample in the batch shares ONE
+        staged-metadata list (a (pipeline, policy) class from the batch
+        matcher), so the clock read and active-stage resolution are paid
+        once for the group instead of per metric (entry.go:446
+        activeStagedMetadataWith hoisted out of the hot loop). Returns
+        per-sample acceptance, order-aligned with mus."""
+        from .entry import _active_stage
+
+        now = self._clock()
+        active = _active_stage(metadatas, now)
+        out = []
+        for mu in mus:
+            shard = self._shard(mu.id)
+            out.append(shard is not None and shard.map.add_untimed_staged(
+                mu, active, now))
+        return out
+
+    def ensure_entries(self, pairs) -> None:
+        """Pre-create entries for (metric_id, metric_type) pairs in
+        order — entry type resolution is first-write-wins, so a batched
+        writer passes global sample order here before grouped adds."""
+        for mid, mtype in pairs:
+            shard = self._shard(mid)
+            if shard is not None:
+                shard.map.ensure_entry(mid, mtype)
+
     def add_timed(self, metric_type: MetricType, metric_id: bytes,
                   t_nanos: int, value: float, policy: StoragePolicy,
                   aggregation_id: int = 0) -> bool:

@@ -66,12 +66,19 @@ class Entry:
         stage (entry.go:221; stage selection :446 activeStagedMetadataWith).
         Returns False if rate-limited or dropped by policy."""
         now = self._clock()
+        return self.add_untimed_staged(mu, _active_stage(metadatas, now), now)
+
+    def add_untimed_staged(self, mu: MetricUnion,
+                           active: Optional[StagedMetadata],
+                           now: int) -> bool:
+        """add_untimed with the metadata stage already resolved — the
+        batched aggregator feed resolves (clock, active stage) ONCE per
+        (pipeline, policy) class and fans the group's samples in here."""
         self.last_access_nanos = now
         n = max(1, len(mu.batch_timer_val))
         if not self._limiter.is_allowed(n):
             self.dropped += n
             return False
-        active = _active_stage(metadatas, now)
         if active is not None and active.tombstoned:
             return False
         self._maybe_update_elems(active)
@@ -183,9 +190,21 @@ class MetricMap:
             )
         return e
 
+    def ensure_entry(self, metric_id: bytes, metric_type: MetricType):
+        """Pre-create the entry for an id (first-write-wins on type):
+        batched writers resolve mixed-type output-id contention in
+        sample order before their grouped adds."""
+        self._entry_for(metric_id, metric_type)
+
     def add_untimed(self, mu: MetricUnion,
                     metadatas: Sequence[StagedMetadata] = ()) -> bool:
         return self._entry_for(mu.id, mu.type).add_untimed(mu, metadatas)
+
+    def add_untimed_staged(self, mu: MetricUnion,
+                           active: Optional[StagedMetadata],
+                           now: int) -> bool:
+        return self._entry_for(mu.id, mu.type).add_untimed_staged(
+            mu, active, now)
 
     def add_timed(self, metric_type: MetricType, metric_id: bytes,
                   t_nanos: int, value: float, policy: StoragePolicy,

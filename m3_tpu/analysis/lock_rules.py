@@ -426,29 +426,35 @@ class HotLoopUnderLockRule(Rule):
         return None
 
 
-# Function names that mark the aggregator's flush/emission paths, and
-# callback parameter names (`flush_fn` / `forward_fn` style) whose
-# per-iteration invocation marks the per-datapoint emit shape.
-_FLUSH_FN_NAME = re.compile(r"flush|emit|consume|reduce")
-_CALLBACK_NAME = re.compile(r"^\w*_fn$")
+# Function names that mark the aggregator's flush/emission paths and the
+# coordinator's downsample write path, and callback names whose
+# per-iteration invocation marks the per-datapoint emit shape:
+# `flush_fn` / `forward_fn` style sink parameters, plus the aggregator's
+# per-metric `add_untimed` entry point (the shape the compiled streaming
+# rules engine replaced with grouped add_untimed_batch feeds).
+_FLUSH_FN_NAME = re.compile(r"flush|emit|consume|reduce|write")
+_CALLBACK_NAME = re.compile(r"^(\w*_fn|add_untimed)$")
 
 
 class FlushCallbackLoopRule(Rule):
     """per-datapoint-callback-in-flush: a Python loop on an aggregator
-    flush/emit/consume path invoking a per-datapoint callback
-    (`*_fn(...)` — flush_fn/forward_fn style sinks) once per iteration.
-    Every flushed window then pays a Python call frame while the whole
-    tier waits — the shape the columnar flush rebuild removed from
-    Elem.emit / reduce_and_emit (one handle_columnar call or a
-    forward_batch per round instead of a callback per datapoint). Fix by
-    emitting through the columnar batch interfaces (emit_batch ->
-    handle_columnar / forward_batch), or justify-suppress a deliberate
-    compat shim. Functions suffixed `_ref` are exempt: retained oracles
-    (reduce_and_emit_ref) preserve the pre-change shape by design."""
+    flush/emit/consume path — or the coordinator's downsample write
+    path — invoking a per-datapoint callback (`*_fn(...)` sinks, or the
+    aggregator's per-metric `add_untimed`) once per iteration. Every
+    flushed window / ingest batch then pays a Python call frame per
+    datapoint while the whole tier waits — the shape the columnar flush
+    rebuild removed from Elem.emit / reduce_and_emit and the compiled
+    rules engine removed from Downsampler.write (one handle_columnar /
+    add_untimed_batch call per group instead of a callback per
+    datapoint). Fix by emitting through the columnar batch interfaces
+    (emit_batch -> handle_columnar / forward_batch / add_untimed_batch),
+    or justify-suppress a deliberate compat shim. Functions suffixed
+    `_ref` are exempt: retained oracles (reduce_and_emit_ref, write_ref)
+    preserve the pre-change shape by design."""
 
     id = "per-datapoint-callback-in-flush"
     severity = "warning"
-    dirs = ("aggregator",)
+    dirs = ("aggregator", "coordinator")
 
     def check(self, mod: Module) -> Iterator[Finding]:
         seen: Set[int] = set()

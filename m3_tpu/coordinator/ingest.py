@@ -66,16 +66,44 @@ class DownsamplerAndWriter:
         front. Per-sample admission would let a mid-batch shed leave a
         partially-written prefix that the 429-retrying producer then
         re-writes, double-counting it — the same partial-prefix hazard
-        m3lint's batch-partial-ingest rule polices at the codec layer."""
+        m3lint's batch-partial-ingest rule polices at the codec layer.
+
+        Downsampling takes the compiled streaming path: ONE
+        Downsampler.write_batch call matches the whole batch against the
+        rule set (batch matcher + grouped columnar aggregator adds)
+        instead of a per-sample match+append loop; the unaggregated leg
+        rides the storage's columnar write_batch when it has one."""
         samples = list(samples)
         if not samples:
             return
+        metric_type = kw.get("metric_type", MetricType.GAUGE)
+        downsample = kw.get("downsample", True)
+        write_unaggregated = kw.get("write_unaggregated", True)
         with self.gate.held(len(samples), priority=priority):
-            for tags, t_nanos, value in samples:
-                self._write_admitted(tags, t_nanos, value,
-                                     kw.get("metric_type", MetricType.GAUGE),
-                                     kw.get("downsample", True),
-                                     kw.get("write_unaggregated", True))
+            if downsample and self._downsampler is not None:
+                matched, dropped = self._downsampler.write_batch(
+                    [(tags, t, v, metric_type) for tags, t, v in samples])
+                # write() counts a sample as downsampled when the
+                # downsampler accepted it — DROP_MUST drops included.
+                accepted = matched + dropped
+                self.downsampled += accepted
+                if accepted:
+                    _scope.counter("downsampled").inc(accepted)
+            if write_unaggregated:
+                self._storage_write_batch(samples)
+
+    def _storage_write_batch(self, samples: Sequence[tuple]):
+        sids = [_series_id(tags) for tags, _t, _v in samples]
+        batch_write = getattr(self._storage, "write_batch", None)
+        if batch_write is not None:
+            batch_write(sids, [s[0] for s in samples],
+                        [s[1] for s in samples], [s[2] for s in samples])
+        else:
+            write = self._storage.write
+            for sid, (tags, t_nanos, value) in zip(sids, samples):
+                write(sid, tags, t_nanos, value)
+        self.written += len(samples)
+        _scope.counter("written").inc(len(samples))
 
 
 class M3MsgIngester:

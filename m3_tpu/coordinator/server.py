@@ -18,6 +18,7 @@ from .admin import AdminAPI
 from .downsample import Downsampler
 from .http_api import HTTPApi
 from .ingest import DownsamplerAndWriter
+from .rules_engine import RulesEngine
 from .selfscrape import SelfScraper
 
 
@@ -31,6 +32,7 @@ class Coordinator:
     # Self-scrape loop (instrument snapshot -> own ingest path) when the
     # deployment enables it; tests/smokes drive scrape_once() directly.
     self_scraper: Optional[SelfScraper] = None
+    clock: Optional[object] = None
 
     @property
     def endpoint(self) -> str:
@@ -38,6 +40,14 @@ class Coordinator:
 
     def flush_downsampler(self, now_nanos: Optional[int] = None) -> int:
         return self.downsampler.flush(now_nanos) if self.downsampler else 0
+
+    def rules_engine(self, **kw) -> RulesEngine:
+        """Standing recording/alert rules over this coordinator: PromQL
+        evaluates through the shared engine (plan cache included) and
+        outputs write back through the downsample-and-write path, so
+        recorded series are rule-matched AND queryable over HTTP."""
+        kw.setdefault("clock", self.clock)
+        return RulesEngine(self.engine, self.writer.write_batch, **kw)
 
     def close(self):
         if self.self_scraper is not None:
@@ -58,7 +68,24 @@ def _build(storage, aggregated_storages: Dict[StoragePolicy, object],
             target = aggregated_storages.get(policy, storage)
             target.write(mid, tags, t_ns, value)
 
-        downsampler = Downsampler(matcher, write_aggregated, clock=clock)
+        def write_aggregated_batch(rows):
+            # one storage write_batch per policy group of the columnar
+            # flush (rows: (mid, tags, t_ns, value, policy))
+            by_policy: Dict[object, list] = {}
+            for row in rows:
+                by_policy.setdefault(row[4], []).append(row)
+            for policy, group in by_policy.items():
+                target = aggregated_storages.get(policy, storage)
+                batch_write = getattr(target, "write_batch", None)
+                if batch_write is not None:
+                    batch_write([r[0] for r in group], [r[1] for r in group],
+                                [r[2] for r in group], [r[3] for r in group])
+                else:
+                    for mid, tags, t_ns, value, _pol in group:
+                        target.write(mid, tags, t_ns, value)
+
+        downsampler = Downsampler(matcher, write_aggregated, clock=clock,
+                                  write_aggregated_batch=write_aggregated_batch)
     writer = DownsamplerAndWriter(storage, downsampler)
     engine = Engine(storage)
     admin = AdminAPI(kv_store if kv_store is not None else cluster_kv.MemStore(),
@@ -70,7 +97,8 @@ def _build(storage, aggregated_storages: Dict[StoragePolicy, object],
         # registry scraped back through its ingest path.
         scraper = SelfScraper(writer, clock=clock,
                               interval_s=self_scrape_interval_s).start()
-    return Coordinator(engine, writer, api, downsampler, admin, scraper)
+    return Coordinator(engine, writer, api, downsampler, admin, scraper,
+                       clock=clock)
 
 
 def run_embedded(db, namespace: bytes = b"default",

@@ -153,20 +153,33 @@ class RuleSetStore:
 
 class Matcher:
     """Per-namespace matcher with KV watch + expiring result cache
-    (matcher/match.go, cache/cache.go)."""
+    (matcher/match.go, cache/cache.go).
+
+    Match results memoize keyed on (rule-set generation, id): a KV rule
+    update bumps the generation, so entries written against a dead
+    generation are UNREACHABLE by construction (the PR 3 postings-cache
+    dead-generation pattern) — and a computation racing the swap is
+    additionally refused at insert. match_batch() routes misses through
+    the compiled batch matcher (metrics/batch_matcher.py): the rule set
+    compiles once per (generation, snapshot epoch) into index queries,
+    so a steady-state batch is a per-id hash probe and a cold batch is
+    one inverted-index pass instead of ids x rules filter evaluations."""
 
     def __init__(self, store: RuleSetStore, namespace: bytes,
                  clock: Optional[Callable[[], int]] = None,
-                 cache_capacity: int = 65536):
+                 cache_capacity: int = 1 << 20):
         import time as _time
 
         self._store = store
         self._namespace = namespace
         self._clock = clock or _time.time_ns
         self._lock = threading.Lock()
-        self._cache: Dict[bytes, MatchResult] = {}
+        # (generation, id) -> MatchResult; the generation in the key is
+        # what makes stale entries unreachable without a scan.
+        self._cache: Dict[tuple, MatchResult] = {}
         self._capacity = cache_capacity
         self._generation = 0
+        self._compiled = None  # CompiledRuleSet for _generation, or None
         rs = store.get(namespace)
         self._active = rs.active_set() if rs is not None else None
         store.on_change(namespace, self._on_ruleset_change)
@@ -176,7 +189,8 @@ class Matcher:
     def _on_ruleset_change(self, rs: RuleSet):
         with self._lock:
             self._active = rs.active_set()
-            self._cache.clear()  # new version invalidates everything
+            self._cache.clear()  # new generation invalidates everything
+            self._compiled = None
             self._generation += 1
 
     def match(self, metric_id: bytes,
@@ -188,7 +202,7 @@ class Matcher:
         with self._lock:
             active = self._active
             generation = self._generation
-            cached = self._cache.get(metric_id)
+            cached = self._cache.get((generation, metric_id))
             if cached is not None and not cached.has_expired(now):
                 self.hits += 1
                 return cached
@@ -196,6 +210,10 @@ class Matcher:
             return None
         self.misses += 1
         result = active.forward_match(metric_id, from_nanos, to_nanos)
+        self._put(generation, metric_id, result)
+        return result
+
+    def _put(self, generation: int, metric_id: bytes, result: MatchResult):
         with self._lock:
             # Only cache if no rule-set swap raced this computation — a
             # stale insert after the invalidating clear would otherwise be
@@ -203,5 +221,60 @@ class Matcher:
             if self._generation == generation:
                 if len(self._cache) >= self._capacity:
                     self._cache.clear()  # simple full-flush eviction
-                self._cache[metric_id] = result
-        return result
+                self._cache[(generation, metric_id)] = result
+
+    def _compiled_for(self, active, generation: int, now: int):
+        """Compiled rule set for this generation + snapshot epoch, built
+        at most once per epoch (rule cutovers expire it)."""
+        from .batch_matcher import CompiledRuleSet
+
+        with self._lock:
+            compiled = self._compiled
+            if (compiled is not None and self._generation == generation
+                    and not compiled.has_expired(now)):
+                return compiled
+        compiled = CompiledRuleSet(active, now)
+        with self._lock:
+            if self._generation == generation:
+                self._compiled = compiled
+        return compiled
+
+    def match_batch(self, metric_ids) -> Optional[list]:
+        """One match pass over a batch of encoded ids (order-aligned
+        list of MatchResult, or None when no rule set is installed).
+        Memoized ids are hash probes; the distinct misses run through
+        the compiled batch matcher in one inverted-index pass."""
+        from .batch_matcher import match_batch as _batch
+
+        now = self._clock()
+        n = len(metric_ids)
+        out = [None] * n
+        misses: Dict[bytes, list] = {}
+        with self._lock:
+            active = self._active
+            generation = self._generation
+            if active is None:
+                return None
+            cache = self._cache
+            for i, mid in enumerate(metric_ids):
+                cached = cache.get((generation, mid))
+                if cached is not None and not cached.has_expired(now):
+                    out[i] = cached
+                else:
+                    misses.setdefault(mid, []).append(i)
+        self.hits += n - sum(map(len, misses.values()))
+        if misses:
+            self.misses += sum(map(len, misses.values()))
+            miss_ids = list(misses)
+            compiled = self._compiled_for(active, generation, now)
+            results = _batch(compiled, miss_ids, now)
+            with self._lock:
+                if self._generation == generation:
+                    for mid, result in zip(miss_ids, results):
+                        if len(cache) >= self._capacity:
+                            cache.clear()
+                        cache[(generation, mid)] = result
+            for mid, result in zip(miss_ids, results):
+                for i in misses[mid]:
+                    out[i] = result
+        return out

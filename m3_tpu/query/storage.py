@@ -44,6 +44,13 @@ class LocalStorage:
               value: float):
         self._db.write(self._namespace, series_id, t_ns, value, tags=tags)
 
+    def write_batch(self, series_ids: Sequence[bytes], tags: Sequence[dict],
+                    ts, vals):
+        """Columnar write: one shard-routed db.write_batch append instead
+        of a per-sample write loop (the coordinator ingest batch path)."""
+        self._db.write_batch(self._namespace, list(series_ids), ts, vals,
+                             tags=list(tags))
+
     def complete_tags(self, matchers: Sequence[Matcher], start_ns: int,
                       end_ns: int, name_only: bool = False,
                       filter_names: Sequence[bytes] = ()) -> Dict[bytes, set]:

@@ -115,6 +115,18 @@ echo "== restart smoke (<10s; kill -9 a real dbnode mid-flush, restart, zero ack
 # budget via RESTART_SMOKE_BUDGET_S.
 JAX_PLATFORMS=cpu python scripts/restart_smoke.py --seed 7
 
+echo "== rules smoke (<5s; batch matcher ≡ per-metric oracle, 100% warm match-cache hits, standing recording+alert pipelines across two windows) =="
+# The compiled streaming rules engine: seeded rule-set x metric-batch
+# corpus through Downsampler.write_batch vs the retained write_ref
+# oracle (bit-identical counters + flushed rows), warm (generation, id)
+# match-memo hit rate with KV-update invalidation, and one recording +
+# one alert rule evaluated incrementally on a live embedded coordinator
+# with the firing transition asserted and recorded output queried back
+# over the PromQL HTTP API. Full matrix: tests/test_batch_matcher.py +
+# tests/test_rules_engine.py; bench: downsample_rules. Wall budget via
+# RULES_SMOKE_BUDGET_S.
+JAX_PLATFORMS=cpu python scripts/rules_smoke.py
+
 echo "== diskfault smoke (<10s; seeded I/O faults on one replica: quarantine, scrub repair from peers, ENOSPC read-only + recovery, zero acked loss) =="
 # The disk-fault plane: one RF=3 drill with the victim's persist tier
 # behind a seeded testing/faultfs plan — serve-time row-checksum

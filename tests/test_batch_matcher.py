@@ -1,0 +1,251 @@
+"""Property suite for the compiled batch matcher (ISSUE 20): seeded
+(rule set x metric batch) corpora asserting the batch path EQUAL to the
+per-metric oracle — filter translation, DROP_MUST classes, rollup id
+generation, snapshot cutovers/tombstones, and rule-set version churn
+mid-stream through the memoizing Matcher."""
+
+import random
+
+import pytest
+
+from m3_tpu.cluster import kv as cluster_kv
+from m3_tpu.coordinator.downsample import Downsampler
+from m3_tpu.metrics import aggregation as magg
+from m3_tpu.metrics import id as metric_id
+from m3_tpu.metrics.batch_matcher import (
+    CompiledRuleSet,
+    filter_to_query,
+    match_batch,
+)
+from m3_tpu.metrics.filters import TagsFilter
+from m3_tpu.metrics.matcher import Matcher, RuleSetStore
+from m3_tpu.metrics.metric import MetricType
+from m3_tpu.metrics.pipeline import Op, Pipeline
+from m3_tpu.metrics.policy import DropPolicy, StoragePolicy
+from m3_tpu.metrics.rules import (
+    MappingRuleSnapshot,
+    RollupRuleSnapshot,
+    RollupTarget,
+    Rule,
+    RuleSet,
+)
+
+S = 1_000_000_000
+T0 = 1_700_000_000 * S
+
+_POL = [
+    (StoragePolicy.parse("10s:2d"),),
+    (StoragePolicy.parse("1m:40d"),),
+    (StoragePolicy.parse("10s:2d"), StoragePolicy.parse("1m:40d")),
+]
+_NAME_PATTERNS = ["svc*", "svc?_lat", "web_requests", "db_*", "*_lat",
+                  "drop_*", "nomatch_zzz"]
+_TAG_PATTERNS = [("dc", "east"), ("dc", "e*"), ("dc", "!west"),
+                 ("host", "h?"), ("env", "prod"), ("env", "!*stage*")]
+_AGG = [0, magg.AggID.compress([magg.AggType.MAX]),
+        magg.AggID.compress([magg.AggType.SUM, magg.AggType.COUNT])]
+
+
+def _rand_filter(rng) -> TagsFilter:
+    filt = {"__name__": rng.choice(_NAME_PATTERNS)}
+    for key, pat in rng.sample(_TAG_PATTERNS, rng.randrange(0, 3)):
+        filt[key] = pat
+    return TagsFilter(filt)
+
+
+def _rand_ruleset(rng, version=1, n_mapping=12, n_rollup=6,
+                  first_op_rollups_only=False) -> RuleSet:
+    mapping = []
+    for k in range(n_mapping):
+        snaps = []
+        # 1-3 snapshots with ascending cutovers; later ones may be in the
+        # future (inactive at T0) or tombstoned
+        cutovers = sorted(rng.sample(
+            [0, T0 - 1000 * S, T0 - 10 * S, T0 + 50 * S, T0 + 500 * S],
+            rng.randrange(1, 4)))
+        for c in cutovers:
+            snaps.append(MappingRuleSnapshot(
+                f"map-{version}-{k}-{c}", c, _rand_filter(rng),
+                rng.choice(_AGG), rng.choice(_POL),
+                DropPolicy.DROP_MUST if rng.random() < 0.15
+                else DropPolicy.NONE,
+                rng.random() < 0.1))
+        mapping.append(Rule(snaps))
+    rollup = []
+    for k in range(n_rollup):
+        targets = []
+        for j in range(rng.randrange(1, 3)):
+            rop = Op.roll(b"rolled_%d_%d" % (k, j),
+                          (b"dc",) if rng.random() < 0.5 else (b"dc", b"env"),
+                          magg.AggID.compress([magg.AggType.SUM]))
+            if first_op_rollups_only or rng.random() < 0.8:
+                pipe = Pipeline((rop,))  # first-op rollup: new id
+            else:
+                # rollup not first: aggregates under the existing id
+                # (matcher-level only — the aggregator tier executes
+                # just first-op rollup pipelines)
+                pipe = Pipeline((Op.aggregate(magg.AggType.MAX), rop))
+            targets.append(RollupTarget(pipe, rng.choice(_POL)))
+        rollup.append(Rule([RollupRuleSnapshot(
+            f"roll-{version}-{k}", rng.choice([0, T0 - 5 * S]),
+            _rand_filter(rng), tuple(targets), rng.random() < 0.1)]))
+    return RuleSet(b"default", version, mapping, rollup)
+
+
+def _rand_batch(rng, n):
+    names = [b"svc1_lat", b"svc2_lat", b"svcX_cpu", b"web_requests",
+             b"db_conns", b"db_errors", b"mem_lat", b"drop_me",
+             b"unmatched_series"]
+    out = []
+    for _ in range(n):
+        tags = {b"__name__": rng.choice(names)}
+        if rng.random() < 0.8:
+            tags[b"dc"] = rng.choice([b"east", b"west", b"eu"])
+        if rng.random() < 0.6:
+            tags[b"host"] = rng.choice([b"h1", b"h2", b"host9"])
+        if rng.random() < 0.4:
+            tags[b"env"] = rng.choice([b"prod", b"stage", b"prestaged"])
+        out.append(tags)
+    return out
+
+
+def _encode(tags):
+    return metric_id.encode(
+        tags.get(b"__name__", b""),
+        {k: v for k, v in tags.items() if k != b"__name__"})
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_match_batch_equals_forward_match_oracle(seed):
+    rng = random.Random(seed)
+    rs = _rand_ruleset(rng)
+    active = rs.active_set()
+    mids = [_encode(t) for t in _rand_batch(rng, 300)]
+    compiled = CompiledRuleSet(active, T0)
+    got = match_batch(compiled, mids, T0)
+    ref = [active.forward_match(mid, T0, T0 + 1) for mid in mids]
+    assert got == ref
+    # the corpus must actually exercise rollup-id generation and drops
+    assert any(r.for_new_rollup_ids for r in ref)
+
+
+def test_filter_to_query_absent_tag_semantics():
+    # positive pattern on an absent tag fails; negated pattern succeeds
+    rs = RuleSet(b"default", 1, [Rule([MappingRuleSnapshot(
+        "neg", 0, TagsFilter({"__name__": "m", "dc": "!east"}),
+        0, _POL[0])])])
+    active = rs.active_set()
+    mids = [_encode({b"__name__": b"m"}),
+            _encode({b"__name__": b"m", b"dc": b"east"}),
+            _encode({b"__name__": b"m", b"dc": b"west"})]
+    got = match_batch(CompiledRuleSet(active, T0), mids, T0)
+    ref = [active.forward_match(m, T0, T0 + 1) for m in mids]
+    assert got == ref
+    assert got[0].for_existing_id[0].metadata.pipelines  # absent: matches
+    assert not got[1].for_existing_id[0].metadata.pipelines
+    assert got[2].for_existing_id[0].metadata.pipelines
+
+
+def _matcher_env(seed=0):
+    rng = random.Random(seed)
+    store = RuleSetStore(cluster_kv.MemStore())
+    store.publish(_rand_ruleset(rng, version=1))
+    now = {"t": T0}
+    m = Matcher(store, b"default", clock=lambda: now["t"])
+    return rng, store, now, m
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_matcher_match_batch_equals_match(seed):
+    rng, _store, _now, m = _matcher_env(seed)
+    batch = [_encode(t) for t in _rand_batch(rng, 200)]
+    got = m.match_batch(batch)
+    # fresh per-metric matcher over the same store state as the oracle
+    _rng2, _s2, _n2, ref_m = _matcher_env(seed)
+    ref = [ref_m.match(mid) for mid in batch]
+    assert got == ref
+
+
+def test_match_batch_warm_pass_is_all_hits():
+    rng, _store, _now, m = _matcher_env(3)
+    batch = [_encode(t) for t in _rand_batch(rng, 200)]
+    m.match_batch(batch)
+    h0, m0 = m.hits, m.misses
+    again = m.match_batch(batch)
+    assert m.hits == h0 + len(batch) and m.misses == m0  # 100% warm hits
+    assert again == m.match_batch(batch)
+
+
+def test_version_churn_mid_stream_invalidates_memo():
+    rng, store, _now, m = _matcher_env(7)
+    batch = [_encode(t) for t in _rand_batch(rng, 150)]
+    first = m.match_batch(batch)
+    assert all(r.version == 1 for r in first)
+    # KV rule update mid-stream: different rules, bumped version
+    rs2 = _rand_ruleset(random.Random(99), version=2)
+    store.publish(rs2)
+    second = m.match_batch(batch)
+    active2 = rs2.active_set()
+    assert second == [active2.forward_match(mid, T0, T0 + 1)
+                      for mid in batch]
+    assert all(r.version == 2 for r in second)
+    # memoized (generation, id) entries from the dead generation are
+    # unreachable: a fresh warm pass hits only generation-2 entries
+    h0 = m.hits
+    assert m.match_batch(batch) == second
+    assert m.hits == h0 + len(batch)
+
+
+def _downsampler_pair(seed):
+    rng = random.Random(seed)
+    store = RuleSetStore(cluster_kv.MemStore())
+    store.publish(_rand_ruleset(rng, version=1, first_op_rollups_only=True))
+    now = {"t": T0}
+    clock = lambda: now["t"]  # noqa: E731
+    sinks = ([], [])
+    got = Downsampler(Matcher(store, b"default", clock=clock),
+                      lambda *a: sinks[0].append(a), clock=clock)
+    ref = Downsampler(Matcher(store, b"default", clock=clock),
+                      lambda *a: sinks[1].append(a), clock=clock)
+    return rng, store, now, got, ref, sinks
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_downsampler_batch_equals_ref(seed):
+    rng, _store, now, got, ref, sinks = _downsampler_pair(seed)
+    types = [MetricType.GAUGE, MetricType.COUNTER, MetricType.TIMER]
+    batch = [(tags, T0, float(i % 13) + 0.25, types[i % 3])
+             for i, tags in enumerate(_rand_batch(rng, 250))]
+    got.write_batch(batch)
+    for tags, t, v, mt in batch:
+        ref.write_ref(tags, t, v, mt)
+    assert (got.samples_matched, got.samples_dropped) == \
+        (ref.samples_matched, ref.samples_dropped)
+    now["t"] = T0 + 120 * S
+    got.flush()
+    ref.flush()
+    assert sorted(sinks[0]) == sorted(sinks[1])
+    assert sinks[0]  # corpus produced aggregated output
+
+
+def test_downsampler_batch_drop_must():
+    store = RuleSetStore(cluster_kv.MemStore())
+    store.publish(RuleSet(b"default", 1, [
+        Rule([MappingRuleSnapshot(
+            "keep", 0, TagsFilter({"__name__": "keep_*"}), 0, _POL[0])]),
+        Rule([MappingRuleSnapshot(
+            "drop", 0, TagsFilter({"__name__": "drop_*"}), 0, _POL[0],
+            DropPolicy.DROP_MUST)]),
+    ]))
+    now = {"t": T0}
+    sink = []
+    ds = Downsampler(Matcher(store, b"default", clock=lambda: now["t"]),
+                     lambda *a: sink.append(a), clock=lambda: now["t"])
+    batch = [({b"__name__": b"keep_a"}, T0, 1.0, MetricType.GAUGE),
+             ({b"__name__": b"drop_a"}, T0, 2.0, MetricType.GAUGE),
+             ({b"__name__": b"drop_b"}, T0, 3.0, MetricType.GAUGE)]
+    matched, dropped = ds.write_batch(batch)
+    assert (matched, dropped) == (1, 2)
+    now["t"] = T0 + 60 * S
+    ds.flush()
+    assert sink and all(b"keep_a" in row[0] for row in sink)

@@ -1886,6 +1886,58 @@ class TestFlushCallbackLoop:
         assert lint(src, FlushCallbackLoopRule(),
                     "m3_tpu/aggregator/list.py") == []
 
+    # The coordinator seeded true positive: the EXACT pre-change
+    # Downsampler.write rollup loop — one add_untimed per rollup id per
+    # ingested sample (metrics_appender.go SamplesAppender shape).
+    PRE_CHANGE_DOWNSAMPLER_WRITE = """
+        class Downsampler:
+            def write(self, tags, t_nanos, value, metric_type):
+                mid = _encode_tags(tags)
+                result = self._matcher.match(mid)
+                if result is None:
+                    return False
+                wrote = False
+                for idm in result.for_new_rollup_ids:
+                    mu = _to_union(metric_type, idm.id, value)
+                    wrote = self._agg.add_untimed(mu, idm.metadatas) or wrote
+                return wrote
+    """
+
+    def test_flags_the_pre_change_downsampler_write_loop(self):
+        found = lint(self.PRE_CHANGE_DOWNSAMPLER_WRITE,
+                     FlushCallbackLoopRule(),
+                     "m3_tpu/coordinator/downsample.py")
+        assert rule_ids(found) == ["per-datapoint-callback-in-flush"]
+        assert "add_untimed" in found[0].message
+
+    def test_downsampler_write_ref_oracle_exempt(self):
+        src = """
+            class Downsampler:
+                def write_ref(self, tags, t_nanos, value, metric_type):
+                    result = self._matcher.match(_encode_tags(tags))
+                    for idm in result.for_new_rollup_ids:
+                        self._agg.add_untimed(
+                            _to_union(metric_type, idm.id, value),
+                            idm.metadatas)
+        """
+        assert lint(src, FlushCallbackLoopRule(),
+                    "m3_tpu/coordinator/downsample.py") == []
+
+    def test_batched_downsampler_write_passes(self):
+        # The post-change shape: grouped columnar adds — one
+        # add_untimed_batch per (pipeline, policy) class, not one
+        # add_untimed per datapoint. `add_untimed_batch` must NOT match
+        # the exact-name `add_untimed` callback detector.
+        src = """
+            class Downsampler:
+                def write_batch(self, samples):
+                    groups = self._group(samples)
+                    for _key, (metadatas, mus) in groups.items():
+                        self._agg.add_untimed_batch(mus, metadatas)
+        """
+        assert lint(src, FlushCallbackLoopRule(),
+                    "m3_tpu/coordinator/downsample.py") == []
+
 
 class TestPerSeriesResultDict:
     """per-series-result-dict: per-row dict materialization inside
